@@ -1,0 +1,26 @@
+"""akka_game_of_life_tpu — a TPU-native distributed cellular-automaton framework.
+
+A ground-up re-architecture of the capabilities of the reference
+``almendar/akka-game-of-life`` (a distributed Conway's-Game-of-Life simulator
+on Akka Cluster, see ``/root/reference``):
+
+- the reference's one-actor-per-cell compute layer (``CellActor.scala`` +
+  ``NextStateCellGathererActor.scala``) collapses into jitted dense stencil
+  kernels over HBM-resident grid arrays (:mod:`akka_game_of_life_tpu.ops`);
+- its Akka-remoting neighbor messages become ``lax.ppermute`` halo exchanges
+  over a 2-D ``jax.sharding.Mesh`` (:mod:`akka_game_of_life_tpu.parallel`);
+- its distributed-systems capabilities — cluster roles, membership, tick-driven
+  epochs, fault injection, crash recovery with replay, node-loss redeployment,
+  epoch-synchronized rendering (``BoardCreator.scala``, ``Run.scala``,
+  ``LoggerActor.scala``) — are rebuilt as a thin host-side control plane with
+  real checkpoint/resume (:mod:`akka_game_of_life_tpu.runtime`).
+
+The per-cell ``Tick``/``CellState`` message protocol of the reference survives
+as the plugin boundary between the CPU per-cell backend and the TPU stencil
+backend (:mod:`akka_game_of_life_tpu.runtime.protocol`).
+"""
+
+__version__ = "0.1.0"
+
+from akka_game_of_life_tpu.ops.rules import Rule, parse_rule  # noqa: F401
+from akka_game_of_life_tpu.models.registry import get_model, list_models  # noqa: F401

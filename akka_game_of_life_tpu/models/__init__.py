@@ -1,0 +1,5 @@
+from akka_game_of_life_tpu.models.registry import (  # noqa: F401
+    CAModel,
+    get_model,
+    list_models,
+)

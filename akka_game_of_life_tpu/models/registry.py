@@ -1,0 +1,69 @@
+"""Model registry: the CA families the framework ships.
+
+The reference supports exactly one hard-coded (and buggy) rule
+(``NextStateCellGathererActor.scala:44``).  Here each "model" is a rule plus
+its execution profile; all BASELINE.json benchmark configs are registered:
+
+- ``conway``           — Conway B3/S23 (configs 1, 2, 5)
+- ``highlife``         — HighLife B36/S23 (config 3)
+- ``day-and-night``    — Day & Night B3678/S34678 (config 3)
+- ``brians-brain``     — Brian's Brain /2/3, int8 Generations state (config 4)
+- plus seeds, life-without-death, star-wars, and any rulestring on demand.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from akka_game_of_life_tpu.ops import stencil
+from akka_game_of_life_tpu.ops.rules import NAMED_RULES, Rule, resolve_rule
+from akka_game_of_life_tpu.utils.patterns import random_grid
+
+
+@dataclasses.dataclass(frozen=True)
+class CAModel:
+    """A cellular-automaton model: rule + init + step.
+
+    ``step``/``run`` are jitted closures over the rule (compiled once per rule
+    and step count); ``init`` produces a host-side numpy board so placement and
+    pattern stamping stay off the device path.
+    """
+
+    rule: Rule
+
+    @property
+    def name(self) -> str:
+        return str(self.rule)
+
+    @property
+    def dtype(self):
+        return stencil.STATE_DTYPE
+
+    def init(
+        self,
+        shape: Tuple[int, int],
+        *,
+        density: float = 0.5,
+        seed: int = 0,
+    ) -> np.ndarray:
+        return random_grid(shape, density=density, seed=seed, states=self.rule.states)
+
+    @property
+    def step(self) -> Callable[[jax.Array], jax.Array]:
+        return stencil.step_fn(self.rule)
+
+    def run(self, n_steps: int) -> Callable[[jax.Array], jax.Array]:
+        return stencil.multi_step_fn(self.rule, n_steps)
+
+
+def get_model(spec) -> CAModel:
+    """Build a model from a Rule, a registered name, or any rulestring."""
+    return CAModel(rule=resolve_rule(spec))
+
+
+def list_models() -> Tuple[str, ...]:
+    return tuple(sorted(NAMED_RULES))

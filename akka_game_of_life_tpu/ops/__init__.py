@@ -1,0 +1,7 @@
+from akka_game_of_life_tpu.ops.rules import Rule, parse_rule  # noqa: F401
+from akka_game_of_life_tpu.ops.stencil import (  # noqa: F401
+    neighbor_counts,
+    step,
+    step_fn,
+    multi_step,
+)

@@ -1,0 +1,153 @@
+"""Cellular-automaton rules as *data*.
+
+The reference hard-codes its (buggy) transition rule in actor code
+(``NextStateCellGathererActor.scala:44`` — a live cell dies iff it has exactly
+3 live neighbors, nothing is ever born).  Here the rule is a value: a pair of
+neighbor-count bitmasks (birth / survive) plus a state count, which covers
+
+- Conway B3/S23 and every outer-totalistic "life-like" rule on the Moore
+  neighborhood (HighLife B36/S23, Day & Night B3678/S34678, Seeds B2/S, ...);
+- multi-state *Generations* CA (Brian's Brain ``/2/3``, Star Wars ``345/2/4``)
+  where dead-ing cells decay through refractory states.
+
+Keeping the rule as two small integers lets every kernel (dense roll-based,
+halo-sharded, bit-packed Pallas) close over it as a compile-time constant so
+XLA folds the thresholding into the stencil fusion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import FrozenSet, Optional
+
+_MAX_NEIGHBORS = 8  # Moore neighborhood
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """An outer-totalistic CA rule on the Moore-8 neighborhood.
+
+    ``birth``/``survive`` are the neighbor counts (0..8) at which a dead cell
+    becomes alive / a live cell stays alive.  ``states`` is the total number of
+    cell states: 2 for plain life-like rules; >2 for Generations rules, where a
+    live cell that fails to survive enters state 2 and decays 2 → 3 → ... →
+    states-1 → 0 (dead), and decaying cells count as *not alive* for neighbor
+    totals but occupy the cell (no birth there).
+    """
+
+    birth: FrozenSet[int]
+    survive: FrozenSet[int]
+    states: int = 2
+    # Cosmetic only: excluded from __eq__/__hash__ so semantically identical
+    # rules share one jit-compilation cache entry in step_fn/multi_step_fn.
+    name: Optional[str] = dataclasses.field(default=None, compare=False)
+
+    def __post_init__(self) -> None:
+        if not (2 <= self.states <= 255):
+            # State arrays are uint8 (ops.stencil.STATE_DTYPE).
+            raise ValueError(f"states must be in 2..255, got {self.states}")
+        for s in self.birth | self.survive:
+            if not (0 <= s <= _MAX_NEIGHBORS):
+                raise ValueError(f"neighbor count out of range 0..8: {s}")
+
+    @property
+    def birth_mask(self) -> int:
+        """Bit i set iff a dead cell with i live neighbors is born."""
+        m = 0
+        for b in self.birth:
+            m |= 1 << b
+        return m
+
+    @property
+    def survive_mask(self) -> int:
+        """Bit i set iff a live cell with i live neighbors survives."""
+        m = 0
+        for s in self.survive:
+            m |= 1 << s
+        return m
+
+    @property
+    def is_binary(self) -> bool:
+        return self.states == 2
+
+    def rulestring(self) -> str:
+        b = "".join(str(i) for i in sorted(self.birth))
+        s = "".join(str(i) for i in sorted(self.survive))
+        if self.is_binary:
+            return f"B{b}/S{s}"
+        return f"{s}/{b}/{self.states}"
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return self.name or self.rulestring()
+
+
+_BS_RE = re.compile(r"^B(?P<b>\d*)/S(?P<s>\d*)$", re.IGNORECASE)
+_SB_RE = re.compile(r"^(?P<s>\d*)/(?P<b>\d*)$")
+_GEN_RE = re.compile(r"^(?P<s>\d*)/(?P<b>\d*)/(?P<c>\d+)$")
+_BSG_RE = re.compile(r"^B(?P<b>\d*)/S(?P<s>\d*)/(?:C|G)?(?P<c>\d+)$", re.IGNORECASE)
+
+
+def _digits(ds: str) -> FrozenSet[int]:
+    return frozenset(int(ch) for ch in ds)
+
+
+def parse_rule(rulestring: str, name: Optional[str] = None) -> Rule:
+    """Parse a rulestring into a :class:`Rule`.
+
+    Accepted formats (all standard in the CA literature):
+
+    - ``"B3/S23"``        — birth/survival (Golly canonical)
+    - ``"23/3"``          — survival/birth (older S/B convention)
+    - ``"345/2/4"``       — Generations: survival/birth/states
+    - ``"B2/S/3"``, ``"B2/S/C3"`` — Generations, B/S-first variant
+    """
+    s = rulestring.strip().replace(" ", "")
+    for rx, has_states in ((_BSG_RE, True), (_GEN_RE, True), (_BS_RE, False), (_SB_RE, False)):
+        m = rx.match(s)
+        if m:
+            states = int(m.group("c")) if has_states else 2
+            return Rule(
+                birth=_digits(m.group("b")),
+                survive=_digits(m.group("s")),
+                states=states,
+                name=name,
+            )
+    raise ValueError(f"unrecognized rulestring: {rulestring!r}")
+
+
+# Named rules covering the BASELINE.json benchmark configs.
+CONWAY = Rule(frozenset({3}), frozenset({2, 3}), name="conway")
+HIGHLIFE = Rule(frozenset({3, 6}), frozenset({2, 3}), name="highlife")
+DAY_AND_NIGHT = Rule(
+    frozenset({3, 6, 7, 8}), frozenset({3, 4, 6, 7, 8}), name="day-and-night"
+)
+SEEDS = Rule(frozenset({2}), frozenset(), name="seeds")
+LIFE_WITHOUT_DEATH = Rule(frozenset({3}), frozenset(range(9)), name="life-without-death")
+BRIANS_BRAIN = Rule(frozenset({2}), frozenset(), states=3, name="brians-brain")
+STAR_WARS = Rule(frozenset({2}), frozenset({3, 4, 5}), states=4, name="star-wars")
+
+NAMED_RULES = {
+    r.name: r
+    for r in (
+        CONWAY,
+        HIGHLIFE,
+        DAY_AND_NIGHT,
+        SEEDS,
+        LIFE_WITHOUT_DEATH,
+        BRIANS_BRAIN,
+        STAR_WARS,
+    )
+}
+
+
+def resolve_rule(spec) -> Rule:
+    """Resolve a Rule from a Rule, a known name, or a rulestring."""
+    if isinstance(spec, Rule):
+        return spec
+    if isinstance(spec, str):
+        key = spec.strip().lower()
+        if key in NAMED_RULES:
+            return NAMED_RULES[key]
+        return parse_rule(spec)
+    raise TypeError(f"cannot resolve rule from {spec!r}")

@@ -1,0 +1,6 @@
+from akka_game_of_life_tpu.utils.patterns import (  # noqa: F401
+    decode_rle,
+    get_pattern,
+    place,
+    random_grid,
+)

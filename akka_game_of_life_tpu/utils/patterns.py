@@ -1,0 +1,121 @@
+"""Pattern library: RLE decoding, canonical Life patterns, random boards.
+
+The reference has no pattern machinery at all — its only initial condition is
+a Bernoulli(1/2) random board (``BoardCreator.scala:23,47-53``).  Patterns are
+needed here because the framework's correctness north star (BASELINE.json) is
+*pattern-level*: blinker period 2, glider translation, Gosper glider-gun
+period 30 preserved across backend kill/restart.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+import numpy as np
+
+# Run-length-encoded patterns in the standard Golly/LifeWiki RLE format.
+# `b` = dead, `o` = alive, `$` = end of row, `!` = end of pattern.
+RLE_PATTERNS: Dict[str, str] = {
+    "blinker": "3o!",
+    "block": "2o$2o!",
+    "beehive": "b2o$o2bo$b2o!",
+    "toad": "b3o$3o!",
+    "beacon": "2o$2o$2b2o$2b2o!",
+    "glider": "bob$2bo$3o!",
+    "lwss": "b4o$o3bo$4bo$o2bo!",
+    "pulsar": (
+        "2b3o3b3o2b$13b$o4bobo4bo$o4bobo4bo$o4bobo4bo$2b3o3b3o2b$13b"
+        "$2b3o3b3o2b$o4bobo4bo$o4bobo4bo$o4bobo4bo$13b$2b3o3b3o2b!"
+    ),
+    "r-pentomino": "b2o$2o$bo!",
+    "gosper-glider-gun": (
+        "24bo$22bobo$12b2o6b2o12b2o$11bo3bo4b2o12b2o$2o8bo5bo3b2o$2o8bo3bob2o4b"
+        "obo$10bo5bo7bo$11bo3bo$12b2o!"
+    ),
+    # HighLife replicator (B36/S23).
+    "replicator": "2b3o$bo2bo$o3bo$o2bob$3o!",
+}
+
+_RLE_TOKEN = re.compile(r"(\d*)([bo$!])")
+
+
+def decode_rle(rle: str) -> np.ndarray:
+    """Decode an RLE body string into a (H, W) uint8 0/1 array."""
+    rows = []
+    row = []
+    for count_s, tag in _RLE_TOKEN.findall(rle.replace("\n", "").replace(" ", "")):
+        count = int(count_s) if count_s else 1
+        if tag == "b":
+            row.extend([0] * count)
+        elif tag == "o":
+            row.extend([1] * count)
+        elif tag == "$":
+            rows.append(row)
+            # A multi-count `$` encodes blank rows.
+            rows.extend([[]] * (count - 1))
+            row = []
+        elif tag == "!":
+            rows.append(row)
+            row = []
+            break
+    if row:
+        # Tolerate a missing '!' terminator (truncated paste) rather than
+        # silently dropping the final row.
+        rows.append(row)
+    width = max((len(r) for r in rows), default=0)
+    grid = np.zeros((len(rows), width), dtype=np.uint8)
+    for y, r in enumerate(rows):
+        grid[y, : len(r)] = r
+    return grid
+
+
+def get_pattern(name: str) -> np.ndarray:
+    """Look up a canonical pattern by name as a (H, W) uint8 array."""
+    key = name.strip().lower()
+    if key not in RLE_PATTERNS:
+        raise KeyError(f"unknown pattern {name!r}; have {sorted(RLE_PATTERNS)}")
+    return decode_rle(RLE_PATTERNS[key])
+
+
+def place(
+    board: np.ndarray, pattern: np.ndarray, top_left: Tuple[int, int] = (0, 0)
+) -> np.ndarray:
+    """Return a copy of ``board`` with ``pattern`` stamped at ``top_left``
+    (toroidal wrap if the pattern crosses the board edge)."""
+    out = np.array(board, copy=True)
+    h, w = out.shape
+    py, px = pattern.shape
+    if py > h or px > w:
+        raise ValueError(
+            f"pattern {pattern.shape} does not fit board {board.shape}"
+        )
+    y0, x0 = top_left
+    ys = (np.arange(py) + y0) % h
+    xs = (np.arange(px) + x0) % w
+    out[np.ix_(ys, xs)] = pattern
+    return out
+
+
+def pattern_board(
+    name: str, board_shape: Tuple[int, int], top_left: Tuple[int, int] = (0, 0)
+) -> np.ndarray:
+    """An empty (H, W) uint8 torus with a named pattern stamped on it."""
+    board = np.zeros(board_shape, dtype=np.uint8)
+    return place(board, get_pattern(name), top_left)
+
+
+def random_grid(
+    shape: Tuple[int, int],
+    *,
+    density: float = 0.5,
+    seed: int = 0,
+    states: int = 2,
+) -> np.ndarray:
+    """Random initial board — the reference's Bernoulli(1/2) initial state
+    (``BoardCreator.scala:23``), generalized to a density knob and, for
+    Generations rules, to live-state-only randomness (refractory states are
+    never part of a fresh board)."""
+    del states  # live/dead only; refractory states arise from dynamics
+    rng = np.random.default_rng(seed)
+    return (rng.random(shape) < density).astype(np.uint8)

@@ -1,0 +1,75 @@
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.utils.patterns import (
+    decode_rle,
+    get_pattern,
+    pattern_board,
+    place,
+    random_grid,
+)
+
+
+def test_decode_blinker():
+    assert np.array_equal(decode_rle("3o!"), np.array([[1, 1, 1]], dtype=np.uint8))
+
+
+def test_decode_glider():
+    want = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], dtype=np.uint8)
+    assert np.array_equal(get_pattern("glider"), want)
+
+
+def test_decode_multirow_counts():
+    # `2$` encodes a blank row between rows.
+    got = decode_rle("o2$o!")
+    want = np.array([[1], [0], [1]], dtype=np.uint8)
+    assert np.array_equal(got, want)
+
+
+def test_gosper_gun_shape_and_population():
+    gun = get_pattern("gosper-glider-gun")
+    assert gun.shape == (9, 36)
+    assert gun.sum() == 36  # canonical gun has 36 live cells
+
+
+def test_place_wraps_toroidally():
+    board = np.zeros((8, 8), dtype=np.uint8)
+    out = place(board, get_pattern("block"), (7, 7))
+    assert out.sum() == 4
+    assert out[7, 7] == out[7, 0] == out[0, 7] == out[0, 0] == 1
+
+
+def test_pattern_board():
+    b = pattern_board("blinker", (5, 5), (2, 1))
+    assert b.sum() == 3
+    assert all(b[2, x] == 1 for x in (1, 2, 3))
+
+
+def test_unknown_pattern():
+    with pytest.raises(KeyError):
+        get_pattern("nope")
+
+
+def test_random_grid_determinism_and_density():
+    a = random_grid((64, 64), density=0.3, seed=1)
+    b = random_grid((64, 64), density=0.3, seed=1)
+    c = random_grid((64, 64), density=0.3, seed=2)
+    assert np.array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert 0.2 < a.mean() < 0.4
+
+
+def test_decode_tolerates_missing_terminator():
+    import numpy as np
+    from akka_game_of_life_tpu.utils.patterns import decode_rle, get_pattern
+
+    assert np.array_equal(decode_rle("bob$2bo$3o"), get_pattern("glider"))
+
+
+def test_place_rejects_oversized_pattern():
+    import numpy as np
+    import pytest
+    from akka_game_of_life_tpu.utils.patterns import get_pattern, place
+
+    with pytest.raises(ValueError):
+        place(np.zeros((3, 3), dtype=np.uint8), get_pattern("gosper-glider-gun"))

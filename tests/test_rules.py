@@ -1,0 +1,83 @@
+import pytest
+
+from akka_game_of_life_tpu.ops.rules import (
+    BRIANS_BRAIN,
+    CONWAY,
+    DAY_AND_NIGHT,
+    HIGHLIFE,
+    Rule,
+    parse_rule,
+    resolve_rule,
+)
+
+
+def test_parse_bs():
+    r = parse_rule("B3/S23")
+    assert r.birth == frozenset({3})
+    assert r.survive == frozenset({2, 3})
+    assert r.states == 2
+
+
+def test_parse_bs_case_insensitive():
+    assert parse_rule("b36/s23") == parse_rule("B36/S23")
+
+
+def test_parse_sb_convention():
+    r = parse_rule("23/3")
+    assert r.birth == frozenset({3})
+    assert r.survive == frozenset({2, 3})
+
+
+def test_parse_generations():
+    r = parse_rule("/2/3")  # Brian's Brain
+    assert r.birth == frozenset({2})
+    assert r.survive == frozenset()
+    assert r.states == 3
+
+    r2 = parse_rule("345/2/4")  # Star Wars
+    assert r2.survive == frozenset({3, 4, 5})
+    assert r2.birth == frozenset({2})
+    assert r2.states == 4
+
+
+def test_parse_generations_bs_variant():
+    assert parse_rule("B2/S/3") == Rule(frozenset({2}), frozenset(), states=3)
+    assert parse_rule("B2/S/C3").states == 3
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_rule("hello")
+    with pytest.raises(ValueError):
+        Rule(frozenset({9}), frozenset())
+    with pytest.raises(ValueError):
+        Rule(frozenset(), frozenset(), states=1)
+
+
+def test_masks():
+    assert CONWAY.birth_mask == 0b1000
+    assert CONWAY.survive_mask == 0b1100
+    assert HIGHLIFE.birth_mask == (1 << 3) | (1 << 6)
+    assert DAY_AND_NIGHT.survive_mask == sum(1 << i for i in (3, 4, 6, 7, 8))
+
+
+def test_rulestring_roundtrip():
+    for r in (CONWAY, HIGHLIFE, DAY_AND_NIGHT, BRIANS_BRAIN):
+        assert parse_rule(r.rulestring()) == Rule(r.birth, r.survive, r.states)
+
+
+def test_resolve_by_name_and_string():
+    assert resolve_rule("conway") == CONWAY
+    assert resolve_rule("B3/S23").birth == CONWAY.birth
+    assert resolve_rule(CONWAY) is CONWAY
+    with pytest.raises(TypeError):
+        resolve_rule(42)
+
+
+def test_name_excluded_from_equality():
+    assert parse_rule("B3/S23") == CONWAY
+
+
+def test_states_bounded_by_uint8():
+    with pytest.raises(ValueError):
+        Rule(frozenset({2}), frozenset(), states=300)
