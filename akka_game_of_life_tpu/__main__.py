@@ -1,0 +1,4 @@
+from akka_game_of_life_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,144 @@
+"""Command-line entry points — the ``Run.scala`` capability layer.
+
+The reference ships two mains: ``RunFrontend [port]`` and ``RunBackend
+[port]`` (``Run.scala:15-54,56-65``), with every other knob in
+``application.conf``.  Here one CLI exposes the same layered precedence
+(defaults < config file < flags) plus a standalone mode the reference lacks:
+
+    python -m akka_game_of_life_tpu run --rule conway --height 256 --width 256
+    python -m akka_game_of_life_tpu frontend --port 2551 ...
+    python -m akka_game_of_life_tpu backend --port 0 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from akka_game_of_life_tpu.runtime.config import load_config, parse_duration
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", help="TOML or JSON config file")
+    p.add_argument("--rule", help="rule name or rulestring (B3/S23, /2/3, ...)")
+    p.add_argument("--height", type=int)
+    p.add_argument("--width", type=int)
+    p.add_argument("--density", type=float)
+    p.add_argument("--seed", type=int)
+    p.add_argument("--pattern", help="named pattern instead of random board")
+    p.add_argument("--max-epochs", type=int)
+    p.add_argument("--tick", help="wall-clock pacing per epoch (e.g. 3000ms); 0 = free-run")
+    p.add_argument("--steps-per-call", type=int)
+    p.add_argument("--halo-width", type=int)
+    p.add_argument("--mesh", help="ROWSxCOLS device mesh, e.g. 4x2")
+    p.add_argument("--backend", choices=["tpu", "actor"])
+    p.add_argument("--checkpoint-dir")
+    p.add_argument("--checkpoint-every", type=int)
+    p.add_argument("--render-every", type=int)
+    p.add_argument("--render-max-cells", type=int)
+    p.add_argument("--metrics-every", type=int)
+    p.add_argument("--log-file")
+    p.add_argument("--inject-faults", action="store_true", default=None)
+
+
+def _overrides(args: argparse.Namespace) -> dict:
+    mesh = None
+    if args.mesh:
+        rows, cols = args.mesh.lower().split("x")
+        mesh = (int(rows), int(cols))
+    out = {
+        "rule": args.rule,
+        "height": args.height,
+        "width": args.width,
+        "density": args.density,
+        "seed": args.seed,
+        "pattern": args.pattern,
+        "max_epochs": args.max_epochs,
+        "tick_s": parse_duration(args.tick) if args.tick is not None else None,
+        "steps_per_call": args.steps_per_call,
+        "halo_width": args.halo_width,
+        "mesh_shape": mesh,
+        "backend": args.backend,
+        "checkpoint_dir": args.checkpoint_dir,
+        "checkpoint_every": args.checkpoint_every,
+        "render_every": args.render_every,
+        "render_max_cells": args.render_max_cells,
+        "metrics_every": args.metrics_every,
+        "log_file": args.log_file,
+    }
+    if args.inject_faults:
+        out["fault_injection"] = {"enabled": True}
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="akka_game_of_life_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    run_p = sub.add_parser("run", help="standalone simulation on local devices")
+    _add_common(run_p)
+
+    fe_p = sub.add_parser("frontend", help="control-plane coordinator (RunFrontend)")
+    _add_common(fe_p)
+    fe_p.add_argument("--port", type=int, default=2551)
+    fe_p.add_argument("--host", default="127.0.0.1")
+    fe_p.add_argument("--wait-for-backends", default=None, help="e.g. 5s")
+    fe_p.add_argument("--min-backends", type=int, default=1)
+
+    be_p = sub.add_parser("backend", help="control-plane worker (RunBackend)")
+    be_p.add_argument("--port", type=int, default=2551, help="frontend port to join")
+    be_p.add_argument("--host", default="127.0.0.1")
+    be_p.add_argument("--name", default=None)
+
+    args = parser.parse_args(argv)
+
+    if args.command == "run":
+        cfg = load_config(args.config, _overrides(args))
+        from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+        if cfg.max_epochs is None:
+            cfg.max_epochs = 100
+        sim = Simulation(cfg)
+        sim.advance()
+        if cfg.render_every == 0 and cfg.metrics_every == 0:
+            # Always show something at the end, like the reference's info.log.
+            from akka_game_of_life_tpu.runtime.render import render_ascii
+
+            print(f"epoch {sim.epoch}:")
+            print(render_ascii(sim.board_host(), cfg.render_max_cells))
+        return 0
+
+    if args.command == "frontend":
+        overrides = _overrides(args)
+        overrides.update(
+            role="frontend",
+            host=args.host,
+            port=args.port,
+            wait_for_backends_s=(
+                parse_duration(args.wait_for_backends)
+                if args.wait_for_backends is not None
+                else None
+            ),
+        )
+        cfg = load_config(args.config, overrides)
+        try:
+            from akka_game_of_life_tpu.runtime.frontend import run_frontend
+        except ImportError as e:  # pragma: no cover
+            raise SystemExit(f"frontend role unavailable: {e}")
+
+        return run_frontend(cfg, min_backends=args.min_backends)
+
+    if args.command == "backend":
+        try:
+            from akka_game_of_life_tpu.runtime.backend import run_backend
+        except ImportError as e:  # pragma: no cover
+            raise SystemExit(f"backend role unavailable: {e}")
+
+        return run_backend(host=args.host, port=args.port, name=args.name)
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+from akka_game_of_life_tpu.parallel.mesh import (  # noqa: F401
+    COL_AXIS,
+    GRID_SPEC,
+    ROW_AXIS,
+    factor_2d,
+    grid_sharding,
+    make_grid_mesh,
+    shard_board,
+)
+from akka_game_of_life_tpu.parallel.halo import (  # noqa: F401
+    exchange_halo,
+    sharded_step_fn,
+    validate_tile_shape,
+)

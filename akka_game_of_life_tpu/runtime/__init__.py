@@ -1,0 +1,8 @@
+from akka_game_of_life_tpu.runtime.config import (  # noqa: F401
+    FaultInjectionConfig,
+    SimulationConfig,
+    load_config,
+    parse_duration,
+)
+from akka_game_of_life_tpu.runtime.render import BoardObserver, render_ascii  # noqa: F401
+from akka_game_of_life_tpu.runtime.checkpoint import Checkpoint, CheckpointStore  # noqa: F401

@@ -1,0 +1,57 @@
+"""Scheduled fault injection — the reference's in-app chaos harness.
+
+The reference ships its chaos testing *inside* the app: a scheduled killer
+picks a random cell and crashes it — first after ``error.delay``, then every
+``error.every``, bounded by ``max-crashes`` (``BoardCreator.scala:97-102,108``,
+``application.conf:41,44-47``).  :class:`CrashInjector` reproduces exactly
+that schedule/budget contract.
+
+What a "crash" means is the consumer's choice (the seam between standalone
+and cluster modes): the standalone simulation loses its in-memory board and
+must restore from checkpoint + deterministic replay; the control-plane
+frontend kills a live backend worker process.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
+
+
+class CrashInjector:
+    """Wall-clock crash scheduler with a budget.
+
+    ``should_crash(now)`` is True when a scheduled crash is due: the first
+    ``first_after_s`` after start, then every ``every_s``, at most
+    ``max_crashes`` times.  Deterministic given the clock readings; the
+    ``rng`` is exposed for consumers that need to pick a random victim (the
+    reference picks a random child cell — ``BoardCreator.scala:99``).
+    """
+
+    def __init__(
+        self, config: FaultInjectionConfig, *, start_time: Optional[float] = None
+    ) -> None:
+        self.config = config
+        self.crashes = 0
+        self.rng = random.Random(config.seed)
+        self._start = start_time if start_time is not None else time.monotonic()
+        self._next_due: Optional[float] = (
+            self._start + config.first_after_s if config.enabled else None
+        )
+
+    @property
+    def exhausted(self) -> bool:
+        return self.crashes >= self.config.max_crashes
+
+    def should_crash(self, now: Optional[float] = None) -> bool:
+        if self._next_due is None or self.exhausted:
+            return False
+        now = now if now is not None else time.monotonic()
+        if now < self._next_due:
+            return False
+        self.crashes += 1
+        self._next_due = now + self.config.every_s
+        return True

@@ -1,0 +1,114 @@
+"""Durable checkpoint/resume — the capability the reference only fakes.
+
+The reference has *no* durable checkpointing: recovery replays from epoch 0
+out of neighbors' unbounded in-memory histories (``CellActor.scala:34,71-74``)
+and the frontend is an unrecoverable single point of failure (SURVEY.md §5).
+Here a checkpoint is the full simulation state — board, epoch, rule, board
+shape — written atomically (tmp + rename) so a kill at any instant leaves a
+loadable latest checkpoint, meeting the north-star "glider-gun period
+preserved across kill/restart" criterion.
+
+Format: numpy .npz (the grid is uint8; a 65536² board is 4 GiB raw, so
+checkpoints are np.packbits-packed for binary rules — 8 cells/byte).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import tempfile
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
+
+
+@dataclasses.dataclass
+class Checkpoint:
+    epoch: int
+    board: np.ndarray
+    rule: str
+    meta: dict
+
+
+class CheckpointStore:
+    """A directory of epoch-stamped checkpoints with atomic writes."""
+
+    def __init__(self, directory: str, keep: int = 3) -> None:
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.keep = keep
+
+    def save(
+        self, epoch: int, board: np.ndarray, rule: str, meta: Optional[dict] = None
+    ) -> Path:
+        board = np.asarray(board, dtype=np.uint8)
+        binary = bool((board <= 1).all())
+        payload = {
+            "epoch": np.int64(epoch),
+            "shape": np.asarray(board.shape, dtype=np.int64),
+            "packed": np.uint8(1 if binary else 0),
+            "board": np.packbits(board) if binary else board,
+            "meta": np.frombuffer(
+                json.dumps({"rule": rule, **(meta or {})}).encode(), dtype=np.uint8
+            ),
+        }
+        target = self.dir / f"ckpt_{epoch:012d}.npz"
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez_compressed(f, **payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, target)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        self._gc()
+        return target
+
+    def _epochs(self):
+        out = []
+        for p in self.dir.iterdir():
+            m = _CKPT_RE.match(p.name)
+            if m:
+                out.append((int(m.group(1)), p))
+        return sorted(out)
+
+    def _gc(self) -> None:
+        epochs = self._epochs()
+        for _, p in epochs[: max(0, len(epochs) - self.keep)]:
+            p.unlink(missing_ok=True)
+
+    def latest_epoch(self) -> Optional[int]:
+        epochs = self._epochs()
+        return epochs[-1][0] if epochs else None
+
+    def load(self, epoch: Optional[int] = None) -> Checkpoint:
+        epochs = self._epochs()
+        if not epochs:
+            raise FileNotFoundError(f"no checkpoints in {self.dir}")
+        if epoch is None:
+            epoch, path = epochs[-1]
+        else:
+            matches = [p for e, p in epochs if e == epoch]
+            if not matches:
+                raise FileNotFoundError(f"no checkpoint for epoch {epoch} in {self.dir}")
+            path = matches[0]
+        with np.load(path) as z:
+            shape: Tuple[int, ...] = tuple(int(v) for v in z["shape"])
+            if int(z["packed"]):
+                n = int(np.prod(shape))
+                board = np.unpackbits(z["board"], count=n).reshape(shape)
+            else:
+                board = z["board"].reshape(shape)
+            meta = json.loads(bytes(z["meta"].tobytes()).decode())
+        rule = meta.pop("rule")
+        return Checkpoint(
+            epoch=int(epoch), board=board.astype(np.uint8), rule=rule, meta=meta
+        )

@@ -1,0 +1,225 @@
+"""Layered configuration — the HOCON/Typesafe-Config capability, TPU-native.
+
+The reference layers: argv port → role string → ``application.conf`` defaults
+(``Run.scala:30-32,59-61``).  Here the same precedence is dataclass defaults →
+config file (TOML or JSON) → explicit overrides (CLI/env), with the
+reference's full knob set (``application.conf:29-48``) plus the TPU-runtime
+knobs the stencil backend needs.  Durations accept the reference's config
+style ("5s", "3000ms", "1 second") as well as bare numbers (seconds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+_DURATION_RE = re.compile(
+    r"^\s*(?P<num>\d+(?:\.\d+)?)\s*(?P<unit>ms|milliseconds?|s|seconds?|m|minutes?|h|hours?)?\s*$",
+    re.IGNORECASE,
+)
+_UNIT_SECONDS = {
+    "ms": 1e-3,
+    "millisecond": 1e-3,
+    "milliseconds": 1e-3,
+    "s": 1.0,
+    "second": 1.0,
+    "seconds": 1.0,
+    "m": 60.0,
+    "minute": 60.0,
+    "minutes": 60.0,
+    "h": 3600.0,
+    "hour": 3600.0,
+    "hours": 3600.0,
+}
+
+
+def parse_duration(value) -> float:
+    """Parse a duration into seconds: 5, 5.0, "5s", "3000ms", "1 second"."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _DURATION_RE.match(str(value))
+    if not m:
+        raise ValueError(f"unparseable duration: {value!r}")
+    unit = (m.group("unit") or "s").lower()
+    return float(m.group("num")) * _UNIT_SECONDS[unit]
+
+
+@dataclasses.dataclass
+class FaultInjectionConfig:
+    """The reference's scheduled crash injector knobs
+    (``application.conf:44-47``, ``BoardCreator.scala:97-102,108``)."""
+
+    enabled: bool = False
+    first_after_s: float = 10.0  # error.delay
+    every_s: float = 15.0  # error.every
+    max_crashes: int = 100  # game-of-life.max-crashes (application.conf:41)
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class SimulationConfig:
+    """All simulation knobs, mirroring ``application.conf``'s game-of-life
+    block and extending it with the TPU runtime's own."""
+
+    # Board (application.conf:30-35; exclusive bounds — the reference's
+    # inclusive-range off-by-one is a documented bug, SURVEY.md §2).
+    height: int = 64
+    width: int = 64
+    rule: str = "conway"
+    density: float = 0.5
+    seed: int = 0
+    pattern: Optional[str] = None  # optional named pattern instead of random
+    pattern_offset: Tuple[int, int] = (2, 2)
+
+    # Timing (application.conf:37-40). tick_s=0 means free-running: no
+    # wall-clock pacing, the TPU-native default.  The reference's fixed 3 s
+    # tick is reproducible by setting tick_s=3.
+    wait_for_backends_s: float = 5.0
+    start_delay_s: float = 1.0
+    tick_s: float = 0.0
+    max_epochs: Optional[int] = None
+
+    # TPU execution.
+    backend: str = "tpu"  # "tpu" (stencil) | "actor" (per-cell CPU parity)
+    steps_per_call: int = 1
+    halo_width: int = 1
+    mesh_shape: Optional[Tuple[int, int]] = None  # None = auto-factor devices
+
+    # Control plane.
+    role: str = "standalone"  # standalone | frontend | backend
+    host: str = "127.0.0.1"
+    port: int = 2551  # the reference's seed-node port (application.conf:20-21)
+    heartbeat_s: float = 0.5
+    # The reference evicts unreachable members after 1 s
+    # (auto-down-unreachable-after, application.conf:23).
+    failure_timeout_s: float = 1.0
+
+    # Checkpoint / resume (capability the reference lacks — SURVEY.md §5).
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0  # epochs between checkpoints; 0 = disabled
+    history_window: int = 8  # bounded per-shard boundary history (vs the
+    # reference's unbounded per-cell History maps)
+
+    # Rendering / observability (LoggerActor capability).
+    render_every: int = 0  # epochs between rendered frames; 0 = never
+    render_max_cells: int = 128  # stride-sample larger boards down to this
+    log_file: Optional[str] = None  # reference renders to info.log
+    metrics_every: int = 0
+
+    fault_injection: FaultInjectionConfig = dataclasses.field(
+        default_factory=FaultInjectionConfig
+    )
+
+    def __post_init__(self) -> None:
+        if self.height <= 0 or self.width <= 0:
+            raise ValueError(f"board must be positive, got {self.height}x{self.width}")
+        if self.backend not in ("tpu", "actor"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.role not in ("standalone", "frontend", "backend"):
+            raise ValueError(f"unknown role {self.role!r}")
+        if self.steps_per_call % self.halo_width:
+            raise ValueError("steps_per_call must be a multiple of halo_width")
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.height, self.width)
+
+
+_DURATION_FIELDS = {
+    "wait_for_backends_s",
+    "start_delay_s",
+    "tick_s",
+    "heartbeat_s",
+    "failure_timeout_s",
+    "first_after_s",
+    "every_s",
+}
+
+# Accept the reference's config spellings as aliases.
+_ALIASES = {
+    "x": "width",
+    "y": "height",
+    "wait-for-backends": "wait_for_backends_s",
+    "start-delay": "start_delay_s",
+    "tick": "tick_s",
+    "max-crashes": "max_crashes",
+    "delay": "first_after_s",
+    "every": "every_s",
+}
+
+
+def _normalize(data: Mapping[str, Any], *, nested: bool = False) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, value in data.items():
+        key = _ALIASES.get(key, key.replace("-", "_"))
+        if key == "max_crashes" and not nested:
+            # The reference keeps max-crashes at the game-of-life level
+            # (application.conf:41) but it belongs to the fault injector.
+            out.setdefault("fault_injection", {})["max_crashes"] = value
+            continue
+        if isinstance(value, Mapping) and key not in ("fault_injection",):
+            # Flatten one nesting level (e.g. the reference's board {x, y} /
+            # error {delay, every} sub-blocks).
+            if key in ("board", "game_of_life"):
+                out.update(_normalize(value))
+                continue
+            if key == "error":
+                fi = out.setdefault("fault_injection", {})
+                fi.update(_normalize(value, nested=True))
+                continue
+        if key == "fault_injection" and isinstance(value, Mapping):
+            out.setdefault("fault_injection", {}).update(_normalize(value, nested=True))
+            continue
+        if key in _DURATION_FIELDS and value is not None:
+            value = parse_duration(value)
+        out[key] = value
+    return out
+
+
+def _field_names(cls) -> set:
+    return {f.name for f in dataclasses.fields(cls)}
+
+
+def load_config(
+    path: Optional[str] = None,
+    overrides: Optional[Mapping[str, Any]] = None,
+) -> SimulationConfig:
+    """Build a config with layered precedence: defaults < file < overrides.
+
+    ``path`` may be TOML or JSON.  Unknown keys are rejected so typos fail
+    loudly instead of silently running defaults.
+    """
+    merged: Dict[str, Any] = {}
+    if path is not None:
+        p = Path(path)
+        text = p.read_text()
+        if p.suffix == ".json":
+            data = json.loads(text)
+        else:
+            import tomllib
+
+            data = tomllib.loads(text)
+        merged.update(_normalize(data))
+    if overrides:
+        deep = _normalize({k: v for k, v in overrides.items() if v is not None})
+        fi = {**merged.get("fault_injection", {}), **deep.pop("fault_injection", {})}
+        merged.update(deep)
+        if fi:
+            merged["fault_injection"] = fi
+
+    fi_kwargs = merged.pop("fault_injection", {})
+    unknown = set(merged) - _field_names(SimulationConfig)
+    unknown_fi = set(fi_kwargs) - _field_names(FaultInjectionConfig)
+    if unknown or unknown_fi:
+        raise ValueError(f"unknown config keys: {sorted(unknown | unknown_fi)}")
+
+    if "mesh_shape" in merged and merged["mesh_shape"] is not None:
+        merged["mesh_shape"] = tuple(merged["mesh_shape"])
+    if "pattern_offset" in merged:
+        merged["pattern_offset"] = tuple(merged["pattern_offset"])
+    return SimulationConfig(
+        fault_injection=FaultInjectionConfig(**fi_kwargs), **merged
+    )

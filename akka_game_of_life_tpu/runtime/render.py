@@ -1,0 +1,168 @@
+"""Rendering & observability — the ``LoggerActor`` capability, done right.
+
+The reference's logger collects per-cell messages and renders an epoch's
+board once `x*y` messages have arrived (``LoggerActor.scala:27-44``) — but
+slices them by *arrival order*, so rows come out scrambled, and its
+"complete" check fires early because of the board off-by-one (SURVEY.md §2
+bugs 2-3).  This renderer assembles frames by position, only marks an epoch
+complete when every tile has reported, and stride-samples huge boards (a
+65536² frame cannot be dumped wholesale — SURVEY.md §7 hard part e).
+
+It also carries the metrics the reference entirely lacks (SURVEY.md §5):
+cell-updates/sec, step latency, population.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+from collections import deque
+from typing import Deque, Dict, IO, Optional, Tuple
+
+import numpy as np
+
+GLYPHS = ".#ox*+=%"  # state 0..7 glyphs; >7 rendered as '?'
+
+
+def render_ascii(board: np.ndarray, max_cells: int = 128) -> str:
+    """Render a board as ASCII rows, stride-sampling to <= max_cells/side.
+
+    Sampling keeps the aspect and phase: cell (0,0) is always shown, matching
+    how a strided probe of a torus should behave.
+    """
+    h, w = board.shape
+    sy = max(1, -(-h // max_cells))
+    sx = max(1, -(-w // max_cells))
+    view = board[::sy, ::sx]
+    rows = []
+    for row in view:
+        rows.append(
+            "".join(GLYPHS[int(v)] if int(v) < len(GLYPHS) else "?" for v in row)
+        )
+    header = f"[{h}x{w}" + (f", sampled /{sy}x{sx}" if (sy, sx) != (1, 1) else "") + "]"
+    return header + "\n" + "\n".join(rows)
+
+
+@dataclasses.dataclass
+class StepMetrics:
+    epoch: int
+    seconds: float  # wall time since the previous observation
+    epochs: int  # generations covered by that interval
+    cells: int  # cell-updates in the interval (board.size * epochs)
+    population: int
+
+    @property
+    def updates_per_sec(self) -> float:
+        return self.cells / self.seconds if self.seconds > 0 else float("inf")
+
+    @property
+    def seconds_per_epoch(self) -> float:
+        return self.seconds / self.epochs if self.epochs else 0.0
+
+
+class BoardObserver:
+    """Epoch-synchronized frame sink + metrics counter.
+
+    ``observe(epoch, board)`` renders complete boards; ``observe_tile`` lets
+    the distributed control plane feed per-shard tiles and only renders once
+    all tiles for an epoch have landed — the reference's complete-epoch
+    barrier (``LoggerActor.scala:35``), with correct placement.
+    """
+
+    def __init__(
+        self,
+        *,
+        render_every: int = 0,
+        render_max_cells: int = 128,
+        metrics_every: int = 0,
+        out: Optional[IO[str]] = None,
+        log_file: Optional[str] = None,
+    ) -> None:
+        self.render_every = render_every
+        self.render_max_cells = render_max_cells
+        self.metrics_every = metrics_every
+        self._own_file = None
+        if log_file is not None:
+            self._own_file = open(log_file, "a")  # reference appends to info.log
+            self.out = self._own_file
+        else:
+            self.out = out if out is not None else sys.stdout
+        self._partial: Dict[int, Dict[Tuple[int, int], np.ndarray]] = {}
+        self._expected_tiles: Optional[int] = None
+        self._last_time: Optional[float] = None
+        self._last_epoch: Optional[int] = None
+        # Bounded, unlike the reference's forever-growing per-epoch map
+        # (LoggerActor.scala:27,34).
+        self.history: Deque[StepMetrics] = deque(maxlen=1024)
+
+    # -- complete-board path (standalone runner) -----------------------------
+
+    def observe(self, epoch: int, board: np.ndarray) -> None:
+        now = time.perf_counter()
+        if self._last_time is not None and epoch > (self._last_epoch or 0):
+            dt = now - self._last_time
+            epochs = epoch - self._last_epoch
+            m = StepMetrics(
+                epoch=epoch,
+                seconds=dt,
+                epochs=epochs,
+                cells=board.size * epochs,
+                population=int((board == 1).sum()),
+            )
+            self.history.append(m)
+            if self.metrics_every and epoch % self.metrics_every == 0:
+                print(
+                    f"epoch {epoch}: pop={m.population} "
+                    f"{m.updates_per_sec:.3e} cell-updates/s "
+                    f"({m.seconds_per_epoch * 1e3:.2f} ms/epoch)",
+                    file=self.out,
+                    flush=True,
+                )
+        self._last_time = now
+        self._last_epoch = epoch
+        if self.render_every and epoch % self.render_every == 0:
+            print(f"epoch {epoch}:", file=self.out)
+            print(render_ascii(board, self.render_max_cells), file=self.out, flush=True)
+
+    # -- tiled path (distributed control plane) ------------------------------
+
+    def expect_tiles(self, n: int) -> None:
+        self._expected_tiles = n
+
+    def observe_tile(
+        self, epoch: int, tile_origin: Tuple[int, int], tile: np.ndarray
+    ) -> Optional[np.ndarray]:
+        """Feed one shard's tile; returns the assembled board when the epoch
+        is complete, else None."""
+        if self._expected_tiles is None:
+            raise RuntimeError("call expect_tiles(n) before observe_tile")
+        tiles = self._partial.setdefault(epoch, {})
+        tiles[tile_origin] = np.asarray(tile)
+        if len(tiles) < self._expected_tiles:
+            return None
+        del self._partial[epoch]
+        board = self._assemble(tiles)
+        self.observe(epoch, board)
+        return board
+
+    @staticmethod
+    def _assemble(tiles: Dict[Tuple[int, int], np.ndarray]) -> np.ndarray:
+        ys = sorted({o[0] for o in tiles})
+        xs = sorted({o[1] for o in tiles})
+        rows = []
+        for y in ys:
+            rows.append(np.concatenate([tiles[(y, x)] for x in xs], axis=1))
+        return np.concatenate(rows, axis=0)
+
+    def close(self) -> None:
+        if self._own_file is not None:
+            self._own_file.close()
+            self._own_file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,201 @@
+"""Standalone simulation driver: config → board → stepper → observer.
+
+This is the single-process equivalent of the reference's whole cluster — the
+coordinator loop that ``BoardCreator`` implements with timers and message
+fan-out (``BoardCreator.scala:105-116``) becomes a host loop around a jitted
+(and, multi-device, sharded) step function.  Pacing is free-running by
+default; set ``tick_s`` to reproduce the reference's fixed wall-clock cadence.
+
+Crash recovery is checkpoint + deterministic replay: a crash (injected by the
+chaos scheduler, or a real kill + re-launch) discards in-memory state, the
+latest checkpoint is restored, and the missed epochs are recomputed — the
+same trajectory, because the update is deterministic.  This is the TPU-native
+version of the reference's replay-from-neighbor-histories recovery
+(SURVEY.md §3.3) without its unbounded memory."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.parallel import (
+    make_grid_mesh,
+    shard_board,
+    sharded_step_fn,
+    validate_tile_shape,
+)
+from akka_game_of_life_tpu.runtime.chaos import CrashInjector
+from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.utils.patterns import pattern_board, random_grid
+
+
+def initial_board(config: SimulationConfig) -> np.ndarray:
+    if config.pattern is not None:
+        return pattern_board(config.pattern, config.shape, config.pattern_offset)
+    return random_grid(config.shape, density=config.density, seed=config.seed)
+
+
+def _crosses(prev_epoch: int, epoch: int, every: int) -> bool:
+    """Did the cadence boundary get crossed in (prev_epoch, epoch]?"""
+    return every > 0 and (epoch // every) > (prev_epoch // every)
+
+
+class Simulation:
+    """One simulation run, resumable from checkpoints."""
+
+    def __init__(
+        self,
+        config: SimulationConfig,
+        observer: Optional[BoardObserver] = None,
+    ) -> None:
+        self.config = config
+        self.rule = resolve_rule(config.rule)
+        self.observer = observer or BoardObserver(
+            render_every=config.render_every,
+            render_max_cells=config.render_max_cells,
+            metrics_every=config.metrics_every,
+            log_file=config.log_file,
+        )
+        self.store = (
+            CheckpointStore(config.checkpoint_dir)
+            if config.checkpoint_dir is not None
+            else None
+        )
+        if config.fault_injection.enabled and self.store is None:
+            raise ValueError(
+                "fault injection requires checkpoint_dir: a crash with no "
+                "checkpoint to recover from would only restart from epoch 0"
+            )
+        self.injector = (
+            CrashInjector(config.fault_injection)
+            if config.fault_injection.enabled
+            else None
+        )
+        self.crash_log: list[int] = []  # epochs at which injected crashes hit
+
+        self.epoch = 0
+        board = initial_board(config)
+        if self.store is not None and self.store.latest_epoch() is not None:
+            ckpt = self.store.load()
+            if ckpt.board.shape != config.shape:
+                raise ValueError(
+                    f"checkpoint shape {ckpt.board.shape} != config {config.shape}"
+                )
+            self.epoch = ckpt.epoch
+            board = ckpt.board
+
+        n_dev = len(jax.devices())
+        self._use_mesh = config.mesh_shape is not None or n_dev > 1
+        if self._use_mesh:
+            self.mesh = make_grid_mesh(config.mesh_shape)
+            validate_tile_shape(self.mesh, config.shape, config.halo_width)
+        else:
+            self.mesh = None
+        self._steppers: Dict[int, Callable] = {}
+        self.board = self._to_device(board)
+
+    # -- device plumbing -----------------------------------------------------
+
+    def _to_device(self, board: np.ndarray) -> jax.Array:
+        arr = jnp.asarray(board)
+        return shard_board(arr, self.mesh) if self.mesh is not None else arr
+
+    def _stepper(self, k: int) -> Callable[[jax.Array], jax.Array]:
+        """A jitted k-epoch advance (cached per k; k is usually
+        steps_per_call, plus at most one partial-chunk size per run)."""
+        if k not in self._steppers:
+            if self.mesh is not None:
+                halo = min(self.config.halo_width, k)
+                while k % halo:
+                    halo -= 1
+                self._steppers[k] = sharded_step_fn(
+                    self.mesh, self.rule, steps_per_call=k, halo_width=halo
+                )
+            else:
+                self._steppers[k] = get_model(self.rule).run(k)
+        return self._steppers[k]
+
+    # -- core loop -----------------------------------------------------------
+
+    def advance(self, epochs: Optional[int] = None) -> int:
+        """Advance by exactly ``epochs`` generations (default:
+        config.max_epochs).  Observation, pacing, checkpointing, and fault
+        injection happen between chunks of ``steps_per_call`` generations —
+        the on-device scan in between has zero host round-trips."""
+        cfg = self.config
+        target = self.epoch + (epochs if epochs is not None else (cfg.max_epochs or 0))
+        next_tick = time.monotonic()
+        while self.epoch < target:
+            if cfg.tick_s > 0:
+                now = time.monotonic()
+                if now < next_tick:
+                    time.sleep(next_tick - now)
+                next_tick = max(next_tick + cfg.tick_s, now)
+
+            if self.injector is not None and self.injector.should_crash():
+                self._crash_and_recover()
+
+            chunk = min(cfg.steps_per_call, target - self.epoch)
+            prev = self.epoch
+            self.board = self._stepper(chunk)(self.board)
+            self.epoch += chunk
+
+            host_board = None
+            if _crosses(prev, self.epoch, cfg.render_every) or _crosses(
+                prev, self.epoch, cfg.metrics_every
+            ):
+                host_board = np.asarray(self.board)
+                self.observer.observe(self.epoch, host_board)
+            if self.store is not None and _crosses(
+                prev, self.epoch, cfg.checkpoint_every
+            ):
+                self.checkpoint(host_board)
+        return self.epoch
+
+    # -- failure & recovery --------------------------------------------------
+
+    def _crash_and_recover(self) -> None:
+        """An injected crash: in-memory state is lost; recover from the
+        latest checkpoint and deterministically replay the missed epochs."""
+        assert self.store is not None
+        target = self.epoch
+        self.crash_log.append(target)
+        self.board = None  # the crash: live state gone
+        ckpt = self.store.load() if self.store.latest_epoch() is not None else None
+        if ckpt is None:
+            self.epoch = 0
+            self.board = self._to_device(initial_board(self.config))
+        else:
+            self.epoch = ckpt.epoch
+            self.board = self._to_device(ckpt.board)
+        while self.epoch < target:
+            # Replay: recompute the lost epochs (deterministic rule ⇒ the
+            # trajectory is bit-identical to the pre-crash one).  Reuses the
+            # steps_per_call stepper so no extra compilation beyond at most
+            # one partial chunk.
+            chunk = min(self.config.steps_per_call, target - self.epoch)
+            self.board = self._stepper(chunk)(self.board)
+            self.epoch += chunk
+
+    def checkpoint(self, host_board: Optional[np.ndarray] = None) -> None:
+        if self.store is None:
+            raise RuntimeError("no checkpoint_dir configured")
+        if host_board is None:
+            host_board = np.asarray(self.board)
+        self.store.save(
+            self.epoch,
+            host_board,
+            self.rule.rulestring(),
+            meta={"height": self.config.height, "width": self.config.width},
+        )
+
+    def board_host(self) -> np.ndarray:
+        return np.asarray(self.board)

@@ -1,0 +1,71 @@
+"""Headline benchmark: Conway B3/S23 toroidal stencil throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): the north-star target is >=1e11 cell-updates/sec
+aggregate on a TPU v5e-8, i.e. 1.25e10 per chip. The reference itself
+publishes no numbers (its wall-clock-ticked actor design caps out around
+~12-16 cell-updates/sec at its 6x6 default — BASELINE.md), so vs_baseline is
+measured against the per-chip north-star share: value / 1.25e10.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PER_CHIP_TARGET = 1.0e11 / 8  # north-star aggregate spread over v5e-8 chips
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size", type=int, default=8192)
+    parser.add_argument("--steps-per-call", type=int, default=128)
+    parser.add_argument("--timed-calls", type=int, default=4)
+    args = parser.parse_args()
+
+    from akka_game_of_life_tpu.models import get_model
+    from akka_game_of_life_tpu.utils.patterns import random_grid
+
+    n = args.size
+    board = jnp.asarray(random_grid((n, n), density=0.5, seed=0))
+    run = get_model("conway").run(args.steps_per_call)
+
+    # Warmup: compile + one full execution of both the step scan and the
+    # population-sum sync op.  NOTE: on this TPU platform block_until_ready
+    # does not actually block, so every timing below ends with a host fetch
+    # of a scalar to force synchronization.
+    board = run(board)
+    _ = int(jnp.sum(board))
+
+    t0 = time.perf_counter()
+    for _ in range(args.timed_calls):
+        board = run(board)
+    population = int(jnp.sum(board))  # forces execution of the whole chain
+    dt = time.perf_counter() - t0
+
+    total_updates = n * n * args.steps_per_call * args.timed_calls
+    rate = total_updates / dt
+    # Keep the result honest: the board must still be alive (not a trivially
+    # dead fixed point that XLA could const-fold).
+    assert population > 0
+
+    print(
+        json.dumps(
+            {
+                "metric": f"cell-updates/sec/chip, Conway B3/S23 {n}x{n} torus",
+                "value": rate,
+                "unit": "cell-updates/sec",
+                "vs_baseline": rate / PER_CHIP_TARGET,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -3,18 +3,25 @@
 Tests run on CPU with a virtual 8-device mesh so the sharded runtime can be
 exercised without TPU hardware — the TPU-native analog of the reference's
 "start N backend JVMs on localhost" manual procedure (``README.md:3-12``).
-The env vars MUST be set before jax initializes its backends, hence the
-top-of-file placement.
+
+Gotcha: this image's sitecustomize registers the axon TPU PJRT plugin at
+interpreter boot and forces ``jax_platforms=axon``, so merely setting
+``JAX_PLATFORMS=cpu`` in conftest is too late — we must override the jax
+config after import.  ``XLA_FLAGS`` is read lazily at first backend init, so
+setting it here (before any test imports jax) is early enough.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

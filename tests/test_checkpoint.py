@@ -1,0 +1,57 @@
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+
+
+def test_save_load_roundtrip_binary(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    board = (np.random.default_rng(0).random((33, 17)) < 0.5).astype(np.uint8)
+    store.save(42, board, "B3/S23", meta={"k": 1})
+    ckpt = store.load()
+    assert ckpt.epoch == 42
+    assert ckpt.rule == "B3/S23"
+    assert ckpt.meta["k"] == 1
+    assert np.array_equal(ckpt.board, board)
+
+
+def test_save_load_multistate(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    board = np.random.default_rng(1).integers(0, 4, size=(16, 16)).astype(np.uint8)
+    store.save(7, board, "345/2/4")
+    ckpt = store.load()
+    assert np.array_equal(ckpt.board, board)
+
+
+def test_latest_and_specific_epoch(tmp_path):
+    store = CheckpointStore(str(tmp_path), keep=10)
+    b = np.zeros((4, 4), np.uint8)
+    for e in (10, 20, 30):
+        b[0, 0] = e
+        store.save(e, b % 2, "conway")
+    assert store.latest_epoch() == 30
+    assert store.load(20).epoch == 20
+    with pytest.raises(FileNotFoundError):
+        store.load(15)
+
+
+def test_gc_keeps_newest(tmp_path):
+    store = CheckpointStore(str(tmp_path), keep=2)
+    b = np.zeros((4, 4), np.uint8)
+    for e in range(5):
+        store.save(e, b, "conway")
+    epochs = [e for e, _ in store._epochs()]
+    assert epochs == [3, 4]
+
+
+def test_empty_store(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    assert store.latest_epoch() is None
+    with pytest.raises(FileNotFoundError):
+        store.load()
+
+
+def test_no_tmp_litter_on_success(tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    store.save(1, np.zeros((4, 4), np.uint8), "conway")
+    assert not list(tmp_path.glob("*.tmp"))

@@ -1,0 +1,94 @@
+import pytest
+
+from akka_game_of_life_tpu.runtime.config import (
+    FaultInjectionConfig,
+    SimulationConfig,
+    load_config,
+    parse_duration,
+)
+
+
+def test_parse_duration():
+    assert parse_duration(5) == 5.0
+    assert parse_duration("5s") == 5.0
+    assert parse_duration("3000ms") == 3.0
+    assert parse_duration("1 second") == 1.0
+    assert parse_duration("2 minutes") == 120.0
+    with pytest.raises(ValueError):
+        parse_duration("soon")
+
+
+def test_defaults():
+    cfg = SimulationConfig()
+    assert cfg.shape == (64, 64)
+    assert cfg.rule == "conway"
+    assert cfg.tick_s == 0.0
+    # The reference's knobs keep their defaults (application.conf:37-47).
+    assert cfg.wait_for_backends_s == 5.0
+    assert cfg.failure_timeout_s == 1.0
+    assert cfg.fault_injection.max_crashes == 100
+    assert cfg.fault_injection.first_after_s == 10.0
+    assert cfg.fault_injection.every_s == 15.0
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        SimulationConfig(height=0)
+    with pytest.raises(ValueError):
+        SimulationConfig(backend="gpu")
+    with pytest.raises(ValueError):
+        SimulationConfig(role="leader")
+    with pytest.raises(ValueError):
+        SimulationConfig(steps_per_call=3, halo_width=2)
+
+
+def test_load_toml_with_reference_spellings(tmp_path):
+    p = tmp_path / "game.toml"
+    p.write_text(
+        """
+rule = "highlife"
+tick = "3000ms"
+"wait-for-backends" = "5s"
+
+[board]
+x = 32
+y = 16
+
+[error]
+delay = "10s"
+every = "15s"
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.rule == "highlife"
+    assert cfg.width == 32 and cfg.height == 16
+    assert cfg.tick_s == 3.0
+    assert cfg.wait_for_backends_s == 5.0
+    assert cfg.fault_injection.first_after_s == 10.0
+    assert cfg.fault_injection.every_s == 15.0
+
+
+def test_load_json_and_overrides(tmp_path):
+    p = tmp_path / "game.json"
+    p.write_text('{"rule": "conway", "height": 8, "width": 8, "tick": 1}')
+    cfg = load_config(str(p), {"rule": "seeds", "height": None})
+    # overrides beat file; None overrides are ignored (unset CLI flags)
+    assert cfg.rule == "seeds"
+    assert cfg.height == 8
+    assert cfg.tick_s == 1.0
+
+
+def test_unknown_keys_fail_loudly(tmp_path):
+    p = tmp_path / "game.toml"
+    p.write_text('ruel = "conway"')
+    with pytest.raises(ValueError, match="ruel"):
+        load_config(str(p))
+
+
+def test_fault_injection_override_merging(tmp_path):
+    p = tmp_path / "game.toml"
+    p.write_text("[fault_injection]\nenabled = false\nmax_crashes = 7\n")
+    cfg = load_config(str(p), {"fault_injection": {"enabled": True}})
+    assert cfg.fault_injection.enabled is True
+    assert cfg.fault_injection.max_crashes == 7
+    assert isinstance(cfg.fault_injection, FaultInjectionConfig)

@@ -1,0 +1,67 @@
+import io
+
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.runtime.render import BoardObserver, render_ascii
+
+
+def test_render_ascii_small():
+    b = np.array([[0, 1], [2, 0]], dtype=np.uint8)
+    out = render_ascii(b)
+    assert out.splitlines() == ["[2x2]", ".#", "o."]
+
+
+def test_render_ascii_samples_large_boards():
+    b = np.zeros((512, 1024), dtype=np.uint8)
+    out = render_ascii(b, max_cells=128)
+    lines = out.splitlines()
+    assert "sampled /4x8" in lines[0]
+    assert len(lines) - 1 == 128
+    assert all(len(line) == 128 for line in lines[1:])
+
+
+def test_observer_metrics_and_frames():
+    sink = io.StringIO()
+    obs = BoardObserver(render_every=2, metrics_every=1, out=sink, render_max_cells=8)
+    b = np.zeros((4, 4), dtype=np.uint8)
+    b[1, 1] = 1
+    obs.observe(1, b)
+    obs.observe(2, b)
+    text = sink.getvalue()
+    assert "epoch 2" in text
+    assert "pop=1" in text
+    assert len(obs.history) == 1  # first observe has no dt yet
+
+
+def test_observer_tile_assembly_is_position_ordered():
+    """Tiles arriving in arbitrary order must assemble by position — fixing
+    the reference's arrival-order scramble (LoggerActor.scala:17,38-40)."""
+    obs = BoardObserver(out=io.StringIO())
+    obs.expect_tiles(4)
+    full = np.arange(16, dtype=np.uint8).reshape(4, 4) % 3
+    tiles = {
+        (0, 0): full[:2, :2],
+        (0, 2): full[:2, 2:],
+        (2, 0): full[2:, :2],
+        (2, 2): full[2:, 2:],
+    }
+    # feed in scrambled arrival order
+    assert obs.observe_tile(5, (2, 2), tiles[(2, 2)]) is None
+    assert obs.observe_tile(5, (0, 2), tiles[(0, 2)]) is None
+    assert obs.observe_tile(5, (2, 0), tiles[(2, 0)]) is None
+    board = obs.observe_tile(5, (0, 0), tiles[(0, 0)])
+    assert np.array_equal(board, full)
+
+
+def test_observer_tile_requires_expectation():
+    obs = BoardObserver(out=io.StringIO())
+    with pytest.raises(RuntimeError):
+        obs.observe_tile(0, (0, 0), np.zeros((2, 2), np.uint8))
+
+
+def test_observer_log_file(tmp_path):
+    path = tmp_path / "info.log"
+    with BoardObserver(render_every=1, log_file=str(path)) as obs:
+        obs.observe(0, np.ones((2, 2), dtype=np.uint8))
+    assert "##" in path.read_text()

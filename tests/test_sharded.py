@@ -1,0 +1,110 @@
+"""Sharded ≡ single-device equivalence — the property-test strategy SURVEY.md
+§4 prescribes in place of the reference's manual multi-JVM procedure."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.ops import stencil
+from akka_game_of_life_tpu.parallel import (
+    factor_2d,
+    make_grid_mesh,
+    shard_board,
+    sharded_step_fn,
+    validate_tile_shape,
+)
+from akka_game_of_life_tpu.utils.patterns import pattern_board, random_grid
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh"
+)
+
+
+def dense_reference(board, rule, steps):
+    return np.asarray(get_model(rule).run(steps)(jnp.asarray(board)))
+
+
+def test_factor_2d():
+    assert factor_2d(8) == (4, 2)
+    assert factor_2d(4) == (2, 2)
+    assert factor_2d(1) == (1, 1)
+    assert factor_2d(7) == (7, 1)
+
+
+def test_mesh_shapes():
+    assert make_grid_mesh().shape == {"row": 4, "col": 2}
+    assert make_grid_mesh((2, 4)).shape == {"row": 2, "col": 4}
+    with pytest.raises(ValueError):
+        make_grid_mesh((3, 2))
+
+
+def test_shard_board_divisibility():
+    mesh = make_grid_mesh((4, 2))
+    with pytest.raises(ValueError):
+        shard_board(np.zeros((30, 16), np.uint8), mesh)
+    with pytest.raises(ValueError):
+        validate_tile_shape(make_grid_mesh((8, 1)), (16, 16), halo_width=3)
+
+
+@pytest.mark.parametrize("mesh_shape", [(1, 1), (2, 2), (4, 2), (2, 4), (8, 1), (1, 8)])
+def test_sharded_equals_dense_conway(mesh_shape):
+    board = random_grid((32, 32), density=0.45, seed=13)
+    mesh = make_grid_mesh(mesh_shape, devices=jax.devices()[: mesh_shape[0] * mesh_shape[1]])
+    step = sharded_step_fn(mesh, "conway", steps_per_call=6)
+    got = np.asarray(step(shard_board(jnp.asarray(board), mesh)))
+    want = dense_reference(board, "conway", 6)
+    assert np.array_equal(got, want), mesh_shape
+
+
+@pytest.mark.parametrize("halo_width", [1, 2, 3])
+def test_wide_halo_equals_dense(halo_width):
+    board = random_grid((48, 24), density=0.4, seed=21)
+    mesh = make_grid_mesh((4, 2))
+    step = sharded_step_fn(mesh, "conway", steps_per_call=6, halo_width=halo_width)
+    got = np.asarray(step(shard_board(jnp.asarray(board), mesh)))
+    want = dense_reference(board, "conway", 6)
+    assert np.array_equal(got, want), halo_width
+
+
+@pytest.mark.parametrize("rule", ["highlife", "day-and-night", "brians-brain"])
+def test_sharded_equals_dense_other_rules(rule):
+    board = random_grid((32, 32), density=0.5, seed=3)
+    if rule == "brians-brain":
+        rng = np.random.default_rng(5)
+        board = rng.integers(0, 3, size=(32, 32)).astype(np.uint8)
+    mesh = make_grid_mesh((4, 2))
+    step = sharded_step_fn(mesh, rule, steps_per_call=4, halo_width=2)
+    got = np.asarray(step(shard_board(jnp.asarray(board), mesh)))
+    want = dense_reference(board, rule, 4)
+    assert np.array_equal(got, want), rule
+
+
+def test_glider_crosses_shard_boundaries():
+    """A glider must sail seamlessly across every ICI tile boundary and wrap
+    the global torus — the capability the reference implements with remote
+    actor messages (and gets wrong at edges)."""
+    board = pattern_board("glider", (32, 32), (2, 2))
+    mesh = make_grid_mesh((4, 2))
+    step = sharded_step_fn(mesh, "conway", steps_per_call=4)
+    g = shard_board(jnp.asarray(board), mesh)
+    for _ in range(32):  # 128 generations: crosses tiles and wraps fully
+        g = step(g)
+    assert np.array_equal(np.asarray(g), board)
+
+
+def test_gosper_gun_period_30_sharded():
+    board = pattern_board("gosper-glider-gun", (64, 64), (4, 4))
+    mesh = make_grid_mesh((4, 2))
+    step = sharded_step_fn(mesh, "conway", steps_per_call=30, halo_width=3)
+    b30 = np.asarray(step(shard_board(jnp.asarray(board), mesh)))
+    gun = np.s_[4:13, 4:40]
+    assert np.array_equal(board[gun], b30[gun])
+    assert b30.sum() > board.sum()
+
+
+def test_steps_must_divide_halo():
+    mesh = make_grid_mesh((4, 2))
+    with pytest.raises(ValueError):
+        sharded_step_fn(mesh, "conway", steps_per_call=5, halo_width=2)

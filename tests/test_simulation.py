@@ -1,0 +1,179 @@
+import io
+
+import numpy as np
+
+from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import Simulation
+from akka_game_of_life_tpu.utils.patterns import pattern_board
+
+import jax.numpy as jnp
+
+
+def _dense(board, rule, steps):
+    return np.asarray(get_model(rule).run(steps)(jnp.asarray(board)))
+
+
+def test_standalone_advance_matches_dense():
+    cfg = SimulationConfig(height=32, width=32, rule="conway", seed=4, steps_per_call=2)
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    start = sim.board_host()
+    sim.advance(10)
+    assert sim.epoch == 10
+    assert np.array_equal(sim.board_host(), _dense(start, "conway", 10))
+
+
+def test_pattern_start_and_gun_period():
+    cfg = SimulationConfig(
+        height=64, width=64, pattern="gosper-glider-gun", pattern_offset=(4, 4),
+        steps_per_call=30,
+    )
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    b0 = sim.board_host()
+    assert np.array_equal(b0, pattern_board("gosper-glider-gun", (64, 64), (4, 4)))
+    sim.advance(30)
+    gun = np.s_[4:13, 4:40]
+    assert np.array_equal(sim.board_host()[gun], b0[gun])
+
+
+def test_kill_and_resume_is_deterministic(tmp_path):
+    """The north-star recovery criterion: kill at any point, resume from the
+    checkpoint store, trajectory identical (SURVEY.md §7.7)."""
+    mk = lambda: SimulationConfig(
+        height=48,
+        width=48,
+        pattern="gosper-glider-gun",
+        pattern_offset=(2, 2),
+        steps_per_call=5,
+        checkpoint_dir=str(tmp_path),
+        checkpoint_every=5,
+    )
+    sim = Simulation(mk(), observer=BoardObserver(out=io.StringIO()))
+    sim.advance(30)
+    reference = sim.board_host()
+
+    # "Kill": discard the live object; resume a fresh one from disk at 30.
+    resumed = Simulation(mk(), observer=BoardObserver(out=io.StringIO()))
+    assert resumed.epoch == 30
+    assert np.array_equal(resumed.board_host(), reference)
+
+    # And both trajectories continue identically.
+    sim.advance(15)
+    resumed.advance(15)
+    assert np.array_equal(sim.board_host(), resumed.board_host())
+
+
+def test_sharded_simulation_on_mesh():
+    cfg = SimulationConfig(
+        height=32, width=32, mesh_shape=(4, 2), steps_per_call=4, halo_width=2, seed=9
+    )
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    start = sim.board_host()
+    sim.advance(8)
+    assert np.array_equal(sim.board_host(), _dense(start, "conway", 8))
+
+
+def test_cli_run(capsys):
+    from akka_game_of_life_tpu.cli import main
+
+    rc = main(
+        [
+            "run",
+            "--rule",
+            "conway",
+            "--height",
+            "16",
+            "--width",
+            "16",
+            "--pattern",
+            "blinker",
+            "--max-epochs",
+            "2",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "epoch 2:" in out
+    assert "###" in out  # blinker back in horizontal phase
+
+
+def test_advance_exact_epoch_count_with_partial_chunk():
+    """max_epochs not a multiple of steps_per_call must not overshoot."""
+    cfg = SimulationConfig(height=16, width=16, seed=1, steps_per_call=30)
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    start = sim.board_host()
+    sim.advance(100)
+    assert sim.epoch == 100
+    assert np.array_equal(sim.board_host(), _dense(start, "conway", 100))
+
+
+def test_checkpoint_cadence_fires_on_crossing(tmp_path):
+    """checkpoint_every=20 with steps_per_call=30 must checkpoint at every
+    crossing (30, 60, 90...), not only at lcm multiples."""
+    cfg = SimulationConfig(
+        height=16, width=16, seed=2, steps_per_call=30,
+        checkpoint_dir=str(tmp_path), checkpoint_every=20,
+    )
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    sim.advance(90)
+    epochs = [e for e, _ in sim.store._epochs()]
+    assert epochs == [30, 60, 90]
+
+
+def test_metrics_account_for_chunked_epochs():
+    sink = io.StringIO()
+    cfg = SimulationConfig(height=16, width=16, seed=3, steps_per_call=10,
+                           metrics_every=10)
+    sim = Simulation(cfg, observer=BoardObserver(out=sink, metrics_every=10))
+    sim.advance(30)
+    m = sim.observer.history[-1]
+    assert m.epochs == 10
+    assert m.cells == 16 * 16 * 10
+
+
+def test_fault_injection_requires_checkpoint_dir():
+    import pytest
+    from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
+
+    cfg = SimulationConfig(
+        height=16, width=16,
+        fault_injection=FaultInjectionConfig(enabled=True),
+    )
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+
+
+def test_chaos_crash_recovery_preserves_gun_phase(tmp_path):
+    """The north-star chaos criterion: injected crashes + checkpoint/replay
+    recovery leave the glider-gun trajectory bit-identical to a crash-free
+    run (reference analog: BoardCreator.scala:97-102 + §3.3 replay)."""
+    from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
+
+    mk = lambda fi, ckdir: SimulationConfig(
+        height=48, width=48, pattern="gosper-glider-gun", pattern_offset=(2, 2),
+        steps_per_call=10, checkpoint_dir=ckdir, checkpoint_every=20,
+        fault_injection=fi,
+    )
+    # Crash-free reference trajectory.
+    clean = Simulation(
+        mk(FaultInjectionConfig(), str(tmp_path / "clean")),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    clean.advance(120)
+
+    # Chaotic run: crash due immediately and after every chunk (first_after_s=0,
+    # every_s=0 -> a crash before every chunk), budget 5.
+    chaotic = Simulation(
+        mk(
+            FaultInjectionConfig(enabled=True, first_after_s=0.0, every_s=0.0,
+                                 max_crashes=5),
+            str(tmp_path / "chaos"),
+        ),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    chaotic.advance(120)
+    assert chaotic.injector.crashes == 5
+    assert len(chaotic.crash_log) == 5
+    assert chaotic.epoch == clean.epoch == 120
+    assert np.array_equal(chaotic.board_host(), clean.board_host())
