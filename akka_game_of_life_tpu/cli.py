@@ -90,6 +90,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     be_p.add_argument("--port", type=int, default=2551, help="frontend port to join")
     be_p.add_argument("--host", default="127.0.0.1")
     be_p.add_argument("--name", default=None)
+    be_p.add_argument(
+        "--engine",
+        choices=["numpy", "jax"],
+        default="jax",
+        help="tile step engine: jax = jitted on local accelerator (TPU path), "
+        "numpy = host-only parity path",
+    )
 
     args = parser.parse_args(argv)
 
@@ -135,7 +142,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         except ImportError as e:  # pragma: no cover
             raise SystemExit(f"backend role unavailable: {e}")
 
-        return run_backend(host=args.host, port=args.port, name=args.name)
+        return run_backend(
+            host=args.host, port=args.port, name=args.name, engine=args.engine
+        )
 
     return 2
 

@@ -124,6 +124,19 @@ def step_fn(rule_key: Rule) -> Callable[[jax.Array], jax.Array]:
     return _step
 
 
+@functools.lru_cache(maxsize=None)
+def step_fn_padded(rule_key: Rule) -> Callable[[jax.Array], jax.Array]:
+    """A jitted halo-padded step closure: (h+2, w+2) → (h, w), cached per
+    rule.  This is the per-tile engine for distributed workers."""
+    rule = resolve_rule(rule_key)
+
+    @jax.jit
+    def _step(padded: jax.Array) -> jax.Array:
+        return step_padded(padded, rule)
+
+    return _step
+
+
 def multi_step(state: jax.Array, rule, n_steps: int) -> jax.Array:
     """Advance ``n_steps`` generations under one jit trace via ``lax.scan``.
 

@@ -1,0 +1,331 @@
+"""The backend worker — the ``RunBackend`` role, upgraded from container to
+shard engine.
+
+The reference's backend is deliberately empty: it starts an ActorSystem,
+joins the cluster, and hosts whatever cells the frontend deploys onto it
+(``Run.scala:56-65``).  This worker keeps that shape — it owns nothing until
+the frontend DEPLOYs tiles — but the deployed unit is a whole grid tile
+advanced by a stencil engine:
+
+- ``engine="numpy"``: host stepping, the portable/parity path;
+- ``engine="jax"``: jitted stepping on the worker's local accelerator (the
+  TPU path; within a multi-device worker the tile itself is mesh-sharded by
+  :mod:`akka_game_of_life_tpu.parallel` — ICI inside, control plane outside).
+
+Per-epoch cycle per tile (the ``CellActor``/gatherer loop collapsed):
+PULL halo(E) → (queued at the frontend until all 8 neighbor rings at E exist)
+→ HALO reply → step to E+1 → push RING(E+1) → PULL halo(E+1)...  A pending
+pull is re-sent after ``retry_s`` (the gatherer's 1 s Retry timer,
+``NextStateCellGathererActor.scala:28``).  Tiles lag and catch up
+independently — there is no global barrier, matching the reference's
+history-buffered asynchrony (``CellActor.scala:41-47``)."""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from akka_game_of_life_tpu.ops.npkernel import step_padded_np
+from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
+from akka_game_of_life_tpu.runtime import protocol as P
+from akka_game_of_life_tpu.runtime.boundary import Halo
+from akka_game_of_life_tpu.runtime.tiles import Ring, TileId
+from akka_game_of_life_tpu.runtime.wire import Channel
+
+
+class _Tile:
+    def __init__(self, arr: np.ndarray, epoch: int) -> None:
+        self.arr = arr
+        self.epoch = epoch
+        self.awaiting_since: Optional[float] = None  # the waitingForNewState latch
+        self.retries = 0
+
+
+def _jax_engine(rule: Rule) -> Callable[[np.ndarray], np.ndarray]:
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.ops.stencil import step_fn_padded
+
+    step = step_fn_padded(rule)
+
+    def run(padded: np.ndarray) -> np.ndarray:
+        return np.asarray(step(jnp.asarray(padded)))
+
+    return run
+
+
+class BackendWorker:
+    """One worker process/thread: joins, hosts tiles, steps them."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        name: Optional[str] = None,
+        engine: str = "jax",
+        retry_s: float = 1.0,
+        crash_hook: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.name = name
+        self.engine = engine
+        self.retry_s = retry_s
+        # DoCrashMsg → throw (CellActor.scala:95-96): default is an abrupt
+        # process death; in-thread harnesses override to simulate it.
+        self.crash_hook = crash_hook or (lambda: os._exit(42))
+
+        self.tiles: Dict[TileId, _Tile] = {}
+        self.rule: Optional[Rule] = None
+        self.target = 0
+        self.final_epoch = 0
+        self.render_every = 0
+        self.checkpoint_every = 0
+        self.metrics_every = 0
+        self.paused = False
+        self.channel: Optional[Channel] = None
+        self._step_padded: Optional[Callable[[np.ndarray], np.ndarray]] = None
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self.stopped_reason: Optional[str] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def connect(self) -> None:
+        sock = socket.create_connection((self.host, self.port), timeout=10)
+        sock.settimeout(None)
+        self.channel = Channel(sock)
+        self.channel.send({"type": P.REGISTER, "name": self.name})
+        welcome = self.channel.recv()
+        if not welcome or welcome.get("type") != P.WELCOME:
+            raise ConnectionError("frontend did not welcome us")
+        self.name = welcome["name"]
+        heartbeat_s = float(welcome.get("heartbeat_s", 0.5))
+        threading.Thread(
+            target=self._heartbeat_loop, args=(heartbeat_s,), daemon=True
+        ).start()
+        threading.Thread(target=self._retry_loop, daemon=True).start()
+
+    def run(self) -> int:
+        """Blocking serve loop; returns when shut down or disconnected."""
+        if self.channel is None:
+            self.connect()
+        try:
+            while not self._stop.is_set():
+                msg = self.channel.recv()
+                if msg is None:
+                    self.stopped_reason = self.stopped_reason or "disconnected"
+                    break
+                self._dispatch(msg)
+        except OSError:
+            self.stopped_reason = self.stopped_reason or "connection error"
+        finally:
+            self._stop.set()
+        return 0 if self.stopped_reason == "shutdown" else 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.channel is not None:
+            try:
+                # Graceful leave (cluster down): distinguishable from a crash.
+                self.channel.send({"type": P.GOODBYE})
+            except OSError:
+                pass
+            self.channel.close()
+
+    # -- helper threads ------------------------------------------------------
+
+    def _heartbeat_loop(self, interval: float) -> None:
+        while not self._stop.is_set():
+            time.sleep(interval)
+            try:
+                self.channel.send({"type": P.HEARTBEAT})
+            except OSError:
+                return
+
+    def _retry_loop(self) -> None:
+        """The gatherer's Retry timer: re-pull stale halo requests."""
+        while not self._stop.is_set():
+            time.sleep(self.retry_s / 4)
+            now = time.monotonic()
+            with self._lock:
+                stale = [
+                    (tid, t)
+                    for tid, t in self.tiles.items()
+                    if t.awaiting_since is not None
+                    and now - t.awaiting_since > self.retry_s
+                ]
+                for tid, t in stale:
+                    t.retries += 1
+                    t.awaiting_since = now
+                    self._send_pull(tid, t)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _dispatch(self, msg: dict) -> None:
+        kind = msg.get("type")
+        if kind == P.DEPLOY:
+            self._on_deploy(msg)
+        elif kind == P.TICK:
+            with self._lock:
+                self.target = int(msg["target"])
+                self._kick()
+        elif kind == P.HALO:
+            self._on_halo(msg)
+        elif kind == P.PAUSE:
+            with self._lock:
+                self.paused = True
+        elif kind == P.RESUME:
+            with self._lock:
+                self.paused = False
+                self._kick()
+        elif kind == P.CRASH:
+            self.crash_hook()
+        elif kind == P.CRASH_TILE:
+            self._on_crash_tile(tuple(msg["tile"]))
+        elif kind == P.SHUTDOWN:
+            self.stopped_reason = "shutdown"
+            self._stop.set()
+            self.channel.close()
+
+    def _on_deploy(self, msg: dict) -> None:
+        with self._lock:
+            rule = resolve_rule(msg["rule"])
+            if self.rule != rule:
+                self.rule = rule
+                self._step_padded = (
+                    _jax_engine(rule)
+                    if self.engine == "jax"
+                    else (lambda padded: step_padded_np(padded, rule))
+                )
+            self.target = int(msg["target"])
+            self.final_epoch = int(msg["final_epoch"])
+            self.render_every = int(msg.get("render_every", 0))
+            self.checkpoint_every = int(msg.get("checkpoint_every", 0))
+            self.metrics_every = int(msg.get("metrics_every", 0))
+            for spec in msg["tiles"]:
+                tid: TileId = tuple(spec["id"])
+                tile = _Tile(np.asarray(spec["array"]), int(spec["epoch"]))
+                self.tiles[tid] = tile
+                # Announce our boundary at the deployed epoch so neighbors
+                # can assemble their halos (History seeding,
+                # CellActor.scala:34).
+                self._send_ring(tid, tile)
+                self._maybe_send_state(tid, tile)
+            self._kick()
+
+    def _on_halo(self, msg: dict) -> None:
+        tid: TileId = tuple(msg["tile"])
+        epoch = int(msg["epoch"])
+        with self._lock:
+            tile = self.tiles.get(tid)
+            if (
+                tile is None
+                or epoch != tile.epoch  # stale/duplicate reply: drop
+                or self.paused
+                or tile.epoch >= self.target
+            ):
+                if tile is not None and epoch == tile.epoch:
+                    tile.awaiting_since = None  # paused: clear latch
+                return
+            halo = Halo.from_wire(msg["halo"])
+            padded = halo.pad(tile.arr)
+            tile.arr = self._step_padded(padded)
+            tile.epoch += 1
+            tile.awaiting_since = None
+            tile.retries = 0
+            self._send_ring(tid, tile)
+            self._maybe_send_state(tid, tile)
+            if tile.epoch < self.target:
+                self._send_pull(tid, tile)
+
+    def _on_crash_tile(self, tid: TileId) -> None:
+        """Supervision-restart analog: the tile's in-memory state is lost;
+        ask the parent to redeploy (postRestart → SendMeMyNeighbours,
+        CellActor.scala:21-25)."""
+        with self._lock:
+            if tid in self.tiles:
+                del self.tiles[tid]
+        try:
+            self.channel.send({"type": P.REDEPLOY_REQUEST, "tile": list(tid)})
+        except OSError:
+            pass
+
+    # -- stepping plumbing ---------------------------------------------------
+
+    def _kick(self) -> None:
+        """Start pulls for every tile that is behind and not already waiting
+        (scheduleTransitionToNextepochIfNeeded, CellActor.scala:41-47)."""
+        if self.paused:
+            return
+        for tid, tile in self.tiles.items():
+            if tile.epoch < self.target and tile.awaiting_since is None:
+                self._send_pull(tid, tile)
+
+    def _send_pull(self, tid: TileId, tile: _Tile) -> None:
+        tile.awaiting_since = time.monotonic()
+        try:
+            self.channel.send(
+                {"type": P.PULL, "tile": list(tid), "epoch": tile.epoch}
+            )
+        except OSError:
+            pass
+
+    def _send_ring(self, tid: TileId, tile: _Tile) -> None:
+        ring = Ring.of(tile.arr)
+        try:
+            self.channel.send(
+                {
+                    "type": P.RING,
+                    "tile": list(tid),
+                    "epoch": tile.epoch,
+                    "top": ring.top,
+                    "bottom": ring.bottom,
+                    "left": ring.left,
+                    "right": ring.right,
+                    "corners": ring.corners,
+                }
+            )
+        except OSError:
+            pass
+
+    def _maybe_send_state(self, tid: TileId, tile: _Tile) -> None:
+        reasons = []
+        e = tile.epoch
+        if e == self.final_epoch:
+            reasons.append("final")
+        if self.checkpoint_every and e > 0 and e % self.checkpoint_every == 0:
+            reasons.append("checkpoint")
+        if self.render_every and e % self.render_every == 0:
+            reasons.append("render")
+        if self.metrics_every and e % self.metrics_every == 0:
+            reasons.append("metrics")
+        if not reasons:
+            return
+        try:
+            self.channel.send(
+                {
+                    "type": P.TILE_STATE,
+                    "tile": list(tid),
+                    "epoch": e,
+                    "array": tile.arr,
+                    "reasons": reasons,
+                }
+            )
+        except OSError:
+            pass
+
+
+def run_backend(
+    host: str, port: int, name: Optional[str] = None, engine: str = "jax"
+) -> int:
+    worker = BackendWorker(host, port, name=name, engine=engine)
+    worker.connect()
+    print(f"backend {worker.name} joined {host}:{port}", flush=True)
+    return worker.run()

@@ -1,0 +1,140 @@
+"""Epoch-tagged boundary-ring store with pull semantics and bounded history.
+
+This reproduces the reference's neighbor-state exchange contract
+(``CellActor.scala:71-88``) at tile granularity:
+
+- workers *push* their boundary ring after computing each epoch (the analog
+  of a cell's state landing in its ``History`` map);
+- workers *pull* the assembled halo for an epoch; a pull for an epoch whose
+  neighbor rings haven't all arrived is **queued** and answered when the last
+  ring lands — exactly the reference's request queue for not-yet-computed
+  epochs (``CellActor.scala:75-77,82-88``);
+- history is **bounded**: rings older than the last durable checkpoint are
+  pruned (the reference's histories grow forever — SURVEY.md §2 bug 5 — and
+  here the checkpoint, not an unbounded log, is the replay source).
+
+Assembly: a tile's halo at epoch E needs its 8 tile-torus neighbors' rings at
+E — edge rows/cols from the 4 axis neighbors, single corner cells from the 4
+diagonals (the corner-propagation job that the sharded data plane solves with
+its two-phase ppermute)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from akka_game_of_life_tpu.runtime.tiles import Ring, TileId, TileLayout
+
+
+class Halo:
+    """The assembled 1-cell halo for a tile: four edges incl. corners."""
+
+    def __init__(self, top: np.ndarray, bottom: np.ndarray, left: np.ndarray, right: np.ndarray):
+        self.top = top  # (w+2,)
+        self.bottom = bottom  # (w+2,)
+        self.left = left  # (h,)
+        self.right = right  # (h,)
+
+    def pad(self, tile: np.ndarray) -> np.ndarray:
+        """(h, w) tile → (h+2, w+2) halo-padded array."""
+        h, w = tile.shape
+        out = np.empty((h + 2, w + 2), dtype=tile.dtype)
+        out[1:-1, 1:-1] = tile
+        out[0, :] = self.top
+        out[-1, :] = self.bottom
+        out[1:-1, 0] = self.left
+        out[1:-1, -1] = self.right
+        return out
+
+    def to_wire(self) -> dict:
+        return {
+            "top": self.top,
+            "bottom": self.bottom,
+            "left": self.left,
+            "right": self.right,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "Halo":
+        return cls(d["top"], d["bottom"], d["left"], d["right"])
+
+
+class BoundaryStore:
+    """Thread-safe ring store + halo assembler + pending-pull queue."""
+
+    def __init__(self, layout: TileLayout) -> None:
+        self.layout = layout
+        self._rings: Dict[Tuple[TileId, int], Ring] = {}
+        self._pending: Dict[Tuple[TileId, int], List[Callable[[Halo], None]]] = {}
+        self._lock = threading.Lock()
+
+    def push_ring(self, tile: TileId, epoch: int, ring: Ring) -> None:
+        """Store a ring; answer any queued pulls it completes."""
+        ready: List[Tuple[Callable[[Halo], None], Halo]] = []
+        with self._lock:
+            self._rings[(tile, epoch)] = ring
+            for (want_tile, want_epoch), callbacks in list(self._pending.items()):
+                if want_epoch != epoch:
+                    continue
+                halo = self._assemble_locked(want_tile, want_epoch)
+                if halo is not None:
+                    for cb in callbacks:
+                        ready.append((cb, halo))
+                    del self._pending[(want_tile, want_epoch)]
+        for cb, halo in ready:
+            cb(halo)
+
+    def pull_halo(
+        self, tile: TileId, epoch: int, callback: Callable[[Halo], None]
+    ) -> None:
+        """Request the halo for (tile, epoch); callback fires immediately if
+        assembled, else when the last missing neighbor ring arrives."""
+        with self._lock:
+            halo = self._assemble_locked(tile, epoch)
+            if halo is None:
+                self._pending.setdefault((tile, epoch), []).append(callback)
+                return
+        callback(halo)
+
+    def _assemble_locked(self, tile: TileId, epoch: int) -> Optional[Halo]:
+        nb = self.layout.neighbors(tile)
+        rings = {}
+        for direction, ntile in nb.items():
+            ring = self._rings.get((ntile, epoch))
+            if ring is None:
+                return None
+            rings[direction] = ring
+        h, w = self.layout.tile_shape
+        top = np.empty(w + 2, dtype=np.uint8)
+        top[0] = rings["nw"].corners["se"]
+        top[1:-1] = rings["n"].bottom
+        top[-1] = rings["ne"].corners["sw"]
+        bottom = np.empty(w + 2, dtype=np.uint8)
+        bottom[0] = rings["sw"].corners["ne"]
+        bottom[1:-1] = rings["s"].top
+        bottom[-1] = rings["se"].corners["nw"]
+        left = np.asarray(rings["w"].right, dtype=np.uint8)
+        right = np.asarray(rings["e"].left, dtype=np.uint8)
+        return Halo(top, bottom, left, right)
+
+    def prune_below(self, epoch: int) -> int:
+        """Drop rings for epochs < epoch (called after a durable checkpoint).
+        Returns how many were dropped."""
+        with self._lock:
+            stale = [k for k in self._rings if k[1] < epoch]
+            for k in stale:
+                del self._rings[k]
+            return len(stale)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._pending.values())
+
+    def drop_pending_for_owner(self, tiles: List[TileId]) -> None:
+        """Forget queued pulls from tiles being re-deployed (their new owner
+        will re-pull)."""
+        with self._lock:
+            for key in [k for k in self._pending if k[0] in tiles]:
+                del self._pending[key]

@@ -57,6 +57,14 @@ class FaultInjectionConfig:
     every_s: float = 15.0  # error.every
     max_crashes: int = 100  # game-of-life.max-crashes (application.conf:41)
     seed: int = 0
+    # Cluster-mode crash flavor: "tile" kills one shard in place (the
+    # reference's supervised CellActor restart, §3.3); "node" kills a whole
+    # worker process (the reference's backend-JVM loss, §3.4).
+    mode: str = "tile"
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("tile", "node"):
+            raise ValueError(f"unknown fault injection mode {self.mode!r}")
 
 
 @dataclasses.dataclass

@@ -1,0 +1,498 @@
+"""The frontend coordinator — the ``BoardCreator`` + ``RunFrontend`` role.
+
+One process drives the cluster, exactly as in the reference
+(``Run.scala:15-54``, ``BoardCreator.scala``): it is the seed node workers
+join, the membership tracker, the placement authority, the epoch driver, the
+fault injector, the render sink, and the recovery orchestrator.  What changed
+is the *unit*: the reference deploys one actor per cell and re-wires 8
+``ActorRef``s per crash; this frontend deploys one HBM-resident tile per
+worker and re-deploys tiles from durable checkpoints with deterministic
+replay (SURVEY.md §7.6-7.7).
+
+Failure model implemented here (the reference's three layers, §5):
+- *detection*: connection EOF (DeathWatch) + stale heartbeat (auto-down);
+- *recovery*: tile redeployment onto survivors, restored from the last
+  checkpoint (or the deterministic initial board) and replayed forward by
+  pulling epoch-tagged boundary rings (``onCellTermination``,
+  ``BoardCreator.scala:138-154``, without the epoch-0 replay cost);
+- *injection*: the scheduled ``crashIfIMay`` killer with budget
+  (``BoardCreator.scala:97-102``) in two flavors: node kill and tile kill.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.runtime import protocol as P
+from akka_game_of_life_tpu.runtime.boundary import BoundaryStore, Halo
+from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+from akka_game_of_life_tpu.runtime.chaos import CrashInjector
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.membership import Member, Membership
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import initial_board
+from akka_game_of_life_tpu.runtime.tiles import Ring, TileId, TileLayout, layout_for_workers
+from akka_game_of_life_tpu.runtime.wire import Channel
+
+_MAINT_INTERVAL_S = 0.05
+
+
+class Frontend:
+    """Coordinator state machine.  Thread layout: one acceptor, one reader
+    thread per worker connection, one maintenance thread (ticks, heartbeat
+    eviction, fault injection)."""
+
+    def __init__(
+        self,
+        config: SimulationConfig,
+        *,
+        min_backends: int = 1,
+        observer: Optional[BoardObserver] = None,
+    ) -> None:
+        if config.max_epochs is None:
+            raise ValueError("frontend requires max_epochs")
+        self.config = config
+        self.rule = resolve_rule(config.rule)
+        self.min_backends = min_backends
+        self.observer = observer or BoardObserver(
+            render_every=config.render_every,
+            render_max_cells=config.render_max_cells,
+            metrics_every=config.metrics_every,
+            log_file=config.log_file,
+        )
+        self.membership = Membership(config.failure_timeout_s)
+        self.store = (
+            CheckpointStore(config.checkpoint_dir) if config.checkpoint_dir else None
+        )
+        # Created in start_simulation so the error.delay schedule counts from
+        # simulation start, not from process start (workers may take a long
+        # time to join during wait-for-backends).
+        self.injector: Optional[CrashInjector] = None
+
+        self.layout: Optional[TileLayout] = None
+        self.boundary: Optional[BoundaryStore] = None
+        self.tile_owner: Dict[TileId, str] = {}
+        self.tile_epochs: Dict[TileId, int] = {}
+        self.target_epoch = 0
+        self.start_epoch = 0
+        self.paused = False
+        self.crash_events: List[dict] = []
+
+        self._last_ckpt: Optional[Tuple[int, np.ndarray]] = None
+        self._ckpt_pending: Dict[int, Dict[TileId, np.ndarray]] = {}
+        self._final_tiles: Dict[TileId, np.ndarray] = {}
+        self.final_board: Optional[np.ndarray] = None
+        self.error: Optional[str] = None
+
+        self._lock = threading.RLock()
+        self._started = threading.Event()
+        self.done = threading.Event()
+        self._stop = threading.Event()
+        self._next_tick: Optional[float] = None
+
+        self._listener = socket.create_server(
+            (config.host, config.port), reuse_port=False
+        )
+        self.port = self._listener.getsockname()[1]
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        for fn in (self._accept_loop, self._maintenance_loop):
+            t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
+            t.start()
+            self._threads.append(t)
+
+    def wait_for_backends(self, timeout: Optional[float] = None) -> bool:
+        """Reference semantics: give workers ``wait-for-backends`` to join
+        (``Run.scala:50``), but start as soon as the quorum is there."""
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else self.config.wait_for_backends_s
+        )
+        while time.monotonic() < deadline:
+            if len(self.membership.alive_members()) >= self.min_backends:
+                return True
+            time.sleep(0.01)
+        return len(self.membership.alive_members()) >= self.min_backends
+
+    def start_simulation(self) -> None:
+        with self._lock:
+            members = self.membership.alive_members()
+            if len(members) < self.min_backends:
+                raise RuntimeError(
+                    f"only {len(members)} backends joined, need {self.min_backends}"
+                )
+            board = initial_board(self.config)
+            epoch0 = 0
+            if self.store is not None and self.store.latest_epoch() is not None:
+                ckpt = self.store.load()
+                board, epoch0 = ckpt.board, ckpt.epoch
+            self._last_ckpt = (epoch0, board.copy())
+            self.start_epoch = epoch0
+            self.layout = layout_for_workers(self.config.shape, len(members))
+            self.boundary = BoundaryStore(self.layout)
+            self.observer.expect_tiles(len(self.layout.tile_ids))
+
+            if self.config.tick_s > 0:
+                # Paced mode: announce epochs one tick at a time, like the
+                # reference's fixed 3 s clock (BoardCreator.scala:107).
+                self.target_epoch = epoch0
+                self._next_tick = time.monotonic() + self.config.start_delay_s
+            else:
+                # Free-running: announce the final target; tiles pipeline
+                # toward it asynchronously, epoch-tagged (the reference's
+                # lag-and-catch-up behavior, CellActor.scala:41-47).
+                self.target_epoch = self.config.max_epochs
+
+            if self.config.fault_injection.enabled:
+                self.injector = CrashInjector(self.config.fault_injection)
+
+            assignments: Dict[str, List[TileId]] = {m.name: [] for m in members}
+            for idx, tile in enumerate(self.layout.tile_ids):
+                m = members[idx % len(members)]
+                assignments[m.name].append(tile)
+                self.tile_owner[tile] = m.name
+                self.tile_epochs[tile] = epoch0
+            for m in members:
+                m.tiles = assignments[m.name]
+                if m.tiles:
+                    self._send_deploy(m, m.tiles, board, epoch0)
+            self._started.set()
+
+    def _send_deploy(
+        self, member: Member, tiles: List[TileId], board: np.ndarray, epoch: int
+    ) -> None:
+        payload = [
+            {
+                "id": list(t),
+                "epoch": epoch,
+                "array": np.asarray(self.layout.extract(board, t)),
+            }
+            for t in tiles
+        ]
+        self._safe_send(
+            member,
+            {
+                "type": P.DEPLOY,
+                "tiles": payload,
+                "rule": self.rule.rulestring(),
+                "target": self.target_epoch,
+                "final_epoch": self.config.max_epochs,
+                "render_every": self.config.render_every,
+                "checkpoint_every": self.config.checkpoint_every
+                if self.store is not None
+                else 0,
+                "metrics_every": self.config.metrics_every,
+            },
+        )
+
+    def _safe_send(self, member: Member, msg: dict) -> None:
+        try:
+            member.channel.send(msg)
+        except OSError:
+            self._on_member_lost(member.name)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for m in self.membership.alive_members():
+            try:
+                m.channel.send({"type": P.SHUTDOWN})
+            except OSError:
+                pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # -- pause/resume (reachable, unlike BoardCreator.scala:109-112) ---------
+
+    def pause(self) -> None:
+        with self._lock:
+            self.paused = True
+            for m in self.membership.alive_members():
+                self._safe_send(m, {"type": P.PAUSE})
+
+    def resume(self) -> None:
+        with self._lock:
+            self.paused = False
+            for m in self.membership.alive_members():
+                self._safe_send(m, {"type": P.RESUME})
+
+    # -- accept / per-connection reader --------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            channel = Channel(sock)
+            t = threading.Thread(
+                target=self._serve_connection, args=(channel,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_connection(self, channel: Channel) -> None:
+        member: Optional[Member] = None
+        try:
+            hello = channel.recv()
+            if not hello or hello.get("type") != P.REGISTER:
+                channel.close()
+                return
+            member = self.membership.register(channel, hello.get("name"))
+            channel.send(
+                {
+                    "type": P.WELCOME,
+                    "name": member.name,
+                    "heartbeat_s": self.config.heartbeat_s,
+                }
+            )
+            while not self._stop.is_set():
+                msg = channel.recv()
+                if msg is None:
+                    break
+                self._dispatch(member, msg)
+        except (OSError, ValueError):
+            pass
+        finally:
+            if member is not None:
+                self._on_member_lost(member.name)
+
+    # -- message handling ----------------------------------------------------
+
+    def _dispatch(self, member: Member, msg: dict) -> None:
+        kind = msg.get("type")
+        if kind == P.HEARTBEAT:
+            self.membership.beat(member.name)
+        elif kind == P.RING:
+            tile = tuple(msg["tile"])
+            epoch = int(msg["epoch"])
+            ring = Ring(
+                top=msg["top"],
+                bottom=msg["bottom"],
+                left=msg["left"],
+                right=msg["right"],
+                corners={k: int(v) for k, v in msg["corners"].items()},
+            )
+            with self._lock:
+                if self.tile_owner.get(tile) != member.name:
+                    return  # stale push from an evicted owner
+                self.tile_epochs[tile] = max(self.tile_epochs.get(tile, 0), epoch)
+            self.boundary.push_ring(tile, epoch, ring)
+        elif kind == P.PULL:
+            tile = tuple(msg["tile"])
+            epoch = int(msg["epoch"])
+            chan = member.channel
+
+            def reply(halo: Halo, _tile=tile, _epoch=epoch, _chan=chan) -> None:
+                try:
+                    _chan.send(
+                        {
+                            "type": P.HALO,
+                            "tile": list(_tile),
+                            "epoch": _epoch,
+                            "halo": halo.to_wire(),
+                        }
+                    )
+                except OSError:
+                    pass
+
+            self.boundary.pull_halo(tile, epoch, reply)
+        elif kind == P.TILE_STATE:
+            self._on_tile_state(member, msg)
+        elif kind == P.REDEPLOY_REQUEST:
+            tile = tuple(msg["tile"])
+            self._redeploy_tile(tile, preferred=member.name)
+        elif kind == P.GOODBYE:
+            self._on_member_lost(member.name)
+
+    def _on_tile_state(self, member: Member, msg: dict) -> None:
+        tile = tuple(msg["tile"])
+        epoch = int(msg["epoch"])
+        arr = np.asarray(msg["array"])
+        reasons = msg.get("reasons", [])
+        with self._lock:
+            if self.tile_owner.get(tile) != member.name:
+                return
+            if "final" in reasons and epoch == self.config.max_epochs:
+                self._final_tiles[tile] = arr
+                if len(self._final_tiles) == len(self.layout.tile_ids):
+                    self.final_board = self._assemble(self._final_tiles)
+                    if self.store is not None:
+                        self.store.save(
+                            epoch, self.final_board, self.rule.rulestring()
+                        )
+                    self.done.set()
+            if (
+                "checkpoint" in reasons
+                and self.store is not None
+                and epoch > self._last_ckpt[0]  # a replaying tile re-reports
+                # epochs already durably saved; don't recreate pending entries
+                # that can never complete
+            ):
+                pend = self._ckpt_pending.setdefault(epoch, {})
+                pend[tile] = arr
+                if len(pend) == len(self.layout.tile_ids):
+                    board = self._assemble(pend)
+                    del self._ckpt_pending[epoch]
+                    self.store.save(epoch, board, self.rule.rulestring())
+                    self._last_ckpt = (epoch, board)
+                    # Bounded history: prune rings no tile can ever need
+                    # again.  The floor is the *slowest* tile, not the
+                    # checkpoint epoch — a tile redeployed from an older
+                    # checkpoint may still be replaying epochs below this
+                    # checkpoint, and pruning those rings would stall its
+                    # replay forever (a race found by the node-loss test).
+                    floor = min(
+                        [epoch] + [self.tile_epochs[t] for t in self.layout.tile_ids]
+                    )
+                    self.boundary.prune_below(floor)
+            if "render" in reasons or "metrics" in reasons:
+                self.observer.observe_tile(epoch, self.layout.origin(tile), arr)
+
+    def _assemble(self, tiles: Dict[TileId, np.ndarray]) -> np.ndarray:
+        from akka_game_of_life_tpu.runtime.tiles import stitch
+
+        return stitch({self.layout.origin(t): arr for t, arr in tiles.items()})
+
+    # -- failure handling / redeployment -------------------------------------
+
+    def _on_member_lost(self, name: str) -> None:
+        member = self.membership.mark_dead(name)
+        if member is None:
+            return
+        try:
+            member.channel.close()
+        except OSError:
+            pass
+        if not self._started.is_set():
+            return
+        if self._stop.is_set() or self.done.is_set():
+            # Orderly shutdown: workers dropping now is expected, not a
+            # failure to recover from.
+            return
+        tiles = list(member.tiles)
+        member.tiles = []
+        if not tiles:
+            return
+        self.boundary.drop_pending_for_owner(tiles)
+        survivors = self.membership.alive_members()
+        if not survivors:
+            with self._lock:
+                self.error = "all backends lost"
+            self.done.set()
+            return
+        for idx, tile in enumerate(tiles):
+            self._redeploy_tile(
+                tile, preferred=survivors[idx % len(survivors)].name
+            )
+
+    def _redeploy_tile(self, tile: TileId, preferred: Optional[str] = None) -> None:
+        """Redeploy one tile from the recovery source (last checkpoint or the
+        deterministic initial board); the new owner replays forward by
+        pulling epoch-tagged halos (the ``onCellTermination`` path)."""
+        with self._lock:
+            member = self.membership.get(preferred) if preferred else None
+            if member is None or not member.alive:
+                survivors = self.membership.alive_members()
+                if not survivors:
+                    self.error = "all backends lost"
+                    self.done.set()
+                    return
+                member = survivors[0]
+            epoch, board = self._last_ckpt
+            if tile not in member.tiles:
+                member.tiles.append(tile)
+            self.tile_owner[tile] = member.name
+            # The tile restarts at the recovery epoch: record that so the
+            # ring-prune floor protects every epoch its replay will pull.
+            self.tile_epochs[tile] = epoch
+            self._send_deploy(member, [tile], board, epoch)
+
+    # -- maintenance: ticks, auto-down, fault injection ----------------------
+
+    def _maintenance_loop(self) -> None:
+        while not self._stop.is_set() and not self.done.is_set():
+            time.sleep(_MAINT_INTERVAL_S)
+            now = time.monotonic()
+            # auto-down stale members (application.conf:23 analog)
+            for m in self.membership.stale_members(now):
+                self._on_member_lost(m.name)
+            # paced epoch announcements
+            if (
+                self._started.is_set()
+                and not self.paused
+                and self.config.tick_s > 0
+                and self._next_tick is not None
+                and now >= self._next_tick
+                and self.target_epoch < self.config.max_epochs
+            ):
+                with self._lock:
+                    self.target_epoch += 1
+                    self._next_tick = now + self.config.tick_s
+                    for m in self.membership.alive_members():
+                        self._safe_send(
+                            m, {"type": P.TICK, "target": self.target_epoch}
+                        )
+            # fault injection (BoardCreator.scala:97-102 analog)
+            if (
+                self.injector is not None
+                and self._started.is_set()
+                and self.injector.should_crash(now)
+            ):
+                self._inject_crash()
+
+    def _inject_crash(self) -> None:
+        members = [m for m in self.membership.alive_members() if m.tiles]
+        if not members:
+            return
+        rng = self.injector.rng
+        victim = rng.choice(members)
+        mode = self.config.fault_injection.mode
+        if mode == "node":
+            self.crash_events.append({"mode": "node", "victim": victim.name})
+            self._safe_send(victim, {"type": P.CRASH})
+        else:
+            tile = rng.choice(victim.tiles)
+            self.crash_events.append(
+                {"mode": "tile", "victim": victim.name, "tile": tile}
+            )
+            self._safe_send(victim, {"type": P.CRASH_TILE, "tile": list(tile)})
+
+
+def run_frontend(config: SimulationConfig, *, min_backends: int = 1) -> int:
+    """CLI entry: serve the cluster until the simulation completes."""
+    fe = Frontend(config, min_backends=min_backends)
+    fe.start()
+    print(f"frontend listening on {config.host}:{fe.port}", flush=True)
+    if not fe.wait_for_backends():
+        print(
+            f"error: only {len(fe.membership.alive_members())} of "
+            f"{min_backends} backends joined within "
+            f"{config.wait_for_backends_s}s",
+            flush=True,
+        )
+        fe.stop()
+        return 1
+    try:
+        # A worker may die between quorum and deployment.
+        fe.start_simulation()
+    except RuntimeError as e:
+        print(f"error: {e}", flush=True)
+        fe.stop()
+        return 1
+    fe.done.wait()
+    fe.stop()
+    if fe.error:
+        print(f"error: {fe.error}", flush=True)
+        return 1
+    print(f"simulation complete at epoch {config.max_epochs}", flush=True)
+    return 0

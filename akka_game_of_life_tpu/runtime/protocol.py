@@ -1,0 +1,52 @@
+"""Control-plane message protocol — the reference's actor messages, as data.
+
+This is the plugin boundary SURVEY.md §2 calls out: the ``Tick``/``CellState``
+message contract between coordinator and compute backends, preserved so the
+CPU per-cell backend and the TPU stencil backend are swappable by role
+config.  Mapping to the reference protocol:
+
+==========================  ====================================================
+This protocol               Reference message (file:line)
+==========================  ====================================================
+REGISTER / WELCOME          cluster join + MemberUp (BoardCreator.scala:125-126)
+HEARTBEAT                   cluster gossip liveness (application.conf:23)
+DEPLOY                      remote CellActor deployment + NeighboursRefs
+                            (BoardCreator.scala:65-70,86-88)
+TICK                        CurrentEpochMsg broadcast (BoardCreator.scala:113-116)
+RING (push)                 a cell's state landing in History (CellActor.scala:81)
+PULL / HALO                 GetStateFromEpoch / StateForEpoch with request
+                            queueing (CellActor.scala:71-77)
+TILE_STATE                  CellStateMsg to the logger (BoardCreator.scala:159)
+CRASH / CRASH_TILE          DoCrashMsg fault injection (CellActor.scala:53-55)
+REDEPLOY_REQUEST            postRestart → SendMeMyNeighbours (CellActor.scala:21-25)
+PAUSE / RESUME              PauseSimulation/ResumeSimulation — *dead code* in
+                            the reference (BoardCreator.scala:109-112); reachable here
+SHUTDOWN                    (new) orderly termination
+GOODBYE                     graceful leave (cluster down)
+==========================  ====================================================
+
+Wire form: each message is a JSON object with a ``type`` field from the
+constants below; numpy arrays ride as base64 (see :mod:`wire`).
+"""
+
+from __future__ import annotations
+
+# backend → frontend
+REGISTER = "register"
+HEARTBEAT = "heartbeat"
+RING = "ring"
+PULL = "pull"
+TILE_STATE = "tile_state"
+REDEPLOY_REQUEST = "redeploy_request"
+GOODBYE = "goodbye"
+
+# frontend → backend
+WELCOME = "welcome"
+DEPLOY = "deploy"
+TICK = "tick"
+HALO = "halo"
+CRASH = "crash"
+CRASH_TILE = "crash_tile"
+PAUSE = "pause"
+RESUME = "resume"
+SHUTDOWN = "shutdown"

@@ -89,6 +89,7 @@ class BoardObserver:
         else:
             self.out = out if out is not None else sys.stdout
         self._partial: Dict[int, Dict[Tuple[int, int], np.ndarray]] = {}
+        self._completed_epochs: Deque[int] = deque(maxlen=256)
         self._expected_tiles: Optional[int] = None
         self._last_time: Optional[float] = None
         self._last_epoch: Optional[int] = None
@@ -137,23 +138,21 @@ class BoardObserver:
         is complete, else None."""
         if self._expected_tiles is None:
             raise RuntimeError("call expect_tiles(n) before observe_tile")
+        if epoch in self._completed_epochs:
+            # A replaying tile re-reports epochs already rendered; recreating
+            # a partial entry for them would leak (it can never complete).
+            return None
         tiles = self._partial.setdefault(epoch, {})
         tiles[tile_origin] = np.asarray(tile)
         if len(tiles) < self._expected_tiles:
             return None
         del self._partial[epoch]
-        board = self._assemble(tiles)
+        self._completed_epochs.append(epoch)
+        from akka_game_of_life_tpu.runtime.tiles import stitch
+
+        board = stitch(tiles)
         self.observe(epoch, board)
         return board
-
-    @staticmethod
-    def _assemble(tiles: Dict[Tuple[int, int], np.ndarray]) -> np.ndarray:
-        ys = sorted({o[0] for o in tiles})
-        xs = sorted({o[1] for o in tiles})
-        rows = []
-        for y in ys:
-            rows.append(np.concatenate([tiles[(y, x)] for x in xs], axis=1))
-        return np.concatenate(rows, axis=0)
 
     def close(self) -> None:
         if self._own_file is not None:

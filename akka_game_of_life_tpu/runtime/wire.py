@@ -1,0 +1,91 @@
+"""Wire format for the control plane: length-prefixed JSON frames over TCP.
+
+The reference rides Akka remoting's Netty TCP transport with Java
+serialization (``application.conf:11-17``; SURVEY.md §2 "Distributed
+communication backend").  The TPU build's control plane is deliberately
+boring: newline-delimited JSON frames, numpy arrays as base64 of raw bytes +
+shape.  All bulk data (the grids) stays on-device in HBM; only boundary rings
+and sampled frames cross this channel, so the wire format is not a
+performance surface.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+MAX_FRAME = 256 * 1024 * 1024
+
+
+def encode_array(arr: np.ndarray) -> Dict[str, Any]:
+    arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    return {
+        "__nd__": base64.b64encode(arr.tobytes()).decode("ascii"),
+        "shape": list(arr.shape),
+    }
+
+
+def decode_array(obj: Dict[str, Any]) -> np.ndarray:
+    raw = base64.b64decode(obj["__nd__"])
+    return np.frombuffer(raw, dtype=np.uint8).reshape(obj["shape"]).copy()
+
+
+def _encode(obj: Any) -> Any:
+    if isinstance(obj, np.ndarray):
+        return encode_array(obj)
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    return obj
+
+
+def _decode(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if "__nd__" in obj:
+            return decode_array(obj)
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    return obj
+
+
+class Channel:
+    """A framed, thread-compatible message channel over a socket.
+
+    ``send`` may be called from multiple threads (a lock serializes frames);
+    ``recv`` is meant for a single reader thread.  ``recv`` returns None on
+    clean EOF — connection loss is a first-class event for the membership
+    layer (the DeathWatch analog), not an exception.
+    """
+
+    def __init__(self, sock: socket.socket) -> None:
+        import threading
+
+        self.sock = sock
+        self._rfile = sock.makefile("rb")
+        self._wlock = threading.Lock()
+
+    def send(self, msg: Dict[str, Any]) -> None:
+        data = (json.dumps(_encode(msg)) + "\n").encode()
+        with self._wlock:
+            self.sock.sendall(data)
+
+    def recv(self) -> Optional[Dict[str, Any]]:
+        line = self._rfile.readline(MAX_FRAME)
+        if not line:
+            return None
+        return _decode(json.loads(line))
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()

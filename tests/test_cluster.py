@@ -1,0 +1,251 @@
+"""In-process cluster tests: frontend + N backend workers as threads.
+
+This automates the reference's manual chaos procedure ("start N backends,
+kill some, watch info.log" — README.md:3-12) as the test plan SURVEY.md §4
+prescribes: trajectory equivalence against the dense oracle, under node loss,
+tile crashes, pause/resume, and coordinator restart."""
+
+import contextlib
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.ops.npkernel import step_np
+from akka_game_of_life_tpu.runtime.backend import BackendWorker
+from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig, SimulationConfig
+from akka_game_of_life_tpu.runtime.frontend import Frontend
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import initial_board
+
+import jax.numpy as jnp
+
+DONE_TIMEOUT = 60
+
+
+def dense_oracle(board, rule, steps):
+    return np.asarray(get_model(rule).run(steps)(jnp.asarray(board)))
+
+
+class ClusterHarness:
+    def __init__(self, config, n_backends, observer=None, engine="numpy"):
+        # numpy engine keeps the suite fast; the jax path is covered by
+        # test_jax_engine_cluster
+        self.engine = engine
+        config.port = 0  # ephemeral: parallel tests must not fight over 2551
+        self.frontend = Frontend(config, min_backends=n_backends, observer=observer)
+        self.frontend.start()
+        self.workers = []
+        self.threads = []
+        for i in range(n_backends):
+            self.add_worker(f"w{i}")
+
+    def add_worker(self, name):
+        w = BackendWorker(
+            "127.0.0.1",
+            self.frontend.port,
+            name=name,
+            engine=self.engine,
+            retry_s=0.5,
+        )
+        w.crash_hook = w.stop  # in-thread "process death": drop the connection
+        w.connect()
+        t = threading.Thread(target=w.run, daemon=True, name=f"worker-{name}")
+        t.start()
+        self.workers.append(w)
+        self.threads.append(t)
+        return w
+
+    def run_to_completion(self):
+        assert self.frontend.wait_for_backends(timeout=5)
+        self.frontend.start_simulation()
+        assert self.frontend.done.wait(DONE_TIMEOUT), "cluster did not finish"
+        assert self.frontend.error is None, self.frontend.error
+        return self.frontend.final_board
+
+    def shutdown(self):
+        self.frontend.stop()
+        for w in self.workers:
+            w.stop()
+
+
+@contextlib.contextmanager
+def cluster(config, n_backends, observer=None, engine="numpy"):
+    h = ClusterHarness(config, n_backends, observer=observer, engine=engine)
+    try:
+        yield h
+    finally:
+        h.shutdown()
+
+
+def test_free_run_two_workers_matches_dense():
+    cfg = SimulationConfig(height=32, width=32, seed=11, max_epochs=25)
+    with cluster(cfg, 2) as h:
+        final = h.run_to_completion()
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 25))
+
+
+def test_four_workers_gun_and_render_assembly():
+    sink = io.StringIO()
+    cfg = SimulationConfig(
+        height=64, width=64, pattern="gosper-glider-gun", pattern_offset=(4, 4),
+        max_epochs=30, render_every=30,
+    )
+    obs = BoardObserver(render_every=30, out=sink, render_max_cells=64)
+    with cluster(cfg, 4, observer=obs) as h:
+        final = h.run_to_completion()
+    want = dense_oracle(initial_board(cfg), "conway", 30)
+    assert np.array_equal(final, want)
+    gun = np.s_[4:13, 4:40]
+    assert np.array_equal(final[gun], initial_board(cfg)[gun])  # period 30
+    assert "epoch 30" in sink.getvalue()
+
+
+def test_paced_ticks():
+    cfg = SimulationConfig(
+        height=16, width=16, seed=3, max_epochs=5, tick_s=0.05, start_delay_s=0.05
+    )
+    with cluster(cfg, 2) as h:
+        t0 = time.monotonic()
+        final = h.run_to_completion()
+        elapsed = time.monotonic() - t0
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 5))
+    assert elapsed >= 0.25  # 5 ticks x 50 ms pacing actually happened
+
+
+def test_multistate_rule_cluster():
+    rng = np.random.default_rng(8)
+    cfg = SimulationConfig(height=24, width=24, rule="brians-brain", density=0.3,
+                           seed=8, max_epochs=12)
+    with cluster(cfg, 2) as h:
+        final = h.run_to_completion()
+    want = initial_board(cfg)
+    for _ in range(12):
+        want = step_np(want, "brians-brain")
+    assert np.array_equal(final, want)
+
+
+def test_node_loss_redeploys_and_preserves_trajectory(tmp_path):
+    """Kill a worker mid-run: its tiles redeploy to the survivor, replay from
+    the checkpoint, and the final board is bit-identical to the dense run —
+    the reference's headline feature (README.md:12, BoardCreator.scala:138-154)."""
+    cfg = SimulationConfig(
+        height=48, width=48, pattern="gosper-glider-gun", pattern_offset=(2, 2),
+        max_epochs=60, tick_s=0.01, checkpoint_dir=str(tmp_path),
+        checkpoint_every=10,
+    )
+    with cluster(cfg, 2) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        # Let it make progress, then kill worker 0 abruptly.
+        deadline = time.monotonic() + 10
+        while min(h.frontend.tile_epochs.values(), default=0) < 10:
+            assert time.monotonic() < deadline, "no progress before kill"
+            time.sleep(0.01)
+        h.workers[0].stop()
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+        # exactly one member was evicted (checked before shutdown tears the
+        # rest of the cluster down)
+        assert len(h.frontend.membership.alive_members()) == 1
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 60))
+
+
+def test_tile_crash_injection_with_budget(tmp_path):
+    cfg = SimulationConfig(
+        height=32, width=32, seed=5, max_epochs=40, tick_s=0.005,
+        checkpoint_dir=str(tmp_path), checkpoint_every=10,
+        fault_injection=FaultInjectionConfig(
+            enabled=True, first_after_s=0.1, every_s=0.2, max_crashes=3, mode="tile"
+        ),
+    )
+    with cluster(cfg, 2) as h:
+        final = h.run_to_completion()
+    assert 1 <= h.frontend.injector.crashes <= 3
+    assert len(h.frontend.crash_events) == h.frontend.injector.crashes
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 40))
+
+
+def test_pause_resume():
+    cfg = SimulationConfig(height=16, width=16, seed=6, max_epochs=200)
+    with cluster(cfg, 2) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.pause()
+        h.frontend.start_simulation()
+        time.sleep(0.3)
+        # Paused: no progress (workers saw PAUSE broadcast... they joined
+        # before pause, so they hold).
+        paused_progress = dict(h.frontend.tile_epochs)
+        assert all(e <= 5 for e in paused_progress.values())
+        h.frontend.resume()
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        final = h.frontend.final_board
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 200))
+
+
+def test_frontend_restart_resumes_from_checkpoint(tmp_path):
+    """The reference's frontend is an unrecoverable SPOF (SURVEY.md §5).
+    Here a new frontend on the same checkpoint dir continues the run."""
+    cfg1 = SimulationConfig(
+        height=32, width=32, seed=12, max_epochs=20,
+        checkpoint_dir=str(tmp_path), checkpoint_every=10,
+    )
+    with cluster(cfg1, 2) as h:
+        h.run_to_completion()
+
+    cfg2 = SimulationConfig(
+        height=32, width=32, seed=12, max_epochs=50,
+        checkpoint_dir=str(tmp_path), checkpoint_every=10,
+    )
+    with cluster(cfg2, 2) as h2:
+        assert h2.frontend.wait_for_backends(timeout=5)
+        h2.frontend.start_simulation()
+        assert h2.frontend.start_epoch == 20  # resumed, not restarted
+        assert h2.frontend.done.wait(DONE_TIMEOUT)
+        final = h2.frontend.final_board
+    assert np.array_equal(final, dense_oracle(initial_board(cfg2), "conway", 50))
+
+
+def test_worker_joining_too_late_is_spare():
+    cfg = SimulationConfig(height=16, width=16, seed=7, max_epochs=10)
+    with cluster(cfg, 2) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        spare = h.add_worker("late")
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        final = h.frontend.final_board
+        # the spare holds no tiles but is a live member
+        assert spare.name in {m.name for m in h.frontend.membership.alive_members()}
+        assert not h.frontend.membership.get(spare.name).tiles
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 10))
+
+
+def test_jax_engine_cluster():
+    """The TPU-path engine (jitted step_fn_padded per tile) through the full
+    cluster protocol."""
+    cfg = SimulationConfig(height=32, width=32, seed=14, max_epochs=15)
+    with cluster(cfg, 2, engine="jax") as h:
+        final = h.run_to_completion()
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 15))
+
+
+def test_graceful_goodbye_redeploys():
+    """A worker leaving via GOODBYE (graceful down) gets its tiles redeployed
+    just like a crash, but without waiting for heartbeat timeout."""
+    cfg = SimulationConfig(height=32, width=32, seed=15, max_epochs=120, tick_s=0.005)
+    with cluster(cfg, 2) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        deadline = time.monotonic() + 10
+        while min(h.frontend.tile_epochs.values(), default=0) < 5:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        h.workers[0].stop()  # sends GOODBYE
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 120))
