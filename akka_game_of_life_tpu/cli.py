@@ -92,10 +92,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     be_p.add_argument("--name", default=None)
     be_p.add_argument(
         "--engine",
-        choices=["numpy", "jax"],
+        choices=["numpy", "jax", "actor"],
         default="jax",
         help="tile step engine: jax = jitted on local accelerator (TPU path), "
-        "numpy = host-only parity path",
+        "numpy = host-only parity path, actor = per-cell actor engine "
+        "(the reference's architecture, BASELINE config 1)",
     )
 
     args = parser.parse_args(argv)

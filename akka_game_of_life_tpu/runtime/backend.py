@@ -10,7 +10,10 @@ advanced by a stencil engine:
 - ``engine="numpy"``: host stepping, the portable/parity path;
 - ``engine="jax"``: jitted stepping on the worker's local accelerator (the
   TPU path; within a multi-device worker the tile itself is mesh-sharded by
-  :mod:`akka_game_of_life_tpu.parallel` — ICI inside, control plane outside).
+  :mod:`akka_game_of_life_tpu.parallel` — ICI inside, control plane outside);
+- ``engine="actor"``: the per-cell actor engine
+  (:mod:`akka_game_of_life_tpu.runtime.actor_engine`) — the reference's own
+  architecture, swappable at role config (BASELINE config 1).
 
 Per-epoch cycle per tile (the ``CellActor``/gatherer loop collapsed):
 PULL halo(E) → (queued at the frontend until all 8 neighbor rings at E exist)
@@ -72,6 +75,8 @@ class BackendWorker:
         retry_s: float = 1.0,
         crash_hook: Optional[Callable[[], None]] = None,
     ) -> None:
+        if engine not in ("numpy", "jax", "actor"):
+            raise ValueError(f"unknown engine {engine!r}; use numpy, jax, or actor")
         self.host = host
         self.port = port
         self.name = name
@@ -91,6 +96,7 @@ class BackendWorker:
         self.paused = False
         self.channel: Optional[Channel] = None
         self._step_padded: Optional[Callable[[np.ndarray], np.ndarray]] = None
+        self._actor_engines: Dict[TileId, object] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self.stopped_reason: Optional[str] = None
@@ -199,11 +205,11 @@ class BackendWorker:
             rule = resolve_rule(msg["rule"])
             if self.rule != rule:
                 self.rule = rule
-                self._step_padded = (
-                    _jax_engine(rule)
-                    if self.engine == "jax"
-                    else (lambda padded: step_padded_np(padded, rule))
-                )
+                if self.engine == "jax":
+                    self._step_padded = _jax_engine(rule)
+                elif self.engine == "numpy":
+                    self._step_padded = lambda padded: step_padded_np(padded, rule)
+                # engine == "actor": stateful per-tile engines, built below
             self.target = int(msg["target"])
             self.final_epoch = int(msg["final_epoch"])
             self.render_every = int(msg.get("render_every", 0))
@@ -213,6 +219,14 @@ class BackendWorker:
                 tid: TileId = tuple(spec["id"])
                 tile = _Tile(np.asarray(spec["array"]), int(spec["epoch"]))
                 self.tiles[tid] = tile
+                if self.engine == "actor":
+                    # A (re)deploy is a supervision restart: fresh actors,
+                    # histories reseeded from the deployed array.
+                    from akka_game_of_life_tpu.runtime.actor_engine import (
+                        ActorTileEngine,
+                    )
+
+                    self._actor_engines[tid] = ActorTileEngine(rule)
                 # Announce our boundary at the deployed epoch so neighbors
                 # can assemble their halos (History seeding,
                 # CellActor.scala:34).
@@ -236,7 +250,10 @@ class BackendWorker:
                 return
             halo = Halo.from_wire(msg["halo"])
             padded = halo.pad(tile.arr)
-            tile.arr = self._step_padded(padded)
+            if self.engine == "actor":
+                tile.arr = self._actor_engines[tid].step(padded)
+            else:
+                tile.arr = self._step_padded(padded)
             tile.epoch += 1
             tile.awaiting_since = None
             tile.retries = 0
@@ -252,6 +269,7 @@ class BackendWorker:
         with self._lock:
             if tid in self.tiles:
                 del self.tiles[tid]
+            self._actor_engines.pop(tid, None)
         try:
             self.channel.send({"type": P.REDEPLOY_REQUEST, "tile": list(tid)})
         except OSError:

@@ -92,6 +92,19 @@ class Simulation:
             self.epoch = ckpt.epoch
             board = ckpt.board
 
+        self._actor_board = None
+        if config.backend == "actor":
+            # The per-cell actor backend (BASELINE config 1): same Simulation
+            # surface, reference-architecture engine underneath.
+            from akka_game_of_life_tpu.runtime.actor_engine import ActorBoard
+
+            self.mesh = None
+            self._actor_board = ActorBoard(board, self.rule)
+            self._actor_epoch0 = self.epoch  # actor engine counts from 0
+            self._steppers = {}
+            self.board = board
+            return
+
         n_dev = len(jax.devices())
         self._use_mesh = config.mesh_shape is not None or n_dev > 1
         if self._use_mesh:
@@ -104,13 +117,28 @@ class Simulation:
 
     # -- device plumbing -----------------------------------------------------
 
-    def _to_device(self, board: np.ndarray) -> jax.Array:
+    def _to_device(self, board: np.ndarray):
+        if self._actor_board is not None:
+            return board
         arr = jnp.asarray(board)
         return shard_board(arr, self.mesh) if self.mesh is not None else arr
 
-    def _stepper(self, k: int) -> Callable[[jax.Array], jax.Array]:
-        """A jitted k-epoch advance (cached per k; k is usually
-        steps_per_call, plus at most one partial-chunk size per run)."""
+    def _stepper(self, k: int) -> Callable:
+        """A k-epoch advance: jitted scan (cached per k) on the tpu backend,
+        event-loop drive on the actor backend."""
+        if self._actor_board is not None:
+
+            def _actor_advance(_board):
+                target = self.epoch - self._actor_epoch0 + k
+                self._actor_board.advance_to(target)
+                # Crash recovery rebuilds a fresh ActorBoard from the durable
+                # checkpoint, never replays in place — so old history entries
+                # are dead weight; bound them (unlike the reference's
+                # forever-growing History maps, SURVEY.md §2 bug 5).
+                self._actor_board.prune_histories_below(target - 1)
+                return self._actor_board.board_at_current()
+
+            return _actor_advance
         if k not in self._steppers:
             if self.mesh is not None:
                 halo = min(self.config.halo_width, k)
@@ -172,10 +200,18 @@ class Simulation:
         ckpt = self.store.load() if self.store.latest_epoch() is not None else None
         if ckpt is None:
             self.epoch = 0
-            self.board = self._to_device(initial_board(self.config))
+            restored = initial_board(self.config)
         else:
             self.epoch = ckpt.epoch
-            self.board = self._to_device(ckpt.board)
+            restored = ckpt.board
+        if self._actor_board is not None:
+            # Fresh actors reseeded from the restored board (supervision
+            # restart at the checkpoint, not epoch 0).
+            from akka_game_of_life_tpu.runtime.actor_engine import ActorBoard
+
+            self._actor_board = ActorBoard(restored, self.rule)
+            self._actor_epoch0 = self.epoch
+        self.board = self._to_device(restored)
         while self.epoch < target:
             # Replay: recompute the lost epochs (deterministic rule ⇒ the
             # trajectory is bit-identical to the pre-crash one).  Reuses the

@@ -1,0 +1,156 @@
+"""Per-cell actor engine parity: the reference's architecture as the CPU
+backend (BASELINE config 1), validated against the dense kernels."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.ops.npkernel import step_np
+from akka_game_of_life_tpu.runtime.actor_engine import ActorBoard, ActorTileEngine
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.simulation import initial_board
+from akka_game_of_life_tpu.utils.patterns import pattern_board, random_grid
+
+
+def dense(board, rule, steps):
+    return np.asarray(get_model(rule).run(steps)(jnp.asarray(board)))
+
+
+def test_parity_config_1_conway_64x64():
+    """BASELINE config 1: Conway B3/S23 on a 64x64 torus, per-cell actors."""
+    board = random_grid((64, 64), density=0.5, seed=42)
+    ab = ActorBoard(board, "conway")
+    ab.advance_to(10)
+    assert np.array_equal(ab.board_at_current(), dense(board, "conway", 10))
+    # every cell fully caught up
+    assert ab.min_epoch() == 10
+
+
+def test_actor_glider_and_torus_wrap():
+    board = pattern_board("glider", (16, 16), (2, 2))
+    ab = ActorBoard(board, "conway")
+    ab.advance_to(64)
+    assert np.array_equal(ab.board_at_current(), board)
+
+
+def test_actor_multistate():
+    rng = np.random.default_rng(2)
+    board = rng.integers(0, 3, size=(12, 12)).astype(np.uint8)
+    ab = ActorBoard(board, "brians-brain")
+    ab.advance_to(6)
+    want = board
+    for _ in range(6):
+        want = step_np(want, "brians-brain")
+    assert np.array_equal(ab.board_at_current(), want)
+
+
+def test_message_accounting_matches_reference_shape():
+    """~19 events per cell per epoch in the reference (SURVEY.md §3.2); the
+    in-process loop books current_epoch + get_to_next + 8 gets + 8 replies +
+    set + rebroadcast = ~20.  This guards against the engine silently
+    becoming dense math."""
+    board = random_grid((8, 8), density=0.5, seed=1)
+    ab = ActorBoard(board, "conway")
+    ab.advance_to(1)
+    per_cell = ab.messages_processed / 64
+    assert 15 <= per_cell <= 25
+
+
+def test_crash_replay_from_neighbor_histories():
+    """DoCrashMsg semantics: a crashed cell resets to epoch 0 and replays to
+    the global epoch via neighbors' histories (SURVEY.md §3.3)."""
+    board = pattern_board("gosper-glider-gun", (48, 48), (2, 2))
+    ab = ActorBoard(board, "conway")
+    ab.advance_to(20)
+    want = dense(board, "conway", 20)
+    # crash a handful of cells, including one inside the gun
+    for pos in [(3, 5), (10, 10), (40, 40)]:
+        ab.crash_cell(pos)
+        assert ab.cells[pos].epoch == 20  # replayed all the way back
+    assert np.array_equal(ab.board_at_current(), want)
+    # and the future is unaffected: keep evolving after recovery
+    ab.advance_to(30)
+    assert np.array_equal(ab.board_at_current(), dense(board, "conway", 30))
+
+
+def test_queued_requests_serve_laggards():
+    """A crashed cell's neighbors queue requests for epochs it hasn't
+    recomputed yet and get flushed as the replay lands (CellActor.scala:75-88)."""
+    board = random_grid((10, 10), density=0.5, seed=9)
+    ab = ActorBoard(board, "conway")
+    ab.advance_to(5)
+    ab.crash_cell((5, 5))
+    ab.advance_to(12)
+    assert ab.min_epoch() == 12
+    assert np.array_equal(ab.board_at_current(), dense(board, "conway", 12))
+
+
+def test_bounded_history_mode():
+    board = random_grid((12, 12), density=0.4, seed=3)
+    ab = ActorBoard(board, "conway")
+    ab.advance_to(10)
+    ab.prune_histories_below(8)
+    assert all(min(c.history) >= 8 for c in ab.cells.values())
+    ab.advance_to(15)
+    assert np.array_equal(ab.board_at_current(), dense(board, "conway", 15))
+
+
+def test_tile_engine_with_ghost_halo():
+    """ActorTileEngine consumes the same padded-halo contract as the dense
+    engines: stepping a tile with wrap-halos == stepping the torus."""
+    board = random_grid((12, 12), density=0.5, seed=4)
+    eng = ActorTileEngine("conway")
+    cur = board
+    for step in range(5):
+        padded = np.pad(cur, 1, mode="wrap")
+        cur = eng.step(padded)
+    assert np.array_equal(cur, dense(board, "conway", 5))
+
+
+def test_actor_engine_in_cluster():
+    """engine='actor' through the full cluster protocol — the reference's
+    per-cell backend and the TPU stencil backend swappable by role config."""
+    from test_cluster import cluster, dense_oracle
+
+    cfg = SimulationConfig(height=16, width=16, seed=21, max_epochs=8)
+    with cluster(cfg, 2, engine="actor") as h:
+        final = h.run_to_completion()
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 8))
+
+
+def test_tiny_torus_multiplicity_matches_stencil():
+    """2x2 torus: wrapped Moore offsets repeat; counting must use
+    multiplicity like the dense kernels (all-alive Conway 2x2 dies of
+    overcrowding: 8 neighbor contributions, not 3)."""
+    board = np.ones((2, 2), dtype=np.uint8)
+    ab = ActorBoard(board, "conway")
+    ab.advance_to(1)
+    assert np.array_equal(ab.board_at_current(), dense(board, "conway", 1))
+    assert ab.board_at_current().sum() == 0
+
+
+def test_histories_bounded_in_simulation_and_tile_engine():
+    import io
+    from akka_game_of_life_tpu.runtime.render import BoardObserver
+    from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+    cfg = SimulationConfig(height=16, width=16, seed=30, backend="actor",
+                           steps_per_call=5)
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    sim.advance(40)
+    assert all(len(c.history) <= 2 for c in sim._actor_board.cells.values())
+
+    eng = ActorTileEngine("conway")
+    cur = random_grid((8, 8), seed=31)
+    for _ in range(10):
+        cur = eng.step(np.pad(cur, 1, mode="wrap"))
+    assert all(len(c.history) <= 2 for c in eng._board.cells.values())
+    assert all(len(g.history) <= 2 for g in eng._board.ghost_cells.values())
+
+
+def test_worker_rejects_unknown_engine():
+    import pytest
+    from akka_game_of_life_tpu.runtime.backend import BackendWorker
+
+    with pytest.raises(ValueError, match="unknown engine"):
+        BackendWorker("127.0.0.1", 1, engine="Actor")

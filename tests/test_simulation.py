@@ -177,3 +177,34 @@ def test_chaos_crash_recovery_preserves_gun_phase(tmp_path):
     assert len(chaotic.crash_log) == 5
     assert chaotic.epoch == clean.epoch == 120
     assert np.array_equal(chaotic.board_host(), clean.board_host())
+
+
+def test_actor_backend_standalone_matches_tpu_backend(tmp_path):
+    """backend='actor' vs backend='tpu': same Simulation surface, same
+    trajectory — the dual-backend seam (SURVEY.md §7 hard part d)."""
+    mk = lambda be: SimulationConfig(
+        height=24, width=24, seed=17, backend=be, steps_per_call=5,
+    )
+    tpu = Simulation(mk("tpu"), observer=BoardObserver(out=io.StringIO()))
+    actor = Simulation(mk("actor"), observer=BoardObserver(out=io.StringIO()))
+    tpu.advance(15)
+    actor.advance(15)
+    assert np.array_equal(tpu.board_host(), actor.board_host())
+
+
+def test_actor_backend_chaos_recovery(tmp_path):
+    from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
+
+    cfg = SimulationConfig(
+        height=24, width=24, seed=18, backend="actor", steps_per_call=5,
+        checkpoint_dir=str(tmp_path), checkpoint_every=10,
+        fault_injection=FaultInjectionConfig(enabled=True, first_after_s=0.0,
+                                             every_s=0.0, max_crashes=2),
+    )
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    sim.advance(30)
+    clean = SimulationConfig(height=24, width=24, seed=18)
+    ref = Simulation(clean, observer=BoardObserver(out=io.StringIO()))
+    ref.advance(30)
+    assert sim.injector.crashes == 2
+    assert np.array_equal(sim.board_host(), ref.board_host())
