@@ -1,0 +1,94 @@
+"""Sharded bit-packed stepping: the 65536²-class multi-chip configuration.
+
+The packed grid (H, W/32) is partitioned by *rows* over a 1-D device ring —
+words stay whole, so the halo is k packed rows per direction per exchange,
+moved with a single ``ppermute`` ring shift each way over ICI.  Horizontal
+(cross-word, cross-torus) bit carries stay entirely local because every
+shard holds full rows.  A k-row halo buys k local steps per exchange, the
+same communication-avoiding trade as the dense path
+(:mod:`akka_game_of_life_tpu.parallel.halo`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from akka_game_of_life_tpu.ops.bitpack import step_planes
+from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
+
+SHARD_AXIS = "shard"
+PACKED_SPEC = PartitionSpec(SHARD_AXIS, None)
+
+
+def make_row_mesh(n_devices: int = None, devices: Sequence[jax.Device] = None) -> Mesh:
+    """A 1-D mesh over which packed rows are ring-sharded."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return jax.make_mesh((len(devices),), (SHARD_AXIS,), devices=devices)
+
+
+def _step_row_padded(padded: jax.Array, rule: Rule) -> jax.Array:
+    """(h+2, words) with 1-row halos → (h, words)."""
+    return step_planes(padded[1:-1], padded[:-2], padded[2:], rule)
+
+
+def sharded_packed_step_fn(
+    mesh: Mesh,
+    rule,
+    *,
+    steps_per_call: int = 1,
+    halo_width: int = 1,
+) -> Callable[[jax.Array], jax.Array]:
+    """A jitted multi-step advance of a row-sharded packed board."""
+    rule = resolve_rule(rule)
+    if not rule.is_binary:
+        raise ValueError("bit-packed kernel supports binary rules only")
+    if steps_per_call % halo_width:
+        raise ValueError("steps_per_call must be a multiple of halo_width")
+    n_shards = mesh.shape[SHARD_AXIS]
+    n_exchanges = steps_per_call // halo_width
+    fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    bwd = [(i, (i - 1) % n_shards) for i in range(n_shards)]
+
+    def local(tile: jax.Array) -> jax.Array:
+        k = halo_width
+        if tile.shape[0] < k:
+            raise ValueError(
+                f"per-shard tile has {tile.shape[0]} rows < halo width {k}; "
+                f"use fewer shards or a smaller halo"
+            )
+
+        def body(t, _):
+            # Exchange k halo rows each way, then take k local steps on the
+            # shrinking slab: (h+2k) → (h) rows (the dense path's scheme).
+            top = jax.lax.ppermute(t[-k:], SHARD_AXIS, fwd)
+            bottom = jax.lax.ppermute(t[:k], SHARD_AXIS, bwd)
+            padded = jnp.concatenate([top, t, bottom], axis=0)
+            for _ in range(k):
+                padded = _step_row_padded(padded, rule)
+            return padded, None
+
+        out, _ = jax.lax.scan(body, tile, None, length=n_exchanges)
+        return out
+
+    mapped = jax.shard_map(local, mesh=mesh, in_specs=PACKED_SPEC, out_specs=PACKED_SPEC)
+    sharding = NamedSharding(mesh, PACKED_SPEC)
+    return jax.jit(mapped, in_shardings=sharding, out_shardings=sharding)
+
+
+def shard_packed(packed: jax.Array, mesh: Mesh) -> jax.Array:
+    h = packed.shape[0]
+    n = mesh.shape[SHARD_AXIS]
+    if h % n:
+        raise ValueError(f"{h} rows not divisible by {n} shards")
+    return jax.device_put(packed, NamedSharding(mesh, PACKED_SPEC))

@@ -1,0 +1,64 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.ops import bitpack
+from akka_game_of_life_tpu.ops.rules import BRIANS_BRAIN
+from akka_game_of_life_tpu.utils.patterns import pattern_board, random_grid
+
+
+def test_pack_unpack_roundtrip():
+    g = random_grid((16, 64), density=0.5, seed=1)
+    packed = bitpack.pack(g)
+    assert packed.shape == (16, 2)
+    assert np.array_equal(np.asarray(bitpack.unpack(packed)), g)
+
+
+def test_pack_rejects_ragged_width():
+    with pytest.raises(ValueError):
+        bitpack.pack(np.zeros((4, 33), np.uint8))
+
+
+def test_pack_np_matches_jax():
+    g = random_grid((8, 96), density=0.4, seed=2)
+    assert np.array_equal(bitpack.pack_np(g), np.asarray(bitpack.pack(g)))
+
+
+@pytest.mark.parametrize("rule", ["conway", "highlife", "day-and-night", "seeds"])
+def test_packed_step_equals_dense(rule):
+    g = random_grid((32, 96), density=0.45, seed=3)
+    packed = bitpack.packed_step_fn(
+        __import__("akka_game_of_life_tpu.ops.rules", fromlist=["resolve_rule"]).resolve_rule(rule)
+    )(bitpack.pack(g))
+    got = np.asarray(bitpack.unpack(packed))
+    want = np.asarray(get_model(rule).step(jnp.asarray(g)))
+    assert np.array_equal(got, want), rule
+
+
+def test_packed_multi_step_glider_crosses_words_and_torus():
+    """The glider must cross uint32 word boundaries and wrap the torus —
+    exercising the cross-word and cross-edge bit carries."""
+    g = pattern_board("glider", (32, 64), (2, 28))  # straddles word boundary
+    run = bitpack.packed_multi_step_fn(
+        __import__("akka_game_of_life_tpu.ops.rules", fromlist=["CONWAY"]).CONWAY, 128
+    )
+    out = np.asarray(bitpack.unpack(run(bitpack.pack(g))))
+    want = np.asarray(get_model("conway").run(128)(jnp.asarray(g)))
+    assert np.array_equal(out, want)
+    assert out.sum() == 5  # still exactly one glider
+
+
+def test_packed_gun_period_30():
+    g = pattern_board("gosper-glider-gun", (64, 96), (4, 4))
+    run = bitpack.packed_multi_step_fn(
+        __import__("akka_game_of_life_tpu.ops.rules", fromlist=["CONWAY"]).CONWAY, 30
+    )
+    out = np.asarray(bitpack.unpack(run(bitpack.pack(g))))
+    gun = np.s_[4:13, 4:40]
+    assert np.array_equal(out[gun], g[gun])
+
+
+def test_packed_rejects_generations():
+    with pytest.raises(ValueError):
+        bitpack.step_packed(bitpack.pack(np.zeros((4, 32), np.uint8)), BRIANS_BRAIN)

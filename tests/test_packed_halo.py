@@ -1,0 +1,76 @@
+"""Row-sharded packed stepping ≡ dense single-device (config 5 validation)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.ops import bitpack
+from akka_game_of_life_tpu.parallel.packed_halo import (
+    make_row_mesh,
+    shard_packed,
+    sharded_packed_step_fn,
+)
+from akka_game_of_life_tpu.utils.patterns import pattern_board, random_grid
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh"
+)
+
+
+def dense(board, rule, steps):
+    return np.asarray(get_model(rule).run(steps)(jnp.asarray(board)))
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4, 8])
+def test_sharded_packed_equals_dense(n_shards):
+    board = random_grid((32, 64), density=0.45, seed=20)
+    mesh = make_row_mesh(n_shards)
+    step = sharded_packed_step_fn(mesh, "conway", steps_per_call=6)
+    x = shard_packed(bitpack.pack(jnp.asarray(board)), mesh)
+    got = np.asarray(bitpack.unpack(step(x)))
+    assert np.array_equal(got, dense(board, "conway", 6)), n_shards
+
+
+@pytest.mark.parametrize("halo_width", [1, 2, 4])
+def test_wide_row_halo(halo_width):
+    board = random_grid((64, 64), density=0.4, seed=21)
+    mesh = make_row_mesh(8)
+    step = sharded_packed_step_fn(
+        mesh, "highlife", steps_per_call=8, halo_width=halo_width
+    )
+    x = shard_packed(bitpack.pack(jnp.asarray(board)), mesh)
+    got = np.asarray(bitpack.unpack(step(x)))
+    assert np.array_equal(got, dense(board, "highlife", 8)), halo_width
+
+
+def test_gun_on_sharded_packed():
+    board = pattern_board("gosper-glider-gun", (64, 64), (4, 4))
+    mesh = make_row_mesh(8)
+    step = sharded_packed_step_fn(mesh, "conway", steps_per_call=30, halo_width=2)
+    x = shard_packed(bitpack.pack(jnp.asarray(board)), mesh)
+    out = np.asarray(bitpack.unpack(step(x)))
+    gun = np.s_[4:13, 4:40]
+    assert np.array_equal(out[gun], board[gun])
+    assert out.sum() > board.sum()
+
+
+def test_validation():
+    mesh = make_row_mesh(8)
+    with pytest.raises(ValueError):
+        sharded_packed_step_fn(mesh, "brians-brain")
+    with pytest.raises(ValueError):
+        sharded_packed_step_fn(mesh, "conway", steps_per_call=3, halo_width=2)
+    with pytest.raises(ValueError):
+        shard_packed(bitpack.pack(np.zeros((12, 32), np.uint8)), mesh)
+
+
+def test_mesh_rejects_overask_and_tiny_tiles():
+    with pytest.raises(ValueError, match="only"):
+        make_row_mesh(99)
+    mesh = make_row_mesh(8)
+    step = sharded_packed_step_fn(mesh, "conway", steps_per_call=4, halo_width=4)
+    board = random_grid((16, 32), seed=5)  # 2 rows/shard < halo 4
+    with pytest.raises(ValueError, match="halo width"):
+        step(shard_packed(bitpack.pack(jnp.asarray(board)), mesh))
