@@ -30,23 +30,31 @@ _U = jnp.uint32
 
 
 def pack(grid) -> jax.Array:
-    """(H, W) 0/1 uint8 → (H, W/32) uint32, LSB-first."""
-    grid = jnp.asarray(grid, dtype=jnp.uint32)
+    """(H, W) 0/1 uint8 → (H, W/32) uint32, LSB-first.
+
+    Stays in uint8 until a final word-level bitcast so the peak intermediate
+    is 1 byte/cell — a 65536² board packs within ~4 GiB of scratch instead of
+    the 17 GiB a uint32 (H, W/32, 32) lane tensor would need.
+    """
+    grid = jnp.asarray(grid, dtype=jnp.uint8)
     h, w = grid.shape
     if w % LANE_BITS:
         raise ValueError(f"width {w} not a multiple of {LANE_BITS}")
-    lanes = grid.reshape(h, w // LANE_BITS, LANE_BITS)
-    weights = (jnp.uint32(1) << jnp.arange(LANE_BITS, dtype=jnp.uint32))
-    return (lanes * weights).sum(axis=-1, dtype=jnp.uint32)
+    packed_bytes = jnp.packbits(grid, axis=-1, bitorder="little")
+    # (H, W/8) LSB-first bytes → uint32 words (TPU/x86 are little-endian, so
+    # byte 0 of the word is bits 0-7 — matching the LSB-first cell layout).
+    return jax.lax.bitcast_convert_type(
+        packed_bytes.reshape(h, w // LANE_BITS, LANE_BITS // 8), jnp.uint32
+    )
 
 
 def unpack(packed: jax.Array) -> jax.Array:
-    """(H, W/32) uint32 → (H, W) uint8."""
+    """(H, W/32) uint32 → (H, W) uint8.  1 byte/cell peak (see ``pack``)."""
     h, words = packed.shape
-    bits = (
-        packed[:, :, None] >> jnp.arange(LANE_BITS, dtype=jnp.uint32)[None, None, :]
-    ) & jnp.uint32(1)
-    return bits.reshape(h, words * LANE_BITS).astype(jnp.uint8)
+    packed_bytes = jax.lax.bitcast_convert_type(packed, jnp.uint8)  # (H, W/32, 4)
+    return jnp.unpackbits(
+        packed_bytes.reshape(h, words * (LANE_BITS // 8)), axis=-1, bitorder="little"
+    )
 
 
 def _hshift_west(x: jax.Array) -> jax.Array:

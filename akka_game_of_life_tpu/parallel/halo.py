@@ -39,11 +39,13 @@ from akka_game_of_life_tpu.parallel.mesh import (
 )
 
 
-def _shift(x: jax.Array, axis_name: str, direction: int) -> jax.Array:
+def ring_shift(x: jax.Array, axis_name: str, direction: int) -> jax.Array:
     """Cyclically send ``x`` to the next device along ``axis_name``.
 
     direction=+1 sends to the higher-indexed neighbor (so each device
-    *receives* from the lower-indexed one), and vice versa.
+    *receives* from the lower-indexed one), and vice versa.  Must be called
+    inside ``shard_map``; shared by the dense 2-D halo exchange here and the
+    packed row-ring exchange (:mod:`..parallel.packed_halo`).
     """
     n = jax.lax.axis_size(axis_name)
     perm = [(i, (i + direction) % n) for i in range(n)]
@@ -57,12 +59,12 @@ def exchange_halo(tile: jax.Array, width: int = 1) -> jax.Array:
     """
     k = width
     # Phase 1 — rows. My top halo is the bottom k rows of the tile above me.
-    top = _shift(tile[-k:, :], ROW_AXIS, +1)
-    bottom = _shift(tile[:k, :], ROW_AXIS, -1)
+    top = ring_shift(tile[-k:, :], ROW_AXIS, +1)
+    bottom = ring_shift(tile[:k, :], ROW_AXIS, -1)
     padded = jnp.concatenate([top, tile, bottom], axis=0)
     # Phase 2 — columns of the row-padded tile: corners ride along.
-    left = _shift(padded[:, -k:], COL_AXIS, +1)
-    right = _shift(padded[:, :k], COL_AXIS, -1)
+    left = ring_shift(padded[:, -k:], COL_AXIS, +1)
+    right = ring_shift(padded[:, :k], COL_AXIS, -1)
     return jnp.concatenate([left, padded, right], axis=1)
 
 

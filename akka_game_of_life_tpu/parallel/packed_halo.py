@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from akka_game_of_life_tpu.ops.bitpack import step_planes
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
+from akka_game_of_life_tpu.parallel.halo import ring_shift
 
 SHARD_AXIS = "shard"
 PACKED_SPEC = PartitionSpec(SHARD_AXIS, None)
@@ -54,11 +55,11 @@ def sharded_packed_step_fn(
     if not rule.is_binary:
         raise ValueError("bit-packed kernel supports binary rules only")
     if steps_per_call % halo_width:
-        raise ValueError("steps_per_call must be a multiple of halo_width")
-    n_shards = mesh.shape[SHARD_AXIS]
+        raise ValueError(
+            f"steps_per_call={steps_per_call} must be a multiple of "
+            f"halo_width={halo_width}"
+        )
     n_exchanges = steps_per_call // halo_width
-    fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-    bwd = [(i, (i - 1) % n_shards) for i in range(n_shards)]
 
     def local(tile: jax.Array) -> jax.Array:
         k = halo_width
@@ -71,8 +72,8 @@ def sharded_packed_step_fn(
         def body(t, _):
             # Exchange k halo rows each way, then take k local steps on the
             # shrinking slab: (h+2k) → (h) rows (the dense path's scheme).
-            top = jax.lax.ppermute(t[-k:], SHARD_AXIS, fwd)
-            bottom = jax.lax.ppermute(t[:k], SHARD_AXIS, bwd)
+            top = ring_shift(t[-k:], SHARD_AXIS, +1)
+            bottom = ring_shift(t[:k], SHARD_AXIS, -1)
             padded = jnp.concatenate([top, t, bottom], axis=0)
             for _ in range(k):
                 padded = _step_row_padded(padded, rule)

@@ -4,7 +4,7 @@ import pytest
 
 from akka_game_of_life_tpu.models import get_model
 from akka_game_of_life_tpu.ops import bitpack
-from akka_game_of_life_tpu.ops.rules import BRIANS_BRAIN
+from akka_game_of_life_tpu.ops.rules import BRIANS_BRAIN, CONWAY, resolve_rule
 from akka_game_of_life_tpu.utils.patterns import pattern_board, random_grid
 
 
@@ -29,7 +29,7 @@ def test_pack_np_matches_jax():
 def test_packed_step_equals_dense(rule):
     g = random_grid((32, 96), density=0.45, seed=3)
     packed = bitpack.packed_step_fn(
-        __import__("akka_game_of_life_tpu.ops.rules", fromlist=["resolve_rule"]).resolve_rule(rule)
+        resolve_rule(rule)
     )(bitpack.pack(g))
     got = np.asarray(bitpack.unpack(packed))
     want = np.asarray(get_model(rule).step(jnp.asarray(g)))
@@ -41,7 +41,7 @@ def test_packed_multi_step_glider_crosses_words_and_torus():
     exercising the cross-word and cross-edge bit carries."""
     g = pattern_board("glider", (32, 64), (2, 28))  # straddles word boundary
     run = bitpack.packed_multi_step_fn(
-        __import__("akka_game_of_life_tpu.ops.rules", fromlist=["CONWAY"]).CONWAY, 128
+        CONWAY, 128
     )
     out = np.asarray(bitpack.unpack(run(bitpack.pack(g))))
     want = np.asarray(get_model("conway").run(128)(jnp.asarray(g)))
@@ -52,7 +52,7 @@ def test_packed_multi_step_glider_crosses_words_and_torus():
 def test_packed_gun_period_30():
     g = pattern_board("gosper-glider-gun", (64, 96), (4, 4))
     run = bitpack.packed_multi_step_fn(
-        __import__("akka_game_of_life_tpu.ops.rules", fromlist=["CONWAY"]).CONWAY, 30
+        CONWAY, 30
     )
     out = np.asarray(bitpack.unpack(run(bitpack.pack(g))))
     gun = np.s_[4:13, 4:40]

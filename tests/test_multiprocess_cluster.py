@@ -1,0 +1,165 @@
+"""True multi-process cluster chaos test: real OS processes, real TCP, kill -9.
+
+The in-process tests in test_cluster.py exercise the same protocol with
+worker threads; this one automates the reference's *actual* manual procedure
+("start N backend JVMs, ctrl+c one, watch it survive" — ``README.md:3-12``,
+``README.md:12``) end to end: spawn a frontend and two backend workers as
+separate Python processes talking over localhost TCP, SIGKILL one backend
+mid-run, and assert the frontend redeploys its tiles and finishes with a
+final checkpoint that matches the dense single-process oracle.
+
+Child processes run on plain CPU JAX: the image's sitecustomize registers the
+axon TPU plugin only when ``PALLAS_AXON_POOL_IPS`` is set, so the spawn env
+drops that variable and pins ``JAX_PLATFORMS=cpu`` (one real TPU chip cannot
+be shared by three processes anyway).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+DEADLINE = 120
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep sitecustomize from pinning axon
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn(args, logfile, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "akka_game_of_life_tpu", *args],
+        stdout=logfile,
+        stderr=subprocess.STDOUT,
+        env=env,
+        cwd=str(REPO),
+    )
+
+
+def _wait_for(predicate, what, timeout=DEADLINE):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.1)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def _listening_port(path: Path) -> int:
+    def probe():
+        if not path.exists():
+            return None
+        for line in path.read_text().splitlines():
+            if line.startswith("frontend listening on "):
+                return int(line.rsplit(":", 1)[1])
+        return None
+
+    return _wait_for(probe, "frontend to listen")
+
+
+@pytest.mark.slow
+def test_kill9_backend_process_redeploys_and_matches_oracle(tmp_path):
+    from akka_game_of_life_tpu.models import get_model
+    from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+    from akka_game_of_life_tpu.runtime.config import load_config
+    from akka_game_of_life_tpu.runtime.simulation import initial_board
+
+    import jax.numpy as jnp
+
+    max_epochs = 120
+    ckpt_dir = tmp_path / "ck"
+    sim_args = [
+        "--pattern",
+        "gosper-glider-gun",
+        "--height",
+        "48",
+        "--width",
+        "48",
+        "--max-epochs",
+        str(max_epochs),
+        "--tick",
+        "20ms",
+        "--checkpoint-dir",
+        str(ckpt_dir),
+        "--checkpoint-every",
+        "20",
+    ]
+    env = _child_env()
+    fe_log = tmp_path / "frontend.log"
+    procs = []
+    try:
+        with open(fe_log, "w") as f:
+            fe = _spawn(
+                ["frontend", "--port", "0", "--min-backends", "2",
+                 "--wait-for-backends", "90s", *sim_args],
+                f,
+                env,
+            )
+        procs.append(fe)
+        port = _listening_port(fe_log)
+
+        be_logs = {}
+        backends = {}
+        for name in ("alpha", "beta"):
+            log = tmp_path / f"{name}.log"
+            be_logs[name] = log
+            with open(log, "w") as f:
+                backends[name] = _spawn(
+                    ["backend", "--port", str(port), "--name", name,
+                     "--engine", "numpy"],
+                    f,
+                    env,
+                )
+            procs.append(backends[name])
+
+        for name, log in be_logs.items():
+            _wait_for(
+                lambda log=log: log.exists() and "joined" in log.read_text(),
+                f"backend {name} to join",
+            )
+
+        # Let the run get past the first durable checkpoint, then kill -9 a
+        # worker mid-flight — the reference's ctrl+c, without the courtesy.
+        _wait_for(lambda: list(ckpt_dir.glob("ckpt_*.npz")), "first checkpoint")
+        backends["beta"].send_signal(signal.SIGKILL)
+
+        _wait_for(lambda: fe.poll() is not None, "frontend to finish")
+        out = fe_log.read_text()
+        assert fe.returncode == 0, out
+        assert f"simulation complete at epoch {max_epochs}" in out
+
+        # The survivor finished the job; the final checkpoint must equal the
+        # dense oracle — glider-gun phase preserved across the kill.
+        cfg = load_config(
+            None,
+            {
+                "pattern": "gosper-glider-gun",
+                "height": 48,
+                "width": 48,
+                "max_epochs": max_epochs,
+            },
+        )
+        store = CheckpointStore(str(ckpt_dir))
+        assert store.latest_epoch() == max_epochs
+        ckpt = store.load()
+        oracle = np.asarray(
+            get_model("conway").run(max_epochs)(jnp.asarray(initial_board(cfg)))
+        )
+        np.testing.assert_array_equal(ckpt.board, oracle)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=10)
