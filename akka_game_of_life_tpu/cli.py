@@ -19,8 +19,37 @@ from typing import Optional, Sequence
 from akka_game_of_life_tpu.runtime.config import load_config, parse_duration
 
 
+def _apply_platform(platform: Optional[str]) -> None:
+    """Pin the JAX platform before anything touches devices.
+
+    ``--platform cpu`` (or ``GOL_PLATFORM=cpu``) is the supported way to run
+    on the host: plugin registrations done at interpreter boot (e.g. a TPU
+    PJRT plugin in sitecustomize) can force ``jax_platforms``, so an env var
+    alone is not honored — the config must be updated after jax imports but
+    before first backend init.
+    """
+    import os
+
+    platform = platform or os.environ.get("GOL_PLATFORM")
+    if platform and platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
+def _add_platform(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--platform",
+        default=None,
+        metavar="NAME",
+        help="JAX platform to pin (e.g. cpu, tpu, or a PJRT plugin name; "
+        "default: auto-detect; GOL_PLATFORM env var is the fallback)",
+    )
+
+
 def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--config", help="TOML or JSON config file")
+    _add_platform(p)
     p.add_argument("--rule", help="rule name or rulestring (B3/S23, /2/3, ...)")
     p.add_argument("--height", type=int)
     p.add_argument("--width", type=int)
@@ -90,6 +119,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     be_p.add_argument("--port", type=int, default=2551, help="frontend port to join")
     be_p.add_argument("--host", default="127.0.0.1")
     be_p.add_argument("--name", default=None)
+    _add_platform(be_p)
     be_p.add_argument(
         "--engine",
         choices=["numpy", "jax", "actor"],
@@ -100,6 +130,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
 
     args = parser.parse_args(argv)
+    _apply_platform(getattr(args, "platform", None))
 
     if args.command == "run":
         cfg = load_config(args.config, _overrides(args))

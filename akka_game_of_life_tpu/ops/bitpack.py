@@ -69,56 +69,39 @@ def _hshift_east(x: jax.Array) -> jax.Array:
     return (x >> 1) | (next_word << (LANE_BITS - 1))
 
 
-def _popcount_planes(planes):
-    """Sum eight 1-bit planes into 4 bit-plane count bits (b3..b0) with
-    carry-save adders — ~30 bitwise ops, no integer adds."""
-    a0, a1, a2, a3, a4, a5, a6, a7 = planes
-    # stage 1: pairwise half-adders (weight-1 sums, weight-2 carries)
-    s0, c0 = a0 ^ a1, a0 & a1
-    s1, c1 = a2 ^ a3, a2 & a3
-    s2, c2 = a4 ^ a5, a4 & a5
-    s3, c3 = a6 ^ a7, a6 & a7
-    # weight-1: s0+s1+s2+s3
-    t0, u0 = s0 ^ s1, s0 & s1
-    t1, u1 = s2 ^ s3, s2 & s3
-    b0 = t0 ^ t1
-    v0 = t0 & t1
-    # weight-2 inputs: c0..c3, u0, u1, v0  (7 values)
-    p0, q0 = c0 ^ c1, c0 & c1
-    p1, q1 = c2 ^ c3, c2 & c3
-    w0 = u0 ^ u1 ^ v0
-    w1 = (u0 & u1) | (u0 & v0) | (u1 & v0)  # weight-4 carry
-    r0, r1 = p0 ^ p1, p0 & p1
-    b1 = r0 ^ w0
-    r2 = r0 & w0
-    # weight-4 inputs: q0, q1, r1, r2, w1  (5 values)
-    e0, f0 = q0 ^ q1, q0 & q1
-    e1, f1 = r1 ^ r2, r1 & r2
-    g0 = e0 ^ e1
-    g1 = e0 & e1
-    b2 = g0 ^ w1
-    g2 = g0 & w1
-    # weight-8: f0, f1, g1, g2 — at most one can be set (count <= 8)
-    b3 = f0 | f1 | g1 | g2
-    return b3, b2, b1, b0
+def _row_triple_sum(x: jax.Array):
+    """Per-row horizontal 3-cell sums *including the center cell*.
+
+    Returns bit planes ``(s, c)`` with per-bit count ``west+center+east =
+    s + 2c`` (a full adder).  Computed ONCE per row and reused as the
+    north/center/south contribution of three different output rows — the
+    classic shared-row-sum Life optimization that nearly halves the VPU op
+    count versus summing eight neighbor planes per output row.
+    """
+    w = _hshift_west(x)
+    e = _hshift_east(x)
+    xw = x ^ w
+    return xw ^ e, (x & w) | (e & xw)
 
 
-def step_planes(x: jax.Array, north: jax.Array, south: jax.Array, rule: Rule) -> jax.Array:
-    """One packed step given explicit north/south row planes (same-shape
-    vertical shifts of ``x``); horizontal carries are handled internally via
-    word rolls.  Shared by the toroidal single-device step (planes = row
-    rolls) and the row-sharded step (planes = halo slices)."""
-    planes = (
-        _hshift_west(north),
-        north,
-        _hshift_east(north),
-        _hshift_west(x),
-        _hshift_east(x),
-        _hshift_west(south),
-        south,
-        _hshift_east(south),
-    )
-    b3, b2, b1, b0 = _popcount_planes(planes)
+def _combine_rows(x, sN, cN, sC, cC, sS, cS, rule: Rule) -> jax.Array:
+    """Next state from three rows' (s, c) triple-sum planes.
+
+    ``count = (sN+sC+sS) + 2*(cN+cC+cS)`` is the 9-cell Moore sum including
+    the center, range 0..9 in bits b3..b0.  Because the center is included,
+    survive thresholds shift by +1: for B/S rule, next =
+    (~x & [count ∈ B]) | (x & [count-1 ∈ S]).
+    """
+    sNC = sN ^ sC
+    b0 = sNC ^ sS  # weight-1 sum bit
+    p1 = (sN & sC) | (sS & sNC)  # weight-2 carry of the s's
+    cNC = cN ^ cC
+    q0 = cNC ^ cS  # weight-2 sum of the c's
+    q1 = (cN & cC) | (cS & cNC)  # weight-4 carry of the c's
+    b1 = p1 ^ q0
+    r2 = p1 & q0
+    b2 = q1 ^ r2
+    b3 = q1 & r2
     nb3, nb2, nb1, nb0 = ~b3, ~b2, ~b1, ~b0
 
     def eq(n: int) -> jax.Array:
@@ -132,8 +115,20 @@ def step_planes(x: jax.Array, north: jax.Array, south: jax.Array, rule: Rule) ->
         birth = birth | eq(n)
     survive = jnp.uint32(0)
     for n in rule.survive:
-        survive = survive | eq(n)
+        survive = survive | eq(n + 1)  # +1: count includes the live center
     return (~x & birth) | (x & survive)
+
+
+def step_padded_rows(padded: jax.Array, rule) -> jax.Array:
+    """One packed step on a row-padded slab: (h+2, words) with one halo row
+    top and bottom → (h, words).  Row sums are computed once per slab row and
+    shared across the three output rows each feeds (see
+    :func:`_row_triple_sum`).  Used by the row-sharded halo path."""
+    rule = resolve_rule(rule)
+    s, c = _row_triple_sum(padded)
+    return _combine_rows(
+        padded[1:-1], s[:-2], c[:-2], s[1:-1], c[1:-1], s[2:], c[2:], rule
+    )
 
 
 def step_packed(x: jax.Array, rule) -> jax.Array:
@@ -141,7 +136,17 @@ def step_packed(x: jax.Array, rule) -> jax.Array:
     rule = resolve_rule(rule)
     if not rule.is_binary:
         raise ValueError("bit-packed kernel supports binary rules only")
-    return step_planes(x, jnp.roll(x, 1, axis=0), jnp.roll(x, -1, axis=0), rule)
+    s, c = _row_triple_sum(x)
+    return _combine_rows(
+        x,
+        jnp.roll(s, 1, axis=0),
+        jnp.roll(c, 1, axis=0),
+        s,
+        c,
+        jnp.roll(s, -1, axis=0),
+        jnp.roll(c, -1, axis=0),
+        rule,
+    )
 
 
 @functools.lru_cache(maxsize=None)

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from akka_game_of_life_tpu.ops.bitpack import step_planes
+from akka_game_of_life_tpu.ops.bitpack import step_padded_rows
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 from akka_game_of_life_tpu.parallel.halo import ring_shift
 
@@ -40,7 +40,7 @@ def make_row_mesh(n_devices: int = None, devices: Sequence[jax.Device] = None) -
 
 def _step_row_padded(padded: jax.Array, rule: Rule) -> jax.Array:
     """(h+2, words) with 1-row halos → (h, words)."""
-    return step_planes(padded[1:-1], padded[:-2], padded[2:], rule)
+    return step_padded_rows(padded, rule)
 
 
 def sharded_packed_step_fn(

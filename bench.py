@@ -29,9 +29,16 @@ PER_CHIP_TARGET = 1.0e11 / 8  # north-star aggregate spread over v5e-8 chips
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--size", type=int, default=65536)
-    parser.add_argument("--kernel", choices=["bitpack", "roll"], default="bitpack")
+    parser.add_argument(
+        "--kernel", choices=["bitpack", "pallas", "roll"], default="bitpack"
+    )
     parser.add_argument("--steps-per-call", type=int, default=64)
     parser.add_argument("--timed-calls", type=int, default=2)
+    parser.add_argument("--block-rows", type=int, default=256)
+    parser.add_argument(
+        "--steps-per-sweep", type=int, default=None,
+        help="pallas temporal-block depth (default: auto-pick a divisor)",
+    )
     args = parser.parse_args()
 
     from akka_game_of_life_tpu.models import get_model
@@ -41,14 +48,24 @@ def main() -> None:
     n = args.size
     # NOTE: on this TPU platform block_until_ready does not actually block,
     # so every timing ends with a host fetch of a scalar to force sync.
-    if args.kernel == "bitpack":
+    if args.kernel in ("bitpack", "pallas"):
         if n % 32:
-            parser.error(f"--size {n} must be a multiple of 32 for --kernel bitpack")
+            parser.error(f"--size {n} must be a multiple of 32 for --kernel {args.kernel}")
         rng = np.random.default_rng(0)
         board = jnp.asarray(
             rng.integers(0, 2**32, size=(n, n // 32), dtype=np.uint32)
         )
-        run = bitpack.packed_multi_step_fn(CONWAY, args.steps_per_call)
+        if args.kernel == "pallas":
+            from akka_game_of_life_tpu.ops import pallas_stencil
+
+            run = pallas_stencil.packed_multi_step_fn(
+                CONWAY,
+                args.steps_per_call,
+                block_rows=args.block_rows,
+                steps_per_sweep=args.steps_per_sweep,
+            )
+        else:
+            run = bitpack.packed_multi_step_fn(CONWAY, args.steps_per_call)
         population = lambda x: int(jnp.sum(jnp.bitwise_count(x)))
     else:
         from akka_game_of_life_tpu.utils.patterns import random_grid
