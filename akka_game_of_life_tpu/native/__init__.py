@@ -1,0 +1,91 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's runtime is JVM-native (Akka on Netty); this package plays the
+same role for the host-side half of the framework: the per-cell actor engine
+(the CPU parity backend, BASELINE config 1) compiled to machine code.  The
+TPU compute path stays JAX/XLA/Pallas — native code here is for the parts
+that run on the host CPU.
+
+Build model: no pip, no pybind11 — a single translation unit compiled on
+demand with ``g++ -O2 -shared -fPIC`` into a content-addressed ``.so`` next
+to the source, loaded with ctypes.  ``load()`` returns None (and the callers
+fall back to the pure-Python engine) when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "actor_engine.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed: Optional[str] = None
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ae_create.restype = ctypes.c_void_p
+    lib.ae_create.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, u8p,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.ae_destroy.argtypes = [ctypes.c_void_p]
+    lib.ae_advance_to.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ae_crash_cell.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+    lib.ae_feed_halo.argtypes = [ctypes.c_void_p, ctypes.c_int32, u8p]
+    lib.ae_get_board.argtypes = [ctypes.c_void_p, u8p]
+    lib.ae_min_epoch.restype = ctypes.c_int32
+    lib.ae_min_epoch.argtypes = [ctypes.c_void_p]
+    lib.ae_messages.restype = ctypes.c_int64
+    lib.ae_messages.argtypes = [ctypes.c_void_p]
+    lib.ae_prune_below.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Compile (once per source revision) and load the native engine.
+
+    Returns None when unavailable (no g++ / build error); the reason is kept
+    in :func:`load_error` so callers can surface it.
+    """
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed is not None:
+            return None
+        try:
+            with open(_SRC, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            so_path = os.path.join(_BUILD_DIR, f"actor_engine_{digest}.so")
+            if not os.path.exists(so_path):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                tmp = so_path + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     _SRC, "-o", tmp],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+            _lib = _configure(ctypes.CDLL(so_path))
+            return _lib
+        except (OSError, subprocess.SubprocessError) as e:
+            stderr = getattr(e, "stderr", b"") or b""
+            _load_failed = f"{type(e).__name__}: {e} {stderr.decode(errors='replace')[:500]}"
+            return None
+
+
+def load_error() -> Optional[str]:
+    return _load_failed
+
+
+def available() -> bool:
+    return load() is not None

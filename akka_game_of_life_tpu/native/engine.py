@@ -1,0 +1,114 @@
+"""ctypes wrappers presenting the native actor engine with the same API as
+:mod:`akka_game_of_life_tpu.runtime.actor_engine` (ActorBoard /
+ActorTileEngine), so the two are drop-in interchangeable wherever the
+per-cell-actor backend is selected."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from akka_game_of_life_tpu.native import load
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+Position = Tuple[int, int]
+
+
+def _as_u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+class NativeActorBoard:
+    """Toroidal per-cell actor board backed by the C++ event loop."""
+
+    def __init__(self, board: np.ndarray, rule) -> None:
+        lib = load()
+        if lib is None:
+            from akka_game_of_life_tpu.native import load_error
+
+            raise RuntimeError(f"native engine unavailable: {load_error()}")
+        self._lib = lib
+        self.rule = resolve_rule(rule)
+        board = np.ascontiguousarray(board, dtype=np.uint8)
+        self.shape = board.shape
+        h, w = board.shape
+        self._ptr = lib.ae_create(
+            h, w, _as_u8p(board),
+            self.rule.birth_mask, self.rule.survive_mask, self.rule.states, 0,
+        )
+        self.global_epoch = 0
+
+    def __del__(self) -> None:
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.ae_destroy(ptr)
+            self._ptr = None
+
+    # -- coordinator API (ActorBoard parity) ---------------------------------
+
+    def advance_to(self, target_epoch: int) -> None:
+        self.global_epoch = max(self.global_epoch, target_epoch)
+        self._lib.ae_advance_to(self._ptr, target_epoch)
+
+    def crash_cell(self, pos: Position) -> None:
+        self._lib.ae_crash_cell(self._ptr, pos[0], pos[1])
+
+    def board_at_current(self) -> np.ndarray:
+        out = np.empty(self.shape, dtype=np.uint8)
+        self._lib.ae_get_board(self._ptr, _as_u8p(out))
+        return out
+
+    def min_epoch(self) -> int:
+        return int(self._lib.ae_min_epoch(self._ptr))
+
+    def prune_histories_below(self, epoch: int) -> None:
+        self._lib.ae_prune_below(self._ptr, epoch)
+
+    @property
+    def messages_processed(self) -> int:
+        return int(self._lib.ae_messages(self._ptr))
+
+
+class NativeActorTileEngine:
+    """``engine="actor-native"`` adapter for BackendWorker: the ghost-ring
+    tile variant (remote neighbors fed from the cluster halo)."""
+
+    def __init__(self, rule) -> None:
+        self.rule = resolve_rule(rule)
+        self._lib = load()
+        if self._lib is None:
+            from akka_game_of_life_tpu.native import load_error
+
+            raise RuntimeError(f"native engine unavailable: {load_error()}")
+        self._ptr: Optional[int] = None
+        self._shape: Optional[Tuple[int, int]] = None
+        self._epoch = 0
+
+    def __del__(self) -> None:
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.ae_destroy(ptr)
+            self._ptr = None
+
+    def step(self, padded: np.ndarray) -> np.ndarray:
+        padded = np.ascontiguousarray(padded, dtype=np.uint8)
+        interior = padded[1:-1, 1:-1]
+        if self._ptr is None:
+            h, w = interior.shape
+            self._shape = (h, w)
+            arr = np.ascontiguousarray(interior)
+            self._ptr = self._lib.ae_create(
+                h, w, _as_u8p(arr),
+                self.rule.birth_mask, self.rule.survive_mask,
+                self.rule.states, 1,
+            )
+        self._lib.ae_feed_halo(self._ptr, self._epoch, _as_u8p(padded))
+        self._epoch += 1
+        self._lib.ae_advance_to(self._ptr, self._epoch)
+        assert int(self._lib.ae_min_epoch(self._ptr)) == self._epoch
+        self._lib.ae_prune_below(self._ptr, self._epoch - 1)
+        out = np.empty(self._shape, dtype=np.uint8)
+        self._lib.ae_get_board(self._ptr, _as_u8p(out))
+        return out

@@ -35,20 +35,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from akka_game_of_life_tpu.ops.bitpack import LANE_BITS, _combine_rows, _row_triple_sum
+from akka_game_of_life_tpu.ops.bitpack import LANE_BITS, step_padded_rows
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 
 DEFAULT_BLOCK_ROWS = 256
 DEFAULT_STEPS_PER_SWEEP = 8
-
-
-def _step_padded_local(padded: jax.Array, rule: Rule) -> jax.Array:
-    """(h+2, words) → (h, words), all in VMEM (same math as bitpack's
-    ``step_padded_rows`` but without the public-API rule resolution)."""
-    s, c = _row_triple_sum(padded)
-    return _combine_rows(
-        padded[1:-1], s[:-2], c[:-2], s[1:-1], c[1:-1], s[2:], c[2:], rule
-    )
 
 
 def _make_kernel(rule: Rule, k: int):
@@ -57,7 +48,7 @@ def _make_kernel(rule: Rule, k: int):
             [north_ref[:], center_ref[:], south_ref[:]], axis=0
         )  # (B + 2k, W)
         for _ in range(k):
-            ext = _step_padded_local(ext, rule)
+            ext = step_padded_rows(ext, rule)
         out_ref[:] = ext
 
     return kernel
@@ -91,8 +82,8 @@ def packed_sweep_fn(
         h, words = x.shape
         if h % b:
             raise ValueError(f"grid height {h} not a multiple of block_rows={b}")
-        if h % k:
-            raise ValueError(f"grid height {h} not a multiple of halo rows k={k}")
+        # h % b == 0 and b % k == 0 together imply h % k == 0, so the k-row
+        # halo views below always tile the array exactly.
         n_row_blocks = h // b
         halo_blocks = h // k  # the same array viewed in (k, words) blocks
 

@@ -75,8 +75,15 @@ class BackendWorker:
         retry_s: float = 1.0,
         crash_hook: Optional[Callable[[], None]] = None,
     ) -> None:
-        if engine not in ("numpy", "jax", "actor"):
-            raise ValueError(f"unknown engine {engine!r}; use numpy, jax, or actor")
+        if engine not in ("numpy", "jax", "actor", "actor-native"):
+            raise ValueError(
+                f"unknown engine {engine!r}; use numpy, jax, actor, or actor-native"
+            )
+        if engine == "actor-native":
+            from akka_game_of_life_tpu.native import available, load_error
+
+            if not available():
+                raise RuntimeError(f"actor-native engine unavailable: {load_error()}")
         self.host = host
         self.port = port
         self.name = name
@@ -227,6 +234,12 @@ class BackendWorker:
                     )
 
                     self._actor_engines[tid] = ActorTileEngine(rule)
+                elif self.engine == "actor-native":
+                    from akka_game_of_life_tpu.native.engine import (
+                        NativeActorTileEngine,
+                    )
+
+                    self._actor_engines[tid] = NativeActorTileEngine(rule)
                 # Announce our boundary at the deployed epoch so neighbors
                 # can assemble their halos (History seeding,
                 # CellActor.scala:34).
@@ -250,7 +263,7 @@ class BackendWorker:
                 return
             halo = Halo.from_wire(msg["halo"])
             padded = halo.pad(tile.arr)
-            if self.engine == "actor":
+            if self.engine in ("actor", "actor-native"):
                 tile.arr = self._actor_engines[tid].step(padded)
             else:
                 tile.arr = self._step_padded(padded)
