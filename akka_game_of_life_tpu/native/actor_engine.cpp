@@ -325,15 +325,14 @@ int64_t ae_messages(void* p) { return static_cast<Board*>(p)->messages; }
 void ae_prune_below(void* p, int32_t epoch) {
   Board* b = static_cast<Board*>(p);
   for (Cell& c : b->cells) {
-    if (c.history.empty()) continue;
-    uint8_t top = c.history.count(c.epoch) ? c.history[c.epoch] : 0;
+    // The top-of-history entry (c.epoch) is always kept, so a non-empty
+    // history stays non-empty.
     for (auto it = c.history.begin(); it != c.history.end();) {
       if (it->first < epoch && it->first != c.epoch)
         it = c.history.erase(it);
       else
         ++it;
     }
-    if (c.history.empty()) set_history(c, c.epoch, top);
   }
 }
 

@@ -1,0 +1,206 @@
+"""Full benchmark suite: every BASELINE.md config, one JSON line each.
+
+``bench.py`` is the driver's single headline number (65536^2 bit-packed
+Conway); this suite covers the rest of the BASELINE.json matrix:
+
+  1. conway-actor-64     Conway B3/S23 64x64 torus on the per-cell actor
+                         backends (python + native C++) — the reference's own
+                         architecture, so this line is the apples-to-apples
+                         comparison against the reference's ~12-16
+                         cell-updates/s ceiling (3 s tick, BASELINE.md).
+  2. conway-8192         8192^2 single-chip dense uint8 stencil (jitted scan).
+  3. lifelike-8192       HighLife B36/S23 + Day & Night B3678/S34678, packed.
+  4. generations-8192    Brian's Brain /2/3 (int8 Generations CA), dense path.
+  5. sharded-65536       65536^2 row-sharded bit-packed torus over every local
+                         device with ppermute halo exchange (on a 1-chip host
+                         this degenerates to a 1-device mesh; on CPU it uses
+                         the virtual device mesh).
+
+Usage:
+  python bench_suite.py                 # all configs, default sizes
+  python bench_suite.py --config 2 5    # a subset
+  python bench_suite.py --scale 0.125   # shrink grids (CI / CPU smoke)
+
+Each line: {"config", "metric", "value", "unit", "vs_baseline"} where
+vs_baseline is value / (north-star aggregate split per chip) for throughput
+lines (see bench.py), and value / reference-ceiling for the actor line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+PER_CHIP_TARGET = 1.0e11 / 8
+# The reference's throughput ceiling: cells/tick at its 6x6 default
+# (49 cells actually created) on a 3 s tick — BASELINE.md.
+REFERENCE_CEILING = 49 / 3.0
+
+
+def _emit(config: str, metric: str, value: float, unit: str, baseline: float) -> None:
+    print(
+        json.dumps(
+            {
+                "config": config,
+                "metric": metric,
+                "value": value,
+                "unit": unit,
+                "vs_baseline": value / baseline,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _time_steps(run, board, population) -> float:
+    """Wall-time a pre-built multi-step callable, forcing host sync."""
+    board = run(board)
+    _ = population(board)  # warm compile
+    t0 = time.perf_counter()
+    board = run(board)
+    pop = population(board)
+    dt = time.perf_counter() - t0
+    assert pop > 0, "board died to a fixed point; timing would be meaningless"
+    return dt
+
+
+def bench_actor(size: int) -> None:
+    from akka_game_of_life_tpu.runtime.actor_engine import ActorBoard
+
+    rng = np.random.default_rng(0)
+    board = (rng.random((size, size)) < 0.5).astype(np.uint8)
+    steps = 10
+
+    engines = [("python", ActorBoard)]
+    try:
+        from akka_game_of_life_tpu.native.engine import NativeActorBoard
+
+        engines.append(("native-c++", NativeActorBoard))
+    except RuntimeError:
+        pass
+    for label, cls in engines:
+        eng = cls(board, "conway")
+        eng.advance_to(2)  # warm
+        t0 = time.perf_counter()
+        eng.advance_to(2 + steps)
+        dt = time.perf_counter() - t0
+        rate = size * size * steps / dt
+        _emit(
+            "conway-actor-64",
+            f"cell-updates/sec, Conway {size}x{size} per-cell actor engine ({label})",
+            rate,
+            "cell-updates/sec",
+            REFERENCE_CEILING,
+        )
+
+
+def bench_dense(size: int, rule: str, config: str, steps: int = 32) -> None:
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.models import get_model
+
+    model = get_model(rule)
+    board = jnp.asarray(model.init((size, size), density=0.5, seed=0))
+    run = model.run(steps)
+    population = lambda x: int(jnp.sum(x != 0))
+    dt = _time_steps(run, board, population)
+    rate = size * size * steps / dt
+    _emit(
+        config,
+        f"cell-updates/sec/chip, {rule} {size}x{size} dense stencil",
+        rate,
+        "cell-updates/sec",
+        PER_CHIP_TARGET,
+    )
+
+
+def bench_packed(size: int, rule: str, config: str, steps: int = 64) -> None:
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.ops import bitpack
+    from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+    rng = np.random.default_rng(0)
+    board = jnp.asarray(rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32))
+    run = bitpack.packed_multi_step_fn(resolve_rule(rule), steps)
+    population = lambda x: int(jnp.sum(jnp.bitwise_count(x)))
+    dt = _time_steps(run, board, population)
+    rate = size * size * steps / dt
+    _emit(
+        config,
+        f"cell-updates/sec/chip, {rule} {size}x{size} bit-packed stencil",
+        rate,
+        "cell-updates/sec",
+        PER_CHIP_TARGET,
+    )
+
+
+def bench_sharded(size: int, steps: int = 64) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.ops import bitpack
+    from akka_game_of_life_tpu.parallel.packed_halo import (
+        make_row_mesh,
+        shard_packed,
+        sharded_packed_step_fn,
+    )
+
+    n_dev = len(jax.devices())
+    halo = 4 if steps % 4 == 0 else 1
+    mesh = make_row_mesh(n_dev)
+    step = sharded_packed_step_fn(mesh, "conway", steps_per_call=steps, halo_width=halo)
+    rng = np.random.default_rng(0)
+    board = shard_packed(
+        jnp.asarray(rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32)),
+        mesh,
+    )
+    population = lambda x: int(jnp.sum(jnp.bitwise_count(x)))
+    dt = _time_steps(step, board, population)
+    rate = size * size * steps / dt
+    _emit(
+        "sharded-65536",
+        f"cell-updates/sec aggregate, conway {size}x{size} row-sharded over "
+        f"{n_dev} device(s), ppermute halo (width {halo})",
+        rate,
+        "cell-updates/sec",
+        PER_CHIP_TARGET * n_dev,
+    )
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=int, nargs="*", default=[1, 2, 3, 4, 5])
+    parser.add_argument(
+        "--scale", type=float, default=1.0,
+        help="multiply grid sides by this (e.g. 0.125 for CPU smoke runs)",
+    )
+    parser.add_argument("--platform", default=None, help="pin jax platform (e.g. cpu)")
+    args = parser.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    def s(n: int, quantum: int = 32) -> int:
+        return max(quantum, int(n * args.scale) // quantum * quantum)
+
+    if 1 in args.config:
+        bench_actor(max(16, int(64 * args.scale)))
+    if 2 in args.config:
+        bench_dense(s(8192), "conway", "conway-8192")
+    if 3 in args.config:
+        bench_packed(s(8192), "highlife", "lifelike-8192")
+        bench_packed(s(8192), "day-and-night", "lifelike-8192")
+    if 4 in args.config:
+        bench_dense(s(8192), "brians-brain", "generations-8192", steps=16)
+    if 5 in args.config:
+        bench_sharded(s(65536, 32 * 8))
+
+
+if __name__ == "__main__":
+    main()
