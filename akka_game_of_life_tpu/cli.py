@@ -61,7 +61,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--steps-per-call", type=int)
     p.add_argument("--halo-width", type=int)
     p.add_argument("--mesh", help="ROWSxCOLS device mesh, e.g. 4x2")
-    p.add_argument("--backend", choices=["tpu", "actor"])
+    p.add_argument("--backend", choices=["tpu", "actor", "actor-native"])
     p.add_argument("--checkpoint-dir")
     p.add_argument("--checkpoint-every", type=int)
     p.add_argument("--render-every", type=int)
@@ -107,6 +107,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     run_p = sub.add_parser("run", help="standalone simulation on local devices")
     _add_common(run_p)
+    run_p.add_argument(
+        "--trace-dir",
+        help="capture a jax.profiler trace of the run into this directory "
+        "(view with TensorBoard/Perfetto)",
+    )
 
     fe_p = sub.add_parser("frontend", help="control-plane coordinator (RunFrontend)")
     _add_common(fe_p)
@@ -140,7 +145,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if cfg.max_epochs is None:
             cfg.max_epochs = 100
         sim = Simulation(cfg)
-        sim.advance()
+        from akka_game_of_life_tpu.runtime import profiling
+
+        with profiling.trace(args.trace_dir):
+            sim.advance()
         if cfg.render_every == 0 and cfg.metrics_every == 0:
             # Always show something at the end, like the reference's info.log.
             from akka_game_of_life_tpu.runtime.render import render_ascii

@@ -91,7 +91,7 @@ class SimulationConfig:
     max_epochs: Optional[int] = None
 
     # TPU execution.
-    backend: str = "tpu"  # "tpu" (stencil) | "actor" (per-cell CPU parity)
+    backend: str = "tpu"  # "tpu" (stencil) | "actor" / "actor-native" (per-cell parity)
     steps_per_call: int = 1
     halo_width: int = 1
     mesh_shape: Optional[Tuple[int, int]] = None  # None = auto-factor devices
@@ -124,7 +124,7 @@ class SimulationConfig:
     def __post_init__(self) -> None:
         if self.height <= 0 or self.width <= 0:
             raise ValueError(f"board must be positive, got {self.height}x{self.width}")
-        if self.backend not in ("tpu", "actor"):
+        if self.backend not in ("tpu", "actor", "actor-native"):
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.role not in ("standalone", "frontend", "backend"):
             raise ValueError(f"unknown role {self.role!r}")

@@ -1,0 +1,78 @@
+"""Tracing & profiling — SURVEY.md §5's tracing slot.
+
+The reference has no tracing at all (its only artifact is an unused sbt
+Activator shim, ``project/inspect.sbt:1-3``); the TPU-native replacement is
+the XLA profiler: ``trace(dir)`` captures a device+host timeline viewable in
+TensorBoard/Perfetto (XLA op breakdown, HBM traffic, host callbacks), and
+:func:`annotate_epochs` marks each host-loop chunk so step boundaries show up
+on the timeline.
+
+Usage:
+
+    from akka_game_of_life_tpu.runtime import profiling
+    with profiling.trace("/tmp/gol-trace"):
+        sim.advance(512)
+
+or ``python -m akka_game_of_life_tpu run ... --trace-dir /tmp/gol-trace``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace into ``trace_dir`` (no-op when None)."""
+    if not trace_dir:
+        yield
+        return
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate_epochs(name: str, epoch: int):
+    """Mark one host-loop chunk on the profiler timeline (shows as a step
+    with ``step_num=epoch`` in the trace viewer)."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=epoch)
+
+
+@contextlib.contextmanager
+def timed(label: str, out=None) -> Iterator[None]:
+    """Host-side wall-clock span, printed on exit — the quick-look
+    complement to the full trace."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        msg = f"[profile] {label}: {dt * 1e3:.2f} ms"
+        if out is None:
+            print(msg, flush=True)
+        else:
+            print(msg, file=out, flush=True)
+
+
+def device_memory_stats() -> dict:
+    """Per-device memory stats where the backend exposes them (TPU does;
+    CPU returns empty)."""
+    out = {}
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except (AttributeError, jax.errors.JaxRuntimeError):
+            stats = None
+        if stats:
+            out[str(d)] = {
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            }
+    return out

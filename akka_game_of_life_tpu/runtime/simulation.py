@@ -30,6 +30,7 @@ from akka_game_of_life_tpu.parallel import (
     sharded_step_fn,
     validate_tile_shape,
 )
+from akka_game_of_life_tpu.runtime import profiling
 from akka_game_of_life_tpu.runtime.chaos import CrashInjector
 from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
 from akka_game_of_life_tpu.runtime.config import SimulationConfig
@@ -93,13 +94,21 @@ class Simulation:
             board = ckpt.board
 
         self._actor_board = None
-        if config.backend == "actor":
+        self._actor_board_cls = None
+        if config.backend in ("actor", "actor-native"):
             # The per-cell actor backend (BASELINE config 1): same Simulation
-            # surface, reference-architecture engine underneath.
-            from akka_game_of_life_tpu.runtime.actor_engine import ActorBoard
+            # surface, reference-architecture engine underneath — interpreted
+            # ("actor") or compiled C++ ("actor-native").
+            if config.backend == "actor-native":
+                from akka_game_of_life_tpu.native.engine import NativeActorBoard
 
+                self._actor_board_cls = NativeActorBoard
+            else:
+                from akka_game_of_life_tpu.runtime.actor_engine import ActorBoard
+
+                self._actor_board_cls = ActorBoard
             self.mesh = None
-            self._actor_board = ActorBoard(board, self.rule)
+            self._actor_board = self._actor_board_cls(board, self.rule)
             self._actor_epoch0 = self.epoch  # actor engine counts from 0
             self._steppers = {}
             self.board = board
@@ -173,7 +182,8 @@ class Simulation:
 
             chunk = min(cfg.steps_per_call, target - self.epoch)
             prev = self.epoch
-            self.board = self._stepper(chunk)(self.board)
+            with profiling.annotate_epochs("advance_chunk", self.epoch):
+                self.board = self._stepper(chunk)(self.board)
             self.epoch += chunk
 
             host_board = None
@@ -207,9 +217,7 @@ class Simulation:
         if self._actor_board is not None:
             # Fresh actors reseeded from the restored board (supervision
             # restart at the checkpoint, not epoch 0).
-            from akka_game_of_life_tpu.runtime.actor_engine import ActorBoard
-
-            self._actor_board = ActorBoard(restored, self.rule)
+            self._actor_board = self._actor_board_cls(restored, self.rule)
             self._actor_epoch0 = self.epoch
         self.board = self._to_device(restored)
         while self.epoch < target:

@@ -75,12 +75,12 @@ def bench_actor(size: int) -> None:
     steps = 10
 
     engines = [("python", ActorBoard)]
-    try:
+    from akka_game_of_life_tpu.native import available
+
+    if available():
         from akka_game_of_life_tpu.native.engine import NativeActorBoard
 
         engines.append(("native-c++", NativeActorBoard))
-    except RuntimeError:
-        pass
     for label, cls in engines:
         eng = cls(board, "conway")
         eng.advance_to(2)  # warm
@@ -181,10 +181,9 @@ def main() -> None:
     parser.add_argument("--platform", default=None, help="pin jax platform (e.g. cpu)")
     args = parser.parse_args()
 
-    if args.platform:
-        import jax
+    from akka_game_of_life_tpu.cli import _apply_platform
 
-        jax.config.update("jax_platforms", args.platform)
+    _apply_platform(args.platform)
 
     def s(n: int, quantum: int = 32) -> int:
         return max(quantum, int(n * args.scale) // quantum * quantum)

@@ -1,0 +1,60 @@
+"""Profiling/tracing subsystem: trace capture, annotations, backend modes."""
+
+import os
+
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.runtime import profiling
+from akka_game_of_life_tpu.runtime.config import load_config
+from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+
+def test_trace_produces_profile_artifacts(tmp_path):
+    cfg = load_config(
+        None, {"height": 32, "width": 32, "max_epochs": 8, "steps_per_call": 4}
+    )
+    sim = Simulation(cfg)
+    with profiling.trace(str(tmp_path / "trace")):
+        sim.advance()
+    assert sim.epoch == 8
+    found = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(tmp_path / "trace")
+        for f in files
+    ]
+    assert found, "profiler trace produced no artifacts"
+
+
+def test_trace_none_is_noop():
+    with profiling.trace(None):
+        pass
+    with profiling.trace(""):
+        pass
+
+
+def test_timed_prints_label(capsys):
+    with profiling.timed("unit-test-span"):
+        pass
+    assert "unit-test-span" in capsys.readouterr().out
+
+
+def test_device_memory_stats_shape():
+    stats = profiling.device_memory_stats()
+    for _, v in stats.items():
+        assert "bytes_in_use" in v
+
+
+@pytest.mark.parametrize("backend", ["actor", "actor-native"])
+def test_simulation_actor_backends_match_tpu_backend(backend):
+    if backend == "actor-native":
+        from akka_game_of_life_tpu.native import available
+
+        if not available():
+            pytest.skip("no C++ toolchain")
+    over = {"height": 20, "width": 20, "max_epochs": 6, "seed": 7}
+    dense = Simulation(load_config(None, dict(over, backend="tpu")))
+    dense.advance()
+    actor = Simulation(load_config(None, dict(over, backend=backend)))
+    actor.advance()
+    np.testing.assert_array_equal(dense.board_host(), actor.board_host())
