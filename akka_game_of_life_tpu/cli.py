@@ -149,6 +149,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         with profiling.trace(args.trace_dir):
             sim.advance()
+        if args.trace_dir:
+            for dev, stats in profiling.device_memory_stats().items():
+                print(f"[profile] {dev}: {stats}", flush=True)
         if cfg.render_every == 0 and cfg.metrics_every == 0:
             # Always show something at the end, like the reference's info.log.
             from akka_game_of_life_tpu.runtime.render import render_ascii

@@ -84,14 +84,9 @@ def _row_triple_sum(x: jax.Array):
     return xw ^ e, (x & w) | (e & xw)
 
 
-def _combine_rows(x, sN, cN, sC, cC, sS, cS, rule: Rule) -> jax.Array:
-    """Next state from three rows' (s, c) triple-sum planes.
-
-    ``count = (sN+sC+sS) + 2*(cN+cC+cS)`` is the 9-cell Moore sum including
-    the center, range 0..9 in bits b3..b0.  Because the center is included,
-    survive thresholds shift by +1: for B/S rule, next =
-    (~x & [count ∈ B]) | (x & [count-1 ∈ S]).
-    """
+def _count_bits(sN, cN, sC, cC, sS, cS):
+    """Assemble ``count = (sN+sC+sS) + 2*(cN+cC+cS)`` — the 9-cell Moore sum
+    including the center, range 0..9 — into bit planes (b3, b2, b1, b0)."""
     sNC = sN ^ sC
     b0 = sNC ^ sS  # weight-1 sum bit
     p1 = (sN & sC) | (sS & sNC)  # weight-2 carry of the s's
@@ -102,6 +97,12 @@ def _combine_rows(x, sN, cN, sC, cC, sS, cS, rule: Rule) -> jax.Array:
     r2 = p1 & q0
     b2 = q1 ^ r2
     b3 = q1 & r2
+    return b3, b2, b1, b0
+
+
+def count_eq_fn(b3, b2, b1, b0):
+    """A predicate plane factory: ``eq(n)`` = bits where the 4-bit count
+    equals n (0..9)."""
     nb3, nb2, nb1, nb0 = ~b3, ~b2, ~b1, ~b0
 
     def eq(n: int) -> jax.Array:
@@ -110,6 +111,16 @@ def _combine_rows(x, sN, cN, sC, cC, sS, cS, rule: Rule) -> jax.Array:
         t = t & (b1 if n & 2 else nb1)
         return t & (b0 if n & 1 else nb0)
 
+    return eq
+
+
+def _combine_rows(x, sN, cN, sC, cC, sS, cS, rule: Rule) -> jax.Array:
+    """Next state from three rows' (s, c) triple-sum planes.
+
+    Because the center is included in the count, survive thresholds shift by
+    +1: for a B/S rule, next = (~x & [count ∈ B]) | (x & [count-1 ∈ S]).
+    """
+    eq = count_eq_fn(*_count_bits(sN, cN, sC, cC, sS, cS))
     birth = jnp.uint32(0)
     for n in rule.birth:
         birth = birth | eq(n)

@@ -1,0 +1,141 @@
+"""Bit-plane SWAR stepping for multi-state *Generations* CA.
+
+The binary bit-packed kernel (:mod:`akka_game_of_life_tpu.ops.bitpack`)
+cannot express refractory states, so Generations rules (Brian's Brain /2/3,
+Star Wars 345/2/4 — BASELINE config 4) previously ran only on the dense
+uint8 path at 1 byte/cell.  Here a cell's state (0=dead, 1=alive, 2..S-1
+refractory, decaying upward and wrapping to 0 — ops/rules.py semantics) is
+stored in ``m = ceil(log2(S))`` packed bit planes, 32 cells per uint32 lane
+per plane, so Brian's Brain is 2 bits/cell and anything up to 255 states
+stays ≤ 8 bits/cell with all transition logic as plane-wise SWAR:
+
+- the *alive* plane (state == 1) feeds the same shared-row-sum Moore counter
+  as the binary kernel (``bitpack._row_triple_sum`` / ``_count_bits``);
+- birth/survive hits come from the count-equality predicate planes;
+- refractory decay is a ripple-carry increment over the m planes with a
+  wrap-to-zero mask at state S-1.
+
+Transition (matching runtime/actor_engine.py's ``Gatherer.result`` and the
+dense kernel): dead → 1 on birth-hit else 0; alive → 1 on survive-hit else
+state+1 (=2); refractory → state+1, wrapping S-1 → 0.  The alive center
+contributes +1 to its own count, so survive thresholds shift by +1 exactly
+as in the binary kernel; a dead or refractory center contributes 0.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from akka_game_of_life_tpu.ops.bitpack import (
+    _count_bits,
+    _row_triple_sum,
+    count_eq_fn,
+    pack,
+    unpack,
+)
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+
+def n_planes(states: int) -> int:
+    return max(1, (states - 1).bit_length())
+
+
+def pack_gen(grid, states: int) -> jax.Array:
+    """(H, W) uint8 states → (m, H, W/32) uint32 bit planes, LSB plane first."""
+    grid = jnp.asarray(grid, dtype=jnp.uint8)
+    if states > 2 ** 8:
+        raise ValueError("states > 256 not supported")
+    planes = [pack((grid >> k) & 1) for k in range(n_planes(states))]
+    return jnp.stack(planes)
+
+
+def unpack_gen(planes: jax.Array) -> jax.Array:
+    """(m, H, W/32) uint32 → (H, W) uint8."""
+    out = None
+    for k in range(planes.shape[0]):
+        part = unpack(planes[k]) << k
+        out = part if out is None else out | part
+    return out
+
+
+def _eq_const(planes: List[jax.Array], value: int) -> jax.Array:
+    """Plane where the m-bit state equals ``value``."""
+    t = None
+    for k, p in enumerate(planes):
+        bit = p if (value >> k) & 1 else ~p
+        t = bit if t is None else t & bit
+    return t
+
+
+def _increment(planes: List[jax.Array]) -> List[jax.Array]:
+    """state+1 over m bit planes (ripple carry; overflow discarded — the
+    wrap mask below zeroes the only state that can overflow)."""
+    out = []
+    carry = None
+    for p in planes:
+        if carry is None:
+            out.append(~p)
+            carry = p
+        else:
+            out.append(p ^ carry)
+            carry = p & carry
+    return out
+
+
+def step_gen(planes: jax.Array, rule) -> jax.Array:
+    """One toroidal Generations step on (m, H, W/32) packed planes."""
+    rule = resolve_rule(rule)
+    m = n_planes(rule.states)
+    if planes.shape[0] != m:
+        raise ValueError(f"expected {m} planes for {rule.states} states")
+    ps = [planes[k] for k in range(m)]
+
+    alive = _eq_const(ps, 1)
+    dead = _eq_const(ps, 0)
+
+    s, c = _row_triple_sum(alive)
+    eq = count_eq_fn(
+        *_count_bits(
+            jnp.roll(s, 1, axis=0),
+            jnp.roll(c, 1, axis=0),
+            s,
+            c,
+            jnp.roll(s, -1, axis=0),
+            jnp.roll(c, -1, axis=0),
+        )
+    )
+    birth = jnp.uint32(0)
+    for n in rule.birth:
+        birth = birth | eq(n)  # dead center: count has no self term
+    survive = jnp.uint32(0)
+    for n in rule.survive:
+        survive = survive | eq(n + 1)  # alive center: +1 self term
+
+    to_one = (dead & birth) | (alive & survive)
+    # Everyone else: dead stays 0; alive/refractory increments, wrapping
+    # S-1 → 0.  (alive+1 = 2 is exactly the "enters state 2" transition.)
+    inc = _increment(ps)
+    wrap = _eq_const(ps, rule.states - 1)
+    advance = ~dead & ~to_one & ~wrap
+    out = [(to_one if k == 0 else jnp.uint32(0)) | (advance & inc[k]) for k in range(m)]
+    return jnp.stack(out)
+
+
+@functools.lru_cache(maxsize=None)
+def gen_multi_step_fn(rule_key, n_steps: int) -> Callable[[jax.Array], jax.Array]:
+    rule = resolve_rule(rule_key)
+
+    @jax.jit
+    def _run(planes: jax.Array) -> jax.Array:
+        def body(p, _):
+            return step_gen(p, rule), None
+
+        out, _ = jax.lax.scan(body, planes, None, length=n_steps)
+        return out
+
+    return _run

@@ -234,12 +234,22 @@ class Simulation:
             raise RuntimeError("no checkpoint_dir configured")
         if host_board is None:
             host_board = np.asarray(self.board)
-        self.store.save(
-            self.epoch,
-            host_board,
-            self.rule.rulestring(),
-            meta={"height": self.config.height, "width": self.config.width},
-        )
+
+        def _save():
+            self.store.save(
+                self.epoch,
+                host_board,
+                self.rule.rulestring(),
+                meta={"height": self.config.height, "width": self.config.width},
+            )
+
+        if self.config.metrics_every:
+            # Checkpoint cost is an operational metric: surface it alongside
+            # the throughput lines.
+            with profiling.timed(f"checkpoint@{self.epoch}", out=self.observer.out):
+                _save()
+        else:
+            _save()
 
     def board_host(self) -> np.ndarray:
         return np.asarray(self.board)

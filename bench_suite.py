@@ -138,6 +138,30 @@ def bench_packed(size: int, rule: str, config: str, steps: int = 64) -> None:
     )
 
 
+def bench_packed_gen(size: int, rule: str, config: str, steps: int = 32) -> None:
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.ops import bitpack_gen
+    from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+    r = resolve_rule(rule)
+    rng = np.random.default_rng(0)
+    board = rng.integers(0, r.states, size=(size, size), dtype=np.uint8)
+    planes = bitpack_gen.pack_gen(jnp.asarray(board), r.states)
+    run = bitpack_gen.gen_multi_step_fn(r, steps)
+    population = lambda p: int(jnp.sum(jnp.bitwise_count(p[0])))
+    dt = _time_steps(run, planes, population)
+    rate = size * size * steps / dt
+    _emit(
+        config,
+        f"cell-updates/sec/chip, {rule} {size}x{size} bit-plane Generations "
+        f"({bitpack_gen.n_planes(r.states)} planes)",
+        rate,
+        "cell-updates/sec",
+        PER_CHIP_TARGET,
+    )
+
+
 def bench_sharded(size: int, steps: int = 64) -> None:
     import jax
     import jax.numpy as jnp
@@ -197,6 +221,8 @@ def main() -> None:
         bench_packed(s(8192), "day-and-night", "lifelike-8192")
     if 4 in args.config:
         bench_dense(s(8192), "brians-brain", "generations-8192", steps=16)
+        bench_packed_gen(s(8192), "brians-brain", "generations-8192")
+        bench_packed_gen(s(8192), "star-wars", "generations-8192")
     if 5 in args.config:
         bench_sharded(s(65536, 32 * 8))
 
