@@ -64,6 +64,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--backend", choices=["tpu", "actor", "actor-native"])
     p.add_argument("--checkpoint-dir")
     p.add_argument("--checkpoint-every", type=int)
+    p.add_argument("--checkpoint-format", choices=["npz", "orbax"])
     p.add_argument("--render-every", type=int)
     p.add_argument("--render-max-cells", type=int)
     p.add_argument("--metrics-every", type=int)
@@ -91,6 +92,7 @@ def _overrides(args: argparse.Namespace) -> dict:
         "backend": args.backend,
         "checkpoint_dir": args.checkpoint_dir,
         "checkpoint_every": args.checkpoint_every,
+        "checkpoint_format": args.checkpoint_format,
         "render_every": args.render_every,
         "render_max_cells": args.render_max_cells,
         "metrics_every": args.metrics_every,
@@ -147,8 +149,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         sim = Simulation(cfg)
         from akka_game_of_life_tpu.runtime import profiling
 
-        with profiling.trace(args.trace_dir):
-            sim.advance()
+        with sim, profiling.trace(args.trace_dir):
+            # --max-epochs is the absolute end epoch: a resumed run (from a
+            # checkpoint at epoch E) advances the remaining max_epochs - E.
+            sim.advance(max(0, cfg.max_epochs - sim.epoch))
         if args.trace_dir:
             for dev, stats in profiling.device_memory_stats().items():
                 print(f"[profile] {dev}: {stats}", flush=True)

@@ -27,6 +27,43 @@ import numpy as np
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
 
 
+def _existing_format(directory: str) -> Optional[str]:
+    """Detect which store format already owns a checkpoint directory."""
+    d = Path(directory)
+    if not d.is_dir():
+        return None
+    for p in d.iterdir():
+        if _CKPT_RE.match(p.name):
+            return "npz"
+        # Orbax lays out one numeric directory per step.
+        if p.is_dir() and p.name.isdigit():
+            return "orbax"
+    return None
+
+
+def make_store(directory: str, fmt: str = "npz", keep: int = 3):
+    """Checkpoint store factory: ``npz`` (host, synchronous, packed) or
+    ``orbax`` (device-native, async, shard-parallel).
+
+    Refuses a directory already holding the *other* format's checkpoints —
+    silently resuming from epoch 0 next to hours of foreign-format progress
+    is the exact failure the checkpoint layer exists to prevent.
+    """
+    if fmt not in ("npz", "orbax"):
+        raise ValueError(f"unknown checkpoint format {fmt!r}; use npz or orbax")
+    existing = _existing_format(directory)
+    if existing is not None and existing != fmt:
+        raise ValueError(
+            f"checkpoint dir {directory} already holds {existing}-format "
+            f"checkpoints; refusing to start a {fmt}-format store there"
+        )
+    if fmt == "npz":
+        return CheckpointStore(directory, keep=keep)
+    from akka_game_of_life_tpu.runtime.orbax_store import OrbaxCheckpointStore
+
+    return OrbaxCheckpointStore(directory, keep=keep)
+
+
 @dataclasses.dataclass
 class Checkpoint:
     epoch: int
@@ -112,3 +149,10 @@ class CheckpointStore:
         return Checkpoint(
             epoch=int(epoch), board=board.astype(np.uint8), rule=rule, meta=meta
         )
+
+    def wait(self) -> None:
+        """Saves are synchronous; nothing to wait for (orbax-store parity)."""
+
+    def close(self) -> None:
+        """No resources held (orbax-store parity — callers can close
+        unconditionally)."""

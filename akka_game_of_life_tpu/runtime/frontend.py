@@ -31,7 +31,7 @@ import numpy as np
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 from akka_game_of_life_tpu.runtime import protocol as P
 from akka_game_of_life_tpu.runtime.boundary import BoundaryStore, Halo
-from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+from akka_game_of_life_tpu.runtime.checkpoint import make_store
 from akka_game_of_life_tpu.runtime.chaos import CrashInjector
 from akka_game_of_life_tpu.runtime.config import SimulationConfig
 from akka_game_of_life_tpu.runtime.membership import Member, Membership
@@ -68,7 +68,9 @@ class Frontend:
         )
         self.membership = Membership(config.failure_timeout_s)
         self.store = (
-            CheckpointStore(config.checkpoint_dir) if config.checkpoint_dir else None
+            make_store(config.checkpoint_dir, config.checkpoint_format)
+            if config.checkpoint_dir
+            else None
         )
         # Created in start_simulation so the error.delay schedule counts from
         # simulation start, not from process start (workers may take a long
@@ -210,6 +212,9 @@ class Frontend:
             self._listener.close()
         except OSError:
             pass
+        if self.store is not None:
+            # Async (orbax) saves must be durable before the process exits.
+            self.store.close()
 
     # -- pause/resume (reachable, unlike BoardCreator.scala:109-112) ---------
 

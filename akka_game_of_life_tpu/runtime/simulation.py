@@ -32,7 +32,7 @@ from akka_game_of_life_tpu.parallel import (
 )
 from akka_game_of_life_tpu.runtime import profiling
 from akka_game_of_life_tpu.runtime.chaos import CrashInjector
-from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+from akka_game_of_life_tpu.runtime.checkpoint import make_store
 from akka_game_of_life_tpu.runtime.config import SimulationConfig
 from akka_game_of_life_tpu.runtime.render import BoardObserver
 from akka_game_of_life_tpu.utils.patterns import pattern_board, random_grid
@@ -66,7 +66,7 @@ class Simulation:
             log_file=config.log_file,
         )
         self.store = (
-            CheckpointStore(config.checkpoint_dir)
+            make_store(config.checkpoint_dir, config.checkpoint_format)
             if config.checkpoint_dir is not None
             else None
         )
@@ -233,7 +233,10 @@ class Simulation:
         if self.store is None:
             raise RuntimeError("no checkpoint_dir configured")
         if host_board is None:
-            host_board = np.asarray(self.board)
+            # The store decides where the bytes come from: the orbax store
+            # saves the (possibly sharded) device array without host gather;
+            # the npz store gathers internally.
+            host_board = self.board
 
         def _save():
             self.store.save(
@@ -253,3 +256,18 @@ class Simulation:
 
     def board_host(self) -> np.ndarray:
         return np.asarray(self.board)
+
+    def close(self) -> None:
+        """Finalize: block until async checkpoint saves are durable.  Must be
+        called before process exit when checkpointing is enabled — an async
+        (orbax) save still in flight at interpreter shutdown is lost."""
+        if self.store is not None:
+            self.store.close()
+        self.observer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
