@@ -12,3 +12,13 @@ from akka_game_of_life_tpu.parallel.halo import (  # noqa: F401
     sharded_step_fn,
     validate_tile_shape,
 )
+from akka_game_of_life_tpu.parallel.packed_halo import (  # noqa: F401
+    make_row_mesh,
+    shard_packed,
+    sharded_packed_step_fn,
+)
+from akka_game_of_life_tpu.parallel.packed_halo2d import (  # noqa: F401
+    shard_packed2d,
+    sharded_packed2d_step_fn,
+    word_halo_width,
+)

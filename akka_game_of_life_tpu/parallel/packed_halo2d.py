@@ -1,0 +1,117 @@
+"""2-D sharded bit-packed stepping: rows × word-columns over a device grid.
+
+The 1-D row ring (:mod:`akka_game_of_life_tpu.parallel.packed_halo`) is the
+right shape for a single v5e-8 slice (65536 rows / 8 devices = 8192-row
+shards); this module completes the scale-out story for larger meshes and
+pods: the packed (H, W/32) grid is tiled over a ("row", "col") mesh, rows
+exchanged along the row axis and *whole 32-cell words* along the col axis.
+
+The word halo is communication-avoiding at the bit level: a halo word's
+outermost cell loses validity first (it lacks its own off-tile neighbor) and
+the garbage front advances exactly one bit per step, so ``hw`` halo words on
+each side stay valid at the interior boundary for up to ``32*hw - 1`` local
+steps — one exchanged uint32 buys 31 steps.  The local stepping reuses the
+*toroidal* :func:`bitpack.step_packed` on the halo-padded tile: its wraps
+only ever corrupt the outermost halo rows/words, which are cut edges
+(garbage-tolerant by construction), so the same kernel serves the toroidal
+single-device path and this tile path — at constant shape, which keeps the
+inner loop a ``lax.scan`` instead of per-step unrolled bodies.
+
+Exchange order is the dense path's two phases (columns first, then rows of
+the column-padded tile) so corner words ride along and 8-direction
+connectivity costs 4 ppermutes per exchange (``parallel/halo.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+
+from akka_game_of_life_tpu.ops.bitpack import LANE_BITS, step_packed
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.parallel.halo import ring_shift
+from akka_game_of_life_tpu.parallel.mesh import COL_AXIS, GRID_SPEC, ROW_AXIS
+
+
+def word_halo_width(steps: int) -> int:
+    """Halo words per side needed for ``steps`` local steps: the garbage
+    front moves 1 bit/step, so hw words survive 32*hw - 1 steps."""
+    return (steps + LANE_BITS) // LANE_BITS
+
+
+def sharded_packed2d_step_fn(
+    mesh: Mesh,
+    rule,
+    *,
+    steps_per_call: int = 1,
+    halo_rows: int = 1,
+) -> Callable[[jax.Array], jax.Array]:
+    """A jitted multi-step advance of a 2-D-sharded packed board.
+
+    ``halo_rows`` is both the row-halo depth and the number of local steps
+    per exchange; the word-column halo width follows from it
+    (:func:`word_halo_width`).
+    """
+    rule = resolve_rule(rule)
+    if not rule.is_binary:
+        raise ValueError("bit-packed kernel supports binary rules only")
+    s = halo_rows
+    if steps_per_call % s:
+        raise ValueError(
+            f"steps_per_call={steps_per_call} must be a multiple of "
+            f"halo_rows={s}"
+        )
+    hw = word_halo_width(s)
+    n_exchanges = steps_per_call // s
+
+    def local(tile: jax.Array) -> jax.Array:
+        h_loc, w_loc = tile.shape
+        if h_loc < s:
+            raise ValueError(f"per-shard tile has {h_loc} rows < halo rows {s}")
+        if w_loc < hw:
+            raise ValueError(
+                f"per-shard tile has {w_loc} words < word halo {hw}; "
+                f"use fewer column shards or fewer steps per exchange"
+            )
+
+        def body(t, _):
+            # Phase 1 — word columns; my west halo is my left neighbor's
+            # easternmost words.
+            west = ring_shift(t[:, -hw:], COL_AXIS, +1)
+            east = ring_shift(t[:, :hw], COL_AXIS, -1)
+            t2 = jnp.concatenate([west, t, east], axis=1)
+            # Phase 2 — rows of the column-padded tile: corner words ride.
+            top = ring_shift(t2[-s:], ROW_AXIS, +1)
+            bottom = ring_shift(t2[:s], ROW_AXIS, -1)
+            padded = jnp.concatenate([top, t2, bottom], axis=0)
+            # s local steps at constant shape: the *toroidal* step's wrap
+            # corrupts only the outermost halo rows/words, which are cut
+            # edges (their true neighbors live off-tile) and garbage-
+            # tolerant by construction; both garbage fronts move 1 cell per
+            # step, so the interior slice below is exact.  Constant shapes
+            # let the inner loop be a scan — compile cost is one step, not
+            # s unrolled bodies.
+            padded, _ = jax.lax.scan(
+                lambda p, _: (step_packed(p, rule), None), padded, None, length=s
+            )
+            return padded[s:-s, hw:-hw], None
+
+        out, _ = jax.lax.scan(body, tile, None, length=n_exchanges)
+        return out
+
+    mapped = jax.shard_map(local, mesh=mesh, in_specs=GRID_SPEC, out_specs=GRID_SPEC)
+    sharding = NamedSharding(mesh, GRID_SPEC)
+    return jax.jit(mapped, in_shardings=sharding, out_shardings=sharding)
+
+
+def shard_packed2d(packed: jax.Array, mesh: Mesh) -> jax.Array:
+    h, words = packed.shape
+    rows, cols = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    if h % rows or words % cols:
+        raise ValueError(
+            f"packed grid {(h, words)} not divisible by mesh {(rows, cols)}"
+        )
+    return jax.device_put(packed, NamedSharding(mesh, GRID_SPEC))

@@ -35,8 +35,17 @@ def _existing_format(directory: str) -> Optional[str]:
     for p in d.iterdir():
         if _CKPT_RE.match(p.name):
             return "npz"
-        # Orbax lays out one numeric directory per step.
-        if p.is_dir() and p.name.isdigit():
+        # An orbax step is a numeric directory carrying orbax metadata —
+        # the name alone isn't enough (an unrelated output dir may contain
+        # numeric subdirectories).
+        if (
+            p.is_dir()
+            and p.name.isdigit()
+            and any(
+                (p / marker).exists()
+                for marker in ("_CHECKPOINT_METADATA", "state", "meta")
+            )
+        ):
             return "orbax"
     return None
 

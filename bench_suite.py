@@ -14,7 +14,8 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
   5. sharded-65536       65536^2 row-sharded bit-packed torus over every local
                          device with ppermute halo exchange (on a 1-chip host
                          this degenerates to a 1-device mesh; on CPU it uses
-                         the virtual device mesh).
+                         the virtual device mesh); plus sharded2d-65536, the
+                         rows x word-columns 2-D mesh variant.
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -189,6 +190,33 @@ def bench_sharded(size: int, steps: int = 64) -> None:
         "sharded-65536",
         f"cell-updates/sec aggregate, conway {size}x{size} row-sharded over "
         f"{n_dev} device(s), ppermute halo (width {halo})",
+        rate,
+        "cell-updates/sec",
+        PER_CHIP_TARGET * n_dev,
+    )
+
+    # 2-D variant: rows × word-columns (the pod-scale layout).
+    from akka_game_of_life_tpu.parallel import (
+        factor_2d,
+        make_grid_mesh,
+        shard_packed2d,
+        sharded_packed2d_step_fn,
+    )
+
+    mesh2 = make_grid_mesh(factor_2d(n_dev))
+    step2 = sharded_packed2d_step_fn(
+        mesh2, "conway", steps_per_call=steps, halo_rows=halo
+    )
+    board2 = shard_packed2d(
+        jnp.asarray(rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32)),
+        mesh2,
+    )
+    dt = _time_steps(step2, board2, population)
+    rate = size * size * steps / dt
+    _emit(
+        "sharded2d-65536",
+        f"cell-updates/sec aggregate, conway {size}x{size} 2-D-sharded "
+        f"{factor_2d(n_dev)} mesh, word+row ppermute halos",
         rate,
         "cell-updates/sec",
         PER_CHIP_TARGET * n_dev,
