@@ -108,6 +108,7 @@ class SimulationConfig:
     # Checkpoint / resume (capability the reference lacks — SURVEY.md §5).
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # epochs between checkpoints; 0 = disabled
+    checkpoint_format: str = "npz"  # "npz" (host, sync) | "orbax" (async, device)
     history_window: int = 8  # bounded per-shard boundary history (vs the
     # reference's unbounded per-cell History maps)
 
@@ -128,6 +129,8 @@ class SimulationConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.role not in ("standalone", "frontend", "backend"):
             raise ValueError(f"unknown role {self.role!r}")
+        if self.checkpoint_format not in ("npz", "orbax"):
+            raise ValueError(f"unknown checkpoint format {self.checkpoint_format!r}")
         if self.steps_per_call % self.halo_width:
             raise ValueError("steps_per_call must be a multiple of halo_width")
 
