@@ -232,6 +232,13 @@ extern "C" {
 void* ae_create(int32_t h, int32_t w, const uint8_t* board,
                 uint32_t birth_mask, uint32_t survive_mask, int32_t states,
                 int32_t tile_mode) {
+  // Flat cell indices are int32 throughout (Msg.a, nbr table); reject boards
+  // whose (ghost-ring-padded) index space would overflow.  The per-cell
+  // engine is the small-board parity path, so this is not a real limit.
+  if (h <= 0 || w <= 0) return nullptr;
+  int64_t fh = static_cast<int64_t>(h) + (tile_mode ? 2 : 0);
+  int64_t fw = static_cast<int64_t>(w) + (tile_mode ? 2 : 0);
+  if (fh * fw > INT32_MAX) return nullptr;
   Board* b = new Board();
   b->h = h;
   b->w = w;

@@ -38,6 +38,8 @@ class NativeActorBoard:
             h, w, _as_u8p(board),
             self.rule.birth_mask, self.rule.survive_mask, self.rule.states, 0,
         )
+        if not self._ptr:
+            raise ValueError(f"board {h}x{w} too large for the per-cell engine")
         self.global_epoch = 0
 
     def __del__(self) -> None:
@@ -104,6 +106,10 @@ class NativeActorTileEngine:
                 self.rule.birth_mask, self.rule.survive_mask,
                 self.rule.states, 1,
             )
+            if not self._ptr:
+                raise ValueError(
+                    f"tile {h}x{w} too large for the per-cell engine"
+                )
         self._lib.ae_feed_halo(self._ptr, self._epoch, _as_u8p(padded))
         self._epoch += 1
         self._lib.ae_advance_to(self._ptr, self._epoch)

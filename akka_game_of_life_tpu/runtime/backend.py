@@ -73,6 +73,7 @@ class BackendWorker:
         name: Optional[str] = None,
         engine: str = "jax",
         retry_s: float = 1.0,
+        max_pull_retries: int = 10,
         crash_hook: Optional[Callable[[], None]] = None,
     ) -> None:
         if engine not in ("numpy", "jax", "actor", "actor-native"):
@@ -89,6 +90,7 @@ class BackendWorker:
         self.name = name
         self.engine = engine
         self.retry_s = retry_s
+        self.max_pull_retries = max_pull_retries
         # DoCrashMsg → throw (CellActor.scala:95-96): default is an abrupt
         # process death; in-thread harnesses override to simulate it.
         self.crash_hook = crash_hook or (lambda: os._exit(42))
@@ -120,6 +122,11 @@ class BackendWorker:
             raise ConnectionError("frontend did not welcome us")
         self.name = welcome["name"]
         heartbeat_s = float(welcome.get("heartbeat_s", 0.5))
+        # Retry policy is cluster config, owned by the frontend
+        # (SimulationConfig.max_pull_retries); the constructor value is only
+        # the standalone/test default.
+        if "max_pull_retries" in welcome:
+            self.max_pull_retries = int(welcome["max_pull_retries"])
         threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_s,), daemon=True
         ).start()
@@ -163,11 +170,22 @@ class BackendWorker:
                 return
 
     def _retry_loop(self) -> None:
-        """The gatherer's Retry timer: re-pull stale halo requests."""
+        """The gatherer's Retry timer: re-pull stale halo requests.
+
+        After ``max_pull_retries`` unanswered re-pulls the worker escalates
+        with GATHER_FAILED — the reference's gatherer gives up after 2 ask
+        rounds and fires ``FailedToGatherInfoMsg`` so its parent repairs the
+        neighborhood (``NextStateCellGathererActor.scala:49-58``,
+        ``CellActor.scala:92-94``).  Like the reference, the tile keeps its
+        state and keeps retrying; the frontend decides whether a blocking
+        neighbor is genuinely stuck and needs redeployment."""
         while not self._stop.is_set():
             time.sleep(self.retry_s / 4)
             now = time.monotonic()
+            failed = []
             with self._lock:
+                if self.paused:
+                    continue
                 stale = [
                     (tid, t)
                     for tid, t in self.tiles.items()
@@ -176,8 +194,18 @@ class BackendWorker:
                 ]
                 for tid, t in stale:
                     t.retries += 1
+                    if t.retries > self.max_pull_retries:
+                        t.retries = 0  # re-arm: escalate again if still stuck
+                        failed.append((tid, t.epoch))
                     t.awaiting_since = now
                     self._send_pull(tid, t)
+            for tid, epoch in failed:
+                try:
+                    self.channel.send(
+                        {"type": P.GATHER_FAILED, "tile": list(tid), "epoch": epoch}
+                    )
+                except OSError:
+                    pass
 
     # -- dispatch ------------------------------------------------------------
 

@@ -47,6 +47,11 @@ def _existing_format(directory: str) -> Optional[str]:
             )
         ):
             return "orbax"
+        # A crash during the very first async orbax save leaves only a
+        # tmp-suffixed step dir (no finalized metadata yet); that directory
+        # is still orbax-owned.
+        if p.is_dir() and ".orbax-checkpoint-tmp" in p.name:
+            return "orbax"
     return None
 
 

@@ -105,6 +105,22 @@ class SimulationConfig:
     # (auto-down-unreachable-after, application.conf:23).
     failure_timeout_s: float = 1.0
 
+    # Supervision (the reference caps restarts: OneForOneStrategy(Restart,
+    # maxNrOfRetries=10, withinTimeRange=1 minute), BoardCreator.scala:42-45).
+    # A tile redeployed more than restart_max times within restart_window_s
+    # escalates: the run fails loudly instead of thrashing forever.
+    restart_max: int = 10
+    restart_window_s: float = 60.0
+    # Worker-side gather escalation (the reference's gatherer gives up after
+    # 2 ask rounds and fires FailedToGatherInfoMsg → neighbor-ref refresh,
+    # NextStateCellGathererActor.scala:49-58).  After this many unanswered
+    # halo re-pulls a worker reports GATHER_FAILED (keeping its tile and
+    # retrying); the frontend then redeploys any blocking neighbor tile that
+    # has pushed no ring for stuck_timeout_s — a worker that is alive at the
+    # protocol level but wedged in compute, which heartbeats cannot catch.
+    max_pull_retries: int = 10
+    stuck_timeout_s: float = 60.0
+
     # Checkpoint / resume (capability the reference lacks — SURVEY.md §5).
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # epochs between checkpoints; 0 = disabled
@@ -145,6 +161,8 @@ _DURATION_FIELDS = {
     "tick_s",
     "heartbeat_s",
     "failure_timeout_s",
+    "restart_window_s",
+    "stuck_timeout_s",
     "first_after_s",
     "every_s",
 }

@@ -24,7 +24,8 @@ from __future__ import annotations
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -41,6 +42,13 @@ from akka_game_of_life_tpu.runtime.tiles import Ring, TileId, TileLayout, layout
 from akka_game_of_life_tpu.runtime.wire import Channel
 
 _MAINT_INTERVAL_S = 0.05
+
+# Cadence of *in-memory* checkpoints when no durable cadence is configured.
+# The frontend needs a periodic full-board snapshot anyway: it is both the
+# recovery source for redeploys and the floor below which boundary rings are
+# pruned — without it ring history grows forever (the reference's
+# unbounded-History bug, SURVEY.md §2 bug 5, at tile granularity).
+_MEMORY_CKPT_EVERY = 32
 
 
 class Frontend:
@@ -85,6 +93,15 @@ class Frontend:
         self.start_epoch = 0
         self.paused = False
         self.crash_events: List[dict] = []
+        # Supervision budget (OneForOneStrategy ≤10 restarts/min,
+        # BoardCreator.scala:42-45): recent redeploy timestamps per tile.
+        self._redeploy_times: Dict[TileId, Deque[float]] = {}
+        # Per-tile progress clock (last RING received) — the evidence a
+        # GATHER_FAILED escalation is judged against.
+        self._last_ring_time: Dict[TileId, float] = {}
+        # Checkpoint cadence workers report at; falls back to an in-memory
+        # cadence so ring pruning and recovery work without a durable store.
+        self._ckpt_cadence = config.checkpoint_every or _MEMORY_CKPT_EVERY
 
         self._last_ckpt: Optional[Tuple[int, np.ndarray]] = None
         self._ckpt_pending: Dict[int, Dict[TileId, np.ndarray]] = {}
@@ -171,6 +188,11 @@ class Frontend:
     def _send_deploy(
         self, member: Member, tiles: List[TileId], board: np.ndarray, epoch: int
     ) -> None:
+        now = time.monotonic()
+        for t in tiles:
+            # A freshly deployed tile gets a full stuck_timeout_s of grace
+            # before a GATHER_FAILED escalation may count it as wedged.
+            self._last_ring_time[t] = now
         payload = [
             {
                 "id": list(t),
@@ -188,9 +210,7 @@ class Frontend:
                 "target": self.target_epoch,
                 "final_epoch": self.config.max_epochs,
                 "render_every": self.config.render_every,
-                "checkpoint_every": self.config.checkpoint_every
-                if self.store is not None
-                else 0,
+                "checkpoint_every": self._ckpt_cadence,
                 "metrics_every": self.config.metrics_every,
             },
         )
@@ -258,6 +278,7 @@ class Frontend:
                     "type": P.WELCOME,
                     "name": member.name,
                     "heartbeat_s": self.config.heartbeat_s,
+                    "max_pull_retries": self.config.max_pull_retries,
                 }
             )
             while not self._stop.is_set():
@@ -291,6 +312,7 @@ class Frontend:
                 if self.tile_owner.get(tile) != member.name:
                     return  # stale push from an evicted owner
                 self.tile_epochs[tile] = max(self.tile_epochs.get(tile, 0), epoch)
+                self._last_ring_time[tile] = time.monotonic()
             self.boundary.push_ring(tile, epoch, ring)
         elif kind == P.PULL:
             tile = tuple(msg["tile"])
@@ -316,6 +338,8 @@ class Frontend:
         elif kind == P.REDEPLOY_REQUEST:
             tile = tuple(msg["tile"])
             self._redeploy_tile(tile, preferred=member.name)
+        elif kind == P.GATHER_FAILED:
+            self._on_gather_failed(member, tuple(msg["tile"]), int(msg["epoch"]))
         elif kind == P.GOODBYE:
             self._on_member_lost(member.name)
 
@@ -338,18 +362,24 @@ class Frontend:
                     self.done.set()
             if (
                 "checkpoint" in reasons
-                and self.store is not None
                 and epoch > self._last_ckpt[0]  # a replaying tile re-reports
-                # epochs already durably saved; don't recreate pending entries
-                # that can never complete
+                # epochs already saved; don't recreate pending entries that
+                # can never complete
             ):
                 pend = self._ckpt_pending.setdefault(epoch, {})
                 pend[tile] = arr
                 if len(pend) == len(self.layout.tile_ids):
                     board = self._assemble(pend)
-                    del self._ckpt_pending[epoch]
-                    self.store.save(epoch, board, self.rule.rulestring())
+                    if self.store is not None and self.config.checkpoint_every:
+                        # An explicit cadence means durable saves; the
+                        # fallback cadence checkpoints in memory only (the
+                        # store still gets the final board).
+                        self.store.save(epoch, board, self.rule.rulestring())
                     self._last_ckpt = (epoch, board)
+                    # Older pending epochs can no longer become the recovery
+                    # point; drop them along with this one.
+                    for e in [e for e in self._ckpt_pending if e <= epoch]:
+                        del self._ckpt_pending[e]
                     # Bounded history: prune rings no tile can ever need
                     # again.  The floor is the *slowest* tile, not the
                     # checkpoint epoch — a tile redeployed from an older
@@ -367,6 +397,28 @@ class Frontend:
         from akka_game_of_life_tpu.runtime.tiles import stitch
 
         return stitch({self.layout.origin(t): arr for t, arr in tiles.items()})
+
+    def _on_gather_failed(self, member: Member, tile: TileId, epoch: int) -> None:
+        """FailedToGatherInfoMsg analog (NextStateCellGathererActor.scala:49-58):
+        a tile's halo pulls have gone unanswered past the retry budget.  The
+        reporting tile keeps its state; the *parent* repairs the neighborhood
+        by redeploying any blocking neighbor that is genuinely stuck — behind
+        the requested epoch AND silent (no ring push) for stuck_timeout_s.
+        A neighbor that is merely slow keeps its progress and its lease."""
+        with self._lock:
+            if self.tile_owner.get(tile) != member.name or self.layout is None:
+                return
+            now = time.monotonic()
+            stuck = [
+                ntile
+                for ntile in sorted(set(self.layout.neighbors(tile).values()))
+                if ntile != tile
+                and self.tile_epochs.get(ntile, 0) < epoch
+                and now - self._last_ring_time.get(ntile, now)
+                > self.config.stuck_timeout_s
+            ]
+        for ntile in stuck:
+            self._redeploy_tile(ntile, avoid=self.tile_owner.get(ntile))
 
     # -- failure handling / redeployment -------------------------------------
 
@@ -400,11 +452,34 @@ class Frontend:
                 tile, preferred=survivors[idx % len(survivors)].name
             )
 
-    def _redeploy_tile(self, tile: TileId, preferred: Optional[str] = None) -> None:
+    def _redeploy_tile(
+        self,
+        tile: TileId,
+        preferred: Optional[str] = None,
+        avoid: Optional[str] = None,
+    ) -> None:
         """Redeploy one tile from the recovery source (last checkpoint or the
         deterministic initial board); the new owner replays forward by
-        pulling epoch-tagged halos (the ``onCellTermination`` path)."""
+        pulling epoch-tagged halos (the ``onCellTermination`` path).
+
+        Restarts are budgeted like the reference's supervision strategy
+        (``OneForOneStrategy(Restart, ≤10 retries/min)``,
+        ``BoardCreator.scala:42-45``): a tile that keeps dying escalates to a
+        run failure instead of redeploy-thrashing forever."""
         with self._lock:
+            now = time.monotonic()
+            times = self._redeploy_times.setdefault(tile, deque())
+            while times and now - times[0] > self.config.restart_window_s:
+                times.popleft()
+            if len(times) >= self.config.restart_max:
+                self.error = (
+                    f"tile {tile} exceeded its restart budget "
+                    f"({self.config.restart_max} redeploys in "
+                    f"{self.config.restart_window_s:.0f}s); escalating"
+                )
+                self.done.set()
+                return
+            times.append(now)
             member = self.membership.get(preferred) if preferred else None
             if member is None or not member.alive:
                 survivors = self.membership.alive_members()
@@ -412,7 +487,9 @@ class Frontend:
                     self.error = "all backends lost"
                     self.done.set()
                     return
-                member = survivors[0]
+                # Prefer moving off the current (possibly wedged) owner.
+                others = [m for m in survivors if m.name != avoid]
+                member = (others or survivors)[0]
             epoch, board = self._last_ckpt
             if tile not in member.tiles:
                 member.tiles.append(tile)

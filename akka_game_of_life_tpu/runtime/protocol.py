@@ -19,6 +19,9 @@ PULL / HALO                 GetStateFromEpoch / StateForEpoch with request
 TILE_STATE                  CellStateMsg to the logger (BoardCreator.scala:159)
 CRASH / CRASH_TILE          DoCrashMsg fault injection (CellActor.scala:53-55)
 REDEPLOY_REQUEST            postRestart → SendMeMyNeighbours (CellActor.scala:21-25)
+GATHER_FAILED               FailedToGatherInfoMsg — gatherer gives up, parent
+                            repairs the neighborhood
+                            (NextStateCellGathererActor.scala:49-58)
 PAUSE / RESUME              PauseSimulation/ResumeSimulation — *dead code* in
                             the reference (BoardCreator.scala:109-112); reachable here
 SHUTDOWN                    (new) orderly termination
@@ -38,6 +41,7 @@ RING = "ring"
 PULL = "pull"
 TILE_STATE = "tile_state"
 REDEPLOY_REQUEST = "redeploy_request"
+GATHER_FAILED = "gather_failed"
 GOODBYE = "goodbye"
 
 # frontend → backend

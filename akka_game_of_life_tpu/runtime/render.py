@@ -89,7 +89,11 @@ class BoardObserver:
         else:
             self.out = out if out is not None else sys.stdout
         self._partial: Dict[int, Dict[Tuple[int, int], np.ndarray]] = {}
-        self._completed_epochs: Deque[int] = deque(maxlen=256)
+        # Epochs complete in increasing order (every tile reports its own
+        # epochs in order, and an epoch completes only when the *last* tile
+        # reports it), so a single floor suffices to recognize re-reports —
+        # no matter how far back a replaying tile rolls.
+        self._max_completed: Optional[int] = None
         self._expected_tiles: Optional[int] = None
         self._last_time: Optional[float] = None
         self._last_epoch: Optional[int] = None
@@ -138,7 +142,7 @@ class BoardObserver:
         is complete, else None."""
         if self._expected_tiles is None:
             raise RuntimeError("call expect_tiles(n) before observe_tile")
-        if epoch in self._completed_epochs:
+        if self._max_completed is not None and epoch <= self._max_completed:
             # A replaying tile re-reports epochs already rendered; recreating
             # a partial entry for them would leak (it can never complete).
             return None
@@ -147,7 +151,10 @@ class BoardObserver:
         if len(tiles) < self._expected_tiles:
             return None
         del self._partial[epoch]
-        self._completed_epochs.append(epoch)
+        self._max_completed = epoch
+        # Anything still partial at or below the floor can never complete.
+        for e in [e for e in self._partial if e <= epoch]:
+            del self._partial[e]
         from akka_game_of_life_tpu.runtime.tiles import stitch
 
         board = stitch(tiles)

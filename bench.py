@@ -17,13 +17,63 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
 PER_CHIP_TARGET = 1.0e11 / 8  # north-star aggregate spread over v5e-8 chips
+
+# A tiny device-touch run in a THROWAWAY subprocess.  On this image the TPU is
+# reached through the experimental axon PJRT tunnel, which can hang
+# indefinitely: any process that merely initializes the backend then blocks
+# forever (BENCH_r01.json died exactly this way).  Probing in a subprocess
+# under a hard timeout means the hang kills the child, not the benchmark.
+_PROBE_CODE = """
+import os
+import jax, jax.numpy as jnp
+plat = os.environ.get("BENCH_PLATFORM")
+if plat:
+    # sitecustomize pins jax_platforms=axon at boot and ignores JAX_PLATFORMS;
+    # an in-process config update is the only override that sticks.
+    jax.config.update("jax_platforms", plat)
+x = jnp.ones((256, 256), jnp.float32)
+# Host fetch forces real execution; block_until_ready alone does not block
+# on the axon platform.
+assert float((x @ x)[0, 0]) == 256.0
+print("probe-ok", jax.default_backend(), jax.device_count())
+"""
+
+
+def probe_device(
+    timeout_s: float, attempts: int, platform: str | None = None
+) -> str | None:
+    """Return None if a small matmul completes on the default platform,
+    else a short machine-readable failure reason."""
+    import os
+
+    env = dict(os.environ)
+    if platform:
+        env["BENCH_PLATFORM"] = platform
+    reason = "unknown"
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(2.0)  # brief backoff between attempts, none after the last
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            reason = f"probe-timeout: device touch exceeded {timeout_s:.0f}s (tunnel hung?)"
+            continue
+        if proc.returncode == 0:
+            return None
+        tail = (proc.stderr or proc.stdout).strip().splitlines()
+        reason = f"probe-init-failure rc={proc.returncode}: {tail[-1] if tail else ''}"
+    return f"{reason} (after {attempts} attempts)"
 
 
 def main() -> None:
@@ -39,7 +89,50 @@ def main() -> None:
         "--steps-per-sweep", type=int, default=None,
         help="pallas temporal-block depth (default: auto-pick a divisor)",
     )
+    parser.add_argument(
+        "--probe-timeout", type=float, default=150.0,
+        help="seconds allowed for the subprocess device probe (first axon "
+        "compile can take ~40s; 0 disables the probe)",
+    )
+    parser.add_argument("--probe-attempts", type=int, default=2)
+    parser.add_argument(
+        "--platform", default=None,
+        help="pin a jax platform (e.g. cpu) for smoke-testing; default is the "
+        "image's pinned platform (the real chip)",
+    )
     args = parser.parse_args()
+
+    metric_label = (
+        f"cell-updates/sec/chip, Conway B3/S23 {args.size}x{args.size} torus "
+        f"({args.kernel} kernel, 1 chip)"
+    )
+
+    if args.probe_timeout > 0:
+        failure = probe_device(
+            args.probe_timeout, max(1, args.probe_attempts), args.platform
+        )
+        if failure is not None:
+            # Structured, parseable record of the failure — never a hang or a
+            # raw traceback (the round-1 artifact failure modes).
+            print(
+                json.dumps(
+                    {
+                        "metric": metric_label,
+                        "value": None,
+                        "unit": "cell-updates/sec",
+                        "vs_baseline": None,
+                        "error": failure,
+                    }
+                )
+            )
+            sys.exit(1)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     from akka_game_of_life_tpu.models import get_model
     from akka_game_of_life_tpu.ops import bitpack
@@ -94,10 +187,7 @@ def main() -> None:
             {
                 # The benchmark computation is a plain single-device jit, so
                 # per-chip is literal regardless of how many chips the host has.
-                "metric": (
-                    f"cell-updates/sec/chip, Conway B3/S23 {n}x{n} torus "
-                    f"({args.kernel} kernel, 1 chip)"
-                ),
+                "metric": metric_label,
                 "value": rate,
                 "unit": "cell-updates/sec",
                 "vs_baseline": rate / PER_CHIP_TARGET,

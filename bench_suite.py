@@ -39,21 +39,35 @@ PER_CHIP_TARGET = 1.0e11 / 8
 # The reference's throughput ceiling: cells/tick at its 6x6 default
 # (49 cells actually created) on a 3 s tick — BASELINE.md.
 REFERENCE_CEILING = 49 / 3.0
+# TPU v5e HBM bandwidth, bytes/sec (the roofline that bounds these kernels —
+# they are bandwidth/VPU-bound, not MXU-bound; BASELINE.md "Roofline").
+V5E_HBM_BPS = 819e9
 
 
-def _emit(config: str, metric: str, value: float, unit: str, baseline: float) -> None:
-    print(
-        json.dumps(
-            {
-                "config": config,
-                "metric": metric,
-                "value": value,
-                "unit": unit,
-                "vs_baseline": value / baseline,
-            }
-        ),
-        flush=True,
-    )
+def _emit(
+    config: str,
+    metric: str,
+    value: float,
+    unit: str,
+    baseline: float,
+    *,
+    bytes_per_cell: float | None = None,
+) -> None:
+    line = {
+        "config": config,
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": value / baseline,
+    }
+    if bytes_per_cell is not None:
+        # Roofline accounting: HBM traffic per cell-update and the fraction
+        # of a v5e chip's bandwidth this rate corresponds to.  hbm_frac << 1
+        # means the kernel is VPU-op bound with bandwidth headroom.
+        line["bytes_per_cell"] = bytes_per_cell
+        line["hbm_bytes_per_sec"] = value * bytes_per_cell
+        line["hbm_frac_v5e"] = value * bytes_per_cell / V5E_HBM_BPS
+    print(json.dumps(line), flush=True)
 
 
 def _time_steps(run, board, population) -> float:
@@ -90,7 +104,7 @@ def bench_actor(size: int) -> None:
         dt = time.perf_counter() - t0
         rate = size * size * steps / dt
         _emit(
-            "conway-actor-64",
+            f"conway-actor-{size}",
             f"cell-updates/sec, Conway {size}x{size} per-cell actor engine ({label})",
             rate,
             "cell-updates/sec",
@@ -115,6 +129,7 @@ def bench_dense(size: int, rule: str, config: str, steps: int = 32) -> None:
         rate,
         "cell-updates/sec",
         PER_CHIP_TARGET,
+        bytes_per_cell=2.0,  # uint8 read + write per step
     )
 
 
@@ -136,6 +151,7 @@ def bench_packed(size: int, rule: str, config: str, steps: int = 64) -> None:
         rate,
         "cell-updates/sec",
         PER_CHIP_TARGET,
+        bytes_per_cell=0.25,  # uint32 word read + write per 32 cells
     )
 
 
@@ -160,6 +176,7 @@ def bench_packed_gen(size: int, rule: str, config: str, steps: int = 32) -> None
         rate,
         "cell-updates/sec",
         PER_CHIP_TARGET,
+        bytes_per_cell=0.25 * bitpack_gen.n_planes(r.states),
     )
 
 
@@ -193,6 +210,7 @@ def bench_sharded(size: int, steps: int = 64) -> None:
         rate,
         "cell-updates/sec",
         PER_CHIP_TARGET * n_dev,
+        bytes_per_cell=0.25,
     )
 
     # 2-D variant: rows × word-columns (the pod-scale layout).
@@ -220,6 +238,7 @@ def bench_sharded(size: int, steps: int = 64) -> None:
         rate,
         "cell-updates/sec",
         PER_CHIP_TARGET * n_dev,
+        bytes_per_cell=0.25,
     )
 
 

@@ -55,3 +55,31 @@ def test_no_tmp_litter_on_success(tmp_path):
     store = CheckpointStore(str(tmp_path))
     store.save(1, np.zeros((4, 4), np.uint8), "conway")
     assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_orbax_tmp_step_dir_counts_as_orbax(tmp_path):
+    """A crash during the very first async orbax save leaves only a
+    tmp-suffixed step dir; the foreign-format guard must still fire
+    (ADVICE.md round 1)."""
+    from akka_game_of_life_tpu.runtime.checkpoint import make_store
+
+    (tmp_path / "0.orbax-checkpoint-tmp-1721234567").mkdir()
+    with pytest.raises(ValueError, match="orbax"):
+        make_store(str(tmp_path), "npz")
+
+
+def test_native_engine_rejects_overflowing_boards():
+    """Flat cell indices are int32; ae_create must refuse h*w > INT32_MAX
+    instead of silently corrupting addressing (ADVICE.md round 1)."""
+    from akka_game_of_life_tpu.native import available
+
+    if not available():
+        pytest.skip("native engine unavailable")
+    import ctypes
+
+    from akka_game_of_life_tpu.native import load as load_lib
+
+    lib = load_lib()
+    one = (ctypes.c_uint8 * 1)(0)
+    ptr = lib.ae_create(70000, 70000, one, 8, 12, 2, 0)
+    assert not ptr

@@ -249,3 +249,111 @@ def test_graceful_goodbye_redeploys():
         assert h.frontend.error is None
         final = h.frontend.final_board
     assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 120))
+
+
+class _RecordingChannel:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+    def close(self):
+        pass
+
+
+def test_pull_retry_escalation_reports_gather_failed():
+    """Unanswered halo pulls escalate to GATHER_FAILED after
+    max_pull_retries — the gatherer's give-up → FailedToGatherInfoMsg path
+    (NextStateCellGathererActor.scala:49-58), which the reference's forever-
+    retrying round-1 loop lacked (VERDICT.md missing #4).  Like the
+    reference's cell, the tile keeps its state and keeps retrying."""
+    from akka_game_of_life_tpu.runtime import protocol as P
+
+    w = BackendWorker(
+        "127.0.0.1", 0, name="w", engine="numpy", retry_s=0.02, max_pull_retries=3
+    )
+    chan = _RecordingChannel()
+    w.channel = chan
+    w._on_deploy(
+        {
+            "type": P.DEPLOY,
+            "tiles": [
+                {"id": [0, 0], "epoch": 0, "array": np.zeros((4, 4), np.uint8)}
+            ],
+            "rule": "conway",
+            "target": 5,
+            "final_epoch": 5,
+        }
+    )
+    t = threading.Thread(target=w._retry_loop, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while not any(m["type"] == P.GATHER_FAILED for m in chan.sent):
+        assert time.monotonic() < deadline, "never escalated"
+        time.sleep(0.01)
+    failed = [m for m in chan.sent if m["type"] == P.GATHER_FAILED]
+    assert failed[0]["epoch"] == 0
+    assert (0, 0) in w.tiles  # tile state kept — only the parent may redeploy
+    pulls = [m for m in chan.sent if m["type"] == P.PULL]
+    assert len(pulls) >= 1 + 3  # initial + re-asks, still retrying
+    w._stop.set()
+
+
+def test_wedged_neighbor_redeployed_via_gather_failed():
+    """A worker that is alive at the protocol level (heartbeats flow) but
+    wedged in compute: its neighbor's GATHER_FAILED escalation makes the
+    frontend judge the silent tiles stuck (no ring for stuck_timeout_s) and
+    move them to a healthy worker; the run completes bit-identically.
+    Heartbeat eviction alone can never catch this failure mode."""
+    cfg = SimulationConfig(
+        height=32, width=32, seed=21, max_epochs=40,
+        max_pull_retries=2, stuck_timeout_s=0.5,
+    )
+    with cluster(cfg, 2) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        # Wedge one worker's compute before deployment; its dispatch thread
+        # and heartbeats stay live (a local wedge, not a PAUSE broadcast).
+        h.workers[1].paused = True
+        h.frontend.start_simulation()
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+        healthy = h.workers[0].name
+        assert all(o == healthy for o in h.frontend.tile_owner.values())
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 40))
+
+
+def test_restart_budget_escalates_to_run_failure():
+    """A tile redeployed past restart_max within the window fails the run
+    loudly — the OneForOneStrategy restart cap (BoardCreator.scala:42-45)
+    the round-1 frontend lacked (VERDICT.md missing #3)."""
+    cfg = SimulationConfig(
+        height=16, width=16, seed=1, max_epochs=10, tick_s=1.0,
+        restart_max=3, restart_window_s=60.0,
+    )
+    with cluster(cfg, 2) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        tile = h.frontend.layout.tile_ids[0]
+        for _ in range(4):
+            h.frontend._redeploy_tile(tile)
+        assert h.frontend.done.wait(5)
+        assert "restart budget" in (h.frontend.error or "")
+
+
+def test_ring_history_bounded_without_checkpoints():
+    """With no checkpoint store, boundary rings must still be pruned (via the
+    in-memory checkpoint cadence) — the reference's unbounded-History bug
+    (SURVEY.md §2 bug 5) must not reproduce at tile granularity
+    (VERDICT.md weak #6)."""
+    cfg = SimulationConfig(height=32, width=32, seed=9, max_epochs=150)
+    with cluster(cfg, 2) as h:
+        final = h.run_to_completion()
+        nrings = len(h.frontend.boundary._rings)
+        ntiles = len(h.frontend.layout.tile_ids)
+        last_mem_ckpt = h.frontend._last_ckpt[0]
+    assert last_mem_ckpt >= 128  # in-memory checkpoints advanced
+    # Bounded by the cadence window, not by total epochs (151 rings/tile).
+    assert nrings <= ntiles * 64
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 150))

@@ -65,3 +65,31 @@ def test_observer_log_file(tmp_path):
     with BoardObserver(render_every=1, log_file=str(path)) as obs:
         obs.observe(0, np.ones((2, 2), dtype=np.uint8))
     assert "##" in path.read_text()
+
+
+def test_observer_ignores_rereports_arbitrarily_far_back():
+    """A tile replaying from a checkpoint re-reports epochs completed long
+    ago (more than any fixed window); those must not recreate partial
+    entries, which could never complete (VERDICT.md weak #7)."""
+    obs = BoardObserver(out=io.StringIO())
+    obs.expect_tiles(2)
+    t = np.zeros((2, 2), np.uint8)
+    for epoch in range(1, 401):
+        assert obs.observe_tile(epoch, (0, 0), t) is None
+        assert obs.observe_tile(epoch, (0, 2), t) is not None
+    # Replay storm: re-report epochs 1..400 from one tile only.
+    for epoch in range(1, 401):
+        assert obs.observe_tile(epoch, (0, 0), t) is None
+    assert obs._partial == {}
+
+
+def test_observer_drops_unfinishable_partials():
+    """When epoch E completes, every tile has passed any E' < E, so a
+    lingering partial at E' can never complete and must be dropped."""
+    obs = BoardObserver(out=io.StringIO())
+    obs.expect_tiles(2)
+    t = np.zeros((2, 2), np.uint8)
+    assert obs.observe_tile(10, (0, 0), t) is None  # never completed
+    assert obs.observe_tile(20, (0, 0), t) is None
+    assert obs.observe_tile(20, (0, 2), t) is not None
+    assert obs._partial == {}
