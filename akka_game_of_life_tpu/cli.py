@@ -70,6 +70,18 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--metrics-every", type=int)
     p.add_argument("--log-file")
     p.add_argument("--inject-faults", action="store_true", default=None)
+    p.add_argument(
+        "--distributed",
+        action="store_true",
+        default=None,
+        help="initialize the JAX distributed runtime so the mesh spans all "
+        "hosts (pod scale); on TPU pods the coordinator/rank flags are "
+        "auto-detected, elsewhere set them or GOL_COORDINATOR / "
+        "GOL_NUM_PROCESSES / GOL_PROCESS_ID",
+    )
+    p.add_argument("--coordinator", metavar="HOST:PORT")
+    p.add_argument("--num-processes", type=int)
+    p.add_argument("--process-id", type=int)
 
 
 def _overrides(args: argparse.Namespace) -> dict:
@@ -97,6 +109,10 @@ def _overrides(args: argparse.Namespace) -> dict:
         "render_max_cells": args.render_max_cells,
         "metrics_every": args.metrics_every,
         "log_file": args.log_file,
+        "distributed": args.distributed,
+        "coordinator_address": args.coordinator,
+        "num_processes": args.num_processes,
+        "process_id": args.process_id,
     }
     if args.inject_faults:
         out["fault_injection"] = {"enabled": True}
@@ -158,10 +174,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 print(f"[profile] {dev}: {stats}", flush=True)
         if cfg.render_every == 0 and cfg.metrics_every == 0:
             # Always show something at the end, like the reference's info.log.
+            # board_host() is a collective in multi-host runs — every rank
+            # calls it; only rank 0 prints.
             from akka_game_of_life_tpu.runtime.render import render_ascii
 
-            print(f"epoch {sim.epoch}:")
-            print(render_ascii(sim.board_host(), cfg.render_max_cells))
+            final = sim.board_host()
+            import jax
+
+            if jax.process_index() == 0:
+                print(f"epoch {sim.epoch}:")
+                print(render_ascii(final, cfg.render_max_cells))
         return 0
 
     if args.command == "frontend":

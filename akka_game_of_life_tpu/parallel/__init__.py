@@ -22,3 +22,4 @@ from akka_game_of_life_tpu.parallel.packed_halo2d import (  # noqa: F401
     sharded_packed2d_step_fn,
     word_halo_width,
 )
+from akka_game_of_life_tpu.parallel import distributed  # noqa: F401

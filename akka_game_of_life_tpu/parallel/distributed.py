@@ -1,0 +1,126 @@
+"""Multi-host (pod-scale) runtime over the JAX distributed runtime.
+
+The reference scales out by joining backend JVMs into an Akka cluster over
+Netty TCP with gossip membership and a static seed node
+(``application.conf:19-23``, ``Run.scala:56-65``).  At pod scale the
+TPU-native analog is ``jax.distributed``: one process per host connects to a
+coordinator over DCN, after which ``jax.devices()`` is the GLOBAL device
+list and a mesh built over it spans hosts — XLA routes collectives over ICI
+within a slice and over DCN across slices (SURVEY.md §2 "TPU-native
+equivalent").
+
+Usage (one process per host, same program on every host):
+
+    from akka_game_of_life_tpu.parallel import distributed
+    distributed.initialize("host0:8476", num_processes=4, process_id=rank)
+    mesh = make_grid_mesh()                      # spans ALL hosts' chips
+    arr = distributed.make_global_array(board, mesh)
+    out = sharded_step_fn(mesh, "conway", steps_per_call=k)(arr)
+    full = distributed.fetch(out)                # host copy, all shards
+
+On a real TPU pod slice every argument of :func:`initialize` is
+auto-detected from the TPU metadata — call it with no arguments.  On
+CPU/GPU clusters (and the 2-process CPU dryrun test) pass them explicitly
+or via the ``GOL_COORDINATOR`` / ``GOL_NUM_PROCESSES`` / ``GOL_PROCESS_ID``
+environment variables — the moral equivalent of the reference's seed-node
+address + argv port overlay (``Run.scala:27-32``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from akka_game_of_life_tpu.parallel.mesh import GRID_SPEC
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> bool:
+    """Idempotent ``jax.distributed.initialize`` with env fallbacks.
+
+    Returns True if this call performed the initialization, False if the
+    runtime was already up (safe to call from every entry point).  Must run
+    before any device query — the same touch-ordering rule the dryrun
+    enforces (``__graft_entry__.dryrun_multichip``).
+    """
+    global _initialized
+    if _initialized:
+        return False
+    coordinator_address = coordinator_address or os.environ.get("GOL_COORDINATOR")
+    if num_processes is None and os.environ.get("GOL_NUM_PROCESSES"):
+        num_processes = int(os.environ["GOL_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("GOL_PROCESS_ID"):
+        process_id = int(os.environ["GOL_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _initialized = True
+    return True
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_info() -> tuple:
+    """(process_index, process_count) — (0, 1) when not distributed."""
+    return jax.process_index(), jax.process_count()
+
+
+def make_global_array(
+    board, mesh, spec: PartitionSpec = GRID_SPEC
+) -> jax.Array:
+    """Shard a host-replicated board onto a (possibly multi-host) mesh.
+
+    Every process passes the same full board (deterministic initial
+    conditions make that free — ``runtime/simulation.py:initial_board``);
+    each materializes only the shards its own devices address, so no process
+    ever holds more than its slice on device.  Works unchanged on a
+    single-host mesh, where it is equivalent to ``shard_board``.
+    """
+    board = np.asarray(board)
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        board.shape, sharding, lambda idx: board[idx]
+    )
+
+
+def fetch(arr) -> np.ndarray:
+    """Bring a (possibly non-fully-addressable) array to the host, whole.
+
+    Single-host arrays copy directly; multi-host arrays are assembled with
+    an all-gather across processes, so every host gets the full board (the
+    render/checkpoint path's host copy)."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+def barrier(tag: str = "gol") -> None:
+    """Cross-host sync point (checkpoint durability, orderly shutdown)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)

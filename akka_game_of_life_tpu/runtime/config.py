@@ -96,6 +96,16 @@ class SimulationConfig:
     halo_width: int = 1
     mesh_shape: Optional[Tuple[int, int]] = None  # None = auto-factor devices
 
+    # Multi-host (pod-scale): bring up the JAX distributed runtime so the
+    # mesh spans every host's chips (SURVEY.md §2 TPU-native equivalent of
+    # the reference's multi-JVM Akka cluster).  On TPU pods leave the three
+    # None fields unset (auto-detected); on CPU/GPU clusters set them or the
+    # GOL_COORDINATOR / GOL_NUM_PROCESSES / GOL_PROCESS_ID env vars.
+    distributed: bool = False
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
     # Control plane.
     role: str = "standalone"  # standalone | frontend | backend
     host: str = "127.0.0.1"

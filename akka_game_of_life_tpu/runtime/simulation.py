@@ -25,6 +25,7 @@ import numpy as np
 from akka_game_of_life_tpu.models import get_model
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 from akka_game_of_life_tpu.parallel import (
+    distributed as dist,
     make_grid_mesh,
     shard_board,
     sharded_step_fn,
@@ -59,6 +60,24 @@ class Simulation:
     ) -> None:
         self.config = config
         self.rule = resolve_rule(config.rule)
+        if config.distributed:
+            # Must happen before ANY backend init — including the checkpoint
+            # store below (orbax queries process_index/count at construction)
+            # and the jax.devices() query further down.  After this,
+            # devices() is the GLOBAL list spanning every host.
+            dist.initialize(
+                config.coordinator_address,
+                config.num_processes,
+                config.process_id,
+            )
+            if config.fault_injection.enabled:
+                raise ValueError(
+                    "fault_injection with distributed=True is unsupported: "
+                    "crash points are per-process wall-clock, so ranks would "
+                    "replay different epochs and desynchronize cross-host "
+                    "collectives (use the cluster control plane's injector "
+                    "for multi-process chaos)"
+                )
         self.observer = observer or BoardObserver(
             render_every=config.render_every,
             render_max_cells=config.render_max_cells,
@@ -129,8 +148,13 @@ class Simulation:
     def _to_device(self, board: np.ndarray):
         if self._actor_board is not None:
             return board
-        arr = jnp.asarray(board)
-        return shard_board(arr, self.mesh) if self.mesh is not None else arr
+        if self.mesh is not None:
+            if jax.process_count() > 1:
+                # Multi-host mesh: every process materializes only the
+                # shards its own devices address.
+                return dist.make_global_array(board, self.mesh)
+            return shard_board(jnp.asarray(board), self.mesh)
+        return jnp.asarray(board)
 
     def _stepper(self, k: int) -> Callable:
         """A k-epoch advance: jitted scan (cached per k) on the tpu backend,
@@ -190,8 +214,9 @@ class Simulation:
             if _crosses(prev, self.epoch, cfg.render_every) or _crosses(
                 prev, self.epoch, cfg.metrics_every
             ):
-                host_board = np.asarray(self.board)
-                self.observer.observe(self.epoch, host_board)
+                host_board = self.board_host()
+                if jax.process_index() == 0:
+                    self.observer.observe(self.epoch, host_board)
             if self.store is not None and _crosses(
                 prev, self.epoch, cfg.checkpoint_every
             ):
@@ -232,6 +257,25 @@ class Simulation:
     def checkpoint(self, host_board: Optional[np.ndarray] = None) -> None:
         if self.store is None:
             raise RuntimeError("no checkpoint_dir configured")
+        if (
+            self.config.checkpoint_format == "npz"
+            and jax.process_count() > 1
+            and jax.process_index() != 0
+        ):
+            # The npz store is a host-side writer: exactly one process owns
+            # the file.  (The orbax store is multihost-aware — every process
+            # participates in a sharded save — so it is not gated.)
+            if host_board is None:
+                self.board_host()  # keep the collective fetch in lockstep
+            return
+        if (
+            host_board is None
+            and jax.process_count() > 1
+            and self.config.checkpoint_format == "npz"
+        ):
+            # npz is a host-side writer and needs the whole board; orbax
+            # keeps its device-native sharded save — no cross-host gather.
+            host_board = self.board_host()
         if host_board is None:
             # The store decides where the bytes come from: the orbax store
             # saves the (possibly sharded) device array without host gather;
@@ -255,7 +299,7 @@ class Simulation:
             _save()
 
     def board_host(self) -> np.ndarray:
-        return np.asarray(self.board)
+        return dist.fetch(self.board)
 
     def close(self) -> None:
         """Finalize: block until async checkpoint saves are durable.  Must be

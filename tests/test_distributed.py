@@ -1,0 +1,56 @@
+"""Multi-host scaffolding: 2 real processes over jax.distributed on CPU.
+
+The pod-scale path SURVEY.md §2 calls for (`jax.distributed` over DCN for
+multi-host meshes): two OS processes, each with 2 virtual CPU devices, form
+one 4-device global mesh; halo ppermutes cross the process boundary through
+gloo collectives — the CPU stand-in for ICI/DCN.  Asserts both the raw
+sharded kernel and the Simulation runtime produce the dense oracle's board
+(VERDICT.md missing #5 / next-round #8)."""
+
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+WORKER = Path(__file__).with_name("_dist_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_global_mesh_matches_dense_oracle():
+    port = _free_port()
+    env = {
+        "PYTHONPATH": str(Path(__file__).resolve().parents[1]),
+        "PATH": "/usr/bin:/bin",
+        "HOME": "/tmp",
+        # Workers pin jax_platforms=cpu themselves (env alone is not honored
+        # when a PJRT plugin pins the platform at boot).
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(port), str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out[-3000:]}"
+        assert f"DIST-OK rank={pid}" in out
