@@ -15,13 +15,24 @@ advanced by a stencil engine:
   (:mod:`akka_game_of_life_tpu.runtime.actor_engine`) — the reference's own
   architecture, swappable at role config (BASELINE config 1).
 
-Per-epoch cycle per tile (the ``CellActor``/gatherer loop collapsed):
-PULL halo(E) → (queued at the frontend until all 8 neighbor rings at E exist)
-→ HALO reply → step to E+1 → push RING(E+1) → PULL halo(E+1)...  A pending
-pull is re-sent after ``retry_s`` (the gatherer's 1 s Retry timer,
-``NextStateCellGathererActor.scala:28``).  Tiles lag and catch up
-independently — there is no global barrier, matching the reference's
-history-buffered asynchrony (``CellActor.scala:41-47``)."""
+**The data plane is peer-to-peer.**  Workers serve each other's boundary
+reads directly, exactly as the reference's gatherers ask neighbor cells
+directly (``NextStateCellGathererActor.scala:32-36``) — the frontend only
+brokers addresses and ownership (OWNERS), never relays ring bytes
+(VERDICT.md weak #4: the round-1 star topology through the coordinator).
+Each worker runs a peer listener plus a local epoch-tagged
+:class:`BoundaryStore`; per-epoch cycle per tile:
+
+  pull halo(E) from the LOCAL store (queued until all 8 neighbor rings at E
+  are present) → step to E+1 → push RING(E+1) locally and PEER_RING it to
+  each distinct owner of the tile's 8 neighbors → PROGRESS ping to the
+  frontend (control only) → pull halo(E+1)...
+
+A stale pull re-asks only the owners of the *missing* rings via PEER_PULL
+(the gatherer's 1 s Retry, ``NextStateCellGathererActor.scala:28``) and
+escalates to the frontend with GATHER_FAILED after ``max_pull_retries``.
+Tiles lag and catch up independently — no global barrier, matching the
+reference's history-buffered asynchrony (``CellActor.scala:41-47``)."""
 
 from __future__ import annotations
 
@@ -29,16 +40,16 @@ import os
 import socket
 import threading
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from akka_game_of_life_tpu.ops.npkernel import step_padded_np
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 from akka_game_of_life_tpu.runtime import protocol as P
-from akka_game_of_life_tpu.runtime.boundary import Halo
-from akka_game_of_life_tpu.runtime.tiles import Ring, TileId
-from akka_game_of_life_tpu.runtime.wire import Channel
+from akka_game_of_life_tpu.runtime.boundary import BoundaryStore, Halo
+from akka_game_of_life_tpu.runtime.tiles import Ring, TileId, TileLayout
+from akka_game_of_life_tpu.runtime.wire import Channel, pack_tile, unpack_tile
 
 
 class _Tile:
@@ -62,8 +73,32 @@ def _jax_engine(rule: Rule) -> Callable[[np.ndarray], np.ndarray]:
     return run
 
 
+def _ring_msg(tid: TileId, epoch: int, ring: Ring) -> dict:
+    return {
+        "type": P.PEER_RING,
+        "tile": list(tid),
+        "epoch": epoch,
+        "top": ring.top,
+        "bottom": ring.bottom,
+        "left": ring.left,
+        "right": ring.right,
+        "corners": ring.corners,
+    }
+
+
+def _ring_of_msg(msg: dict) -> Ring:
+    return Ring(
+        top=msg["top"],
+        bottom=msg["bottom"],
+        left=msg["left"],
+        right=msg["right"],
+        corners={k: int(v) for k, v in msg["corners"].items()},
+    )
+
+
 class BackendWorker:
-    """One worker process/thread: joins, hosts tiles, steps them."""
+    """One worker process/thread: joins, hosts tiles, steps them, and serves
+    its boundary rings to peer workers directly."""
 
     def __init__(
         self,
@@ -74,6 +109,7 @@ class BackendWorker:
         engine: str = "jax",
         retry_s: float = 1.0,
         max_pull_retries: int = 10,
+        peer_host: str = "0.0.0.0",
         crash_hook: Optional[Callable[[], None]] = None,
     ) -> None:
         if engine not in ("numpy", "jax", "actor", "actor-native"):
@@ -102,6 +138,8 @@ class BackendWorker:
         self.render_every = 0
         self.checkpoint_every = 0
         self.metrics_every = 0
+        self.render_strides: Tuple[int, int] = (1, 1)
+        self.origins: Dict[TileId, Tuple[int, int]] = {}
         self.paused = False
         self.channel: Optional[Channel] = None
         self._step_padded: Optional[Callable[[np.ndarray], np.ndarray]] = None
@@ -110,13 +148,26 @@ class BackendWorker:
         self._stop = threading.Event()
         self.stopped_reason: Optional[str] = None
 
+        # -- peer-to-peer data plane -----------------------------------------
+        self.layout: Optional[TileLayout] = None
+        self.store: Optional[BoundaryStore] = None
+        # tile → (owner name, host, port); OWNERS broadcasts keep it current
+        self.owners: Dict[TileId, Tuple[str, str, int]] = {}
+        self._peers: Dict[str, Channel] = {}  # dialed, by owner name
+        self._peer_lock = threading.Lock()
+        self._peer_listener = socket.create_server((peer_host, 0))
+        self.peer_port = self._peer_listener.getsockname()[1]
+        threading.Thread(target=self._peer_accept_loop, daemon=True).start()
+
     # -- lifecycle -----------------------------------------------------------
 
     def connect(self) -> None:
         sock = socket.create_connection((self.host, self.port), timeout=10)
         sock.settimeout(None)
         self.channel = Channel(sock)
-        self.channel.send({"type": P.REGISTER, "name": self.name})
+        self.channel.send(
+            {"type": P.REGISTER, "name": self.name, "peer_port": self.peer_port}
+        )
         welcome = self.channel.recv()
         if not welcome or welcome.get("type") != P.WELCOME:
             raise ConnectionError("frontend did not welcome us")
@@ -158,6 +209,112 @@ class BackendWorker:
             except OSError:
                 pass
             self.channel.close()
+        try:
+            self._peer_listener.close()
+        except OSError:
+            pass
+        with self._peer_lock:
+            for ch in self._peers.values():
+                ch.close()
+            self._peers.clear()
+
+    # -- peer plumbing ---------------------------------------------------------
+
+    def _peer_accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._peer_listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_peer, args=(Channel(sock),), daemon=True
+            ).start()
+
+    def _serve_peer(self, channel: Channel) -> None:
+        try:
+            while not self._stop.is_set():
+                msg = channel.recv()
+                if msg is None:
+                    return
+                self._on_peer_msg(msg, channel)
+        except (OSError, ValueError):
+            pass
+
+    def _on_peer_msg(self, msg: dict, channel: Channel) -> None:
+        kind = msg.get("type")
+        if kind == P.PEER_HELLO:
+            # Adopt the incoming channel for our own pushes to that peer —
+            # peer links are symmetric, so one TCP connection per pair.
+            name = msg.get("name")
+            if name:
+                with self._peer_lock:
+                    self._peers.setdefault(name, channel)
+        elif kind == P.PEER_RING:
+            if self.store is not None:
+                # push_ring fires queued local pull callbacks (_apply_halo).
+                self.store.push_ring(
+                    tuple(msg["tile"]), int(msg["epoch"]), _ring_of_msg(msg)
+                )
+        elif kind == P.PEER_PULL:
+            # Serve every ring we have from the asked epoch forward: a
+            # redeployed neighbor replaying from a checkpoint streams its
+            # whole catch-up window in one exchange instead of one
+            # round-trip per epoch.
+            tile, epoch = tuple(msg["tile"]), int(msg["epoch"])
+            rings = self.store.rings_from(tile, epoch) if self.store else []
+            for e, ring in rings:
+                try:
+                    channel.send(_ring_msg(tile, e, ring))
+                except OSError:
+                    return
+
+    def _peer_channel(self, owner: str) -> Optional[Channel]:
+        """The dialed channel to a peer worker, connecting on first use."""
+        entry = self.owners_by_name().get(owner)
+        if entry is None:
+            return None
+        host, port = entry
+        with self._peer_lock:
+            ch = self._peers.get(owner)
+            if ch is not None:
+                return ch
+            try:
+                sock = socket.create_connection((host, port), timeout=5)
+                sock.settimeout(None)
+            except OSError:
+                return None
+            ch = Channel(sock)
+            self._peers[owner] = ch
+        # Peer channels are bidirectional: the accepting side serves our
+        # PEER_PULLs and may push rings back on the same socket.
+        threading.Thread(target=self._serve_peer, args=(ch,), daemon=True).start()
+        try:
+            ch.send({"type": P.PEER_HELLO, "name": self.name})
+        except OSError:
+            self._drop_peer(owner)
+            return None
+        return ch
+
+    def _drop_peer(self, owner: str) -> None:
+        with self._peer_lock:
+            ch = self._peers.pop(owner, None)
+        if ch is not None:
+            ch.close()
+
+    def owners_by_name(self) -> Dict[str, Tuple[str, int]]:
+        with self._lock:
+            return {name: (host, port) for name, host, port in self.owners.values()}
+
+    def _send_peer(self, owner: str, msg: dict) -> None:
+        ch = self._peer_channel(owner)
+        if ch is None:
+            return
+        try:
+            ch.send(msg)
+        except OSError:
+            # Stale address or dead peer: drop; OWNERS rewiring + the retry
+            # loop's PEER_PULLs recover.
+            self._drop_peer(owner)
 
     # -- helper threads ------------------------------------------------------
 
@@ -170,35 +327,37 @@ class BackendWorker:
                 return
 
     def _retry_loop(self) -> None:
-        """The gatherer's Retry timer: re-pull stale halo requests.
+        """The gatherer's Retry timer: re-ask the owners of missing rings.
 
-        After ``max_pull_retries`` unanswered re-pulls the worker escalates
+        After ``max_pull_retries`` unanswered re-asks the worker escalates
         with GATHER_FAILED — the reference's gatherer gives up after 2 ask
         rounds and fires ``FailedToGatherInfoMsg`` so its parent repairs the
         neighborhood (``NextStateCellGathererActor.scala:49-58``,
-        ``CellActor.scala:92-94``).  Like the reference, the tile keeps its
-        state and keeps retrying; the frontend decides whether a blocking
-        neighbor is genuinely stuck and needs redeployment."""
+        ``CellActor.scala:92-94``).  The tile keeps its state and keeps
+        retrying; the frontend decides whether a blocking neighbor is
+        genuinely stuck."""
         while not self._stop.is_set():
             time.sleep(self.retry_s / 4)
             now = time.monotonic()
-            failed = []
+            failed: List[Tuple[TileId, int]] = []
+            stale: List[Tuple[TileId, int]] = []
             with self._lock:
                 if self.paused:
                     continue
-                stale = [
-                    (tid, t)
-                    for tid, t in self.tiles.items()
-                    if t.awaiting_since is not None
-                    and now - t.awaiting_since > self.retry_s
-                ]
-                for tid, t in stale:
+                for tid, t in self.tiles.items():
+                    if (
+                        t.awaiting_since is None
+                        or now - t.awaiting_since <= self.retry_s
+                    ):
+                        continue
                     t.retries += 1
                     if t.retries > self.max_pull_retries:
                         t.retries = 0  # re-arm: escalate again if still stuck
                         failed.append((tid, t.epoch))
                     t.awaiting_since = now
-                    self._send_pull(tid, t)
+                    stale.append((tid, t.epoch))
+            for tid, epoch in stale:
+                self._ask_missing(tid, epoch)
             for tid, epoch in failed:
                 try:
                     self.channel.send(
@@ -213,19 +372,22 @@ class BackendWorker:
         kind = msg.get("type")
         if kind == P.DEPLOY:
             self._on_deploy(msg)
+        elif kind == P.OWNERS:
+            self._on_owners(msg)
         elif kind == P.TICK:
             with self._lock:
                 self.target = int(msg["target"])
-                self._kick()
-        elif kind == P.HALO:
-            self._on_halo(msg)
+            self._kick()
+        elif kind == P.PRUNE:
+            if self.store is not None:
+                self.store.prune_below(int(msg["floor"]))
         elif kind == P.PAUSE:
             with self._lock:
                 self.paused = True
         elif kind == P.RESUME:
             with self._lock:
                 self.paused = False
-                self._kick()
+            self._kick()
         elif kind == P.CRASH:
             self.crash_hook()
         elif kind == P.CRASH_TILE:
@@ -235,7 +397,32 @@ class BackendWorker:
             self._stop.set()
             self.channel.close()
 
+    def _on_owners(self, msg: dict) -> None:
+        """Ownership/wiring update — the reference's NeighboursRefs re-send
+        (``BoardCreator.scala:149-151``)."""
+        grid = tuple(msg["grid"])
+        shape = tuple(msg["shape"])
+        dropped: List[TileId] = []
+        with self._lock:
+            if self.layout is None or self.layout.grid != grid:
+                self.layout = TileLayout(shape, grid)
+                self.store = BoundaryStore(self.layout)
+            self.owners = {
+                tuple(t): (name, host, int(port))
+                for t, name, host, port in msg["tiles"]
+            }
+            # Tiles moved away from us (e.g. judged stuck and re-placed):
+            # stop stepping them; the new owner replays from the checkpoint.
+            for tid in [t for t in self.tiles if self.owners.get(t, ("",))[0] != self.name]:
+                del self.tiles[tid]
+                self._actor_engines.pop(tid, None)
+                dropped.append(tid)
+        if dropped and self.store is not None:
+            for tid in dropped:
+                self.store.drop_pending_for_owner([tid])
+
     def _on_deploy(self, msg: dict) -> None:
+        outbound: List[Tuple[TileId, _Tile]] = []
         with self._lock:
             rule = resolve_rule(msg["rule"])
             if self.rule != rule:
@@ -250,10 +437,12 @@ class BackendWorker:
             self.render_every = int(msg.get("render_every", 0))
             self.checkpoint_every = int(msg.get("checkpoint_every", 0))
             self.metrics_every = int(msg.get("metrics_every", 0))
+            self.render_strides = tuple(msg.get("render_strides", (1, 1)))
             for spec in msg["tiles"]:
                 tid: TileId = tuple(spec["id"])
-                tile = _Tile(np.asarray(spec["array"]), int(spec["epoch"]))
+                tile = _Tile(unpack_tile(spec["state"]), int(spec["epoch"]))
                 self.tiles[tid] = tile
+                self.origins[tid] = tuple(spec.get("origin", (0, 0)))
                 if self.engine == "actor":
                     # A (re)deploy is a supervision restart: fresh actors,
                     # histories reseeded from the deployed array.
@@ -268,40 +457,13 @@ class BackendWorker:
                     )
 
                     self._actor_engines[tid] = NativeActorTileEngine(rule)
-                # Announce our boundary at the deployed epoch so neighbors
-                # can assemble their halos (History seeding,
-                # CellActor.scala:34).
-                self._send_ring(tid, tile)
-                self._maybe_send_state(tid, tile)
-            self._kick()
-
-    def _on_halo(self, msg: dict) -> None:
-        tid: TileId = tuple(msg["tile"])
-        epoch = int(msg["epoch"])
-        with self._lock:
-            tile = self.tiles.get(tid)
-            if (
-                tile is None
-                or epoch != tile.epoch  # stale/duplicate reply: drop
-                or self.paused
-                or tile.epoch >= self.target
-            ):
-                if tile is not None and epoch == tile.epoch:
-                    tile.awaiting_since = None  # paused: clear latch
-                return
-            halo = Halo.from_wire(msg["halo"])
-            padded = halo.pad(tile.arr)
-            if self.engine in ("actor", "actor-native"):
-                tile.arr = self._actor_engines[tid].step(padded)
-            else:
-                tile.arr = self._step_padded(padded)
-            tile.epoch += 1
-            tile.awaiting_since = None
-            tile.retries = 0
-            self._send_ring(tid, tile)
-            self._maybe_send_state(tid, tile)
-            if tile.epoch < self.target:
-                self._send_pull(tid, tile)
+                outbound.append((tid, tile))
+        for tid, tile in outbound:
+            # Announce our boundary at the deployed epoch so neighbors can
+            # assemble their halos (History seeding, CellActor.scala:34).
+            self._publish_ring(tid, tile)
+            self._report_state(tid, tile)
+        self._kick()
 
     def _on_crash_tile(self, tid: TileId) -> None:
         """Supervision-restart analog: the tile's in-memory state is lost;
@@ -319,42 +481,136 @@ class BackendWorker:
     # -- stepping plumbing ---------------------------------------------------
 
     def _kick(self) -> None:
-        """Start pulls for every tile that is behind and not already waiting
-        (scheduleTransitionToNextepochIfNeeded, CellActor.scala:41-47)."""
-        if self.paused:
-            return
-        for tid, tile in self.tiles.items():
-            if tile.epoch < self.target and tile.awaiting_since is None:
-                self._send_pull(tid, tile)
+        """Start the drive loop for every tile that is behind and not
+        already waiting (scheduleTransitionToNextepochIfNeeded,
+        CellActor.scala:41-47).  Must be called WITHOUT the lock held — the
+        drive loop sends to peer sockets, and no thread may hold its worker
+        lock while writing into another worker (deadlock discipline)."""
+        with self._lock:
+            tids = list(self.tiles)
+        for tid in tids:
+            self._drive(tid)
 
-    def _send_pull(self, tid: TileId, tile: _Tile) -> None:
-        tile.awaiting_since = time.monotonic()
-        try:
-            self.channel.send(
-                {"type": P.PULL, "tile": list(tid), "epoch": tile.epoch}
+    def _drive(self, tid: TileId) -> None:
+        """Advance a tile while halos are immediately available, registering
+        one queued pull when they are not.  Iterative on purpose: a tile
+        replaying thousands of epochs against already-present rings must not
+        recurse once per epoch."""
+        while True:
+            with self._lock:
+                tile = self.tiles.get(tid)
+                if (
+                    tile is None
+                    or self.store is None
+                    or self.paused
+                    or tile.epoch >= self.target
+                    or tile.awaiting_since is not None  # pull already in flight
+                ):
+                    return
+                epoch = tile.epoch
+                # The waitingForNewState latch (CellActor.scala:32): set
+                # before the pull so concurrent kicks don't double-drive.
+                tile.awaiting_since = time.monotonic()
+            halo = self.store.pull_halo_now(
+                tid, epoch, lambda h, e=epoch: self._on_halo_ready(tid, e, h)
             )
-        except OSError:
-            pass
+            if halo is None:
+                # Queued: the last PEER_RING's push will resume us.  Ask the
+                # missing rings' owners right away (first-pull latency; the
+                # retry loop is only the loss backstop).
+                self._ask_missing(tid, epoch)
+                return
+            if not self._step_tile(tid, epoch, halo):
+                return
 
-    def _send_ring(self, tid: TileId, tile: _Tile) -> None:
+    def _ask_missing(self, tid: TileId, epoch: int) -> None:
+        asks: List[Tuple[str, dict]] = []
+        with self._lock:
+            if self.store is None:
+                return
+            for ntile in self.store.missing_neighbor_rings(tid, epoch):
+                entry = self.owners.get(ntile)
+                if entry is not None and entry[0] != self.name:
+                    asks.append(
+                        (
+                            entry[0],
+                            {
+                                "type": P.PEER_PULL,
+                                "tile": list(ntile),
+                                "epoch": epoch,
+                            },
+                        )
+                    )
+        for owner, msg in asks:
+            self._send_peer(owner, msg)
+
+    def _on_halo_ready(self, tid: TileId, epoch: int, halo: Halo) -> None:
+        """Queued-pull completion, on whichever thread pushed the last ring."""
+        if self._step_tile(tid, epoch, halo):
+            self._drive(tid)
+
+    def _step_tile(self, tid: TileId, epoch: int, halo: Halo) -> bool:
+        """One epoch of one tile.  Compute happens under the lock; ring and
+        state sends happen after releasing it so two workers never hold
+        their locks while writing into each other's sockets."""
+        with self._lock:
+            tile = self.tiles.get(tid)
+            if (
+                tile is None
+                or epoch != tile.epoch  # stale/duplicate completion: drop
+                or self.paused
+                or tile.epoch >= self.target
+            ):
+                if tile is not None and epoch == tile.epoch:
+                    tile.awaiting_since = None  # paused: clear latch
+                return False
+            padded = halo.pad(tile.arr)
+            if self.engine in ("actor", "actor-native"):
+                tile.arr = self._actor_engines[tid].step(padded)
+            else:
+                tile.arr = self._step_padded(padded)
+            tile.epoch += 1
+            tile.awaiting_since = None
+            tile.retries = 0
+        self._publish_ring(tid, tile)
+        self._report_state(tid, tile)
+        return True
+
+    def _publish_ring(self, tid: TileId, tile: _Tile) -> None:
+        """Store our ring locally (answers our own and co-located pulls) and
+        push it to each distinct remote owner among the tile's 8 neighbors —
+        the direct neighbor-to-neighbor data plane."""
         ring = Ring.of(tile.arr)
+        epoch = tile.epoch
+        if self.store is not None:
+            self.store.push_ring(tid, epoch, ring)
+        with self._lock:
+            remote_owners = sorted(
+                {
+                    self.owners[ntile][0]
+                    for ntile in self.layout.neighbors(tid).values()
+                    if ntile in self.owners and self.owners[ntile][0] != self.name
+                }
+                if self.layout is not None
+                else set()
+            )
+        msg = _ring_msg(tid, epoch, ring)
+        for owner in remote_owners:
+            self._send_peer(owner, msg)
+        # Control-plane progress ping (no arrays): feeds the frontend's
+        # prune floor, stuck detection, and lag accounting.
         try:
             self.channel.send(
-                {
-                    "type": P.RING,
-                    "tile": list(tid),
-                    "epoch": tile.epoch,
-                    "top": ring.top,
-                    "bottom": ring.bottom,
-                    "left": ring.left,
-                    "right": ring.right,
-                    "corners": ring.corners,
-                }
+                {"type": P.PROGRESS, "tile": list(tid), "epoch": epoch}
             )
         except OSError:
             pass
 
-    def _maybe_send_state(self, tid: TileId, tile: _Tile) -> None:
+    def _report_state(self, tid: TileId, tile: _Tile) -> None:
+        """Report tile state at cadence boundaries, shipping only what each
+        reason needs — never the raw full tile (VERDICT.md weak #5):
+        checkpoint/final ride bit-packed (8 cells/byte), render ships the
+        frontend's strided sample, metrics ships a single population count."""
         reasons = []
         e = tile.epoch
         if e == self.final_epoch:
@@ -367,16 +623,28 @@ class BackendWorker:
             reasons.append("metrics")
         if not reasons:
             return
+        msg = {
+            "type": P.TILE_STATE,
+            "tile": list(tid),
+            "epoch": e,
+            "reasons": reasons,
+        }
+        if "final" in reasons or "checkpoint" in reasons:
+            msg["state"] = pack_tile(tile.arr)
+        if "render" in reasons:
+            sy, sx = self.render_strides
+            oy, ox = self.origins.get(tid, (0, 0))
+            # Phase-align to the tile origin so the union over tiles is the
+            # canonical full-board strided probe (cell (0,0) always shown).
+            msg["sample"] = tile.arr[(-oy) % sy :: sy, (-ox) % sx :: sx]
+            msg["scaled_origin"] = [
+                (oy + sy - 1) // sy,
+                (ox + sx - 1) // sx,
+            ]
+        if "metrics" in reasons:
+            msg["population"] = int((tile.arr == 1).sum())
         try:
-            self.channel.send(
-                {
-                    "type": P.TILE_STATE,
-                    "tile": list(tid),
-                    "epoch": e,
-                    "array": tile.arr,
-                    "reasons": reasons,
-                }
-            )
+            self.channel.send(msg)
         except OSError:
             pass
 

@@ -86,17 +86,18 @@ class BoundaryStore:
         for cb, halo in ready:
             cb(halo)
 
-    def pull_halo(
+    def pull_halo_now(
         self, tile: TileId, epoch: int, callback: Callable[[Halo], None]
-    ) -> None:
-        """Request the halo for (tile, epoch); callback fires immediately if
-        assembled, else when the last missing neighbor ring arrives."""
+    ) -> Optional[Halo]:
+        """Return the halo if assemblable right now; otherwise queue
+        ``callback`` for when the last ring lands and return None.  Lets a
+        caller catching up over many epochs consume ready halos in a loop
+        instead of recursing through callbacks."""
         with self._lock:
             halo = self._assemble_locked(tile, epoch)
             if halo is None:
                 self._pending.setdefault((tile, epoch), []).append(callback)
-                return
-        callback(halo)
+            return halo
 
     def _assemble_locked(self, tile: TileId, epoch: int) -> Optional[Halo]:
         nb = self.layout.neighbors(tile)
@@ -118,6 +119,32 @@ class BoundaryStore:
         left = np.asarray(rings["w"].right, dtype=np.uint8)
         right = np.asarray(rings["e"].left, dtype=np.uint8)
         return Halo(top, bottom, left, right)
+
+    def missing_neighbor_rings(self, tile: TileId, epoch: int) -> List[TileId]:
+        """Which of a tile's 8 neighbors have no stored ring at ``epoch`` —
+        the re-ask targets for a stale pull."""
+        with self._lock:
+            return sorted(
+                {
+                    ntile
+                    for ntile in self.layout.neighbors(tile).values()
+                    if (ntile, epoch) not in self._rings
+                }
+            )
+
+    def ring_count(self) -> int:
+        with self._lock:
+            return len(self._rings)
+
+    def rings_from(
+        self, tile: TileId, epoch: int, limit: int = 256
+    ) -> List[Tuple[int, Ring]]:
+        """All stored rings of ``tile`` at epochs >= ``epoch`` (ascending,
+        bounded).  A PEER_PULL reply streams these so a replaying neighbor
+        catches up without one round-trip per epoch."""
+        with self._lock:
+            eps = sorted(e for (t, e) in self._rings if t == tile and e >= epoch)
+            return [(e, self._rings[(tile, e)]) for e in eps[:limit]]
 
     def prune_below(self, epoch: int) -> int:
         """Drop rings for epochs < epoch (called after a durable checkpoint).

@@ -25,6 +25,10 @@ from typing import Optional, Tuple
 import numpy as np
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
+# Per-tile checkpoint epoch directory (streamed saves, no full-board
+# assembly): ckpt_<epoch>.d/tile_<r>_<c>.npz + COMPLETE.json when durable.
+_TILE_DIR_RE = re.compile(r"^ckpt_(\d+)\.d$")
+_COMPLETE = "COMPLETE.json"
 
 
 def _existing_format(directory: str) -> Optional[str]:
@@ -33,7 +37,7 @@ def _existing_format(directory: str) -> Optional[str]:
     if not d.is_dir():
         return None
     for p in d.iterdir():
-        if _CKPT_RE.match(p.name):
+        if _CKPT_RE.match(p.name) or (p.is_dir() and _TILE_DIR_RE.match(p.name)):
             return "npz"
         # An orbax step is a numeric directory carrying orbax metadata —
         # the name alone isn't enough (an unrelated output dir may contain
@@ -123,18 +127,128 @@ class CheckpointStore:
         self._gc()
         return target
 
+    # -- per-tile streaming saves (no full-board assembly anywhere) ----------
+
+    def _tile_dir(self, epoch: int) -> Path:
+        return self.dir / f"ckpt_{epoch:012d}.d"
+
+    def save_tile(self, epoch: int, tile, arr) -> Path:
+        """Stream one tile of epoch ``epoch`` to disk, atomically.
+
+        ``arr`` is a uint8 tile or an already-bit-packed wire payload (the
+        cluster ships tiles packed; they go to disk without a round-trip).
+        Tiles arrive as workers report them; nothing holds more than one
+        tile in memory and no process ever assembles the full board.  The
+        epoch becomes durable (visible to ``latest_epoch``/``load``) only
+        when :meth:`finalize_epoch` lands its COMPLETE marker."""
+        from akka_game_of_life_tpu.runtime.wire import pack_tile
+
+        d = self._tile_dir(epoch)
+        d.mkdir(parents=True, exist_ok=True)
+        payload = arr if isinstance(arr, dict) else pack_tile(
+            np.asarray(arr, dtype=np.uint8)
+        )
+        target = d / f"tile_{int(tile[0])}_{int(tile[1])}.npz"
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez_compressed(
+                    f,
+                    enc=np.frombuffer(payload["enc"].encode(), dtype=np.uint8),
+                    shape=np.asarray(payload["shape"], dtype=np.int64),
+                    data=payload["data"],
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, target)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return target
+
+    def finalize_epoch(
+        self, epoch: int, rule: str, grid, board_shape, meta: Optional[dict] = None
+    ) -> None:
+        """Mark a per-tile epoch durable once every tile has been saved."""
+        d = self._tile_dir(epoch)
+        doc = json.dumps(
+            {
+                "epoch": epoch,
+                "rule": rule,
+                "grid": list(grid),
+                "shape": list(board_shape),
+                **(meta or {}),
+            }
+        )
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(doc)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, d / _COMPLETE)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        self._gc()
+
+    def tile_meta(self, epoch: int) -> dict:
+        return json.loads((self._tile_dir(epoch) / _COMPLETE).read_text())
+
+    def load_tile_payload(self, epoch: int, tile) -> dict:
+        """The tile's wire payload exactly as stored — recovery deploys ship
+        it onward without ever materializing the unpacked tile."""
+        path = self._tile_dir(epoch) / f"tile_{int(tile[0])}_{int(tile[1])}.npz"
+        with np.load(path) as z:
+            return {
+                "enc": bytes(z["enc"].tobytes()).decode(),
+                "shape": [int(v) for v in z["shape"]],
+                "data": z["data"].copy(),
+            }
+
+    def load_tile(self, epoch: int, tile) -> np.ndarray:
+        from akka_game_of_life_tpu.runtime.wire import unpack_tile
+
+        return unpack_tile(self.load_tile_payload(epoch, tile))
+
     def _epochs(self):
+        """(epoch, path) of every durable checkpoint — full-board files and
+        COMPLETE-marked tile dirs — sorted by epoch."""
         out = []
         for p in self.dir.iterdir():
             m = _CKPT_RE.match(p.name)
             if m:
                 out.append((int(m.group(1)), p))
+                continue
+            m = _TILE_DIR_RE.match(p.name)
+            if m and p.is_dir() and (p / _COMPLETE).exists():
+                out.append((int(m.group(1)), p))
         return sorted(out)
 
     def _gc(self) -> None:
+        import shutil
+
         epochs = self._epochs()
         for _, p in epochs[: max(0, len(epochs) - self.keep)]:
-            p.unlink(missing_ok=True)
+            if p.is_dir():
+                shutil.rmtree(p, ignore_errors=True)
+            else:
+                p.unlink(missing_ok=True)
+        # Unfinalized tile dirs older than the newest durable epoch are
+        # failed partial saves; sweep them.
+        if epochs:
+            newest = epochs[-1][0]
+            for p in self.dir.iterdir():
+                m = _TILE_DIR_RE.match(p.name)
+                if (
+                    m
+                    and p.is_dir()
+                    and not (p / _COMPLETE).exists()
+                    and int(m.group(1)) < newest
+                ):
+                    shutil.rmtree(p, ignore_errors=True)
 
     def latest_epoch(self) -> Optional[int]:
         epochs = self._epochs()
@@ -151,6 +265,24 @@ class CheckpointStore:
             if not matches:
                 raise FileNotFoundError(f"no checkpoint for epoch {epoch} in {self.dir}")
             path = matches[0]
+        if path.is_dir():
+            # Per-tile epoch: stitch on demand (small boards / tests; the
+            # cluster frontend deploys tile-by-tile via load_tile instead).
+            meta = self.tile_meta(epoch)
+            rows, cols = meta["grid"]
+            shape = tuple(int(v) for v in meta["shape"])
+            th, tw = shape[0] // rows, shape[1] // cols
+            board = np.empty(shape, dtype=np.uint8)
+            for i in range(rows):
+                for j in range(cols):
+                    board[i * th : (i + 1) * th, j * tw : (j + 1) * tw] = (
+                        self.load_tile(epoch, (i, j))
+                    )
+            rule = meta.pop("rule")
+            extra = {
+                k: v for k, v in meta.items() if k not in ("epoch", "grid", "shape")
+            }
+            return Checkpoint(epoch=int(epoch), board=board, rule=rule, meta=extra)
         with np.load(path) as z:
             shape: Tuple[int, ...] = tuple(int(v) for v in z["shape"])
             if int(z["packed"]):

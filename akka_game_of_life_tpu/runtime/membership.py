@@ -26,6 +26,11 @@ class Member:
     last_seen: float
     tiles: List[TileId] = dataclasses.field(default_factory=list)
     alive: bool = True
+    # Peer-to-peer data-plane address (host as seen by the frontend, the
+    # worker's advertised peer listener port) — brokered to other workers
+    # via OWNERS; the frontend itself never carries ring bytes.
+    peer_host: str = ""
+    peer_port: int = 0
 
 
 class Membership:
@@ -37,14 +42,26 @@ class Membership:
         self._lock = threading.RLock()
         self._seq = 0
 
-    def register(self, channel, name: Optional[str] = None) -> Member:
+    def register(
+        self,
+        channel,
+        name: Optional[str] = None,
+        peer_host: str = "",
+        peer_port: int = 0,
+    ) -> Member:
         with self._lock:
             self._seq += 1
             if not name:
                 name = f"backend-{self._seq}"
             if name in self._members and self._members[name].alive:
                 name = f"{name}-{self._seq}"
-            m = Member(name=name, channel=channel, last_seen=time.monotonic())
+            m = Member(
+                name=name,
+                channel=channel,
+                last_seen=time.monotonic(),
+                peer_host=peer_host,
+                peer_port=peer_port,
+            )
             self._members[name] = m
             return m
 

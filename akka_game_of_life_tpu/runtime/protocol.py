@@ -10,12 +10,19 @@ This protocol               Reference message (file:line)
 ==========================  ====================================================
 REGISTER / WELCOME          cluster join + MemberUp (BoardCreator.scala:125-126)
 HEARTBEAT                   cluster gossip liveness (application.conf:23)
-DEPLOY                      remote CellActor deployment + NeighboursRefs
-                            (BoardCreator.scala:65-70,86-88)
+DEPLOY                      remote CellActor deployment (BoardCreator.scala:65-70)
+OWNERS                      NeighboursRefs wiring + re-wiring — who serves each
+                            tile, with peer addresses
+                            (BoardCreator.scala:86-88,149-151)
 TICK                        CurrentEpochMsg broadcast (BoardCreator.scala:113-116)
-RING (push)                 a cell's state landing in History (CellActor.scala:81)
-PULL / HALO                 GetStateFromEpoch / StateForEpoch with request
-                            queueing (CellActor.scala:71-77)
+PROGRESS                    a cell's state landing in History, as a control
+                            ping only — the data rides peer-to-peer
+                            (CellActor.scala:81)
+PEER_RING (worker↔worker)   neighbor state push between cells — direct, no
+                            coordinator relay (NextStateCellGathererActor:32-36)
+PEER_PULL (worker↔worker)   GetStateFromEpoch re-ask to a specific neighbor
+                            (NextStateCellGathererActor.scala:49-53)
+PRUNE                       (new) bounded-history floor broadcast
 TILE_STATE                  CellStateMsg to the logger (BoardCreator.scala:159)
 CRASH / CRASH_TILE          DoCrashMsg fault injection (CellActor.scala:53-55)
 REDEPLOY_REQUEST            postRestart → SendMeMyNeighbours (CellActor.scala:21-25)
@@ -37,8 +44,7 @@ from __future__ import annotations
 # backend → frontend
 REGISTER = "register"
 HEARTBEAT = "heartbeat"
-RING = "ring"
-PULL = "pull"
+PROGRESS = "progress"
 TILE_STATE = "tile_state"
 REDEPLOY_REQUEST = "redeploy_request"
 GATHER_FAILED = "gather_failed"
@@ -47,10 +53,16 @@ GOODBYE = "goodbye"
 # frontend → backend
 WELCOME = "welcome"
 DEPLOY = "deploy"
+OWNERS = "owners"
 TICK = "tick"
-HALO = "halo"
+PRUNE = "prune"
 CRASH = "crash"
 CRASH_TILE = "crash_tile"
 PAUSE = "pause"
 RESUME = "resume"
 SHUTDOWN = "shutdown"
+
+# worker ↔ worker (the peer-to-peer data plane)
+PEER_HELLO = "peer_hello"
+PEER_RING = "peer_ring"
+PEER_PULL = "peer_pull"

@@ -25,23 +25,36 @@ import numpy as np
 GLYPHS = ".#ox*+=%"  # state 0..7 glyphs; >7 rendered as '?'
 
 
+def sample_strides(shape: Tuple[int, int], max_cells: int) -> Tuple[int, int]:
+    """Strides that sample an (H, W) board down to <= max_cells per side."""
+    return (
+        max(1, -(-shape[0] // max_cells)),
+        max(1, -(-shape[1] // max_cells)),
+    )
+
+
+def ascii_rows(board: np.ndarray) -> str:
+    return "\n".join(
+        "".join(GLYPHS[int(v)] if int(v) < len(GLYPHS) else "?" for v in row)
+        for row in board
+    )
+
+
+def frame_header(shape: Tuple[int, int], strides: Tuple[int, int]) -> str:
+    h, w = shape
+    sy, sx = strides
+    return f"[{h}x{w}" + (f", sampled /{sy}x{sx}" if (sy, sx) != (1, 1) else "") + "]"
+
+
 def render_ascii(board: np.ndarray, max_cells: int = 128) -> str:
     """Render a board as ASCII rows, stride-sampling to <= max_cells/side.
 
     Sampling keeps the aspect and phase: cell (0,0) is always shown, matching
     how a strided probe of a torus should behave.
     """
-    h, w = board.shape
-    sy = max(1, -(-h // max_cells))
-    sx = max(1, -(-w // max_cells))
+    sy, sx = sample_strides(board.shape, max_cells)
     view = board[::sy, ::sx]
-    rows = []
-    for row in view:
-        rows.append(
-            "".join(GLYPHS[int(v)] if int(v) < len(GLYPHS) else "?" for v in row)
-        )
-    header = f"[{h}x{w}" + (f", sampled /{sy}x{sx}" if (sy, sx) != (1, 1) else "") + "]"
-    return header + "\n" + "\n".join(rows)
+    return frame_header(board.shape, (sy, sx)) + "\n" + ascii_rows(view)
 
 
 @dataclasses.dataclass
@@ -95,6 +108,15 @@ class BoardObserver:
         # no matter how far back a replaying tile rolls.
         self._max_completed: Optional[int] = None
         self._expected_tiles: Optional[int] = None
+        # Cluster-scale paths: per-epoch population sums (metrics without
+        # shipping any array) and stride-sampled frames (render without
+        # shipping whole tiles) — a 65536² board never crosses the wire.
+        self._board_shape: Optional[Tuple[int, int]] = None
+        self._render_strides: Tuple[int, int] = (1, 1)
+        self._pop_partial: Dict[int, Dict[object, int]] = {}
+        self._pop_floor: Optional[int] = None
+        self._sample_partial: Dict[int, Dict[Tuple[int, int], np.ndarray]] = {}
+        self._sample_floor: Optional[int] = None
         self._last_time: Optional[float] = None
         self._last_epoch: Optional[int] = None
         # Bounded, unlike the reference's forever-growing per-epoch map
@@ -103,7 +125,8 @@ class BoardObserver:
 
     # -- complete-board path (standalone runner) -----------------------------
 
-    def observe(self, epoch: int, board: np.ndarray) -> None:
+    def _note_progress(self, epoch: int, population: int, total_cells: int) -> None:
+        """Advance the metrics clock and emit a metrics line at cadence."""
         now = time.perf_counter()
         if self._last_time is not None and epoch > (self._last_epoch or 0):
             dt = now - self._last_time
@@ -112,8 +135,8 @@ class BoardObserver:
                 epoch=epoch,
                 seconds=dt,
                 epochs=epochs,
-                cells=board.size * epochs,
-                population=int((board == 1).sum()),
+                cells=total_cells * epochs,
+                population=population,
             )
             self.history.append(m)
             if self.metrics_every and epoch % self.metrics_every == 0:
@@ -126,6 +149,9 @@ class BoardObserver:
                 )
         self._last_time = now
         self._last_epoch = epoch
+
+    def observe(self, epoch: int, board: np.ndarray) -> None:
+        self._note_progress(epoch, int((board == 1).sum()), board.size)
         if self.render_every and epoch % self.render_every == 0:
             print(f"epoch {epoch}:", file=self.out)
             print(render_ascii(board, self.render_max_cells), file=self.out, flush=True)
@@ -134,6 +160,69 @@ class BoardObserver:
 
     def expect_tiles(self, n: int) -> None:
         self._expected_tiles = n
+
+    def set_cluster_layout(self, n_tiles: int, board_shape: Tuple[int, int]) -> None:
+        """Configure the scale-safe cluster paths (sampled frames +
+        population-only metrics)."""
+        self._expected_tiles = n_tiles
+        self._board_shape = tuple(board_shape)
+        self._render_strides = sample_strides(self._board_shape, self.render_max_cells)
+
+    @property
+    def render_strides(self) -> Tuple[int, int]:
+        """(sy, sx) every worker samples its render tiles with (phase-aligned
+        to its origin so the union is the canonical strided probe)."""
+        return self._render_strides
+
+    def add_population(self, epoch: int, key, population: int) -> None:
+        """One tile's population at a metrics-cadence epoch; emits the
+        metrics line when every tile has reported."""
+        if self._pop_floor is not None and epoch <= self._pop_floor:
+            return
+        d = self._pop_partial.setdefault(epoch, {})
+        d[key] = int(population)
+        if len(d) < (self._expected_tiles or 0):
+            return
+        del self._pop_partial[epoch]
+        self._pop_floor = epoch
+        for e in [e for e in self._pop_partial if e <= epoch]:
+            del self._pop_partial[e]
+        h, w = self._board_shape
+        self._note_progress(epoch, sum(d.values()), h * w)
+
+    def add_sample(
+        self,
+        epoch: int,
+        key,
+        scaled_origin: Tuple[int, int],
+        sample: np.ndarray,
+    ) -> None:
+        """One tile's stride-sampled view at a render-cadence epoch; stitches
+        and prints the frame when every tile has reported.  ``key`` is the
+        tile's identity (completion is counted by tile, since a tile smaller
+        than the stride contributes an empty sample)."""
+        if self._sample_floor is not None and epoch <= self._sample_floor:
+            return
+        tiles = self._sample_partial.setdefault(epoch, {})
+        tiles[key] = (tuple(scaled_origin), np.asarray(sample))
+        if len(tiles) < (self._expected_tiles or 0):
+            return
+        del self._sample_partial[epoch]
+        self._sample_floor = epoch
+        for e in [e for e in self._sample_partial if e <= epoch]:
+            del self._sample_partial[e]
+        from akka_game_of_life_tpu.runtime.tiles import stitch
+
+        view = stitch(
+            {o: s for o, s in tiles.values() if s.size}  # drop empty slivers
+        )
+        print(f"epoch {epoch}:", file=self.out)
+        print(
+            frame_header(self._board_shape, self._render_strides) + "\n"
+            + ascii_rows(view),
+            file=self.out,
+            flush=True,
+        )
 
     def observe_tile(
         self, epoch: int, tile_origin: Tuple[int, int], tile: np.ndarray

@@ -83,3 +83,56 @@ def test_native_engine_rejects_overflowing_boards():
     one = (ctypes.c_uint8 * 1)(0)
     ptr = lib.ae_create(70000, 70000, one, 8, 12, 2, 0)
     assert not ptr
+
+
+def test_tile_store_roundtrip(tmp_path):
+    """Per-tile streamed checkpoints: tiles saved one at a time, epoch
+    durable only after finalize, load() stitches, load_tile serves one."""
+    store = CheckpointStore(str(tmp_path))
+    rng = np.random.default_rng(3)
+    board = (rng.random((24, 32)) < 0.5).astype(np.uint8)
+    grid = (2, 2)
+    th, tw = 12, 16
+    for i in range(2):
+        for j in range(2):
+            store.save_tile(7, (i, j), board[i * th:(i + 1) * th, j * tw:(j + 1) * tw])
+    assert store.latest_epoch() is None  # not durable until finalized
+    store.finalize_epoch(7, "B3/S23", grid, board.shape)
+    assert store.latest_epoch() == 7
+    ckpt = store.load()
+    assert ckpt.epoch == 7 and ckpt.rule == "B3/S23"
+    assert np.array_equal(ckpt.board, board)
+    assert np.array_equal(store.load_tile(7, (1, 0)), board[12:, :16])
+
+
+def test_tile_store_accepts_packed_payloads(tmp_path):
+    from akka_game_of_life_tpu.runtime.wire import pack_tile
+
+    store = CheckpointStore(str(tmp_path))
+    t = (np.random.default_rng(4).random((8, 8)) < 0.5).astype(np.uint8)
+    store.save_tile(3, (0, 0), pack_tile(t))
+    store.finalize_epoch(3, "B3/S23", (1, 1), (8, 8))
+    assert np.array_equal(store.load_tile(3, (0, 0)), t)
+    assert np.array_equal(store.load().board, t)
+
+
+def test_tile_store_gc_and_mixed_formats(tmp_path):
+    store = CheckpointStore(str(tmp_path), keep=2)
+    t = np.ones((4, 4), np.uint8)
+    store.save(5, t, "B3/S23")  # full-board file
+    for e in (10, 20):
+        store.save_tile(e, (0, 0), t)
+        store.finalize_epoch(e, "B3/S23", (1, 1), (4, 4))
+    # keep=2: epoch 5 GC'd, 10+20 remain; latest is a tile dir
+    assert store.latest_epoch() == 20
+    assert [e for e, _ in store._epochs()] == [10, 20]
+    # an unfinalized (crashed) tile dir below the newest durable epoch can
+    # never finalize (every tile already passed it) — swept by the next _gc
+    store.save_tile(15, (0, 0), t)
+    store.save(40, t, "B3/S23")
+    assert not (tmp_path / "ckpt_000000000015.d").exists()  # swept
+    # an in-flight save ABOVE the newest durable epoch is preserved
+    store.save_tile(50, (0, 0), t)
+    store.save(41, t, "B3/S23")
+    assert (tmp_path / "ckpt_000000000050.d").exists()
+    assert store.latest_epoch() == 41

@@ -7,6 +7,7 @@ tile crashes, pause/resume, and coordinator restart."""
 
 import contextlib
 import io
+import os
 import threading
 import time
 
@@ -269,17 +270,36 @@ def test_pull_retry_escalation_reports_gather_failed():
     retrying round-1 loop lacked (VERDICT.md missing #4).  Like the
     reference's cell, the tile keeps its state and keeps retrying."""
     from akka_game_of_life_tpu.runtime import protocol as P
+    from akka_game_of_life_tpu.runtime.wire import pack_tile
 
     w = BackendWorker(
         "127.0.0.1", 0, name="w", engine="numpy", retry_s=0.02, max_pull_retries=3
     )
     chan = _RecordingChannel()
     w.channel = chan
+    # Wiring: we own tile (0,0); tile (1,0) belongs to an unreachable peer,
+    # so our halo pulls can never complete.
+    w._on_owners(
+        {
+            "type": P.OWNERS,
+            "grid": [2, 1],
+            "shape": [8, 4],
+            "tiles": [
+                [[0, 0], "w", "127.0.0.1", 1],
+                [[1, 0], "ghost", "127.0.0.1", 1],
+            ],
+        }
+    )
     w._on_deploy(
         {
             "type": P.DEPLOY,
             "tiles": [
-                {"id": [0, 0], "epoch": 0, "array": np.zeros((4, 4), np.uint8)}
+                {
+                    "id": [0, 0],
+                    "epoch": 0,
+                    "origin": [0, 0],
+                    "state": pack_tile(np.zeros((4, 4), np.uint8)),
+                }
             ],
             "rule": "conway",
             "target": 5,
@@ -295,9 +315,9 @@ def test_pull_retry_escalation_reports_gather_failed():
     failed = [m for m in chan.sent if m["type"] == P.GATHER_FAILED]
     assert failed[0]["epoch"] == 0
     assert (0, 0) in w.tiles  # tile state kept — only the parent may redeploy
-    pulls = [m for m in chan.sent if m["type"] == P.PULL]
-    assert len(pulls) >= 1 + 3  # initial + re-asks, still retrying
+    assert w.tiles[(0, 0)].epoch == 0  # never stepped without the halo
     w._stop.set()
+    w.stop()
 
 
 def test_wedged_neighbor_redeployed_via_gather_failed():
@@ -343,17 +363,112 @@ def test_restart_budget_escalates_to_run_failure():
 
 
 def test_ring_history_bounded_without_checkpoints():
-    """With no checkpoint store, boundary rings must still be pruned (via the
-    in-memory checkpoint cadence) — the reference's unbounded-History bug
+    """With no checkpoint store, boundary rings must still be pruned (via
+    the in-memory checkpoint cadence driving PRUNE broadcasts to the
+    workers' local stores) — the reference's unbounded-History bug
     (SURVEY.md §2 bug 5) must not reproduce at tile granularity
     (VERDICT.md weak #6)."""
     cfg = SimulationConfig(height=32, width=32, seed=9, max_epochs=150)
     with cluster(cfg, 2) as h:
         final = h.run_to_completion()
-        nrings = len(h.frontend.boundary._rings)
+        nrings = max(w.store.ring_count() for w in h.workers)
         ntiles = len(h.frontend.layout.tile_ids)
         last_mem_ckpt = h.frontend._last_ckpt[0]
     assert last_mem_ckpt >= 128  # in-memory checkpoints advanced
     # Bounded by the cadence window, not by total epochs (151 rings/tile).
     assert nrings <= ntiles * 64
     assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 150))
+
+
+def test_sampled_render_and_population_metrics():
+    """Render frames cross the wire as strided samples and metrics as
+    per-tile population counts — never whole tiles (VERDICT.md weak #5).
+    The stitched sampled frame must equal the dense board's strided probe."""
+    sink = io.StringIO()
+    cfg = SimulationConfig(
+        height=64, width=64, seed=31, max_epochs=20,
+        render_every=20, render_max_cells=16, metrics_every=10,
+    )
+    obs = BoardObserver(render_every=20, render_max_cells=16, metrics_every=10, out=sink)
+    with cluster(cfg, 4, observer=obs) as h:
+        final = h.run_to_completion()
+    want = dense_oracle(initial_board(cfg), "conway", 20)
+    assert np.array_equal(final, want)
+    out = sink.getvalue()
+    assert "[64x64, sampled /4x4]" in out  # strides = ceil(64/16)
+    # the last frame (epoch 20; epoch 0 also renders at deploy) equals the
+    # canonical strided probe of the dense board
+    frame_rows = out.split("[64x64, sampled /4x4]\n")[-1].splitlines()[:16]
+    want_rows = ["".join(".#"[v] for v in row) for row in want[::4, ::4]]
+    assert frame_rows == want_rows
+    # population metrics line (summed from per-tile counts)
+    m = [l for l in out.splitlines() if l.startswith("epoch 20: pop=")]
+    assert m and f"pop={int((want == 1).sum())}" in m[0]
+
+
+def _scale_cluster_recovery(size, n_workers, tmp_path):
+    """Kill a worker mid-run at `size`²: per-tile streamed checkpoints +
+    packed wire tiles carry the board; recovery replays; the final per-tile
+    checkpoint matches the bitpack oracle."""
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.ops import bitpack
+    from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+
+    cfg = SimulationConfig(
+        height=size, width=size, seed=41, density=0.5, max_epochs=3,
+        checkpoint_dir=str(tmp_path), checkpoint_every=1,
+        # At this scale a single CPU step takes seconds and Python-side
+        # transfers hold the GIL long enough to starve heartbeat threads;
+        # the reference's aggressive 1 s auto-down (application.conf:23) is
+        # calibrated for 6x6 boards, not 16384².
+        failure_timeout_s=10.0,
+    )
+    with cluster(cfg, n_workers, engine="jax") as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        deadline = time.monotonic() + 120
+        while h.frontend._last_ckpt[0] < 1:  # first durable checkpoint
+            assert time.monotonic() < deadline, "no checkpoint before kill"
+            time.sleep(0.05)
+        h.workers[0].stop()
+        assert h.frontend.done.wait(600)
+        assert h.frontend.error is None
+    # big boards skip in-memory final assembly; the durable store has it
+    store = CheckpointStore(str(tmp_path))
+    assert store.latest_epoch() == 3
+    ckpt = store.load()
+    # oracle via the fast bit-packed kernel
+    board0 = initial_board(cfg)
+    packed = bitpack.pack(jnp.asarray(board0))
+    want = np.asarray(bitpack.unpack(bitpack.packed_multi_step_fn("conway", 3)(packed)))
+    assert np.array_equal(ckpt.board, want)
+
+
+def test_cluster_recovery_at_4096(tmp_path):
+    _scale_cluster_recovery(4096, 2, tmp_path)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("GOL_SCALE_TESTS"),
+    reason="16384² cluster run takes minutes on CPU; set GOL_SCALE_TESTS=1",
+)
+def test_cluster_recovery_at_16384(tmp_path):
+    _scale_cluster_recovery(16384, 2, tmp_path)
+
+
+def test_ring_traffic_is_peer_to_peer():
+    """VERDICT.md weak #4 done-criterion: the data plane is direct
+    worker-to-worker (the reference's neighbor asks,
+    NextStateCellGathererActor.scala:32-36); the frontend brokers addresses
+    only — it has no ring handler at all, and every tile-holding worker
+    dialed peers."""
+    from akka_game_of_life_tpu.runtime import protocol as P
+
+    assert not hasattr(P, "RING") and not hasattr(P, "PULL")
+    cfg = SimulationConfig(height=32, width=32, seed=13, max_epochs=20)
+    with cluster(cfg, 4) as h:
+        final = h.run_to_completion()
+        for w in h.workers:
+            assert not w.tiles or w._peers, f"{w.name} never dialed a peer"
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 20))

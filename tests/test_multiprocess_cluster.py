@@ -129,9 +129,13 @@ def test_kill9_backend_process_redeploys_and_matches_oracle(tmp_path):
                 f"backend {name} to join",
             )
 
-        # Let the run get past the first durable checkpoint, then kill -9 a
-        # worker mid-flight — the reference's ctrl+c, without the courtesy.
-        _wait_for(lambda: list(ckpt_dir.glob("ckpt_*.npz")), "first checkpoint")
+        # Let the run get past the first durable checkpoint (a finalized
+        # per-tile epoch dir), then kill -9 a worker mid-flight — the
+        # reference's ctrl+c, without the courtesy.
+        _wait_for(
+            lambda: list(ckpt_dir.glob("ckpt_*.d/COMPLETE.json")),
+            "first checkpoint",
+        )
         backends["beta"].send_signal(signal.SIGKILL)
 
         _wait_for(lambda: fe.poll() is not None, "frontend to finish")
