@@ -61,14 +61,53 @@ class _Tile:
 
 
 def _jax_engine(rule: Rule) -> Callable[[np.ndarray], np.ndarray]:
+    """Jitted tile stepping on the worker's local accelerator(s).
+
+    With more than one local device the padded slab is row-sharded over a
+    1-D local mesh and the step jitted with sharding constraints — GSPMD
+    inserts the interior halo exchanges itself, so a worker on a multi-chip
+    host spreads its tile across its chips (ICI inside the worker, the
+    cluster control plane outside).  Single device degenerates to a plain
+    jit."""
+    import jax
     import jax.numpy as jnp
 
-    from akka_game_of_life_tpu.ops.stencil import step_fn_padded
+    from akka_game_of_life_tpu.ops.stencil import step_fn_padded, step_padded
 
-    step = step_fn_padded(rule)
+    devices = jax.local_devices()
+    if len(devices) == 1:
+        step = step_fn_padded(rule)
+
+        def run(padded: np.ndarray) -> np.ndarray:
+            return np.asarray(step(jnp.asarray(padded)))
+
+        return run
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    n = len(devices)
+    # Auto axis type: GSPMD propagates shardings through the stencil's
+    # slices/rolls itself (explicit mode refuses non-divisible slicing).
+    mesh = jax.make_mesh(
+        (n,), ("rows",), devices=devices, axis_types=(jax.sharding.AxisType.Auto,)
+    )
+    rows = NamedSharding(mesh, PartitionSpec("rows", None))
+
+    # Output sharding is left to GSPMD: the (rows-2) output height need not
+    # divide the mesh even when the padded input does.
+    sharded_step = jax.jit(
+        lambda padded: step_padded(padded, rule), in_shardings=rows
+    )
 
     def run(padded: np.ndarray) -> np.ndarray:
-        return np.asarray(step(jnp.asarray(padded)))
+        h_out = padded.shape[0] - 2
+        pad = (-padded.shape[0]) % n
+        if pad:
+            # Row-pad up to a mesh multiple; trailing junk rows only feed
+            # trailing outputs, sliced off below (the stencil is local).
+            padded = np.pad(padded, ((0, pad), (0, 0)))
+        out = sharded_step(jax.device_put(padded, rows))
+        return np.asarray(out)[:h_out]
 
     return run
 

@@ -135,8 +135,8 @@ class SimulationConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # epochs between checkpoints; 0 = disabled
     checkpoint_format: str = "npz"  # "npz" (host, sync) | "orbax" (async, device)
-    history_window: int = 8  # bounded per-shard boundary history (vs the
-    # reference's unbounded per-cell History maps)
+    # (Boundary-ring history is bounded by the checkpoint-cadence PRUNE
+    # floor, not a separate window — see frontend._on_tile_state.)
 
     # Rendering / observability (LoggerActor capability).
     render_every: int = 0  # epochs between rendered frames; 0 = never
