@@ -42,10 +42,19 @@ DEFAULT_BLOCK_ROWS = 256
 DEFAULT_STEPS_PER_SWEEP = 8
 
 
-def _make_kernel(rule: Rule, k: int):
+def _round_up8(n: int) -> int:
+    return -(-n // 8) * 8
+
+
+def _make_kernel(rule: Rule, k: int, hb: int):
+    """Mosaic requires sublane-dim block sizes divisible by 8, so the halo
+    blocks are ``hb = round_up(k, 8)`` rows; the kernel statically slices the
+    ``k`` rows actually adjacent to the center block (the last k of the north
+    block, the first k of the south block)."""
+
     def kernel(north_ref, center_ref, south_ref, out_ref):
         ext = jnp.concatenate(
-            [north_ref[:], center_ref[:], south_ref[:]], axis=0
+            [north_ref[hb - k :], center_ref[:], south_ref[:k]], axis=0
         )  # (B + 2k, W)
         for _ in range(k):
             ext = step_padded_rows(ext, rule)
@@ -73,34 +82,40 @@ def packed_sweep_fn(
     b, k = block_rows, steps_per_sweep
     if k < 1:
         raise ValueError(f"steps_per_sweep={k} must be >= 1")
-    if b % k:
-        raise ValueError(f"block_rows={b} must be a multiple of steps_per_sweep={k}")
+    hb = _round_up8(k)  # Mosaic sublane alignment for the halo blocks
+    if b % hb:
+        raise ValueError(
+            f"block_rows={b} must be a multiple of {hb} "
+            f"(steps_per_sweep={k} rounded up to the 8-row sublane tile)"
+        )
 
-    kernel = _make_kernel(rule, k)
+    kernel = _make_kernel(rule, k, hb)
 
     def sweep(x: jax.Array) -> jax.Array:
         h, words = x.shape
         if h % b:
             raise ValueError(f"grid height {h} not a multiple of block_rows={b}")
-        # h % b == 0 and b % k == 0 together imply h % k == 0, so the k-row
-        # halo views below always tile the array exactly.
+        # h % b == 0 and b % hb == 0 together imply h % hb == 0, so the
+        # hb-row halo views below always tile the array exactly.
         n_row_blocks = h // b
-        halo_blocks = h // k  # the same array viewed in (k, words) blocks
+        halo_blocks = h // hb  # the same array viewed in (hb, words) blocks
 
         grid_spec = pl.GridSpec(
             grid=(n_row_blocks,),
             in_specs=[
-                # North halo: k rows ending just above the center block.
+                # North halo: the hb-row block ending exactly where the center
+                # block starts (its last k rows are the true halo).
                 pl.BlockSpec(
-                    (k, words),
-                    lambda i: ((i * (b // k) - 1) % halo_blocks, 0),
+                    (hb, words),
+                    lambda i: ((i * (b // hb) - 1) % halo_blocks, 0),
                     memory_space=pltpu.VMEM,
                 ),
                 pl.BlockSpec((b, words), lambda i: (i, 0), memory_space=pltpu.VMEM),
-                # South halo: k rows starting just below the center block.
+                # South halo: the hb-row block starting just below the center
+                # block (its first k rows are the true halo).
                 pl.BlockSpec(
-                    (k, words),
-                    lambda i: (((i + 1) * (b // k)) % halo_blocks, 0),
+                    (hb, words),
+                    lambda i: (((i + 1) * (b // hb)) % halo_blocks, 0),
                     memory_space=pltpu.VMEM,
                 ),
             ],
@@ -139,7 +154,7 @@ def packed_multi_step_fn(
             (
                 d
                 for d in range(1, DEFAULT_STEPS_PER_SWEEP + 1)
-                if n_steps % d == 0 and block_rows % d == 0
+                if n_steps % d == 0 and block_rows % _round_up8(d) == 0
             ),
         )
     if n_steps % steps_per_sweep:

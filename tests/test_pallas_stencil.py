@@ -56,7 +56,11 @@ def test_rejects_bad_configs():
     with pytest.raises(ValueError, match="binary"):
         pallas_stencil.packed_sweep_fn(BRIANS_BRAIN)
     with pytest.raises(ValueError, match="multiple"):
-        pallas_stencil.packed_sweep_fn("conway", block_rows=8, steps_per_sweep=3)
+        # k=9 rounds up to a 16-row halo tile, which 8 rows can't hold.
+        pallas_stencil.packed_sweep_fn("conway", block_rows=8, steps_per_sweep=9)
+    with pytest.raises(ValueError, match="multiple"):
+        # block_rows must be sublane-aligned (multiple of the rounded halo).
+        pallas_stencil.packed_sweep_fn("conway", block_rows=12, steps_per_sweep=2)
     sweep = pallas_stencil.packed_sweep_fn(
         "conway", block_rows=8, steps_per_sweep=2, interpret=True
     )
