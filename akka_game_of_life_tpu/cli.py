@@ -59,6 +59,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--max-epochs", type=int)
     p.add_argument("--tick", help="wall-clock pacing per epoch (e.g. 3000ms); 0 = free-run")
     p.add_argument("--steps-per-call", type=int)
+    p.add_argument(
+        "--kernel",
+        choices=["auto", "dense", "bitpack", "pallas"],
+        help="stencil kernel: auto picks bitpack (32 cells/uint32 SWAR) for "
+        "binary rules on 32-aligned widths, else dense uint8; pallas is the "
+        "Mosaic temporal-blocking kernel (single device, fastest on TPU)",
+    )
+    p.add_argument("--pallas-block-rows", type=int)
     p.add_argument("--halo-width", type=int)
     p.add_argument("--mesh", help="ROWSxCOLS device mesh, e.g. 4x2")
     p.add_argument("--backend", choices=["tpu", "actor", "actor-native"])
@@ -99,6 +107,8 @@ def _overrides(args: argparse.Namespace) -> dict:
         "max_epochs": args.max_epochs,
         "tick_s": parse_duration(args.tick) if args.tick is not None else None,
         "steps_per_call": args.steps_per_call,
+        "kernel": args.kernel,
+        "pallas_block_rows": args.pallas_block_rows,
         "halo_width": args.halo_width,
         "mesh_shape": mesh,
         "backend": args.backend,
@@ -137,6 +147,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     fe_p.add_argument("--host", default="127.0.0.1")
     fe_p.add_argument("--wait-for-backends", default=None, help="e.g. 5s")
     fe_p.add_argument("--min-backends", type=int, default=1)
+    fe_p.add_argument(
+        "--exchange-width",
+        type=int,
+        default=None,
+        help="boundary-ring width k: one peer exchange buys k local epochs "
+        "per tile (communication-avoiding; cadences must be multiples of k)",
+    )
 
     be_p = sub.add_parser("backend", help="control-plane worker (RunBackend)")
     be_p.add_argument("--port", type=int, default=2551, help="frontend port to join")
@@ -192,6 +209,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             role="frontend",
             host=args.host,
             port=args.port,
+            exchange_width=args.exchange_width,
             wait_for_backends_s=(
                 parse_duration(args.wait_for_backends)
                 if args.wait_for_backends is not None

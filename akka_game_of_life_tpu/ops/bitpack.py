@@ -187,10 +187,58 @@ def packed_multi_step_fn(rule_key: Rule, n_steps: int) -> Callable[[jax.Array], 
 
 
 def pack_np(grid: np.ndarray) -> np.ndarray:
-    """Host-side packer (for checkpoints / wire transfers)."""
+    """Host-side packer (for checkpoints / wire transfers).
+
+    Peak scratch is board/8 bytes (the packbits output viewed as words) —
+    a 65536² board packs within ~512 MiB, not the 16 GiB a uint32 lane
+    tensor would cost."""
     h, w = grid.shape
     if w % LANE_BITS:
         raise ValueError(f"width {w} not a multiple of {LANE_BITS}")
-    lanes = grid.astype(np.uint32).reshape(h, w // LANE_BITS, LANE_BITS)
-    weights = (np.uint32(1) << np.arange(LANE_BITS, dtype=np.uint32))
-    return (lanes * weights).sum(axis=-1, dtype=np.uint32)
+    packed_bytes = np.packbits(
+        np.asarray(grid, dtype=np.uint8), axis=-1, bitorder="little"
+    )
+    # 4 consecutive LSB-first bytes little-endian-viewed = one LSB-first word.
+    return (
+        np.ascontiguousarray(packed_bytes)
+        .reshape(h, (w // LANE_BITS) * 4)
+        .view("<u4")
+    )
+
+
+def unpack_np(words: np.ndarray) -> np.ndarray:
+    """Host-side unpacker: (H, W/32) uint32 LSB-first words → (H, W) uint8."""
+    h, w32 = words.shape
+    # Little-endian byte view matches the LSB-first cell layout (see pack()).
+    packed_bytes = np.ascontiguousarray(words.astype("<u4")).view(np.uint8)
+    return np.unpackbits(
+        packed_bytes.reshape(h, w32 * 4), axis=-1, bitorder="little"
+    )
+
+
+def population_rows(x: jax.Array) -> jax.Array:
+    """Device-side per-row population of a packed board: (H, W/32) uint32 →
+    (H,) uint32 row counts.  Row sums cannot overflow (a row holds at most
+    32·W/32 = W ≤ 2³²−1 cells); callers sum the rows on host in int64 so a
+    65536² board's population (up to 2³²) is exact — and only the (H,)
+    vector ever crosses to the host, never the board.  Unjitted: callers
+    wrap it to suit their sharding (jit, or auto_axes on a mesh)."""
+    return jnp.sum(jnp.bitwise_count(x).astype(jnp.uint32), axis=1)
+
+
+def sample_packed_core(
+    sy: int, sx: int, width: int
+) -> Callable[[jax.Array], jax.Array]:
+    """Device-side strided probe of a packed board: bit (x·sx) of every
+    sy-th row, as a small uint8 view — the render sample for boards too big
+    to ship (a 65536² frame never leaves the device).  Unjitted core, like
+    :func:`population_rows`."""
+    xs = np.arange(0, width, sx)
+    word_idx = jnp.asarray(xs // LANE_BITS)
+    bit_idx = jnp.asarray((xs % LANE_BITS).astype(np.uint32))
+
+    def _sample(x: jax.Array) -> jax.Array:
+        rows = x[::sy]
+        return ((rows[:, word_idx] >> bit_idx) & 1).astype(jnp.uint8)
+
+    return _sample

@@ -85,9 +85,13 @@ def make_store(directory: str, fmt: str = "npz", keep: int = 3):
 @dataclasses.dataclass
 class Checkpoint:
     epoch: int
-    board: np.ndarray
+    board: Optional[np.ndarray]  # None only when loaded with keep_packed=True
     rule: str
     meta: dict
+    # Bit-packed payload ((H, W/32) uint32 LSB-first words) when the
+    # checkpoint was saved by a packed-kernel run and loaded with
+    # keep_packed=True — lets a packed resume skip the O(board) host unpack.
+    packed32: Optional[np.ndarray] = None
 
 
 class CheckpointStore:
@@ -98,20 +102,8 @@ class CheckpointStore:
         self.dir.mkdir(parents=True, exist_ok=True)
         self.keep = keep
 
-    def save(
-        self, epoch: int, board: np.ndarray, rule: str, meta: Optional[dict] = None
-    ) -> Path:
-        board = np.asarray(board, dtype=np.uint8)
-        binary = bool((board <= 1).all())
-        payload = {
-            "epoch": np.int64(epoch),
-            "shape": np.asarray(board.shape, dtype=np.int64),
-            "packed": np.uint8(1 if binary else 0),
-            "board": np.packbits(board) if binary else board,
-            "meta": np.frombuffer(
-                json.dumps({"rule": rule, **(meta or {})}).encode(), dtype=np.uint8
-            ),
-        }
+    def _write_epoch(self, epoch: int, payload: dict) -> Path:
+        """Atomically write one epoch's npz (tmp + fsync + rename), then GC."""
         target = self.dir / f"ckpt_{epoch:012d}.npz"
         fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
         try:
@@ -126,6 +118,54 @@ class CheckpointStore:
             raise
         self._gc()
         return target
+
+    @staticmethod
+    def _meta_blob(rule: str, meta: Optional[dict]) -> np.ndarray:
+        return np.frombuffer(
+            json.dumps({"rule": rule, **(meta or {})}).encode(), dtype=np.uint8
+        )
+
+    def save(
+        self, epoch: int, board: np.ndarray, rule: str, meta: Optional[dict] = None
+    ) -> Path:
+        board = np.asarray(board, dtype=np.uint8)
+        binary = bool((board <= 1).all())
+        return self._write_epoch(
+            epoch,
+            {
+                "epoch": np.int64(epoch),
+                "shape": np.asarray(board.shape, dtype=np.int64),
+                "packed": np.uint8(1 if binary else 0),
+                "board": np.packbits(board) if binary else board,
+                "meta": self._meta_blob(rule, meta),
+            },
+        )
+
+    def save_packed32(
+        self,
+        epoch: int,
+        words: np.ndarray,
+        shape: Tuple[int, int],
+        rule: str,
+        meta: Optional[dict] = None,
+    ) -> Path:
+        """Save an already-bit-packed board ((H, W/32) uint32 LSB-first) as
+        it arrived from the device — the packed-kernel runtime never unpacks
+        on host, so a 65536² checkpoint transfers and writes 0.25 B/cell."""
+        words = np.ascontiguousarray(words, dtype=np.uint32)
+        h, w = shape
+        if words.shape != (h, w // 32):
+            raise ValueError(f"packed words {words.shape} != {(h, w // 32)}")
+        return self._write_epoch(
+            epoch,
+            {
+                "epoch": np.int64(epoch),
+                "shape": np.asarray(shape, dtype=np.int64),
+                "packed": np.uint8(2),  # 2 = uint32-word LSB-first layout
+                "board": words,
+                "meta": self._meta_blob(rule, meta),
+            },
+        )
 
     # -- per-tile streaming saves (no full-board assembly anywhere) ----------
 
@@ -254,7 +294,12 @@ class CheckpointStore:
         epochs = self._epochs()
         return epochs[-1][0] if epochs else None
 
-    def load(self, epoch: Optional[int] = None) -> Checkpoint:
+    def load(
+        self, epoch: Optional[int] = None, *, keep_packed: bool = False
+    ) -> Checkpoint:
+        """Load a checkpoint.  With ``keep_packed=True`` a packed32-format
+        checkpoint comes back with ``packed32`` set and ``board=None`` — the
+        packed-kernel resume path pushes the words straight to device."""
         epochs = self._epochs()
         if not epochs:
             raise FileNotFoundError(f"no checkpoints in {self.dir}")
@@ -285,12 +330,32 @@ class CheckpointStore:
             return Checkpoint(epoch=int(epoch), board=board, rule=rule, meta=extra)
         with np.load(path) as z:
             shape: Tuple[int, ...] = tuple(int(v) for v in z["shape"])
-            if int(z["packed"]):
+            meta = json.loads(bytes(z["meta"].tobytes()).decode())
+            fmt = int(z["packed"])
+            if fmt == 2:  # uint32-word LSB-first (save_packed32)
+                words = z["board"].copy()
+                rule = meta.pop("rule")
+                if keep_packed:
+                    return Checkpoint(
+                        epoch=int(epoch),
+                        board=None,
+                        rule=rule,
+                        meta=meta,
+                        packed32=words,
+                    )
+                from akka_game_of_life_tpu.ops.bitpack import unpack_np
+
+                return Checkpoint(
+                    epoch=int(epoch),
+                    board=unpack_np(words).reshape(shape),
+                    rule=rule,
+                    meta=meta,
+                )
+            if fmt:
                 n = int(np.prod(shape))
                 board = np.unpackbits(z["board"], count=n).reshape(shape)
             else:
                 board = z["board"].reshape(shape)
-            meta = json.loads(bytes(z["meta"].tobytes()).decode())
         rule = meta.pop("rule")
         return Checkpoint(
             epoch=int(epoch), board=board.astype(np.uint8), rule=rule, meta=meta

@@ -92,6 +92,14 @@ class SimulationConfig:
 
     # TPU execution.
     backend: str = "tpu"  # "tpu" (stencil) | "actor" / "actor-native" (per-cell parity)
+    # Stencil kernel on the tpu backend:
+    #   dense   — uint8 roll-sum (any rule, incl. multi-state Generations)
+    #   bitpack — 32 cells/uint32 SWAR (binary rules, width % 32 == 0)
+    #   pallas  — temporally-blocked Mosaic kernel (binary rules; fastest on
+    #             real TPU hardware, interpret-mode elsewhere)
+    #   auto    — bitpack when the rule/shape allow it, else dense
+    kernel: str = "auto"
+    pallas_block_rows: int = 64  # VMEM row-block for kernel="pallas"
     steps_per_call: int = 1
     halo_width: int = 1
     mesh_shape: Optional[Tuple[int, int]] = None  # None = auto-factor devices
@@ -121,6 +129,13 @@ class SimulationConfig:
     # escalates: the run fails loudly instead of thrashing forever.
     restart_max: int = 10
     restart_window_s: float = 60.0
+    # Communication-avoiding cluster exchange: boundary rings are this many
+    # cells wide and one peer exchange licenses this many local epochs per
+    # tile (the wire analog of the on-device width-k halos,
+    # parallel/halo.py:82-110; 1 = the reference's per-epoch exchange).
+    # Requires every observation cadence to land on chunk boundaries:
+    # render/metrics/checkpoint cadences must be multiples of this.
+    exchange_width: int = 1
     # Worker-side gather escalation (the reference's gatherer gives up after
     # 2 ask rounds and fires FailedToGatherInfoMsg → neighbor-ref refresh,
     # NextStateCellGathererActor.scala:49-58).  After this many unanswered
@@ -153,12 +168,34 @@ class SimulationConfig:
             raise ValueError(f"board must be positive, got {self.height}x{self.width}")
         if self.backend not in ("tpu", "actor", "actor-native"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.kernel not in ("auto", "dense", "bitpack", "pallas"):
+            raise ValueError(f"unknown kernel {self.kernel!r}")
+        if self.pallas_block_rows < 8 or self.pallas_block_rows % 8:
+            # Mosaic requires sublane-dim block sizes in multiples of 8
+            # (ops/pallas_stencil.py); catch it here with the knob's name
+            # instead of a bare max()/ZeroDivisionError deep in the run.
+            raise ValueError(
+                f"pallas_block_rows={self.pallas_block_rows} must be a "
+                f"positive multiple of 8 (TPU sublane tile)"
+            )
         if self.role not in ("standalone", "frontend", "backend"):
             raise ValueError(f"unknown role {self.role!r}")
         if self.checkpoint_format not in ("npz", "orbax"):
             raise ValueError(f"unknown checkpoint format {self.checkpoint_format!r}")
         if self.steps_per_call % self.halo_width:
             raise ValueError("steps_per_call must be a multiple of halo_width")
+        if self.exchange_width < 1:
+            raise ValueError(f"exchange_width must be >= 1, got {self.exchange_width}")
+        if self.exchange_width > 1:
+            for name in ("render_every", "metrics_every", "checkpoint_every"):
+                cadence = getattr(self, name)
+                if cadence and cadence % self.exchange_width:
+                    raise ValueError(
+                        f"{name}={cadence} must be a multiple of "
+                        f"exchange_width={self.exchange_width}: cluster tiles "
+                        f"advance in exchange_width-epoch chunks, so other "
+                        f"epochs are never observable"
+                    )
 
     @property
     def shape(self) -> Tuple[int, int]:

@@ -66,7 +66,9 @@ class OrbaxCheckpointStore:
         step = self._mgr.latest_step()
         return int(step) if step is not None else None
 
-    def load(self, epoch: Optional[int] = None) -> Checkpoint:
+    def load(
+        self, epoch: Optional[int] = None, *, keep_packed: bool = False
+    ) -> Checkpoint:
         ocp = self._ocp
         self.wait()
         if epoch is None:
@@ -90,8 +92,27 @@ class OrbaxCheckpointStore:
         )
         meta = dict(out["meta"])
         rule = meta.pop("rule")
-        board = np.asarray(out["state"]["board"], dtype=np.uint8)
-        return Checkpoint(epoch=int(epoch), board=board, rule=rule, meta=meta)
+        raw = np.asarray(out["state"]["board"])
+        if meta.get("layout") == "packed32":
+            # Saved by a packed-kernel run: the board is (H, W/32) uint32
+            # LSB-first words, written device-native without host unpack.
+            words = raw.astype(np.uint32, copy=False)
+            if keep_packed:
+                return Checkpoint(
+                    epoch=int(epoch), board=None, rule=rule, meta=meta,
+                    packed32=words,
+                )
+            from akka_game_of_life_tpu.ops.bitpack import unpack_np
+
+            return Checkpoint(
+                epoch=int(epoch), board=unpack_np(words), rule=rule, meta=meta
+            )
+        return Checkpoint(
+            epoch=int(epoch),
+            board=raw.astype(np.uint8, copy=False),
+            rule=rule,
+            meta=meta,
+        )
 
     def close(self) -> None:
         self.wait()

@@ -156,6 +156,29 @@ class BoardObserver:
             print(f"epoch {epoch}:", file=self.out)
             print(render_ascii(board, self.render_max_cells), file=self.out, flush=True)
 
+    def observe_summary(
+        self,
+        epoch: int,
+        population: int,
+        board_shape: Tuple[int, int],
+        view: Optional[np.ndarray] = None,
+        strides: Tuple[int, int] = (1, 1),
+    ) -> None:
+        """Device-side observation: the caller computed the population and
+        (at render cadence) a stride-sampled view on the accelerator, so only
+        a scalar and a <=max_cells² probe ever reached the host — the
+        standalone analog of the cluster's sampled TILE_STATE path (nothing
+        here is O(board))."""
+        h, w = board_shape
+        self._note_progress(epoch, population, h * w)
+        if self.render_every and epoch % self.render_every == 0 and view is not None:
+            print(f"epoch {epoch}:", file=self.out)
+            print(
+                frame_header(board_shape, strides) + "\n" + ascii_rows(view),
+                file=self.out,
+                flush=True,
+            )
+
     # -- tiled path (distributed control plane) ------------------------------
 
     def expect_tiles(self, n: int) -> None:

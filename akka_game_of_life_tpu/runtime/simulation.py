@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.ops import bitpack
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 from akka_game_of_life_tpu.parallel import (
     distributed as dist,
@@ -30,6 +31,11 @@ from akka_game_of_life_tpu.parallel import (
     shard_board,
     sharded_step_fn,
     validate_tile_shape,
+)
+from akka_game_of_life_tpu.parallel.packed_halo2d import (
+    shard_packed2d,
+    sharded_packed2d_step_fn,
+    word_halo_width,
 )
 from akka_game_of_life_tpu.runtime import profiling
 from akka_game_of_life_tpu.runtime.chaos import CrashInjector
@@ -102,15 +108,6 @@ class Simulation:
         self.crash_log: list[int] = []  # epochs at which injected crashes hit
 
         self.epoch = 0
-        board = initial_board(config)
-        if self.store is not None and self.store.latest_epoch() is not None:
-            ckpt = self.store.load()
-            if ckpt.board.shape != config.shape:
-                raise ValueError(
-                    f"checkpoint shape {ckpt.board.shape} != config {config.shape}"
-                )
-            self.epoch = ckpt.epoch
-            board = ckpt.board
 
         self._actor_board = None
         self._actor_board_cls = None
@@ -118,6 +115,15 @@ class Simulation:
             # The per-cell actor backend (BASELINE config 1): same Simulation
             # surface, reference-architecture engine underneath — interpreted
             # ("actor") or compiled C++ ("actor-native").
+            board = initial_board(config)
+            if self.store is not None and self.store.latest_epoch() is not None:
+                ckpt = self.store.load()
+                if ckpt.board.shape != config.shape:
+                    raise ValueError(
+                        f"checkpoint shape {ckpt.board.shape} != config {config.shape}"
+                    )
+                self.epoch = ckpt.epoch
+                board = ckpt.board
             if config.backend == "actor-native":
                 from akka_game_of_life_tpu.native.engine import NativeActorBoard
 
@@ -127,6 +133,8 @@ class Simulation:
 
                 self._actor_board_cls = ActorBoard
             self.mesh = None
+            self.kernel = "dense"
+            self._packed = False
             self._actor_board = self._actor_board_cls(board, self.rule)
             self._actor_epoch0 = self.epoch  # actor engine counts from 0
             self._steppers = {}
@@ -134,20 +142,133 @@ class Simulation:
             return
 
         n_dev = len(jax.devices())
-        self._use_mesh = config.mesh_shape is not None or n_dev > 1
+        self._n_dev = n_dev
+        # An explicit pallas kernel pins the run to one device (the Mosaic
+        # sweep owns the whole grid); an explicit mesh_shape then errors in
+        # _resolve_kernel rather than silently ignoring either request.
+        self._use_mesh = config.mesh_shape is not None or (
+            n_dev > 1 and config.kernel != "pallas"
+        )
+        self.kernel = self._resolve_kernel()
+        self._packed = self.kernel in ("bitpack", "pallas")
         if self._use_mesh:
-            self.mesh = make_grid_mesh(config.mesh_shape)
-            validate_tile_shape(self.mesh, config.shape, config.halo_width)
+            if self._packed:
+                # Auto meshes go rows-only for packed boards: a row of uint32
+                # words is 32 cells wide per word, so narrow boards rarely
+                # split column-wise; the row ring is the natural 1-D layout
+                # (65536 rows / 8 devices = 8192-row shards on a v5e-8).
+                self.mesh = make_grid_mesh(self._packed_mesh_shape())
+                self._validate_packed_mesh()
+            else:
+                self.mesh = make_grid_mesh(config.mesh_shape)
+                validate_tile_shape(self.mesh, config.shape, config.halo_width)
         else:
             self.mesh = None
         self._steppers: Dict[int, Callable] = {}
-        self.board = self._to_device(board)
+        self._obs_fns: Dict[str, Callable] = {}
+
+        board = words = None
+        if self.store is not None and self.store.latest_epoch() is not None:
+            ckpt = self.store.load(keep_packed=self._packed)
+            self.epoch = ckpt.epoch
+            if ckpt.packed32 is not None:
+                words = ckpt.packed32
+                expect = (config.height, config.width // 32)
+                if words.shape != expect:
+                    raise ValueError(
+                        f"checkpoint packed shape {words.shape} != config {expect}"
+                    )
+            else:
+                if ckpt.board.shape != config.shape:
+                    raise ValueError(
+                        f"checkpoint shape {ckpt.board.shape} != config {config.shape}"
+                    )
+                board = ckpt.board
+        else:
+            board = initial_board(config)
+        self.board = (
+            self._words_to_device(words)
+            if words is not None
+            else self._to_device(board)
+        )
+
+    # -- kernel selection ----------------------------------------------------
+
+    def _resolve_kernel(self) -> str:
+        """Pick the stencil kernel the tpu backend steps with.  ``auto``
+        prefers the bit-packed SWAR kernel (the certified-fast path —
+        BASELINE.md roofline) whenever the rule and shape allow, falling back
+        to the dense uint8 kernel for multi-state rules and odd widths;
+        ``pallas`` is explicit opt-in (Mosaic-compiled, single device)."""
+        cfg = self.config
+        kernel = cfg.kernel
+        if kernel == "auto":
+            if not (self.rule.is_binary and cfg.width % 32 == 0):
+                return "dense"
+            if self._use_mesh and not self._packed_mesh_fits():
+                return "dense"
+            return "bitpack"
+        if kernel in ("bitpack", "pallas"):
+            if not self.rule.is_binary:
+                raise ValueError(
+                    f"kernel={kernel} supports binary rules only; rule "
+                    f"{self.rule} is multi-state (use kernel=dense)"
+                )
+            if cfg.width % 32:
+                raise ValueError(
+                    f"kernel={kernel} requires width % 32 == 0, got {cfg.width}"
+                )
+        if kernel == "pallas":
+            if self._use_mesh:
+                raise ValueError(
+                    "kernel=pallas is single-device (the Mosaic sweep owns "
+                    "the whole grid); use kernel=bitpack for sharded runs"
+                )
+            if cfg.height % cfg.pallas_block_rows:
+                raise ValueError(
+                    f"kernel=pallas requires height % pallas_block_rows "
+                    f"({cfg.pallas_block_rows}) == 0, got {cfg.height}"
+                )
+        return kernel
+
+    def _packed_mesh_shape(self) -> tuple:
+        return self.config.mesh_shape or (self._n_dev, 1)
+
+    def _packed_mesh_fits(self) -> bool:
+        cfg = self.config
+        rows, cols = self._packed_mesh_shape()
+        words = cfg.width // 32
+        s = self._halo_for(cfg.steps_per_call)
+        return not (
+            cfg.height % rows
+            or words % cols
+            or cfg.height // rows < s
+            or words // cols < word_halo_width(s)
+        )
+
+    def _validate_packed_mesh(self) -> None:
+        if not self._packed_mesh_fits():
+            cfg = self.config
+            raise ValueError(
+                f"packed grid ({cfg.height} rows x {cfg.width // 32} words) "
+                f"cannot shard over mesh {self._packed_mesh_shape()} with "
+                f"{self._halo_for(cfg.steps_per_call)} steps per exchange; "
+                f"use kernel=dense or a different mesh"
+            )
+
+    def _halo_for(self, k: int) -> int:
+        halo = min(self.config.halo_width, k)
+        while k % halo:
+            halo -= 1
+        return halo
 
     # -- device plumbing -----------------------------------------------------
 
     def _to_device(self, board: np.ndarray):
         if self._actor_board is not None:
             return board
+        if self._packed:
+            return self._words_to_device(bitpack.pack_np(np.asarray(board)))
         if self.mesh is not None:
             if jax.process_count() > 1:
                 # Multi-host mesh: every process materializes only the
@@ -155,6 +276,15 @@ class Simulation:
                 return dist.make_global_array(board, self.mesh)
             return shard_board(jnp.asarray(board), self.mesh)
         return jnp.asarray(board)
+
+    def _words_to_device(self, words: np.ndarray):
+        """Packed (H, W/32) uint32 words → the device-resident (and, on a
+        mesh, sharded) board — the packed twin of :meth:`_to_device`."""
+        if self.mesh is not None:
+            if jax.process_count() > 1:
+                return dist.make_global_array(words, self.mesh)
+            return shard_packed2d(jnp.asarray(words), self.mesh)
+        return jnp.asarray(words)
 
     def _stepper(self, k: int) -> Callable:
         """A k-epoch advance: jitted scan (cached per k) on the tpu backend,
@@ -173,12 +303,31 @@ class Simulation:
 
             return _actor_advance
         if k not in self._steppers:
-            if self.mesh is not None:
-                halo = min(self.config.halo_width, k)
-                while k % halo:
-                    halo -= 1
+            if self._packed:
+                if self.mesh is not None:
+                    self._steppers[k] = sharded_packed2d_step_fn(
+                        self.mesh,
+                        self.rule,
+                        steps_per_call=k,
+                        halo_rows=self._halo_for(k),
+                    )
+                elif self.kernel == "pallas":
+                    from akka_game_of_life_tpu.ops import pallas_stencil
+
+                    self._steppers[k] = pallas_stencil.packed_multi_step_fn(
+                        self.rule,
+                        k,
+                        block_rows=self.config.pallas_block_rows,
+                        # Mosaic needs a real TPU; everywhere else the kernel
+                        # runs (slowly) in interpret mode, as documented on
+                        # the config knob.
+                        interpret=jax.default_backend() != "tpu",
+                    )
+                else:
+                    self._steppers[k] = bitpack.packed_multi_step_fn(self.rule, k)
+            elif self.mesh is not None:
                 self._steppers[k] = sharded_step_fn(
-                    self.mesh, self.rule, steps_per_call=k, halo_width=halo
+                    self.mesh, self.rule, steps_per_call=k, halo_width=self._halo_for(k)
                 )
             else:
                 self._steppers[k] = get_model(self.rule).run(k)
@@ -210,18 +359,73 @@ class Simulation:
                 self.board = self._stepper(chunk)(self.board)
             self.epoch += chunk
 
-            host_board = None
             if _crosses(prev, self.epoch, cfg.render_every) or _crosses(
                 prev, self.epoch, cfg.metrics_every
             ):
-                host_board = self.board_host()
-                if jax.process_index() == 0:
-                    self.observer.observe(self.epoch, host_board)
+                self._observe(render=_crosses(prev, self.epoch, cfg.render_every))
             if self.store is not None and _crosses(
                 prev, self.epoch, cfg.checkpoint_every
             ):
-                self.checkpoint(host_board)
+                self.checkpoint()
         return self.epoch
+
+    # -- observation (device-side: nothing here is O(board) on host) ---------
+
+    def _obs_fn(self, name: str, core: Callable) -> Callable:
+        """A cached observation closure.  On a mesh the core runs under
+        ``auto_axes`` with a replicated output spec: strided slices and
+        word-index gathers have no unambiguous output sharding under the
+        explicit-sharding mesh, and the outputs are tiny (a row vector, a
+        <=max_cells² probe) so replication is the right answer."""
+        if name not in self._obs_fns:
+            if self.mesh is not None:
+                from jax.sharding import PartitionSpec, auto_axes
+
+                jitted = jax.jit(auto_axes(core, out_sharding=PartitionSpec()))
+                mesh = self.mesh
+
+                def call(b):
+                    with jax.set_mesh(mesh):
+                        return jitted(b)
+
+                self._obs_fns[name] = call
+            else:
+                self._obs_fns[name] = jax.jit(core)
+        return self._obs_fns[name]
+
+    def _observe(self, *, render: bool) -> None:
+        """Population (always) and a strided render probe (at render cadence),
+        both computed on device; only an (H,)-row-count vector and a
+        <=max_cells² sample cross to the host — the standalone runtime's
+        answer to VERDICT.md weak #4 (the old path shipped the whole board,
+        a full cross-host allgather at 65536²)."""
+        if self._actor_board is not None:
+            if jax.process_index() == 0:
+                self.observer.observe(self.epoch, np.asarray(self.board))
+            return
+        cfg = self.config
+        from akka_game_of_life_tpu.runtime.render import sample_strides
+
+        if self._packed:
+            pop_core = bitpack.population_rows
+        else:
+            pop_core = lambda b: jnp.sum((b == 1).astype(jnp.uint32), axis=1)
+        row_pops = self._obs_fn("pop", pop_core)(self.board)
+        population = int(np.asarray(dist.fetch(row_pops), dtype=np.int64).sum())
+        view = None
+        sy, sx = sample_strides(cfg.shape, cfg.render_max_cells)
+        if render:
+            if self._packed:
+                sample_core = bitpack.sample_packed_core(sy, sx, cfg.width)
+            else:
+                sample_core = lambda b: b[::sy, ::sx]
+            view = dist.fetch(
+                self._obs_fn(f"sample_{sy}_{sx}", sample_core)(self.board)
+            )
+        if jax.process_index() == 0:
+            self.observer.observe_summary(
+                self.epoch, population, cfg.shape, view, (sy, sx)
+            )
 
     # -- failure & recovery --------------------------------------------------
 
@@ -232,19 +436,26 @@ class Simulation:
         target = self.epoch
         self.crash_log.append(target)
         self.board = None  # the crash: live state gone
-        ckpt = self.store.load() if self.store.latest_epoch() is not None else None
+        ckpt = (
+            self.store.load(keep_packed=self._packed)
+            if self.store.latest_epoch() is not None
+            else None
+        )
         if ckpt is None:
             self.epoch = 0
-            restored = initial_board(self.config)
+            self.board = self._to_device(initial_board(self.config))
+        elif ckpt.packed32 is not None:
+            self.epoch = ckpt.epoch
+            self.board = self._words_to_device(ckpt.packed32)
         else:
             self.epoch = ckpt.epoch
             restored = ckpt.board
-        if self._actor_board is not None:
-            # Fresh actors reseeded from the restored board (supervision
-            # restart at the checkpoint, not epoch 0).
-            self._actor_board = self._actor_board_cls(restored, self.rule)
-            self._actor_epoch0 = self.epoch
-        self.board = self._to_device(restored)
+            if self._actor_board is not None:
+                # Fresh actors reseeded from the restored board (supervision
+                # restart at the checkpoint, not epoch 0).
+                self._actor_board = self._actor_board_cls(restored, self.rule)
+                self._actor_epoch0 = self.epoch
+            self.board = self._to_device(restored)
         while self.epoch < target:
             # Replay: recompute the lost epochs (deterministic rule ⇒ the
             # trajectory is bit-identical to the pre-crash one).  Reuses the
@@ -257,38 +468,54 @@ class Simulation:
     def checkpoint(self, host_board: Optional[np.ndarray] = None) -> None:
         if self.store is None:
             raise RuntimeError("no checkpoint_dir configured")
-        if (
-            self.config.checkpoint_format == "npz"
-            and jax.process_count() > 1
-            and jax.process_index() != 0
-        ):
+        meta = {"height": self.config.height, "width": self.config.width}
+        npz = self.config.checkpoint_format == "npz"
+        if npz and jax.process_count() > 1 and jax.process_index() != 0:
             # The npz store is a host-side writer: exactly one process owns
             # the file.  (The orbax store is multihost-aware — every process
             # participates in a sharded save — so it is not gated.)
             if host_board is None:
-                self.board_host()  # keep the collective fetch in lockstep
+                # Keep the collective fetch in lockstep with rank 0.
+                dist.fetch(self.board) if self._packed else self.board_host()
             return
-        if (
-            host_board is None
-            and jax.process_count() > 1
-            and self.config.checkpoint_format == "npz"
-        ):
-            # npz is a host-side writer and needs the whole board; orbax
-            # keeps its device-native sharded save — no cross-host gather.
-            host_board = self.board_host()
-        if host_board is None:
-            # The store decides where the bytes come from: the orbax store
-            # saves the (possibly sharded) device array without host gather;
-            # the npz store gathers internally.
-            host_board = self.board
 
-        def _save():
-            self.store.save(
-                self.epoch,
-                host_board,
-                self.rule.rulestring(),
-                meta={"height": self.config.height, "width": self.config.width},
-            )
+        if self._packed and host_board is None:
+            # Packed runs never unpack for a checkpoint: npz receives the
+            # (H, W/32) uint32 words (0.25 B/cell host transfer); orbax saves
+            # the packed device array in place, tagged so load() can decode.
+            def _save():
+                if npz:
+                    words = np.asarray(dist.fetch(self.board), dtype=np.uint32)
+                    self.store.save_packed32(
+                        self.epoch,
+                        words,
+                        self.config.shape,
+                        self.rule.rulestring(),
+                        meta=meta,
+                    )
+                else:
+                    self.store.save(
+                        self.epoch,
+                        self.board,
+                        self.rule.rulestring(),
+                        meta={**meta, "layout": "packed32"},
+                    )
+
+        else:
+            if host_board is None and npz and jax.process_count() > 1:
+                # npz is a host-side writer and needs the whole board; orbax
+                # keeps its device-native sharded save — no cross-host gather.
+                host_board = self.board_host()
+            if host_board is None:
+                # The store decides where the bytes come from: the orbax
+                # store saves the (possibly sharded) device array without
+                # host gather; the npz store gathers internally.
+                host_board = self.board
+
+            def _save():
+                self.store.save(
+                    self.epoch, host_board, self.rule.rulestring(), meta=meta
+                )
 
         if self.config.metrics_every:
             # Checkpoint cost is an operational metric: surface it alongside
@@ -299,6 +526,12 @@ class Simulation:
             _save()
 
     def board_host(self) -> np.ndarray:
+        """The full board as host uint8 — O(board); for final renders, tests,
+        and small boards (the steady-state loop never calls this)."""
+        if self._packed:
+            return bitpack.unpack_np(
+                np.asarray(dist.fetch(self.board), dtype=np.uint32)
+            )
         return dist.fetch(self.board)
 
     def close(self) -> None:

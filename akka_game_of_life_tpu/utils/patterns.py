@@ -118,4 +118,23 @@ def random_grid(
     never part of a fresh board)."""
     del states  # live/dead only; refractory states arise from dynamics
     rng = np.random.default_rng(seed)
-    return (rng.random(shape) < density).astype(np.uint8)
+    # Chunked uint16 thresholding: rng.random would allocate 8 bytes/cell
+    # (34 GiB at 65536²); this path peaks at the uint8 board plus one
+    # ~256 MiB scratch block, with density quantized to 1/65536.
+    h, w = shape
+    thresh = max(0, min(65536, round(density * 65536)))
+    # Saturated densities never reach the comparison: 65536 overflows uint16
+    # (np.less with an out-of-range python int segfaults NumPy 2.0.2).
+    if thresh == 0:
+        return np.zeros(shape, dtype=np.uint8)
+    if thresh == 65536:
+        return np.ones(shape, dtype=np.uint8)
+    out = np.empty(shape, dtype=np.uint8)
+    t16 = np.uint16(thresh)
+    rows_per = max(1, (1 << 27) // max(1, w))
+    for y in range(0, h, rows_per):
+        block = rng.integers(
+            0, 65536, size=(min(rows_per, h - y), w), dtype=np.uint16
+        )
+        np.less(block, t16, out=out[y : y + block.shape[0]])
+    return out

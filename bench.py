@@ -1,6 +1,10 @@
 """Headline benchmark: Conway B3/S23 toroidal stencil throughput.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per BASELINE.json config (actor 64², dense 8192²,
+HighLife/Day&Night, Brian's Brain, then the 65536² headline LAST so a
+one-line consumer reads the headline): {"metric", "value", "unit",
+"vs_baseline"} (+ "config" on the non-headline lines).  --headline-only
+restores the single-line behavior.
 
 Baseline (BASELINE.md): the north-star target is >=1e11 cell-updates/sec
 aggregate on a TPU v5e-8 at 65536^2, i.e. 1.25e10 per chip; vs_baseline is
@@ -8,9 +12,10 @@ value / 1.25e10 measured on the chips available (one, under the driver).
 The reference itself publishes no numbers — its wall-clock-ticked
 actor-per-cell design tops out around ~12-16 cell-updates/sec (BASELINE.md).
 
-Default kernel is the bit-packed SWAR stencil (ops/bitpack.py): 32 cells per
-uint32 lane, carry-save-adder neighbor counts, whole multi-step scan fused
-on-device.  --kernel roll falls back to the uint8 shift-sum stencil.
+Default headline kernel is the Mosaic temporal-blocking Pallas stencil
+(ops/pallas_stencil.py — 1.78e12 cells/s/chip measured on v5e, ~8.5x the
+XLA bitpack path), falling back to the bit-packed SWAR stencil
+(ops/bitpack.py) if the Pallas compile/run fails.  --kernel pins one.
 """
 
 from __future__ import annotations
@@ -80,11 +85,19 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--size", type=int, default=65536)
     parser.add_argument(
-        "--kernel", choices=["bitpack", "pallas", "roll"], default="bitpack"
+        "--kernel",
+        choices=["auto", "bitpack", "pallas", "roll"],
+        default="auto",
+        help="auto = pallas with bitpack fallback on compile/run failure",
+    )
+    parser.add_argument(
+        "--headline-only",
+        action="store_true",
+        help="emit only the 65536^2 headline line (skip the other BASELINE configs)",
     )
     parser.add_argument("--steps-per-call", type=int, default=64)
     parser.add_argument("--timed-calls", type=int, default=2)
-    parser.add_argument("--block-rows", type=int, default=256)
+    parser.add_argument("--block-rows", type=int, default=128)
     parser.add_argument(
         "--steps-per-sweep", type=int, default=None,
         help="pallas temporal-block depth (default: auto-pick a divisor)",
@@ -102,10 +115,11 @@ def main() -> None:
     )
     args = parser.parse_args()
 
-    metric_label = (
-        f"cell-updates/sec/chip, Conway B3/S23 {args.size}x{args.size} torus "
-        f"({args.kernel} kernel, 1 chip)"
-    )
+    def _label(kernel: str) -> str:
+        return (
+            f"cell-updates/sec/chip, Conway B3/S23 {args.size}x{args.size} "
+            f"torus ({kernel} kernel, 1 chip)"
+        )
 
     if args.probe_timeout > 0:
         failure = probe_device(
@@ -117,7 +131,7 @@ def main() -> None:
             print(
                 json.dumps(
                     {
-                        "metric": metric_label,
+                        "metric": _label(args.kernel),
                         "value": None,
                         "unit": "cell-updates/sec",
                         "vs_baseline": None,
@@ -138,62 +152,122 @@ def main() -> None:
     from akka_game_of_life_tpu.ops import bitpack
     from akka_game_of_life_tpu.ops.rules import CONWAY
 
+    if not args.headline_only:
+        # The other BASELINE.json configs, one JSON line each (VERDICT.md
+        # round-2 next #5); a failure in one config is recorded as a line,
+        # never a crash of the headline run.
+        import bench_suite
+
+        aux = [
+            ("conway-actor-64", lambda: bench_suite.bench_actor(64)),
+            (
+                "conway-8192",
+                lambda: bench_suite.bench_dense(8192, "conway", "conway-8192"),
+            ),
+            (
+                "lifelike-8192",
+                lambda: (
+                    bench_suite.bench_packed(8192, "highlife", "lifelike-8192"),
+                    bench_suite.bench_packed(8192, "day-and-night", "lifelike-8192"),
+                ),
+            ),
+            (
+                "generations-8192",
+                lambda: bench_suite.bench_packed_gen(
+                    8192, "brians-brain", "generations-8192"
+                ),
+            ),
+        ]
+        for name, fn in aux:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — recorded, not raised
+                print(
+                    json.dumps(
+                        {"config": name, "error": f"{type(e).__name__}: {e}"}
+                    ),
+                    flush=True,
+                )
+
     n = args.size
+    if args.kernel != "roll" and n % 32:
+        # Packed kernels only; the dense roll path takes any size.
+        parser.error(f"--size {n} must be a multiple of 32 for --kernel {args.kernel}")
+
     # NOTE: on this TPU platform block_until_ready does not actually block,
     # so every timing ends with a host fetch of a scalar to force sync.
-    if args.kernel in ("bitpack", "pallas"):
-        if n % 32:
-            parser.error(f"--size {n} must be a multiple of 32 for --kernel {args.kernel}")
-        rng = np.random.default_rng(0)
-        board = jnp.asarray(
-            rng.integers(0, 2**32, size=(n, n // 32), dtype=np.uint32)
-        )
-        if args.kernel == "pallas":
-            from akka_game_of_life_tpu.ops import pallas_stencil
-
-            run = pallas_stencil.packed_multi_step_fn(
-                CONWAY,
-                args.steps_per_call,
-                block_rows=args.block_rows,
-                steps_per_sweep=args.steps_per_sweep,
+    def _headline(kernel: str) -> float:
+        if kernel in ("bitpack", "pallas"):
+            rng = np.random.default_rng(0)
+            board = jnp.asarray(
+                rng.integers(0, 2**32, size=(n, n // 32), dtype=np.uint32)
             )
+            if kernel == "pallas":
+                from akka_game_of_life_tpu.ops import pallas_stencil
+
+                run = pallas_stencil.packed_multi_step_fn(
+                    CONWAY,
+                    args.steps_per_call,
+                    block_rows=args.block_rows,
+                    steps_per_sweep=args.steps_per_sweep,
+                )
+            else:
+                run = bitpack.packed_multi_step_fn(CONWAY, args.steps_per_call)
+            population = lambda x: int(jnp.sum(jnp.bitwise_count(x)))
         else:
-            run = bitpack.packed_multi_step_fn(CONWAY, args.steps_per_call)
-        population = lambda x: int(jnp.sum(jnp.bitwise_count(x)))
-    else:
-        from akka_game_of_life_tpu.utils.patterns import random_grid
+            from akka_game_of_life_tpu.utils.patterns import random_grid
 
-        board = jnp.asarray(random_grid((n, n), density=0.5, seed=0))
-        run = get_model("conway").run(args.steps_per_call)
-        population = lambda x: int(jnp.sum(x))
+            board = jnp.asarray(random_grid((n, n), density=0.5, seed=0))
+            run = get_model("conway").run(args.steps_per_call)
+            population = lambda x: int(jnp.sum(x))
 
-    board = run(board)
-    _ = population(board)  # warm both compiles
-
-    t0 = time.perf_counter()
-    for _ in range(args.timed_calls):
         board = run(board)
-    pop = population(board)  # forces execution of the whole chain
-    dt = time.perf_counter() - t0
+        _ = population(board)  # warm both compiles
 
-    total_updates = n * n * args.steps_per_call * args.timed_calls
-    rate = total_updates / dt
-    # Keep the result honest: the board must still be alive (not a trivially
-    # dead fixed point that XLA could const-fold).
-    assert pop > 0
+        t0 = time.perf_counter()
+        for _ in range(args.timed_calls):
+            board = run(board)
+        pop = population(board)  # forces execution of the whole chain
+        dt = time.perf_counter() - t0
+        # Keep the result honest: the board must still be alive (not a
+        # trivially dead fixed point that XLA could const-fold).
+        assert pop > 0
+        return n * n * args.steps_per_call * args.timed_calls / dt
 
-    print(
-        json.dumps(
-            {
-                # The benchmark computation is a plain single-device jit, so
-                # per-chip is literal regardless of how many chips the host has.
-                "metric": metric_label,
-                "value": rate,
-                "unit": "cell-updates/sec",
-                "vs_baseline": rate / PER_CHIP_TARGET,
-            }
+    kernels = ["pallas", "bitpack"] if args.kernel == "auto" else [args.kernel]
+    rate = None
+    fallback_note = None
+    for kernel in kernels:
+        try:
+            rate = _headline(kernel)
+            break
+        except Exception as e:  # noqa: BLE001 — fall back, record why
+            fallback_note = f"{kernel} failed: {type(e).__name__}: {e}"
+    if rate is None:
+        print(
+            json.dumps(
+                {
+                    "metric": _label(kernels[-1]),
+                    "value": None,
+                    "unit": "cell-updates/sec",
+                    "vs_baseline": None,
+                    "error": fallback_note,
+                }
+            )
         )
-    )
+        sys.exit(1)
+
+    line = {
+        # The benchmark computation is a plain single-device jit, so
+        # per-chip is literal regardless of how many chips the host has.
+        "metric": _label(kernel),
+        "value": rate,
+        "unit": "cell-updates/sec",
+        "vs_baseline": rate / PER_CHIP_TARGET,
+    }
+    if fallback_note is not None:
+        line["note"] = fallback_note
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

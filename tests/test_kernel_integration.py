@@ -1,0 +1,178 @@
+"""Product-runtime kernel integration: the packed kernels behind the same
+Simulation/CLI surface as dense (VERDICT.md round-2 next #1).
+
+The reference's single entry point runs its real compute
+(``/root/reference/src/main/scala/gameoflife/Run.scala:15-54``); here the
+certified-fast bitpack/pallas kernels must be what ``run`` actually steps —
+with render, metrics, checkpoint/resume, and chaos riding along — not just
+what ``bench.py`` times.  These tests pin packed-sim ≡ dense-sim across
+render/metrics/checkpoint cadence boundaries, packed checkpoint round-trips,
+and the auto-selection rules.
+"""
+
+import io
+import re
+
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.ops import bitpack
+from akka_game_of_life_tpu.runtime.config import (
+    FaultInjectionConfig,
+    SimulationConfig,
+)
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+import jax.numpy as jnp
+
+
+def _dense(board, rule, steps):
+    return np.asarray(get_model(rule).run(steps)(jnp.asarray(board)))
+
+
+def _cfg(kernel, tmp_path=None, **kw):
+    base = dict(
+        height=64,
+        width=64,
+        rule="conway",
+        seed=11,
+        steps_per_call=8,
+        kernel=kernel,
+        render_every=16,
+        metrics_every=16,
+    )
+    if tmp_path is not None:
+        base.update(checkpoint_dir=str(tmp_path), checkpoint_every=16)
+    base.update(kw)
+    return SimulationConfig(**base)
+
+
+def test_auto_selects_bitpack_for_binary_32aligned():
+    sim = Simulation(_cfg("auto"), observer=BoardObserver(out=io.StringIO()))
+    assert sim.kernel == "bitpack"
+    assert sim._packed
+
+
+def test_auto_falls_back_to_dense_for_multistate_and_odd_width():
+    sim = Simulation(
+        _cfg("auto", rule="brians-brain"), observer=BoardObserver(out=io.StringIO())
+    )
+    assert sim.kernel == "dense"
+    sim = Simulation(
+        _cfg("auto", width=60), observer=BoardObserver(out=io.StringIO())
+    )
+    assert sim.kernel == "dense"
+
+
+def test_explicit_bitpack_rejects_multistate():
+    with pytest.raises(ValueError, match="binary"):
+        Simulation(
+            _cfg("bitpack", rule="brians-brain"),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+    with pytest.raises(ValueError, match="width"):
+        Simulation(_cfg("bitpack", width=60), observer=BoardObserver(out=io.StringIO()))
+
+
+def test_bitpack_sim_matches_dense_sim_across_cadences(tmp_path):
+    """The VERDICT done-criterion: packed-sim ≡ dense-sim across a
+    render/metrics/checkpoint cadence boundary (40 epochs crosses all three
+    at 16 and 32, plus a partial trailing chunk)."""
+    dense = Simulation(
+        _cfg("dense", tmp_path / "d"), observer=BoardObserver(out=io.StringIO())
+    )
+    packed = Simulation(
+        _cfg("bitpack", tmp_path / "p"), observer=BoardObserver(out=io.StringIO())
+    )
+    start = dense.board_host()
+    assert np.array_equal(start, packed.board_host())
+    dense.advance(40)
+    packed.advance(40)
+    assert np.array_equal(dense.board_host(), packed.board_host())
+    assert np.array_equal(dense.board_host(), _dense(start, "conway", 40))
+
+
+def test_packed_and_dense_render_identically(tmp_path):
+    """Same frames, same metrics populations, byte-for-byte — the packed
+    observer path (device-side population + strided sample) must be
+    indistinguishable from the dense one."""
+    out_d, out_p = io.StringIO(), io.StringIO()
+    obs = lambda out: BoardObserver(out=out, render_every=16, metrics_every=16)
+    dense = Simulation(_cfg("dense"), observer=obs(out_d))
+    packed = Simulation(_cfg("bitpack"), observer=obs(out_p))
+    dense.advance(32)
+    packed.advance(32)
+    # Identical frames and populations; only the wall-clock rates may differ.
+    detime = lambda s: re.sub(
+        r"[\d.]+e[+-]\d+ cell-updates/s \([\d.]+ ms/epoch\)", "<rate>", s
+    )
+    assert detime(out_d.getvalue()) == detime(out_p.getvalue())
+    assert "pop=" in out_d.getvalue()
+
+
+def test_packed_checkpoint_roundtrip_and_resume(tmp_path):
+    """A packed run checkpoints packed words (never unpacking on host) and a
+    fresh Simulation resumes from them bit-identically; a dense run can also
+    resume from a packed checkpoint (format interop)."""
+    sim = Simulation(
+        _cfg("bitpack", tmp_path), observer=BoardObserver(out=io.StringIO())
+    )
+    start = sim.board_host()
+    sim.advance(32)
+    want = sim.board_host()
+
+    resumed = Simulation(
+        _cfg("bitpack", tmp_path), observer=BoardObserver(out=io.StringIO())
+    )
+    assert resumed.epoch == 32
+    assert np.array_equal(resumed.board_host(), want)
+    resumed.advance(8)
+    assert np.array_equal(resumed.board_host(), _dense(start, "conway", 40))
+
+    # Dense engine resuming the packed-format checkpoint: same state.
+    dense_resume = Simulation(
+        _cfg("dense", tmp_path), observer=BoardObserver(out=io.StringIO())
+    )
+    assert dense_resume.epoch == 32
+    assert np.array_equal(dense_resume.board_host(), want)
+
+
+def test_packed_chaos_recovery_matches_clean_run(tmp_path):
+    """Fault injection on the packed kernel: crash, restore from the packed
+    checkpoint, deterministically replay — same trajectory as a clean run."""
+    chaotic = Simulation(
+        _cfg(
+            "bitpack",
+            tmp_path,
+            fault_injection=FaultInjectionConfig(
+                enabled=True, first_after_s=0.0, every_s=0.0, max_crashes=2
+            ),
+        ),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    clean = Simulation(_cfg("bitpack"), observer=BoardObserver(out=io.StringIO()))
+    chaotic.advance(40)
+    clean.advance(40)
+    assert chaotic.crash_log, "injector never fired"
+    assert np.array_equal(chaotic.board_host(), clean.board_host())
+
+
+def test_pack_unpack_np_roundtrip():
+    rng = np.random.default_rng(3)
+    board = rng.integers(0, 2, size=(16, 96), dtype=np.uint8)
+    words = bitpack.pack_np(board)
+    assert words.dtype == np.uint32
+    assert np.array_equal(bitpack.unpack_np(words), board)
+
+
+def test_pallas_kernel_in_simulation_interpret():
+    """kernel=pallas through the Simulation surface (interpret-mode compile
+    on CPU is exercised by ops tests; here we only check selection plumbing
+    rejects meshes and accepts the single-device config)."""
+    with pytest.raises(ValueError, match="single-device"):
+        Simulation(
+            _cfg("pallas", mesh_shape=(2, 1)),
+            observer=BoardObserver(out=io.StringIO()),
+        )
