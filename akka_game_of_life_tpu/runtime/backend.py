@@ -60,26 +60,52 @@ class _Tile:
         self.retries = 0
 
 
-def _jax_engine(rule: Rule) -> Callable[[np.ndarray], np.ndarray]:
+def _jax_engine(rule: Rule) -> Callable[[np.ndarray, int, int], np.ndarray]:
     """Jitted tile stepping on the worker's local accelerator(s).
 
-    With more than one local device the padded slab is row-sharded over a
-    1-D local mesh and the step jitted with sharding constraints — GSPMD
-    inserts the interior halo exchanges itself, so a worker on a multi-chip
-    host spreads its tile across its chips (ICI inside the worker, the
-    cluster control plane outside).  Single device degenerates to a plain
-    jit."""
+    Takes a width-k halo-padded (h+2k, w+2k) slab and advances the (h, w)
+    interior by ``steps`` (<= k) generations in ONE device round-trip: a
+    ``lax.scan`` of the *toroidal* step at constant shape — the wraps only
+    ever corrupt the outermost halo cells, which are cut edges whose garbage
+    front moves one cell per step, so the interior slice is exact (the same
+    argument as ``parallel/packed_halo2d.py``).  This is the cluster's
+    communication-avoiding engine: one exchange, k on-device epochs, zero
+    per-epoch host round-trips inside the chunk.
+
+    With more than one local device the slab is row-sharded over a 1-D local
+    mesh and the scan jitted with sharding constraints — GSPMD inserts the
+    interior halo exchanges itself, so a worker on a multi-chip host spreads
+    its tile across its chips (ICI inside the worker, the cluster control
+    plane outside).  Single device degenerates to a plain jit."""
     import jax
     import jax.numpy as jnp
 
-    from akka_game_of_life_tpu.ops.stencil import step_fn_padded, step_padded
+    from akka_game_of_life_tpu.ops.stencil import step as stencil_step
 
     devices = jax.local_devices()
-    if len(devices) == 1:
-        step = step_fn_padded(rule)
+    compiled: Dict[int, Callable] = {}  # steps → jitted chunk fn
 
-        def run(padded: np.ndarray) -> np.ndarray:
-            return np.asarray(step(jnp.asarray(padded)))
+    def _chunk_fn(steps: int):
+        def chunk(padded):
+            out, _ = jax.lax.scan(
+                lambda s, _: (stencil_step(s, rule), None),
+                padded,
+                None,
+                length=steps,
+            )
+            return out
+
+        return chunk
+
+    if len(devices) == 1:
+
+        def run(padded: np.ndarray, steps: int, halo: int) -> np.ndarray:
+            assert steps <= halo, (steps, halo)
+            fn = compiled.get(steps)
+            if fn is None:
+                fn = compiled[steps] = jax.jit(_chunk_fn(steps))
+            out = fn(jnp.asarray(padded))
+            return np.asarray(out[halo:-halo, halo:-halo])
 
         return run
 
@@ -93,23 +119,37 @@ def _jax_engine(rule: Rule) -> Callable[[np.ndarray], np.ndarray]:
     )
     rows = NamedSharding(mesh, PartitionSpec("rows", None))
 
-    # Output sharding is left to GSPMD: the (rows-2) output height need not
-    # divide the mesh even when the padded input does.
-    sharded_step = jax.jit(
-        lambda padded: step_padded(padded, rule), in_shardings=rows
-    )
-
-    def run(padded: np.ndarray) -> np.ndarray:
-        h_out = padded.shape[0] - 2
+    def run(padded: np.ndarray, steps: int, halo: int) -> np.ndarray:
+        assert steps <= halo, (steps, halo)
+        h_out = padded.shape[0] - 2 * halo
         pad = (-padded.shape[0]) % n
         if pad:
-            # Row-pad up to a mesh multiple; trailing junk rows only feed
-            # trailing outputs, sliced off below (the stencil is local).
+            # Row-pad up to a mesh multiple.  The junk rows sit below the
+            # south halo; the toroidal wrap feeds their garbage into the
+            # outermost halo rows (already cut edges), and both fronts move
+            # one row per step — with steps <= halo the interior slice below
+            # is never reached.
             padded = np.pad(padded, ((0, pad), (0, 0)))
-        out = sharded_step(jax.device_put(padded, rows))
-        return np.asarray(out)[:h_out]
+        fn = compiled.get(steps)
+        if fn is None:
+            fn = compiled[steps] = jax.jit(_chunk_fn(steps), in_shardings=rows)
+        out = fn(jax.device_put(padded, rows))
+        return np.asarray(out)[halo : halo + h_out, halo:-halo]
 
     return run
+
+
+def _np_chunk(padded: np.ndarray, steps: int, halo: int, rule: Rule) -> np.ndarray:
+    """Host-engine chunk: ``steps`` (<= halo) epochs on a width-``halo``
+    padded slab; each step peels one boundary layer, then the exact (h, w)
+    interior is sliced out."""
+    assert steps <= halo, (steps, halo)
+    h, w = padded.shape[0] - 2 * halo, padded.shape[1] - 2 * halo
+    out = padded
+    for _ in range(steps):
+        out = step_padded_np(out, rule)
+    m = halo - steps  # remaining margin after `steps` peels
+    return out[m : m + h, m : m + w]
 
 
 def _ring_msg(tid: TileId, epoch: int, ring: Ring) -> dict:
@@ -131,7 +171,7 @@ def _ring_of_msg(msg: dict) -> Ring:
         bottom=msg["bottom"],
         left=msg["left"],
         right=msg["right"],
-        corners={k: int(v) for k, v in msg["corners"].items()},
+        corners=dict(msg["corners"]),  # (k, k) blocks, decoded as arrays
     )
 
 
@@ -174,6 +214,10 @@ class BackendWorker:
         self.rule: Optional[Rule] = None
         self.target = 0
         self.final_epoch = 0
+        # Communication-avoiding exchange: rings/halos are this many cells
+        # wide and one exchange buys this many local epochs (cluster-wide,
+        # frontend-owned; arrives in WELCOME).
+        self.exchange_width = 1
         self.render_every = 0
         self.checkpoint_every = 0
         self.metrics_every = 0
@@ -181,7 +225,7 @@ class BackendWorker:
         self.origins: Dict[TileId, Tuple[int, int]] = {}
         self.paused = False
         self.channel: Optional[Channel] = None
-        self._step_padded: Optional[Callable[[np.ndarray], np.ndarray]] = None
+        self._step_chunk: Optional[Callable[[np.ndarray, int, int], np.ndarray]] = None
         self._actor_engines: Dict[TileId, object] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -205,7 +249,14 @@ class BackendWorker:
         sock.settimeout(None)
         self.channel = Channel(sock)
         self.channel.send(
-            {"type": P.REGISTER, "name": self.name, "peer_port": self.peer_port}
+            {
+                "type": P.REGISTER,
+                "name": self.name,
+                "peer_port": self.peer_port,
+                # The frontend rejects engines that can't honor the cluster's
+                # exchange width (actor engines need per-epoch halos).
+                "engine": self.engine,
+            }
         )
         welcome = self.channel.recv()
         if not welcome or welcome.get("type") != P.WELCOME:
@@ -217,6 +268,7 @@ class BackendWorker:
         # the standalone/test default.
         if "max_pull_retries" in welcome:
             self.max_pull_retries = int(welcome["max_pull_retries"])
+        self.exchange_width = int(welcome.get("exchange_width", 1))
         threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_s,), daemon=True
         ).start()
@@ -445,7 +497,7 @@ class BackendWorker:
         with self._lock:
             if self.layout is None or self.layout.grid != grid:
                 self.layout = TileLayout(shape, grid)
-                self.store = BoundaryStore(self.layout)
+                self.store = BoundaryStore(self.layout, self.exchange_width)
             self.owners = {
                 tuple(t): (name, host, int(port))
                 for t, name, host, port in msg["tiles"]
@@ -467,9 +519,11 @@ class BackendWorker:
             if self.rule != rule:
                 self.rule = rule
                 if self.engine == "jax":
-                    self._step_padded = _jax_engine(rule)
+                    self._step_chunk = _jax_engine(rule)
                 elif self.engine == "numpy":
-                    self._step_padded = lambda padded: step_padded_np(padded, rule)
+                    self._step_chunk = (
+                        lambda padded, steps, halo: _np_chunk(padded, steps, halo, rule)
+                    )
                 # engine == "actor": stateful per-tile engines, built below
             self.target = int(msg["target"])
             self.final_epoch = int(msg["final_epoch"])
@@ -542,9 +596,17 @@ class BackendWorker:
                     tile is None
                     or self.store is None
                     or self.paused
-                    or tile.epoch >= self.target
                     or tile.awaiting_since is not None  # pull already in flight
                 ):
+                    return
+                # Chunked advance: one width-k halo exchange licenses the
+                # next c = min(k, final-epoch) epochs; the tile waits until
+                # the target covers the WHOLE chunk so every tile visits the
+                # same epoch grid {0, k, 2k, ..., final} regardless of TICK
+                # arrival order (mixed chunk boundaries would ask neighbors
+                # for rings at epochs they never computed).
+                c = self._chunk_for(tile.epoch)
+                if c <= 0 or self.target < tile.epoch + c:
                     return
                 epoch = tile.epoch
                 # The waitingForNewState latch (CellActor.scala:32): set
@@ -588,27 +650,38 @@ class BackendWorker:
         if self._step_tile(tid, epoch, halo):
             self._drive(tid)
 
+    def _chunk_for(self, epoch: int) -> int:
+        """Epochs the next exchange buys from ``epoch``: the full exchange
+        width, or the remainder to final_epoch (the one partial chunk)."""
+        k = self.exchange_width
+        return min(k, self.final_epoch - epoch) if self.final_epoch else k
+
     def _step_tile(self, tid: TileId, epoch: int, halo: Halo) -> bool:
-        """One epoch of one tile.  Compute happens under the lock; ring and
-        state sends happen after releasing it so two workers never hold
-        their locks while writing into each other's sockets."""
+        """One chunk (1..exchange_width epochs) of one tile.  Compute happens
+        under the lock; ring and state sends happen after releasing it so two
+        workers never hold their locks while writing into each other's
+        sockets."""
         with self._lock:
             tile = self.tiles.get(tid)
+            c = self._chunk_for(epoch)
             if (
                 tile is None
                 or epoch != tile.epoch  # stale/duplicate completion: drop
                 or self.paused
-                or tile.epoch >= self.target
+                or c <= 0
+                or self.target < epoch + c
             ):
                 if tile is not None and epoch == tile.epoch:
-                    tile.awaiting_since = None  # paused: clear latch
+                    tile.awaiting_since = None  # paused/short target: clear latch
                 return False
             padded = halo.pad(tile.arr)
             if self.engine in ("actor", "actor-native"):
+                # Actor engines exchange per-epoch (the frontend rejects them
+                # when exchange_width > 1), so c == 1 here.
                 tile.arr = self._actor_engines[tid].step(padded)
             else:
-                tile.arr = self._step_padded(padded)
-            tile.epoch += 1
+                tile.arr = self._step_chunk(padded, c, self.exchange_width)
+            tile.epoch += c
             tile.awaiting_since = None
             tile.retries = 0
         self._publish_ring(tid, tile)
@@ -619,7 +692,7 @@ class BackendWorker:
         """Store our ring locally (answers our own and co-located pulls) and
         push it to each distinct remote owner among the tile's 8 neighbors —
         the direct neighbor-to-neighbor data plane."""
-        ring = Ring.of(tile.arr)
+        ring = Ring.of(tile.arr, self.exchange_width)
         epoch = tile.epoch
         if self.store is not None:
             self.store.push_ring(tid, epoch, ring)

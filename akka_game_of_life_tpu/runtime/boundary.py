@@ -29,23 +29,33 @@ from akka_game_of_life_tpu.runtime.tiles import Ring, TileId, TileLayout
 
 
 class Halo:
-    """The assembled 1-cell halo for a tile: four edges incl. corners."""
+    """The assembled width-k halo for a tile: four edge blocks incl. corners.
+
+    k=1 is the reference's per-epoch exchange; k>1 is the communication-
+    avoiding contract — one assembled halo licenses k local steps (the outer
+    garbage front advances one cell per step, so the (h, w) interior of the
+    padded slab stays exact through step k)."""
 
     def __init__(self, top: np.ndarray, bottom: np.ndarray, left: np.ndarray, right: np.ndarray):
-        self.top = top  # (w+2,)
-        self.bottom = bottom  # (w+2,)
-        self.left = left  # (h,)
-        self.right = right  # (h,)
+        self.top = top  # (k, w+2k)
+        self.bottom = bottom  # (k, w+2k)
+        self.left = left  # (h, k)
+        self.right = right  # (h, k)
+
+    @property
+    def width(self) -> int:
+        return len(self.top)
 
     def pad(self, tile: np.ndarray) -> np.ndarray:
-        """(h, w) tile → (h+2, w+2) halo-padded array."""
+        """(h, w) tile → (h+2k, w+2k) halo-padded array."""
+        k = self.width
         h, w = tile.shape
-        out = np.empty((h + 2, w + 2), dtype=tile.dtype)
-        out[1:-1, 1:-1] = tile
-        out[0, :] = self.top
-        out[-1, :] = self.bottom
-        out[1:-1, 0] = self.left
-        out[1:-1, -1] = self.right
+        out = np.empty((h + 2 * k, w + 2 * k), dtype=tile.dtype)
+        out[k : h + k, k : w + k] = tile
+        out[:k, :] = self.top
+        out[h + k :, :] = self.bottom
+        out[k : h + k, :k] = self.left
+        out[k : h + k, w + k :] = self.right
         return out
 
     def to_wire(self) -> dict:
@@ -64,8 +74,14 @@ class Halo:
 class BoundaryStore:
     """Thread-safe ring store + halo assembler + pending-pull queue."""
 
-    def __init__(self, layout: TileLayout) -> None:
+    def __init__(self, layout: TileLayout, width: int = 1) -> None:
+        th, tw = layout.tile_shape
+        if width < 1 or th < width or tw < width:
+            raise ValueError(
+                f"ring width {width} infeasible for tile shape {(th, tw)}"
+            )
         self.layout = layout
+        self.width = width
         self._rings: Dict[Tuple[TileId, int], Ring] = {}
         self._pending: Dict[Tuple[TileId, int], List[Callable[[Halo], None]]] = {}
         self._lock = threading.Lock()
@@ -108,14 +124,15 @@ class BoundaryStore:
                 return None
             rings[direction] = ring
         h, w = self.layout.tile_shape
-        top = np.empty(w + 2, dtype=np.uint8)
-        top[0] = rings["nw"].corners["se"]
-        top[1:-1] = rings["n"].bottom
-        top[-1] = rings["ne"].corners["sw"]
-        bottom = np.empty(w + 2, dtype=np.uint8)
-        bottom[0] = rings["sw"].corners["ne"]
-        bottom[1:-1] = rings["s"].top
-        bottom[-1] = rings["se"].corners["nw"]
+        k = self.width
+        top = np.empty((k, w + 2 * k), dtype=np.uint8)
+        top[:, :k] = rings["nw"].corners["se"]
+        top[:, k : w + k] = rings["n"].bottom
+        top[:, w + k :] = rings["ne"].corners["sw"]
+        bottom = np.empty((k, w + 2 * k), dtype=np.uint8)
+        bottom[:, :k] = rings["sw"].corners["ne"]
+        bottom[:, k : w + k] = rings["s"].top
+        bottom[:, w + k :] = rings["se"].corners["nw"]
         left = np.asarray(rings["w"].right, dtype=np.uint8)
         right = np.asarray(rings["e"].left, dtype=np.uint8)
         return Halo(top, bottom, left, right)

@@ -118,6 +118,15 @@ class Frontend:
         # Checkpoint cadence workers report at; falls back to an in-memory
         # cadence so ring pruning and recovery work without a durable store.
         self._ckpt_cadence = config.checkpoint_every or _MEMORY_CKPT_EVERY
+        if self._ckpt_cadence % config.exchange_width:
+            # Tiles only visit exchange_width-aligned epochs (config
+            # validates the explicit cadences; the in-memory fallback must
+            # hold the same invariant or recovery epochs would never land).
+            self._ckpt_cadence = (
+                self._ckpt_cadence
+                + config.exchange_width
+                - self._ckpt_cadence % config.exchange_width
+            )
 
         # Recovery source: (epoch, {tile: bit-packed payload}).  Kept packed
         # (8 cells/byte) so a 65536² board's recovery state is ~512 MiB, and
@@ -200,6 +209,11 @@ class Frontend:
                     f"the {MAX_FRAME}-byte frame cap — run more workers so "
                     "tiles shrink"
                 )
+            if min(th, tw) < self.config.exchange_width:
+                raise RuntimeError(
+                    f"exchange_width={self.config.exchange_width} exceeds the "
+                    f"{th}x{tw} tile — a ring cannot be wider than its tile"
+                )
             epoch0, tiles0 = self._load_recovery_tiles()
             self._last_ckpt = (epoch0, tiles0)
             self.start_epoch = epoch0
@@ -236,7 +250,7 @@ class Frontend:
         # Bulk sends outside the lock (see _send_deploy).
         for m in members:
             if m.tiles:
-                self._send_deploy(m, m.tiles, epoch0)
+                self._send_deploy(m, m.tiles)
 
     def _broadcast_owners(self) -> None:
         """NeighboursRefs (re-)wiring (BoardCreator.scala:86-88,149-151):
@@ -289,21 +303,29 @@ class Frontend:
             t: pack_tile(layout.extract(board, t)) for t in layout.tile_ids
         }
 
-    def _send_deploy(
-        self, member: Member, tiles: List[TileId], epoch: int
-    ) -> None:
+    def _send_deploy(self, member: Member, tiles: List[TileId]) -> None:
         """Ship tiles to a worker.  Callers must NOT hold the frontend lock:
         a DEPLOY is a multi-megabyte send, and the receiving worker may be
         deep in a multi-second compute step, not reading — a blocking send
         under the global lock would stall every reader thread behind it and
-        auto-down live workers (the bulk-send liveness hazard)."""
+        auto-down live workers (the bulk-send liveness hazard).
+
+        The recovery (epoch, payload) pair is read HERE, under one lock
+        acquisition: a caller passing an epoch it read earlier races with a
+        checkpoint completing in between, shipping a newer board labeled
+        with the older epoch — the tile then replays from a wrong state and
+        silently corrupts the trajectory (caught by the width-k node-loss
+        test, where chunked stepping makes kill-during-checkpoint likely)."""
         with self._lock:
             now = time.monotonic()
+            epoch, recovery = self._last_ckpt
             for t in tiles:
                 # A freshly deployed tile gets a full stuck_timeout_s of
                 # grace before GATHER_FAILED may count it as wedged.
                 self._last_ring_time[t] = now
-            _, recovery = self._last_ckpt
+                # Keep the lag/prune bookkeeping consistent with the epoch
+                # actually shipped (not one a caller read before the swap).
+                self.tile_epochs[t] = epoch
             msg = {
                 "type": P.DEPLOY,
                 "tiles": [
@@ -385,6 +407,20 @@ class Frontend:
             if not hello or hello.get("type") != P.REGISTER:
                 channel.close()
                 return
+            engine = hello.get("engine", "jax")
+            if self.config.exchange_width > 1 and str(engine).startswith("actor"):
+                # Actor engines step per-epoch and cannot honor width-k
+                # rings; a mixed-width cluster would deadlock on epochs the
+                # chunked tiles never compute, so refuse at the door.
+                print(
+                    f"rejecting worker with engine={engine}: exchange_width="
+                    f"{self.config.exchange_width} needs chunk-capable "
+                    f"engines (numpy/jax)",
+                    flush=True,
+                )
+                channel.send({"type": P.SHUTDOWN})
+                channel.close()
+                return
             try:
                 peer_host = channel.sock.getpeername()[0]
             except OSError:
@@ -401,6 +437,7 @@ class Frontend:
                     "name": member.name,
                     "heartbeat_s": self.config.heartbeat_s,
                     "max_pull_retries": self.config.max_pull_retries,
+                    "exchange_width": self.config.exchange_width,
                 }
             )
             while not self._stop.is_set():
@@ -597,12 +634,11 @@ class Frontend:
                     return  # budget/survivor escalation already set error
                 assigned.setdefault(m.name, []).append(tile)
             self._broadcast_owners()
-            epoch = self._last_ckpt[0]
         # Bulk sends outside the lock (see _send_deploy).
         for name, batch in assigned.items():
             m = self.membership.get(name)
             if m is not None and m.alive:
-                self._send_deploy(m, batch, epoch)
+                self._send_deploy(m, batch)
 
     def _assign_tile(
         self,
@@ -663,8 +699,7 @@ class Frontend:
             # Re-wire everyone first (NeighboursRefs re-send to the whole
             # neighborhood, BoardCreator.scala:149-151), then deploy.
             self._broadcast_owners()
-            epoch = self._last_ckpt[0]
-        self._send_deploy(member, [tile], epoch)
+        self._send_deploy(member, [tile])
 
     # -- maintenance: ticks, auto-down, fault injection ----------------------
 

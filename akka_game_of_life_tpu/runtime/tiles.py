@@ -96,25 +96,41 @@ def stitch(tiles_by_origin) -> "np.ndarray":
 
 @dataclasses.dataclass
 class Ring:
-    """A tile's 1-cell boundary ring at one epoch: what neighbors need."""
+    """A tile's width-k boundary ring at one epoch: what neighbors need.
 
-    top: object  # (w,) row
-    bottom: object
-    left: object  # (h,) col
-    right: object
-    corners: Dict[str, int]  # nw/ne/sw/se scalars
+    Width 1 is the reference's per-epoch exchange contract; width k>1 is the
+    communication-avoiding trade (one exchange buys k local steps — the
+    cluster analog of ``parallel/halo.py``'s on-device width-k halos and of
+    the reference's history-buffered asynchrony, ``CellActor.scala:34-47``).
+    The ring is purely spatial: a tile at epoch E always *has* its k
+    outermost rows/cols, so publishing a wide ring needs no lookahead.
+    """
+
+    top: object  # (k, w) rows
+    bottom: object  # (k, w)
+    left: object  # (h, k) cols
+    right: object  # (h, k)
+    corners: Dict[str, object]  # nw/ne/sw/se (k, k) blocks
 
     @classmethod
-    def of(cls, tile) -> "Ring":
+    def of(cls, tile, width: int = 1) -> "Ring":
+        k = width
+        h, w = tile.shape
+        if h < k or w < k:
+            raise ValueError(f"tile {tile.shape} smaller than ring width {k}")
         return cls(
-            top=tile[0, :].copy(),
-            bottom=tile[-1, :].copy(),
-            left=tile[:, 0].copy(),
-            right=tile[:, -1].copy(),
+            top=tile[:k, :].copy(),
+            bottom=tile[-k:, :].copy(),
+            left=tile[:, :k].copy(),
+            right=tile[:, -k:].copy(),
             corners={
-                "nw": int(tile[0, 0]),
-                "ne": int(tile[0, -1]),
-                "sw": int(tile[-1, 0]),
-                "se": int(tile[-1, -1]),
+                "nw": tile[:k, :k].copy(),
+                "ne": tile[:k, -k:].copy(),
+                "sw": tile[-k:, :k].copy(),
+                "se": tile[-k:, -k:].copy(),
             },
         )
+
+    @property
+    def width(self) -> int:
+        return len(self.top)  # (k, w): first axis is the ring width
