@@ -513,7 +513,7 @@ class BackendWorker:
                 self.store.drop_pending_for_owner([tid])
 
     def _on_deploy(self, msg: dict) -> None:
-        outbound: List[Tuple[TileId, _Tile]] = []
+        outbound: List[Tuple[TileId, np.ndarray, int]] = []
         with self._lock:
             rule = resolve_rule(msg["rule"])
             if self.rule != rule:
@@ -550,12 +550,12 @@ class BackendWorker:
                     )
 
                     self._actor_engines[tid] = NativeActorTileEngine(rule)
-                outbound.append((tid, tile))
-        for tid, tile in outbound:
+                outbound.append((tid, tile.arr, tile.epoch))
+        for tid, arr, epoch in outbound:
             # Announce our boundary at the deployed epoch so neighbors can
             # assemble their halos (History seeding, CellActor.scala:34).
-            self._publish_ring(tid, tile)
-            self._report_state(tid, tile)
+            self._publish_ring(tid, arr, epoch)
+            self._report_state(tid, arr, epoch)
         self._kick()
 
     def _on_crash_tile(self, tid: TileId) -> None:
@@ -684,16 +684,21 @@ class BackendWorker:
             tile.epoch += c
             tile.awaiting_since = None
             tile.retries = 0
-        self._publish_ring(tid, tile)
-        self._report_state(tid, tile)
+            # Snapshot (arr, epoch) while still holding the lock: the sends
+            # below run unlocked, and a concurrent kick may step the tile
+            # again in between — publishing from the live tile there would
+            # pair one chunk's data with another's epoch label.
+            arr, epoch_now = tile.arr, tile.epoch
+        self._publish_ring(tid, arr, epoch_now)
+        self._report_state(tid, arr, epoch_now)
         return True
 
-    def _publish_ring(self, tid: TileId, tile: _Tile) -> None:
+    def _publish_ring(self, tid: TileId, arr: np.ndarray, epoch: int) -> None:
         """Store our ring locally (answers our own and co-located pulls) and
         push it to each distinct remote owner among the tile's 8 neighbors —
-        the direct neighbor-to-neighbor data plane."""
-        ring = Ring.of(tile.arr, self.exchange_width)
-        epoch = tile.epoch
+        the direct neighbor-to-neighbor data plane.  Takes an (arr, epoch)
+        snapshot captured under the worker lock, never the live tile."""
+        ring = Ring.of(arr, self.exchange_width)
         if self.store is not None:
             self.store.push_ring(tid, epoch, ring)
         with self._lock:
@@ -718,13 +723,14 @@ class BackendWorker:
         except OSError:
             pass
 
-    def _report_state(self, tid: TileId, tile: _Tile) -> None:
+    def _report_state(self, tid: TileId, arr: np.ndarray, epoch: int) -> None:
         """Report tile state at cadence boundaries, shipping only what each
         reason needs — never the raw full tile (VERDICT.md weak #5):
         checkpoint/final ride bit-packed (8 cells/byte), render ships the
-        frontend's strided sample, metrics ships a single population count."""
+        frontend's strided sample, metrics ships a single population count.
+        Takes an (arr, epoch) snapshot captured under the worker lock."""
         reasons = []
-        e = tile.epoch
+        e = epoch
         if e == self.final_epoch:
             reasons.append("final")
         if self.checkpoint_every and e > 0 and e % self.checkpoint_every == 0:
@@ -742,19 +748,19 @@ class BackendWorker:
             "reasons": reasons,
         }
         if "final" in reasons or "checkpoint" in reasons:
-            msg["state"] = pack_tile(tile.arr)
+            msg["state"] = pack_tile(arr)
         if "render" in reasons:
             sy, sx = self.render_strides
             oy, ox = self.origins.get(tid, (0, 0))
             # Phase-align to the tile origin so the union over tiles is the
             # canonical full-board strided probe (cell (0,0) always shown).
-            msg["sample"] = tile.arr[(-oy) % sy :: sy, (-ox) % sx :: sx]
+            msg["sample"] = arr[(-oy) % sy :: sy, (-ox) % sx :: sx]
             msg["scaled_origin"] = [
                 (oy + sy - 1) // sy,
                 (ox + sx - 1) // sx,
             ]
         if "metrics" in reasons:
-            msg["population"] = int((tile.arr == 1).sum())
+            msg["population"] = int((arr == 1).sum())
         try:
             self.channel.send(msg)
         except OSError:

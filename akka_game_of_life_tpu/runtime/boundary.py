@@ -58,18 +58,6 @@ class Halo:
         out[k : h + k, w + k :] = self.right
         return out
 
-    def to_wire(self) -> dict:
-        return {
-            "top": self.top,
-            "bottom": self.bottom,
-            "left": self.left,
-            "right": self.right,
-        }
-
-    @classmethod
-    def from_wire(cls, d: dict) -> "Halo":
-        return cls(d["top"], d["bottom"], d["left"], d["right"])
-
 
 class BoundaryStore:
     """Thread-safe ring store + halo assembler + pending-pull queue."""

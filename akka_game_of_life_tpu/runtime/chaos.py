@@ -47,6 +47,8 @@ class CrashInjector:
         return self.crashes >= self.config.max_crashes
 
     def should_crash(self, now: Optional[float] = None) -> bool:
+        if self.config.epoch_indexed:
+            return False  # epoch-indexed schedules use should_crash_at_epoch
         if self._next_due is None or self.exhausted:
             return False
         now = now if now is not None else time.monotonic()
@@ -54,4 +56,20 @@ class CrashInjector:
             return False
         self.crashes += 1
         self._next_due = now + self.config.every_s
+        return True
+
+    def should_crash_at_epoch(self, epoch: int) -> bool:
+        """Epoch-indexed twin of :meth:`should_crash`: due once the
+        simulation reaches ``first_after_epochs``, then every
+        ``every_epochs`` further.  Pure in simulation time — every rank of a
+        multi-host run computes the identical schedule, so injected crashes
+        are lockstep SPMD events (the distributed-chaos requirement)."""
+        if not self.config.epoch_indexed or not self.config.enabled:
+            return False
+        if self.exhausted:
+            return False
+        due = self.config.first_after_epochs + self.crashes * self.config.every_epochs
+        if epoch < due:
+            return False
+        self.crashes += 1
         return True

@@ -61,10 +61,35 @@ class FaultInjectionConfig:
     # reference's supervised CellActor restart, §3.3); "node" kills a whole
     # worker process (the reference's backend-JVM loss, §3.4).
     mode: str = "tile"
+    # Epoch-indexed schedule (alternative to the wall-clock one): first
+    # crash once the simulation reaches ``first_after_epochs``, then every
+    # ``every_epochs``.  Deterministic in simulation time, so every rank of
+    # a multi-host (jax.distributed) run injects at the SAME epoch and the
+    # crash/restore/replay cycle stays an SPMD-lockstep event — the only
+    # chaos shape that composes with cross-host collectives (wall-clock
+    # schedules desynchronize ranks and are rejected in distributed mode).
+    first_after_epochs: Optional[int] = None
+    every_epochs: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.mode not in ("tile", "node"):
             raise ValueError(f"unknown fault injection mode {self.mode!r}")
+        if (self.first_after_epochs is None) != (self.every_epochs is None):
+            raise ValueError(
+                "epoch-indexed injection needs both first_after_epochs and "
+                "every_epochs (or neither, for the wall-clock schedule)"
+            )
+        if self.every_epochs is not None and (
+            self.first_after_epochs < 0 or self.every_epochs < 1
+        ):
+            raise ValueError(
+                f"bad epoch schedule: first_after_epochs="
+                f"{self.first_after_epochs}, every_epochs={self.every_epochs}"
+            )
+
+    @property
+    def epoch_indexed(self) -> bool:
+        return self.every_epochs is not None
 
 
 @dataclasses.dataclass

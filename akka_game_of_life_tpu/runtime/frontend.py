@@ -83,6 +83,19 @@ class Frontend:
             metrics_every=config.metrics_every,
             log_file=config.log_file,
         )
+        if config.fault_injection.enabled and config.fault_injection.epoch_indexed:
+            # The cluster injector is the reference's wall-clock killer
+            # (BoardCreator.scala:97-102): crashes are per-worker events on a
+            # clock, not lockstep simulation-time events.  The epoch-indexed
+            # schedule exists for SPMD multi-host runs (Simulation
+            # distributed=True); accepting it here would silently never fire
+            # (the maintenance loop polls the wall-clock schedule).
+            raise ValueError(
+                "epoch-indexed fault injection (first_after_epochs/"
+                "every_epochs) is a distributed-Simulation feature; the "
+                "cluster frontend injects on the wall-clock schedule "
+                "(first_after_s/every_s)"
+            )
         self.membership = Membership(config.failure_timeout_s)
         if config.checkpoint_dir and config.checkpoint_format != "npz":
             # The cluster frontend streams per-tile saves (save_tile /

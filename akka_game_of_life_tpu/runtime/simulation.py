@@ -76,13 +76,16 @@ class Simulation:
                 config.num_processes,
                 config.process_id,
             )
-            if config.fault_injection.enabled:
+            if config.fault_injection.enabled and not config.fault_injection.epoch_indexed:
                 raise ValueError(
-                    "fault_injection with distributed=True is unsupported: "
-                    "crash points are per-process wall-clock, so ranks would "
-                    "replay different epochs and desynchronize cross-host "
-                    "collectives (use the cluster control plane's injector "
-                    "for multi-process chaos)"
+                    "wall-clock fault_injection with distributed=True is "
+                    "unsupported: crash points are per-process wall-clock, so "
+                    "ranks would replay different epochs and desynchronize "
+                    "cross-host collectives.  Use the epoch-indexed schedule "
+                    "(fault_injection.first_after_epochs / every_epochs) — "
+                    "deterministic in simulation time, so every rank injects "
+                    "at the same epoch — or the cluster control plane's "
+                    "injector for per-worker chaos."
                 )
         self.observer = observer or BoardObserver(
             render_every=config.render_every,
@@ -350,7 +353,10 @@ class Simulation:
                     time.sleep(next_tick - now)
                 next_tick = max(next_tick + cfg.tick_s, now)
 
-            if self.injector is not None and self.injector.should_crash():
+            if self.injector is not None and (
+                self.injector.should_crash()
+                or self.injector.should_crash_at_epoch(self.epoch)
+            ):
                 self._crash_and_recover()
 
             chunk = min(cfg.steps_per_call, target - self.epoch)
@@ -477,6 +483,12 @@ class Simulation:
             if host_board is None:
                 # Keep the collective fetch in lockstep with rank 0.
                 dist.fetch(self.board) if self._packed else self.board_host()
+            # No rank may run past a checkpoint epoch before the file is
+            # durable: an epoch-indexed crash right after this boundary makes
+            # every rank load the store, and a rank racing ahead of rank 0's
+            # write would restore an older epoch and replay a different
+            # number of collective steps — deadlocking the mesh.
+            dist.barrier(f"ckpt-{self.epoch}")
             return
 
         if self._packed and host_board is None:
@@ -524,6 +536,9 @@ class Simulation:
                 _save()
         else:
             _save()
+        if npz and jax.process_count() > 1:
+            # Rank 0's side of the durability barrier (see the gated branch).
+            dist.barrier(f"ckpt-{self.epoch}")
 
     def board_host(self) -> np.ndarray:
         """The full board as host uint8 — O(board); for final renders, tests,

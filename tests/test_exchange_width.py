@@ -155,3 +155,21 @@ def test_widek_rejects_actor_engine_workers():
         assert not fe.membership.alive_members()
     finally:
         fe.stop()
+
+
+def test_frontend_rejects_epoch_indexed_injection():
+    """Cluster chaos is the reference's wall-clock killer; the epoch-indexed
+    schedule (a distributed-Simulation feature) must error loudly here, not
+    silently never fire."""
+    from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
+    from akka_game_of_life_tpu.runtime.frontend import Frontend
+
+    cfg = SimulationConfig(
+        height=16, width=16, max_epochs=4,
+        fault_injection=FaultInjectionConfig(
+            enabled=True, first_after_epochs=2, every_epochs=2
+        ),
+    )
+    cfg.port = 0
+    with pytest.raises(ValueError, match="epoch-indexed"):
+        Frontend(cfg, min_backends=1)

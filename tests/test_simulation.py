@@ -208,3 +208,35 @@ def test_actor_backend_chaos_recovery(tmp_path):
     ref.advance(30)
     assert sim.injector.crashes == 2
     assert np.array_equal(sim.board_host(), ref.board_host())
+
+
+def test_epoch_indexed_injection_matches_clean_run(tmp_path):
+    """The epoch-indexed chaos schedule (the distributed-compatible flavor):
+    crashes fire at deterministic simulation epochs, recovery replays from
+    the checkpoint, trajectory identical to a clean run."""
+    from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
+
+    cfg = SimulationConfig(
+        height=32, width=32, seed=8, max_epochs=24, steps_per_call=4,
+        checkpoint_dir=str(tmp_path), checkpoint_every=4,
+        fault_injection=FaultInjectionConfig(
+            enabled=True, first_after_epochs=8, every_epochs=8, max_crashes=2
+        ),
+    )
+    chaotic = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    chaotic.advance(24)
+    assert chaotic.crash_log == [8, 16]
+    clean_cfg = SimulationConfig(height=32, width=32, seed=8, steps_per_call=4)
+    clean = Simulation(clean_cfg, observer=BoardObserver(out=io.StringIO()))
+    clean.advance(24)
+    assert np.array_equal(chaotic.board_host(), clean.board_host())
+
+
+def test_epoch_schedule_validation():
+    from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
+    import pytest
+
+    with pytest.raises(ValueError, match="both"):
+        FaultInjectionConfig(enabled=True, first_after_epochs=4)
+    with pytest.raises(ValueError, match="bad epoch schedule"):
+        FaultInjectionConfig(enabled=True, first_after_epochs=4, every_epochs=0)
