@@ -80,30 +80,61 @@ def _jax_engine(rule: Rule) -> Callable[[np.ndarray, int, int], np.ndarray]:
     import jax
     import jax.numpy as jnp
 
+    from akka_game_of_life_tpu.ops import bitpack
     from akka_game_of_life_tpu.ops.stencil import step as stencil_step
 
     devices = jax.local_devices()
-    compiled: Dict[int, Callable] = {}  # steps → jitted chunk fn
+    compiled: Dict[tuple, Callable] = {}  # (steps, col_pad) → jitted chunk fn
+    # Binary rules step BIT-PACKED on device (the certified-fast SWAR path —
+    # VERDICT.md round-2 next #1: the cluster jax engine must run the packed
+    # kernel, not only bench.py): the uint8 slab packs to uint32 words on
+    # device, the whole chunk scans packed, and unpacks before the interior
+    # slice.  Multi-state Generations rules keep the dense uint8 scan, as do
+    # single-step chunks (exchange_width=1): pack+unpack costs ~2.25 B/cell
+    # of HBM traffic around ~0.25 B/cell packed steps vs ~2 B/cell dense, so
+    # packing only wins once a chunk amortizes it over >= 2 steps.
+    def _use_packed(steps: int) -> bool:
+        return rule.is_binary and steps >= 2
 
-    def _chunk_fn(steps: int):
+    def _chunk_fn(steps: int, col_pad: int):
+        packed = _use_packed(steps)
+
         def chunk(padded):
+            if packed:
+                if col_pad:
+                    # Junk columns up to a 32-multiple.  They sit between the
+                    # east halo and the (toroidally wrapped) west halo — both
+                    # cut edges whose garbage front moves one cell per step —
+                    # so with steps <= halo they never reach the interior
+                    # slice, exactly like the junk rows below.
+                    padded = jnp.pad(padded, ((0, 0), (0, col_pad)))
+                state = bitpack.pack(padded)
+                step_one = lambda s: bitpack.step_packed(s, rule)
+            else:
+                state = padded
+                step_one = lambda s: stencil_step(s, rule)
             out, _ = jax.lax.scan(
-                lambda s, _: (stencil_step(s, rule), None),
-                padded,
-                None,
-                length=steps,
+                lambda s, _: (step_one(s), None), state, None, length=steps
             )
+            if packed:
+                out = bitpack.unpack(out)
+                if col_pad:
+                    out = out[:, :-col_pad]
             return out
 
         return chunk
+
+    def _col_pad(width: int, steps: int) -> int:
+        return (-width) % bitpack.LANE_BITS if _use_packed(steps) else 0
 
     if len(devices) == 1:
 
         def run(padded: np.ndarray, steps: int, halo: int) -> np.ndarray:
             assert steps <= halo, (steps, halo)
-            fn = compiled.get(steps)
+            key = (steps, _col_pad(padded.shape[1], steps))
+            fn = compiled.get(key)
             if fn is None:
-                fn = compiled[steps] = jax.jit(_chunk_fn(steps))
+                fn = compiled[key] = jax.jit(_chunk_fn(*key))
             out = fn(jnp.asarray(padded))
             return np.asarray(out[halo:-halo, halo:-halo])
 
@@ -130,9 +161,10 @@ def _jax_engine(rule: Rule) -> Callable[[np.ndarray, int, int], np.ndarray]:
             # one row per step — with steps <= halo the interior slice below
             # is never reached.
             padded = np.pad(padded, ((0, pad), (0, 0)))
-        fn = compiled.get(steps)
+        key = (steps, _col_pad(padded.shape[1], steps))
+        fn = compiled.get(key)
         if fn is None:
-            fn = compiled[steps] = jax.jit(_chunk_fn(steps), in_shardings=rows)
+            fn = compiled[key] = jax.jit(_chunk_fn(*key), in_shardings=rows)
         out = fn(jax.device_put(padded, rows))
         return np.asarray(out)[halo : halo + h_out, halo:-halo]
 
