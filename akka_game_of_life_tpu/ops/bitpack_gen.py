@@ -63,6 +63,29 @@ def unpack_gen(planes: jax.Array) -> jax.Array:
     return out
 
 
+def pack_gen_np(grid: np.ndarray, states: int) -> np.ndarray:
+    """Host-side :func:`pack_gen` twin: (H, W) uint8 → (m, H, W/32) uint32."""
+    from akka_game_of_life_tpu.ops.bitpack import pack_np
+
+    if states > 2 ** 8:
+        raise ValueError("states > 256 not supported")
+    grid = np.asarray(grid, dtype=np.uint8)
+    return np.stack(
+        [pack_np((grid >> k) & 1) for k in range(n_planes(states))]
+    )
+
+
+def unpack_gen_np(planes: np.ndarray) -> np.ndarray:
+    """Host-side :func:`unpack_gen` twin: (m, H, W/32) uint32 → (H, W) uint8."""
+    from akka_game_of_life_tpu.ops.bitpack import unpack_np
+
+    out = None
+    for k in range(planes.shape[0]):
+        part = unpack_np(planes[k]) << k
+        out = part if out is None else out | part
+    return out.astype(np.uint8)
+
+
 def _eq_const(planes: List[jax.Array], value: int) -> jax.Array:
     """Plane where the m-bit state equals ``value``."""
     t = None

@@ -107,6 +107,62 @@ def sharded_packed2d_step_fn(
     return jax.jit(mapped, in_shardings=sharding, out_shardings=sharding)
 
 
+def sharded_gen_step_fn(
+    mesh: Mesh,
+    rule,
+    *,
+    steps_per_call: int = 1,
+    halo_rows: int = 1,
+) -> Callable[[jax.Array], jax.Array]:
+    """Width-k sharded stepping for Generations bit planes: (m, H, W/32)
+    with the tiny plane dim replicated and rows × word-columns tiled over
+    the grid mesh.  Same two-phase exchange and garbage-front economics as
+    :func:`sharded_packed2d_step_fn` — the refractory-decay planes update
+    cell-locally, so the alive plane's 1-cell/step front bounds them too."""
+    from akka_game_of_life_tpu.ops.bitpack_gen import n_planes, step_gen
+
+    rule = resolve_rule(rule)
+    s = halo_rows
+    if steps_per_call % s:
+        raise ValueError(
+            f"steps_per_call={steps_per_call} must be a multiple of "
+            f"halo_rows={s}"
+        )
+    hw = word_halo_width(s)
+    n_exchanges = steps_per_call // s
+    m = n_planes(rule.states)
+    spec = jax.sharding.PartitionSpec(None, ROW_AXIS, COL_AXIS)
+
+    def local(planes: jax.Array) -> jax.Array:
+        if planes.shape[0] != m:
+            raise ValueError(f"expected {m} planes for {rule.states} states")
+        _, h_loc, w_loc = planes.shape
+        if h_loc < s or w_loc < hw:
+            raise ValueError(
+                f"per-shard plane tile {(h_loc, w_loc)} too small for "
+                f"{s} steps per exchange"
+            )
+
+        def body(t, _):
+            west = ring_shift(t[:, :, -hw:], COL_AXIS, +1)
+            east = ring_shift(t[:, :, :hw], COL_AXIS, -1)
+            t2 = jnp.concatenate([west, t, east], axis=2)
+            top = ring_shift(t2[:, -s:], ROW_AXIS, +1)
+            bottom = ring_shift(t2[:, :s], ROW_AXIS, -1)
+            padded = jnp.concatenate([top, t2, bottom], axis=1)
+            padded, _ = jax.lax.scan(
+                lambda p, _: (step_gen(p, rule), None), padded, None, length=s
+            )
+            return padded[:, s:-s, hw:-hw], None
+
+        out, _ = jax.lax.scan(body, planes, None, length=n_exchanges)
+        return out
+
+    mapped = jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+    sharding = NamedSharding(mesh, spec)
+    return jax.jit(mapped, in_shardings=sharding, out_shardings=sharding)
+
+
 def shard_packed2d(packed: jax.Array, mesh: Mesh) -> jax.Array:
     h, words = packed.shape
     rows, cols = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]

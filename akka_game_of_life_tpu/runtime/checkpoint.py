@@ -149,19 +149,27 @@ class CheckpointStore:
         rule: str,
         meta: Optional[dict] = None,
     ) -> Path:
-        """Save an already-bit-packed board ((H, W/32) uint32 LSB-first) as
-        it arrived from the device — the packed-kernel runtime never unpacks
-        on host, so a 65536² checkpoint transfers and writes 0.25 B/cell."""
+        """Save an already-bit-packed board as it arrived from the device —
+        the packed-kernel runtime never unpacks on host, so a 65536²
+        checkpoint transfers and writes 0.25 B/cell.  ``words`` is either
+        (H, W/32) uint32 LSB-first (binary rules) or (m, H, W/32) bit planes
+        (Generations rules — 0.25·m B/cell)."""
         words = np.ascontiguousarray(words, dtype=np.uint32)
         h, w = shape
-        if words.shape != (h, w // 32):
-            raise ValueError(f"packed words {words.shape} != {(h, w // 32)}")
+        if words.ndim == 2:
+            expect = (h, w // 32)
+            fmt = 2  # uint32-word LSB-first layout
+        else:
+            expect = (words.shape[0], h, w // 32)
+            fmt = 3  # Generations bit planes, LSB plane first
+        if words.shape != expect:
+            raise ValueError(f"packed words {words.shape} != {expect}")
         return self._write_epoch(
             epoch,
             {
                 "epoch": np.int64(epoch),
                 "shape": np.asarray(shape, dtype=np.int64),
-                "packed": np.uint8(2),  # 2 = uint32-word LSB-first layout
+                "packed": np.uint8(fmt),
                 "board": words,
                 "meta": self._meta_blob(rule, meta),
             },
@@ -332,7 +340,7 @@ class CheckpointStore:
             shape: Tuple[int, ...] = tuple(int(v) for v in z["shape"])
             meta = json.loads(bytes(z["meta"].tobytes()).decode())
             fmt = int(z["packed"])
-            if fmt == 2:  # uint32-word LSB-first (save_packed32)
+            if fmt in (2, 3):  # uint32 words / Generations bit planes
                 words = z["board"].copy()
                 rule = meta.pop("rule")
                 if keep_packed:
@@ -343,13 +351,16 @@ class CheckpointStore:
                         meta=meta,
                         packed32=words,
                     )
-                from akka_game_of_life_tpu.ops.bitpack import unpack_np
+                if fmt == 3:
+                    from akka_game_of_life_tpu.ops.bitpack_gen import unpack_gen_np
 
+                    board = unpack_gen_np(words).reshape(shape)
+                else:
+                    from akka_game_of_life_tpu.ops.bitpack import unpack_np
+
+                    board = unpack_np(words).reshape(shape)
                 return Checkpoint(
-                    epoch=int(epoch),
-                    board=unpack_np(words).reshape(shape),
-                    rule=rule,
-                    meta=meta,
+                    epoch=int(epoch), board=board, rule=rule, meta=meta
                 )
             if fmt:
                 n = int(np.prod(shape))

@@ -94,19 +94,24 @@ class OrbaxCheckpointStore:
         rule = meta.pop("rule")
         raw = np.asarray(out["state"]["board"])
         if meta.get("layout") == "packed32":
-            # Saved by a packed-kernel run: the board is (H, W/32) uint32
-            # LSB-first words, written device-native without host unpack.
+            # Saved by a packed-kernel run: (H, W/32) uint32 LSB-first words
+            # (binary) or (m, H, W/32) Generations bit planes, written
+            # device-native without host unpack.
             words = raw.astype(np.uint32, copy=False)
             if keep_packed:
                 return Checkpoint(
                     epoch=int(epoch), board=None, rule=rule, meta=meta,
                     packed32=words,
                 )
-            from akka_game_of_life_tpu.ops.bitpack import unpack_np
+            if words.ndim == 3:
+                from akka_game_of_life_tpu.ops.bitpack_gen import unpack_gen_np
 
-            return Checkpoint(
-                epoch=int(epoch), board=unpack_np(words), rule=rule, meta=meta
-            )
+                board = unpack_gen_np(words)
+            else:
+                from akka_game_of_life_tpu.ops.bitpack import unpack_np
+
+                board = unpack_np(words)
+            return Checkpoint(epoch=int(epoch), board=board, rule=rule, meta=meta)
         return Checkpoint(
             epoch=int(epoch),
             board=raw.astype(np.uint8, copy=False),

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from akka_game_of_life_tpu.models import get_model
-from akka_game_of_life_tpu.ops import bitpack
+from akka_game_of_life_tpu.ops import bitpack, bitpack_gen
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 from akka_game_of_life_tpu.parallel import (
     distributed as dist,
@@ -138,6 +138,7 @@ class Simulation:
             self.mesh = None
             self.kernel = "dense"
             self._packed = False
+            self._gen = False
             self._actor_board = self._actor_board_cls(board, self.rule)
             self._actor_epoch0 = self.epoch  # actor engine counts from 0
             self._steppers = {}
@@ -154,11 +155,15 @@ class Simulation:
         )
         self.kernel = self._resolve_kernel()
         self._packed = self.kernel in ("bitpack", "pallas")
+        # Multi-state Generations rules on the packed kernel use bit planes
+        # (ops/bitpack_gen.py): m = ceil(log2(states)) packed planes.
+        self._gen = self._packed and not self.rule.is_binary
         if self._use_mesh:
             if self._packed:
-                # Auto meshes go rows-only for packed boards: a row of uint32
-                # words is 32 cells wide per word, so narrow boards rarely
-                # split column-wise; the row ring is the natural 1-D layout
+                # Auto meshes go rows-only for packed boards (binary words
+                # and Generations planes alike): a row of uint32 words is 32
+                # cells wide per word, so narrow boards rarely split
+                # column-wise; the row ring is the natural 1-D layout
                 # (65536 rows / 8 devices = 8192-row shards on a v5e-8).
                 self.mesh = make_grid_mesh(self._packed_mesh_shape())
                 self._validate_packed_mesh()
@@ -177,6 +182,8 @@ class Simulation:
             if ckpt.packed32 is not None:
                 words = ckpt.packed32
                 expect = (config.height, config.width // 32)
+                if self._gen:
+                    expect = (bitpack_gen.n_planes(self.rule.states),) + expect
                 if words.shape != expect:
                     raise ValueError(
                         f"checkpoint packed shape {words.shape} != config {expect}"
@@ -206,17 +213,27 @@ class Simulation:
         cfg = self.config
         kernel = cfg.kernel
         if kernel == "auto":
-            if not (self.rule.is_binary and cfg.width % 32 == 0):
+            if cfg.width % 32:
                 return "dense"
             if self._use_mesh and not self._packed_mesh_fits():
                 return "dense"
-            return "bitpack"
+            if self.rule.is_binary:
+                return "bitpack"
+            # Generations rules: bit planes (0.25·m B/cell vs 1 B/cell dense).
+            return "bitpack" if self.rule.states <= 256 else "dense"
         if kernel in ("bitpack", "pallas"):
             if not self.rule.is_binary:
-                raise ValueError(
-                    f"kernel={kernel} supports binary rules only; rule "
-                    f"{self.rule} is multi-state (use kernel=dense)"
-                )
+                if kernel == "pallas":
+                    raise ValueError(
+                        f"kernel=pallas supports binary rules only; rule "
+                        f"{self.rule} is multi-state (use kernel=bitpack for "
+                        f"the bit-plane Generations path, or dense)"
+                    )
+                if self.rule.states > 256:
+                    raise ValueError(
+                        f"kernel=bitpack supports at most 256 states, rule "
+                        f"{self.rule} has {self.rule.states}"
+                    )
             if cfg.width % 32:
                 raise ValueError(
                     f"kernel={kernel} requires width % 32 == 0, got {cfg.width}"
@@ -270,6 +287,10 @@ class Simulation:
     def _to_device(self, board: np.ndarray):
         if self._actor_board is not None:
             return board
+        if self._gen:
+            return self._words_to_device(
+                bitpack_gen.pack_gen_np(np.asarray(board), self.rule.states)
+            )
         if self._packed:
             return self._words_to_device(bitpack.pack_np(np.asarray(board)))
         if self.mesh is not None:
@@ -280,10 +301,29 @@ class Simulation:
             return shard_board(jnp.asarray(board), self.mesh)
         return jnp.asarray(board)
 
+    def _gen_spec(self):
+        """Sharding spec for Generations bit planes: the plane dim is tiny
+        and replicated; rows/word-cols shard over the grid mesh."""
+        from jax.sharding import PartitionSpec
+
+        from akka_game_of_life_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
+
+        return PartitionSpec(None, ROW_AXIS, COL_AXIS)
+
     def _words_to_device(self, words: np.ndarray):
-        """Packed (H, W/32) uint32 words → the device-resident (and, on a
-        mesh, sharded) board — the packed twin of :meth:`_to_device`."""
+        """Packed uint32 payload → the device-resident (and, on a mesh,
+        sharded) board — the packed twin of :meth:`_to_device`.  2-D words
+        for binary rules; (m, H, W/32) bit planes for Generations."""
         if self.mesh is not None:
+            if self._gen:
+                from jax.sharding import NamedSharding
+
+                sharding = NamedSharding(self.mesh, self._gen_spec())
+                if jax.process_count() > 1:
+                    return dist.make_global_array(
+                        words, self.mesh, spec=self._gen_spec()
+                    )
+                return jax.device_put(jnp.asarray(words), sharding)
             if jax.process_count() > 1:
                 return dist.make_global_array(words, self.mesh)
             return shard_packed2d(jnp.asarray(words), self.mesh)
@@ -306,7 +346,25 @@ class Simulation:
 
             return _actor_advance
         if k not in self._steppers:
-            if self._packed:
+            if self._gen:
+                if self.mesh is None:
+                    self._steppers[k] = bitpack_gen.gen_multi_step_fn(self.rule, k)
+                else:
+                    from akka_game_of_life_tpu.parallel.packed_halo2d import (
+                        sharded_gen_step_fn,
+                    )
+
+                    # Same width-k communication-avoiding exchange as the
+                    # binary packed mesh path, extended over the (replicated)
+                    # plane dim — one ppermute round per k epochs, not per
+                    # epoch.
+                    self._steppers[k] = sharded_gen_step_fn(
+                        self.mesh,
+                        self.rule,
+                        steps_per_call=k,
+                        halo_rows=self._halo_for(k),
+                    )
+            elif self._packed:
                 if self.mesh is not None:
                     self._steppers[k] = sharded_packed2d_step_fn(
                         self.mesh,
@@ -412,7 +470,14 @@ class Simulation:
         cfg = self.config
         from akka_game_of_life_tpu.runtime.render import sample_strides
 
-        if self._packed:
+        if self._gen:
+            m = bitpack_gen.n_planes(self.rule.states)
+
+            def pop_core(p):
+                alive = bitpack_gen._eq_const([p[k] for k in range(m)], 1)
+                return bitpack.population_rows(alive)
+
+        elif self._packed:
             pop_core = bitpack.population_rows
         else:
             pop_core = lambda b: jnp.sum((b == 1).astype(jnp.uint32), axis=1)
@@ -421,7 +486,17 @@ class Simulation:
         view = None
         sy, sx = sample_strides(cfg.shape, cfg.render_max_cells)
         if render:
-            if self._packed:
+            if self._gen:
+                plane_sample = bitpack.sample_packed_core(sy, sx, cfg.width)
+                m = bitpack_gen.n_planes(self.rule.states)
+
+                def sample_core(p):
+                    out = plane_sample(p[0])
+                    for k in range(1, m):
+                        out = out | (plane_sample(p[k]) << k)
+                    return out
+
+            elif self._packed:
                 sample_core = bitpack.sample_packed_core(sy, sx, cfg.width)
             else:
                 sample_core = lambda b: b[::sy, ::sx]
@@ -543,6 +618,10 @@ class Simulation:
     def board_host(self) -> np.ndarray:
         """The full board as host uint8 — O(board); for final renders, tests,
         and small boards (the steady-state loop never calls this)."""
+        if self._gen:
+            return bitpack_gen.unpack_gen_np(
+                np.asarray(dist.fetch(self.board), dtype=np.uint32)
+            )
         if self._packed:
             return bitpack.unpack_np(
                 np.asarray(dist.fetch(self.board), dtype=np.uint32)

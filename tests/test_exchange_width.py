@@ -173,3 +173,15 @@ def test_frontend_rejects_epoch_indexed_injection():
     cfg.port = 0
     with pytest.raises(ValueError, match="epoch-indexed"):
         Frontend(cfg, min_backends=1)
+
+
+def test_widek_four_workers_2d_grid():
+    """k=4 over a (2,2) tile grid: corner blocks cross diagonal peers (not
+    just the vertical wrap of a (2,1) grid)."""
+    cfg = SimulationConfig(
+        height=32, width=32, seed=17, max_epochs=20, exchange_width=4
+    )
+    with cluster(cfg, 4) as h:
+        final = h.run_to_completion()
+    assert h.frontend.layout.grid == (2, 2)
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 20))

@@ -55,25 +55,64 @@ def test_auto_selects_bitpack_for_binary_32aligned():
     assert sim._packed
 
 
-def test_auto_falls_back_to_dense_for_multistate_and_odd_width():
+def test_auto_selects_gen_planes_for_multistate():
     sim = Simulation(
         _cfg("auto", rule="brians-brain"), observer=BoardObserver(out=io.StringIO())
     )
-    assert sim.kernel == "dense"
+    assert sim.kernel == "bitpack" and sim._gen
+
+
+def test_auto_falls_back_to_dense_for_odd_width():
     sim = Simulation(
         _cfg("auto", width=60), observer=BoardObserver(out=io.StringIO())
     )
     assert sim.kernel == "dense"
 
 
-def test_explicit_bitpack_rejects_multistate():
+def test_explicit_kernel_rejections():
     with pytest.raises(ValueError, match="binary"):
         Simulation(
-            _cfg("bitpack", rule="brians-brain"),
+            _cfg("pallas", rule="brians-brain"),
             observer=BoardObserver(out=io.StringIO()),
         )
     with pytest.raises(ValueError, match="width"):
         Simulation(_cfg("bitpack", width=60), observer=BoardObserver(out=io.StringIO()))
+
+
+def test_gen_planes_sim_matches_dense_sim(tmp_path):
+    """Brian's Brain / Star Wars on the bit-plane kernel ≡ dense, across
+    render/metrics/checkpoint cadences, plus packed-gen checkpoint resume."""
+    for rule in ("brians-brain", "star-wars"):
+        dense = Simulation(
+            _cfg("dense", tmp_path / f"d-{rule}", rule=rule, seed=21),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+        packed = Simulation(
+            _cfg("bitpack", tmp_path / f"p-{rule}", rule=rule, seed=21),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+        assert packed._gen
+        dense.advance(40)
+        packed.advance(40)
+        assert np.array_equal(dense.board_host(), packed.board_host()), rule
+
+        resumed = Simulation(
+            _cfg("bitpack", tmp_path / f"p-{rule}", rule=rule, seed=21),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+        assert resumed.epoch == 32  # checkpoint cadence 16
+        resumed.advance(8)
+        assert np.array_equal(resumed.board_host(), dense.board_host()), rule
+        # Dense engine can resume the packed-gen checkpoint too — and the
+        # fmt-3 decode-on-load must restore the exact state, not just the
+        # epoch: continue it and compare against the packed trajectory.
+        dense_resume = Simulation(
+            _cfg("dense", tmp_path / f"p-{rule}", rule=rule, seed=21),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+        assert dense_resume.epoch == 32
+        dense_resume.advance(8)
+        assert np.array_equal(dense_resume.board_host(), dense.board_host()), rule
 
 
 def test_bitpack_sim_matches_dense_sim_across_cadences(tmp_path):
@@ -174,5 +213,23 @@ def test_pallas_kernel_in_simulation_interpret():
     with pytest.raises(ValueError, match="single-device"):
         Simulation(
             _cfg("pallas", mesh_shape=(2, 1)),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+
+
+def test_gen_mesh_misfit_falls_back_or_errors():
+    """A Generations board whose rows don't divide the auto mesh: auto falls
+    back to dense (like the binary path); explicit bitpack errors at config
+    time, not with a deep device_put failure."""
+    # 36 rows: divides the dense auto mesh (4, 2) but not the packed
+    # rows-only mesh (8, 1) on the 8-device test host.
+    sim = Simulation(
+        _cfg("auto", rule="brians-brain", height=36, width=32),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    assert sim.kernel == "dense"
+    with pytest.raises(ValueError, match="cannot shard"):
+        Simulation(
+            _cfg("bitpack", rule="brians-brain", height=36, width=32),
             observer=BoardObserver(out=io.StringIO()),
         )
