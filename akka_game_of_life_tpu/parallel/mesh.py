@@ -19,6 +19,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 ROW_AXIS = "row"
 COL_AXIS = "col"
 GRID_SPEC = PartitionSpec(ROW_AXIS, COL_AXIS)
+# Generations bit planes (m, H, W/32): tiny plane dim replicated, grid tiled.
+GEN_SPEC = PartitionSpec(None, ROW_AXIS, COL_AXIS)
 
 
 def factor_2d(n: int) -> Tuple[int, int]:

@@ -33,13 +33,76 @@ from jax.sharding import Mesh, NamedSharding
 from akka_game_of_life_tpu.ops.bitpack import LANE_BITS, step_packed
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 from akka_game_of_life_tpu.parallel.halo import ring_shift
-from akka_game_of_life_tpu.parallel.mesh import COL_AXIS, GRID_SPEC, ROW_AXIS
+from akka_game_of_life_tpu.parallel.mesh import (
+    COL_AXIS,
+    GEN_SPEC,
+    GRID_SPEC,
+    ROW_AXIS,
+)
 
 
 def word_halo_width(steps: int) -> int:
     """Halo words per side needed for ``steps`` local steps: the garbage
     front moves 1 bit/step, so hw words survive 32*hw - 1 steps."""
     return (steps + LANE_BITS) // LANE_BITS
+
+
+def _sharded_exchange_fn(
+    mesh: Mesh,
+    spec,
+    step_one: Callable[[jax.Array], jax.Array],
+    *,
+    steps_per_call: int,
+    halo_rows: int,
+    check_tile: Callable[[jax.Array], None],
+) -> Callable[[jax.Array], jax.Array]:
+    """The shared width-k two-phase halo-exchange loop over a grid mesh.
+
+    Works on any array whose LAST TWO axes are (rows, word-cols) — the
+    binary packed board (H, W/32) and the Generations plane stack
+    (m, H, W/32) alike.  Per exchange: word-column ppermutes first, then
+    rows of the column-padded tile (corner words ride along), then ``s``
+    local steps of the *toroidal* ``step_one`` at constant shape — the
+    wraps only ever corrupt the outermost halo rows/words, which are cut
+    edges (their true neighbors live off-tile) and garbage-tolerant by
+    construction; both garbage fronts move 1 cell per step, so the
+    interior slice is exact.  Constant shapes keep the inner loop a scan —
+    compile cost is one step, not s unrolled bodies.
+    """
+    s = halo_rows
+    if steps_per_call % s:
+        raise ValueError(
+            f"steps_per_call={steps_per_call} must be a multiple of "
+            f"halo_rows={s}"
+        )
+    hw = word_halo_width(s)
+    n_exchanges = steps_per_call // s
+
+    def local(tile: jax.Array) -> jax.Array:
+        check_tile(tile)
+        row_ax, col_ax = tile.ndim - 2, tile.ndim - 1
+
+        def body(t, _):
+            # Phase 1 — word columns; my west halo is my left neighbor's
+            # easternmost words.
+            west = ring_shift(t[..., -hw:], COL_AXIS, +1)
+            east = ring_shift(t[..., :hw], COL_AXIS, -1)
+            t2 = jnp.concatenate([west, t, east], axis=col_ax)
+            # Phase 2 — rows of the column-padded tile: corner words ride.
+            top = ring_shift(t2[..., -s:, :], ROW_AXIS, +1)
+            bottom = ring_shift(t2[..., :s, :], ROW_AXIS, -1)
+            padded = jnp.concatenate([top, t2, bottom], axis=row_ax)
+            padded, _ = jax.lax.scan(
+                lambda p, _: (step_one(p), None), padded, None, length=s
+            )
+            return padded[..., s:-s, hw:-hw], None
+
+        out, _ = jax.lax.scan(body, tile, None, length=n_exchanges)
+        return out
+
+    mapped = jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+    sharding = NamedSharding(mesh, spec)
+    return jax.jit(mapped, in_shardings=sharding, out_shardings=sharding)
 
 
 def sharded_packed2d_step_fn(
@@ -58,16 +121,9 @@ def sharded_packed2d_step_fn(
     rule = resolve_rule(rule)
     if not rule.is_binary:
         raise ValueError("bit-packed kernel supports binary rules only")
-    s = halo_rows
-    if steps_per_call % s:
-        raise ValueError(
-            f"steps_per_call={steps_per_call} must be a multiple of "
-            f"halo_rows={s}"
-        )
-    hw = word_halo_width(s)
-    n_exchanges = steps_per_call // s
+    s, hw = halo_rows, word_halo_width(halo_rows)
 
-    def local(tile: jax.Array) -> jax.Array:
+    def check(tile: jax.Array) -> None:
         h_loc, w_loc = tile.shape
         if h_loc < s:
             raise ValueError(f"per-shard tile has {h_loc} rows < halo rows {s}")
@@ -77,34 +133,14 @@ def sharded_packed2d_step_fn(
                 f"use fewer column shards or fewer steps per exchange"
             )
 
-        def body(t, _):
-            # Phase 1 — word columns; my west halo is my left neighbor's
-            # easternmost words.
-            west = ring_shift(t[:, -hw:], COL_AXIS, +1)
-            east = ring_shift(t[:, :hw], COL_AXIS, -1)
-            t2 = jnp.concatenate([west, t, east], axis=1)
-            # Phase 2 — rows of the column-padded tile: corner words ride.
-            top = ring_shift(t2[-s:], ROW_AXIS, +1)
-            bottom = ring_shift(t2[:s], ROW_AXIS, -1)
-            padded = jnp.concatenate([top, t2, bottom], axis=0)
-            # s local steps at constant shape: the *toroidal* step's wrap
-            # corrupts only the outermost halo rows/words, which are cut
-            # edges (their true neighbors live off-tile) and garbage-
-            # tolerant by construction; both garbage fronts move 1 cell per
-            # step, so the interior slice below is exact.  Constant shapes
-            # let the inner loop be a scan — compile cost is one step, not
-            # s unrolled bodies.
-            padded, _ = jax.lax.scan(
-                lambda p, _: (step_packed(p, rule), None), padded, None, length=s
-            )
-            return padded[s:-s, hw:-hw], None
-
-        out, _ = jax.lax.scan(body, tile, None, length=n_exchanges)
-        return out
-
-    mapped = jax.shard_map(local, mesh=mesh, in_specs=GRID_SPEC, out_specs=GRID_SPEC)
-    sharding = NamedSharding(mesh, GRID_SPEC)
-    return jax.jit(mapped, in_shardings=sharding, out_shardings=sharding)
+    return _sharded_exchange_fn(
+        mesh,
+        GRID_SPEC,
+        lambda p: step_packed(p, rule),
+        steps_per_call=steps_per_call,
+        halo_rows=halo_rows,
+        check_tile=check,
+    )
 
 
 def sharded_gen_step_fn(
@@ -122,18 +158,10 @@ def sharded_gen_step_fn(
     from akka_game_of_life_tpu.ops.bitpack_gen import n_planes, step_gen
 
     rule = resolve_rule(rule)
-    s = halo_rows
-    if steps_per_call % s:
-        raise ValueError(
-            f"steps_per_call={steps_per_call} must be a multiple of "
-            f"halo_rows={s}"
-        )
-    hw = word_halo_width(s)
-    n_exchanges = steps_per_call // s
+    s, hw = halo_rows, word_halo_width(halo_rows)
     m = n_planes(rule.states)
-    spec = jax.sharding.PartitionSpec(None, ROW_AXIS, COL_AXIS)
 
-    def local(planes: jax.Array) -> jax.Array:
+    def check(planes: jax.Array) -> None:
         if planes.shape[0] != m:
             raise ValueError(f"expected {m} planes for {rule.states} states")
         _, h_loc, w_loc = planes.shape
@@ -143,24 +171,14 @@ def sharded_gen_step_fn(
                 f"{s} steps per exchange"
             )
 
-        def body(t, _):
-            west = ring_shift(t[:, :, -hw:], COL_AXIS, +1)
-            east = ring_shift(t[:, :, :hw], COL_AXIS, -1)
-            t2 = jnp.concatenate([west, t, east], axis=2)
-            top = ring_shift(t2[:, -s:], ROW_AXIS, +1)
-            bottom = ring_shift(t2[:, :s], ROW_AXIS, -1)
-            padded = jnp.concatenate([top, t2, bottom], axis=1)
-            padded, _ = jax.lax.scan(
-                lambda p, _: (step_gen(p, rule), None), padded, None, length=s
-            )
-            return padded[:, s:-s, hw:-hw], None
-
-        out, _ = jax.lax.scan(body, planes, None, length=n_exchanges)
-        return out
-
-    mapped = jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
-    sharding = NamedSharding(mesh, spec)
-    return jax.jit(mapped, in_shardings=sharding, out_shardings=sharding)
+    return _sharded_exchange_fn(
+        mesh,
+        GEN_SPEC,
+        lambda p: step_gen(p, rule),
+        steps_per_call=steps_per_call,
+        halo_rows=halo_rows,
+        check_tile=check,
+    )
 
 
 def shard_packed2d(packed: jax.Array, mesh: Mesh) -> jax.Array:

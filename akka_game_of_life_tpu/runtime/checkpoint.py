@@ -160,7 +160,13 @@ class CheckpointStore:
             expect = (h, w // 32)
             fmt = 2  # uint32-word LSB-first layout
         else:
-            expect = (words.shape[0], h, w // 32)
+            # The plane count is derivable from the rule — deriving it from
+            # the input would validate nothing, and a truncated plane stack
+            # would silently decode to a wrong board on resume.
+            from akka_game_of_life_tpu.ops.bitpack_gen import n_planes
+            from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+            expect = (n_planes(resolve_rule(rule).states), h, w // 32)
             fmt = 3  # Generations bit planes, LSB plane first
         if words.shape != expect:
             raise ValueError(f"packed words {words.shape} != {expect}")

@@ -301,15 +301,6 @@ class Simulation:
             return shard_board(jnp.asarray(board), self.mesh)
         return jnp.asarray(board)
 
-    def _gen_spec(self):
-        """Sharding spec for Generations bit planes: the plane dim is tiny
-        and replicated; rows/word-cols shard over the grid mesh."""
-        from jax.sharding import PartitionSpec
-
-        from akka_game_of_life_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
-
-        return PartitionSpec(None, ROW_AXIS, COL_AXIS)
-
     def _words_to_device(self, words: np.ndarray):
         """Packed uint32 payload → the device-resident (and, on a mesh,
         sharded) board — the packed twin of :meth:`_to_device`.  2-D words
@@ -318,12 +309,13 @@ class Simulation:
             if self._gen:
                 from jax.sharding import NamedSharding
 
-                sharding = NamedSharding(self.mesh, self._gen_spec())
+                from akka_game_of_life_tpu.parallel.mesh import GEN_SPEC
+
                 if jax.process_count() > 1:
-                    return dist.make_global_array(
-                        words, self.mesh, spec=self._gen_spec()
-                    )
-                return jax.device_put(jnp.asarray(words), sharding)
+                    return dist.make_global_array(words, self.mesh, spec=GEN_SPEC)
+                return jax.device_put(
+                    jnp.asarray(words), NamedSharding(self.mesh, GEN_SPEC)
+                )
             if jax.process_count() > 1:
                 return dist.make_global_array(words, self.mesh)
             return shard_packed2d(jnp.asarray(words), self.mesh)

@@ -61,6 +61,25 @@ np.testing.assert_array_equal(
     final, np.asarray(multi_step(jnp.asarray(initial_board(cfg)), "conway", 8))
 )
 
+# -- packed kernels over the cross-host mesh ---------------------------------
+# kernel=auto resolves to bitpack here (binary, 32-aligned): the packed words
+# shard over a rows-only global mesh spanning both processes, stepping via
+# the width-k packed halo exchange with cross-host ppermutes; Generations
+# rules ride their bit planes the same way.
+for rule, steps in (("conway", 8), ("brians-brain", 8)):
+    pcfg = SimulationConfig(
+        height=16, width=32, seed=6, rule=rule, max_epochs=steps,
+        steps_per_call=4, distributed=True,
+    )
+    with Simulation(pcfg) as sim:
+        assert sim._packed, (rule, sim.kernel)
+        sim.advance()
+        got = sim.board_host()
+    np.testing.assert_array_equal(
+        got,
+        np.asarray(multi_step(jnp.asarray(initial_board(pcfg)), rule, steps)),
+    )
+
 # -- chaos path: epoch-indexed injection is an SPMD-lockstep event -----------
 # Every rank computes the same crash schedule (deterministic in simulation
 # time), loses its in-memory global array at the same chunk boundary,
