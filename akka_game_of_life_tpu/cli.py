@@ -162,12 +162,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     _add_platform(be_p)
     be_p.add_argument(
         "--engine",
-        choices=["numpy", "jax", "actor", "actor-native"],
+        choices=["numpy", "jax", "swar", "actor", "actor-native"],
         default="jax",
         help="tile step engine: jax = jitted on local accelerator (TPU path), "
-        "numpy = host-only parity path, actor = per-cell actor engine "
-        "(the reference's architecture, BASELINE config 1), actor-native = "
-        "the same engine compiled to machine code (C++ via ctypes)",
+        "numpy = host-only parity path, swar = C++ 64-cells-per-word SWAR "
+        "chunks (host machine code; binary rules), actor = per-cell actor "
+        "engine (the reference's architecture, BASELINE config 1), "
+        "actor-native = the same engine compiled to machine code (C++ via "
+        "ctypes)",
     )
 
     args = parser.parse_args(argv)

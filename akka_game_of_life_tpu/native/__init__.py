@@ -6,10 +6,16 @@ same role for the host-side half of the framework: the per-cell actor engine
 TPU compute path stays JAX/XLA/Pallas — native code here is for the parts
 that run on the host CPU.
 
-Build model: no pip, no pybind11 — a single translation unit compiled on
-demand with ``g++ -O2 -shared -fPIC`` into a content-addressed ``.so`` next
-to the source, loaded with ctypes.  ``load()`` returns None (and the callers
-fall back to the pure-Python engine) when no compiler is available.
+Components: the per-cell actor engine (``actor_engine.cpp`` — the CPU parity
+backend, BASELINE config 1) and the SWAR chunk stepper (``swar_kernel.cpp``
+— 64 cells/uint64 lane, the host twin of the TPU bit-packed kernel).
+
+Build model: no pip, no pybind11 — the translation units in ``_SRCS`` are
+compiled together on demand with ``g++ -O2 -shared -fPIC`` into one
+content-addressed ``.so`` (digest spans every source, so editing either
+file rebuilds), loaded with ctypes.  ``load()`` returns None (and the
+callers fall back to the pure-Python engines) when no compiler is
+available.
 """
 
 from __future__ import annotations
@@ -21,7 +27,10 @@ import subprocess
 import threading
 from typing import Optional
 
-_SRC = os.path.join(os.path.dirname(__file__), "actor_engine.cpp")
+_SRCS = [
+    os.path.join(os.path.dirname(__file__), "actor_engine.cpp"),
+    os.path.join(os.path.dirname(__file__), "swar_kernel.cpp"),
+]
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -45,6 +54,11 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ae_messages.restype = ctypes.c_int64
     lib.ae_messages.argtypes = [ctypes.c_void_p]
     lib.ae_prune_below.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.swar_chunk.argtypes = [
+        u8p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_uint32, ctypes.c_uint32, u8p,
+    ]
     return lib
 
 
@@ -61,15 +75,18 @@ def load() -> Optional[ctypes.CDLL]:
         if _load_failed is not None:
             return None
         try:
-            with open(_SRC, "rb") as f:
-                digest = hashlib.sha256(f.read()).hexdigest()[:16]
-            so_path = os.path.join(_BUILD_DIR, f"actor_engine_{digest}.so")
+            hasher = hashlib.sha256()
+            for src in _SRCS:
+                with open(src, "rb") as f:
+                    hasher.update(f.read())
+            digest = hasher.hexdigest()[:16]
+            so_path = os.path.join(_BUILD_DIR, f"gol_native_{digest}.so")
             if not os.path.exists(so_path):
                 os.makedirs(_BUILD_DIR, exist_ok=True)
                 tmp = so_path + f".tmp{os.getpid()}"
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     _SRC, "-o", tmp],
+                     *_SRCS, "-o", tmp],
                     check=True,
                     capture_output=True,
                     timeout=120,

@@ -118,3 +118,31 @@ class NativeActorTileEngine:
         out = np.empty(self._shape, dtype=np.uint8)
         self._lib.ae_get_board(self._ptr, _as_u8p(out))
         return out
+
+
+def swar_chunk_native(
+    padded: np.ndarray, steps: int, halo: int, rule
+) -> np.ndarray:
+    """Advance the (h, w) interior of a width-``halo`` padded slab by
+    ``steps`` (<= halo) generations with the C++ SWAR kernel (64 cells per
+    uint64 lane; native/swar_kernel.cpp) — the host-CPU twin of the TPU
+    bit-packed kernel, and the machine-code replacement for the numpy
+    engine's roll-sum stepping on binary rules."""
+    rule = resolve_rule(rule)
+    if not rule.is_binary:
+        raise ValueError("native SWAR kernel supports binary rules only")
+    if steps > halo:
+        raise ValueError(f"steps={steps} > halo={halo}")
+    lib = load()
+    if lib is None:
+        from akka_game_of_life_tpu.native import load_error
+
+        raise RuntimeError(f"native engine unavailable: {load_error()}")
+    padded = np.ascontiguousarray(padded, dtype=np.uint8)
+    ph, pw = padded.shape
+    out = np.empty((ph - 2 * halo, pw - 2 * halo), dtype=np.uint8)
+    lib.swar_chunk(
+        _as_u8p(padded), ph, pw, steps, halo,
+        rule.birth_mask, rule.survive_mask, _as_u8p(out),
+    )
+    return out

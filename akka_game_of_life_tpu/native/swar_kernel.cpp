@@ -1,0 +1,125 @@
+// Native SWAR stepper for binary life-like rules — the host-CPU twin of the
+// TPU bit-packed kernel (ops/bitpack.py): 64 cells per uint64 lane,
+// carry-save-adder Moore counts over shared per-row triple sums, B/S rule as
+// count-equality predicate planes.
+//
+// Reference capability note: this is the same collapse of the per-cell actor
+// protocol (/root/reference/src/main/scala/gameoflife/CellActor.scala:63-89,
+// NextStateCellGathererActor.scala:32-45) into pure arithmetic that the XLA
+// kernels perform, compiled for the host so the cluster's CPU engine matches
+// the reference's JVM-native runtime with machine code instead of actor
+// message storms.
+//
+// Contract (mirrors runtime/backend._np_chunk): `swar_chunk` takes a
+// width-`halo` padded slab (ph, pw) = (h + 2*halo, w + 2*halo) of 0/1 uint8
+// cells and advances the (h, w) interior by `steps` <= halo generations,
+// treating everything beyond the slab as dead.  Each step's garbage front
+// moves one cell inward from the slab edge, so after `steps` steps the
+// interior slice is exact — the same peeling argument as step_padded_np.
+//
+// Build: compiled into the shared native .so by native/__init__.py (g++ -O2,
+// no external deps).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Planes {
+  // Per-row bit planes with one guard word on each side (kept zero) so the
+  // west/east cross-word shifts need no edge branches.
+  int rows, words;
+  std::vector<uint64_t> data;  // rows * (words + 2)
+
+  Planes(int r, int w) : rows(r), words(w), data((size_t)r * (w + 2), 0) {}
+  uint64_t* row(int r) { return data.data() + (size_t)r * (words + 2) + 1; }
+  void clear() { std::fill(data.begin(), data.end(), 0); }
+};
+
+// Horizontal 3-cell full-adder planes for one row: s + 2c = west+center+east
+// (center included — survive thresholds shift by +1, as in ops/bitpack.py).
+inline void row_triple(const uint64_t* x, uint64_t* s, uint64_t* c, int words) {
+  for (int i = 0; i < words; ++i) {
+    uint64_t w = (x[i] << 1) | (x[i - 1] >> 63);
+    uint64_t e = (x[i] >> 1) | (x[i + 1] << 63);
+    uint64_t xw = x[i] ^ w;
+    s[i] = xw ^ e;
+    c[i] = (x[i] & w) | (e & xw);
+  }
+}
+
+}  // namespace
+
+extern "C" void swar_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
+                           int32_t steps, int32_t halo,
+                           uint32_t birth_mask, uint32_t survive_mask,
+                           uint8_t* out) {
+  const int words = (pw + 63) / 64;
+  Planes cur(ph, words), next(ph, words);
+  Planes S(ph, words), C(ph, words);
+
+  // Pack the uint8 slab into LSB-first bitboards (bit i of word k = column
+  // 64k + i), zeros beyond pw.
+  for (int r = 0; r < ph; ++r) {
+    const uint8_t* src = padded + (size_t)r * pw;
+    uint64_t* dst = cur.row(r);
+    for (int x = 0; x < pw; ++x)
+      if (src[x]) dst[x >> 6] |= (uint64_t)1 << (x & 63);
+  }
+
+  std::vector<uint64_t> zero(words + 2, 0);
+  for (int step = 0; step < steps; ++step) {
+    for (int r = 0; r < ph; ++r)
+      row_triple(cur.row(r), S.row(r), C.row(r), words);
+    for (int r = 0; r < ph; ++r) {
+      const uint64_t* sN = r > 0 ? S.row(r - 1) : zero.data() + 1;
+      const uint64_t* cN = r > 0 ? C.row(r - 1) : zero.data() + 1;
+      const uint64_t* sS = r < ph - 1 ? S.row(r + 1) : zero.data() + 1;
+      const uint64_t* cS = r < ph - 1 ? C.row(r + 1) : zero.data() + 1;
+      const uint64_t* sC = S.row(r);
+      const uint64_t* cC = C.row(r);
+      const uint64_t* x = cur.row(r);
+      uint64_t* o = next.row(r);
+      for (int i = 0; i < words; ++i) {
+        // count = (sN+sC+sS) + 2*(cN+cC+cS), range 0..9, as bit planes.
+        uint64_t sNC = sN[i] ^ sC[i];
+        uint64_t b0 = sNC ^ sS[i];
+        uint64_t p1 = (sN[i] & sC[i]) | (sS[i] & sNC);
+        uint64_t cNC = cN[i] ^ cC[i];
+        uint64_t q0 = cNC ^ cS[i];
+        uint64_t q1 = (cN[i] & cC[i]) | (cS[i] & cNC);
+        uint64_t b1 = p1 ^ q0;
+        uint64_t r2 = p1 & q0;
+        uint64_t b2 = q1 ^ r2;
+        uint64_t b3 = q1 & r2;
+        uint64_t birth = 0, survive = 0;
+        for (int n = 0; n <= 9; ++n) {
+          // Predicate plane: count == n.
+          uint64_t t = (n & 8 ? b3 : ~b3) & (n & 4 ? b2 : ~b2) &
+                       (n & 2 ? b1 : ~b1) & (n & 1 ? b0 : ~b0);
+          if (birth_mask & (1u << n)) birth |= t;
+          // Count includes the live center: survive threshold n matches
+          // count n+1.
+          if (n > 0 && (survive_mask & (1u << (n - 1)))) survive |= t;
+        }
+        o[i] = (~x[i] & birth) | (x[i] & survive);
+      }
+      // Keep the out-of-slab columns dead (shift guards must stay zero and
+      // bits >= pw must not become fake neighbors through later steps).
+      if (pw & 63) o[words - 1] &= ((uint64_t)1 << (pw & 63)) - 1;
+    }
+    std::swap(cur.data, next.data);
+  }
+
+  // Extract the exact (h, w) interior.
+  const int h = ph - 2 * halo, w = pw - 2 * halo;
+  for (int r = 0; r < h; ++r) {
+    const uint64_t* src = cur.row(r + halo);
+    uint8_t* dst = out + (size_t)r * w;
+    for (int x = 0; x < w; ++x) {
+      int col = x + halo;
+      dst[x] = (src[col >> 6] >> (col & 63)) & 1;
+    }
+  }
+}

@@ -11,9 +11,13 @@ advanced by a stencil engine:
 - ``engine="jax"``: jitted stepping on the worker's local accelerator (the
   TPU path; within a multi-device worker the tile itself is mesh-sharded by
   :mod:`akka_game_of_life_tpu.parallel` — ICI inside, control plane outside);
-- ``engine="actor"``: the per-cell actor engine
-  (:mod:`akka_game_of_life_tpu.runtime.actor_engine`) — the reference's own
-  architecture, swappable at role config (BASELINE config 1).
+- ``engine="swar"``: C++ 64-cells-per-uint64 SWAR chunks
+  (``native/swar_kernel.cpp``) — host machine code for binary rules,
+  falling back to the numpy chunk for Generations rules;
+- ``engine="actor"`` / ``"actor-native"``: the per-cell actor engine
+  (:mod:`akka_game_of_life_tpu.runtime.actor_engine` and its C++ twin) —
+  the reference's own architecture, swappable at role config (BASELINE
+  config 1).
 
 **The data plane is peer-to-peer.**  Workers serve each other's boundary
 reads directly, exactly as the reference's gatherers ask neighbor cells
@@ -223,15 +227,16 @@ class BackendWorker:
         peer_host: str = "0.0.0.0",
         crash_hook: Optional[Callable[[], None]] = None,
     ) -> None:
-        if engine not in ("numpy", "jax", "actor", "actor-native"):
+        if engine not in ("numpy", "jax", "swar", "actor", "actor-native"):
             raise ValueError(
-                f"unknown engine {engine!r}; use numpy, jax, actor, or actor-native"
+                f"unknown engine {engine!r}; use numpy, jax, swar, actor, "
+                f"or actor-native"
             )
-        if engine == "actor-native":
+        if engine in ("swar", "actor-native"):
             from akka_game_of_life_tpu.native import available, load_error
 
             if not available():
-                raise RuntimeError(f"actor-native engine unavailable: {load_error()}")
+                raise RuntimeError(f"{engine} engine unavailable: {load_error()}")
         self.host = host
         self.port = port
         self.name = name
@@ -552,6 +557,23 @@ class BackendWorker:
                 self.rule = rule
                 if self.engine == "jax":
                     self._step_chunk = _jax_engine(rule)
+                elif self.engine == "swar":
+                    from akka_game_of_life_tpu.native.engine import swar_chunk_native
+
+                    if rule.is_binary:
+                        self._step_chunk = (
+                            lambda padded, steps, halo: swar_chunk_native(
+                                padded, steps, halo, rule
+                            )
+                        )
+                    else:
+                        # The C++ SWAR kernel is binary-only; Generations
+                        # rules fall back to the numpy chunk on this engine.
+                        self._step_chunk = (
+                            lambda padded, steps, halo: _np_chunk(
+                                padded, steps, halo, rule
+                            )
+                        )
                 elif self.engine == "numpy":
                     self._step_chunk = (
                         lambda padded, steps, halo: _np_chunk(padded, steps, halo, rule)
