@@ -112,6 +112,33 @@ def bench_actor(size: int) -> None:
         )
 
 
+def bench_swar(size: int, steps: int = 8) -> None:
+    """The native C++ SWAR chunk engine (host machine code, the cluster's
+    'swar' worker engine) — reported beside the actor engines so the host
+    data path has a throughput record too."""
+    from akka_game_of_life_tpu.native import available
+
+    if not available():
+        return
+    from akka_game_of_life_tpu.native.engine import swar_chunk_native
+
+    rng = np.random.default_rng(0)
+    padded = rng.integers(0, 2, size=(size + 2 * steps, size + 2 * steps), dtype=np.uint8)
+    swar_chunk_native(padded, steps, steps, "conway")  # warm (JIT-free, but page in)
+    t0 = time.perf_counter()
+    out = swar_chunk_native(padded, steps, steps, "conway")
+    dt = time.perf_counter() - t0
+    assert out.any()
+    _emit(
+        f"conway-swar-{size}",
+        f"cell-updates/sec, Conway {size}x{size} native C++ SWAR chunks "
+        f"({steps} steps/chunk, 1 core)",
+        size * size * steps / dt,
+        "cell-updates/sec",
+        REFERENCE_CEILING,
+    )
+
+
 def bench_dense(size: int, rule: str, config: str, steps: int = 32) -> None:
     import jax.numpy as jnp
 
@@ -261,6 +288,7 @@ def main() -> None:
 
     if 1 in args.config:
         bench_actor(max(16, int(64 * args.scale)))
+        bench_swar(s(2048))
     if 2 in args.config:
         bench_dense(s(8192), "conway", "conway-8192")
     if 3 in args.config:
