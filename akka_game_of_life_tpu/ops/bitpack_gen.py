@@ -110,6 +110,58 @@ def _increment(planes: List[jax.Array]) -> List[jax.Array]:
     return out
 
 
+def _transition(
+    ps_center: List[jax.Array],
+    alive_c: jax.Array,
+    dead_c: jax.Array,
+    eq,
+    rule,
+) -> jax.Array:
+    """Next-state planes from center-row plane slices plus count predicates
+    (shared by the toroidal and padded-rows steppers)."""
+    birth = jnp.uint32(0)
+    for n in rule.birth:
+        birth = birth | eq(n)  # dead center: count has no self term
+    survive = jnp.uint32(0)
+    for n in rule.survive:
+        survive = survive | eq(n + 1)  # alive center: +1 self term
+    to_one = (dead_c & birth) | (alive_c & survive)
+    # Everyone else: dead stays 0; alive/refractory increments, wrapping
+    # S-1 → 0.  (alive+1 = 2 is exactly the "enters state 2" transition.)
+    inc = _increment(ps_center)
+    wrap = _eq_const(ps_center, rule.states - 1)
+    advance = ~dead_c & ~to_one & ~wrap
+    return jnp.stack(
+        [
+            (to_one if k == 0 else jnp.uint32(0)) | (advance & inc[k])
+            for k in range(len(ps_center))
+        ]
+    )
+
+
+def step_gen_padded_rows(padded: jax.Array, rule) -> jax.Array:
+    """One Generations step on a row-padded plane slab: (m, h+2, words) with
+    one halo row top and bottom → (m, h, words).  Row triple sums of the
+    alive plane are computed once per slab row and shared across the three
+    output rows each feeds — the Generations twin of
+    :func:`akka_game_of_life_tpu.ops.bitpack.step_padded_rows`, used by the
+    Pallas temporal-blocking kernel."""
+    rule = resolve_rule(rule)
+    m = n_planes(rule.states)
+    if padded.shape[0] != m:
+        raise ValueError(f"expected {m} planes for {rule.states} states")
+    ps = [padded[k] for k in range(m)]
+    alive = _eq_const(ps, 1)
+    dead = _eq_const(ps, 0)
+    s, c = _row_triple_sum(alive)
+    eq = count_eq_fn(
+        *_count_bits(s[:-2], c[:-2], s[1:-1], c[1:-1], s[2:], c[2:])
+    )
+    return _transition(
+        [p[1:-1] for p in ps], alive[1:-1], dead[1:-1], eq, rule
+    )
+
+
 def step_gen(planes: jax.Array, rule) -> jax.Array:
     """One toroidal Generations step on (m, H, W/32) packed planes."""
     rule = resolve_rule(rule)
@@ -132,21 +184,7 @@ def step_gen(planes: jax.Array, rule) -> jax.Array:
             jnp.roll(c, -1, axis=0),
         )
     )
-    birth = jnp.uint32(0)
-    for n in rule.birth:
-        birth = birth | eq(n)  # dead center: count has no self term
-    survive = jnp.uint32(0)
-    for n in rule.survive:
-        survive = survive | eq(n + 1)  # alive center: +1 self term
-
-    to_one = (dead & birth) | (alive & survive)
-    # Everyone else: dead stays 0; alive/refractory increments, wrapping
-    # S-1 → 0.  (alive+1 = 2 is exactly the "enters state 2" transition.)
-    inc = _increment(ps)
-    wrap = _eq_const(ps, rule.states - 1)
-    advance = ~dead & ~to_one & ~wrap
-    out = [(to_one if k == 0 else jnp.uint32(0)) | (advance & inc[k]) for k in range(m)]
-    return jnp.stack(out)
+    return _transition(ps, alive, dead, eq, rule)
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,98 @@
+"""Pallas TPU kernel for bit-plane Generations CA — the multi-state twin of
+:mod:`akka_game_of_life_tpu.ops.pallas_stencil`, built on the same shared
+temporally-blocked sweep (:func:`pallas_stencil.temporal_sweep_fn`) with the
+plane stack's leading ``m`` axis carried whole in every block.
+
+Each grid step loads ``block_rows + 2k`` packed rows of every plane into
+VMEM, advances the central ``block_rows`` by ``k`` generations with
+:func:`bitpack_gen.step_gen_padded_rows` (shared-row alive sums,
+ripple-carry refractory decay), and writes back — HBM sees one read and one
+write of the (m, H, W/32) plane stack per sweep.
+
+Reference capability note: this is the Generations-family end point of
+collapsing the reference's per-cell actor protocol
+(``CellActor.scala:63-89``) into on-chip arithmetic — multi-state decay
+included, which the reference's single hard-coded rule
+(``NextStateCellGathererActor.scala:44``) never had.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from akka_game_of_life_tpu.ops.bitpack_gen import n_planes, step_gen_padded_rows
+from akka_game_of_life_tpu.ops.pallas_stencil import (
+    DEFAULT_STEPS_PER_SWEEP,
+    auto_steps_per_sweep,
+    temporal_sweep_fn,
+)
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+DEFAULT_BLOCK_ROWS = 64
+
+
+def gen_sweep_fn(
+    rule,
+    *,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    steps_per_sweep: int = DEFAULT_STEPS_PER_SWEEP,
+    interpret: bool = False,
+) -> Callable[[jax.Array], jax.Array]:
+    """One Pallas sweep advancing (m, H, W/32) packed planes by
+    ``steps_per_sweep`` generations."""
+    rule = resolve_rule(rule)
+    m = n_planes(rule.states)
+    inner = temporal_sweep_fn(
+        lambda ext: step_gen_padded_rows(ext, rule),
+        n_prefix=1,
+        block_rows=block_rows,
+        steps_per_sweep=steps_per_sweep,
+        interpret=interpret,
+    )
+
+    def sweep(planes: jax.Array) -> jax.Array:
+        if planes.shape[0] != m:
+            raise ValueError(f"expected {m} planes for {rule.states} states")
+        return inner(planes)
+
+    return sweep
+
+
+@functools.lru_cache(maxsize=None)
+def gen_pallas_multi_step_fn(
+    rule_key,
+    n_steps: int,
+    *,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    steps_per_sweep: Optional[int] = None,
+    interpret: bool = False,
+) -> Callable[[jax.Array], jax.Array]:
+    """Jitted n-step Generations advance from temporally-blocked sweeps
+    (defaulting ``steps_per_sweep`` like the binary kernel)."""
+    rule = resolve_rule(rule_key)
+    if steps_per_sweep is None:
+        steps_per_sweep = auto_steps_per_sweep(n_steps, block_rows)
+    if n_steps % steps_per_sweep:
+        raise ValueError(
+            f"n_steps={n_steps} not a multiple of steps_per_sweep={steps_per_sweep}"
+        )
+    sweep = gen_sweep_fn(
+        rule,
+        block_rows=block_rows,
+        steps_per_sweep=steps_per_sweep,
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def run(planes: jax.Array) -> jax.Array:
+        def body(s, _):
+            return sweep(s), None
+
+        out, _ = jax.lax.scan(body, planes, None, length=n_steps // steps_per_sweep)
+        return out
+
+    return run

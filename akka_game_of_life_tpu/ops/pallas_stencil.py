@@ -46,21 +46,115 @@ def _round_up8(n: int) -> int:
     return -(-n // 8) * 8
 
 
-def _make_kernel(rule: Rule, k: int, hb: int):
-    """Mosaic requires sublane-dim block sizes divisible by 8, so the halo
-    blocks are ``hb = round_up(k, 8)`` rows; the kernel statically slices the
-    ``k`` rows actually adjacent to the center block (the last k of the north
-    block, the first k of the south block)."""
+def auto_steps_per_sweep(n_steps: int, block_rows: int) -> int:
+    """The largest feasible sweep depth <= DEFAULT_STEPS_PER_SWEEP that
+    divides ``n_steps`` with sublane-aligned halo blocks."""
+    candidates = [
+        d
+        for d in range(1, DEFAULT_STEPS_PER_SWEEP + 1)
+        if n_steps % d == 0 and block_rows % _round_up8(d) == 0
+    ]
+    if not candidates:
+        raise ValueError(
+            f"no feasible steps_per_sweep for n_steps={n_steps}, "
+            f"block_rows={block_rows} (block_rows must be a positive "
+            f"multiple of 8)"
+        )
+    return max(candidates)
+
+
+def temporal_sweep_fn(
+    step_padded_rows_fn: Callable[[jax.Array], jax.Array],
+    *,
+    n_prefix: int,
+    block_rows: int,
+    steps_per_sweep: int,
+    interpret: bool,
+) -> Callable[[jax.Array], jax.Array]:
+    """The shared temporally-blocked Pallas sweep over a row-tiled array
+    whose LAST TWO axes are (rows, packed words), with ``n_prefix`` leading
+    axes carried whole in every block (0 for the binary board, 1 for the
+    Generations plane stack).
+
+    Mosaic requires sublane-dim block sizes divisible by 8, so the halo
+    blocks are ``hb = round_up(k, 8)`` rows; the kernel statically slices
+    the ``k`` rows actually adjacent to the center block (the last k of the
+    north block, the first k of the south block).  The torus wraps through
+    the halo BlockSpec ``index_map`` modulo.
+    """
+    b, k = block_rows, steps_per_sweep
+    if k < 1:
+        raise ValueError(f"steps_per_sweep={k} must be >= 1")
+    hb = _round_up8(k)  # Mosaic sublane alignment for the halo blocks
+    if b % hb:
+        raise ValueError(
+            f"block_rows={b} must be a multiple of {hb} "
+            f"(steps_per_sweep={k} rounded up to the 8-row sublane tile)"
+        )
+    row_ax = n_prefix
+    pre = (slice(None),) * n_prefix
 
     def kernel(north_ref, center_ref, south_ref, out_ref):
         ext = jnp.concatenate(
-            [north_ref[hb - k :], center_ref[:], south_ref[:k]], axis=0
-        )  # (B + 2k, W)
+            [
+                north_ref[pre + (slice(hb - k, None),)],
+                center_ref[...],
+                south_ref[pre + (slice(None, k),)],
+            ],
+            axis=row_ax,
+        )  # (..., B + 2k, W)
         for _ in range(k):
-            ext = step_padded_rows(ext, rule)
-        out_ref[:] = ext
+            ext = step_padded_rows_fn(ext)
+        out_ref[...] = ext
 
-    return kernel
+    def sweep(x: jax.Array) -> jax.Array:
+        prefix = x.shape[:n_prefix]
+        h, words = x.shape[row_ax], x.shape[row_ax + 1]
+        if h % b:
+            raise ValueError(f"grid height {h} not a multiple of block_rows={b}")
+        # h % b == 0 and b % hb == 0 together imply h % hb == 0, so the
+        # hb-row halo views below always tile the array exactly.
+        n_row_blocks = h // b
+        halo_blocks = h // hb  # the same array viewed in (hb, words) blocks
+        zeros = (0,) * n_prefix
+
+        grid_spec = pl.GridSpec(
+            grid=(n_row_blocks,),
+            in_specs=[
+                # North halo: the hb-row block ending exactly where the center
+                # block starts (its last k rows are the true halo).
+                pl.BlockSpec(
+                    prefix + (hb, words),
+                    lambda i: zeros + ((i * (b // hb) - 1) % halo_blocks, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    prefix + (b, words),
+                    lambda i: zeros + (i, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                # South halo: the hb-row block starting just below the center
+                # block (its first k rows are the true halo).
+                pl.BlockSpec(
+                    prefix + (hb, words),
+                    lambda i: zeros + (((i + 1) * (b // hb)) % halo_blocks, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                prefix + (b, words),
+                lambda i: zeros + (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        )
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            grid_spec=grid_spec,
+            interpret=interpret,
+        )(x, x, x)
+
+    return sweep
 
 
 def packed_sweep_fn(
@@ -73,64 +167,19 @@ def packed_sweep_fn(
     """One Pallas sweep advancing a packed (H, W/32) uint32 torus by
     ``steps_per_sweep`` generations.
 
-    Requires ``H % block_rows == 0`` and ``block_rows % steps_per_sweep == 0``
-    (so the k-row halo blocks land on k-aligned block indices).
+    Requires ``H % block_rows == 0`` and sublane-aligned halos (see
+    :func:`temporal_sweep_fn`).
     """
     rule = resolve_rule(rule)
     if not rule.is_binary:
         raise ValueError("bit-packed kernel supports binary rules only")
-    b, k = block_rows, steps_per_sweep
-    if k < 1:
-        raise ValueError(f"steps_per_sweep={k} must be >= 1")
-    hb = _round_up8(k)  # Mosaic sublane alignment for the halo blocks
-    if b % hb:
-        raise ValueError(
-            f"block_rows={b} must be a multiple of {hb} "
-            f"(steps_per_sweep={k} rounded up to the 8-row sublane tile)"
-        )
-
-    kernel = _make_kernel(rule, k, hb)
-
-    def sweep(x: jax.Array) -> jax.Array:
-        h, words = x.shape
-        if h % b:
-            raise ValueError(f"grid height {h} not a multiple of block_rows={b}")
-        # h % b == 0 and b % hb == 0 together imply h % hb == 0, so the
-        # hb-row halo views below always tile the array exactly.
-        n_row_blocks = h // b
-        halo_blocks = h // hb  # the same array viewed in (hb, words) blocks
-
-        grid_spec = pl.GridSpec(
-            grid=(n_row_blocks,),
-            in_specs=[
-                # North halo: the hb-row block ending exactly where the center
-                # block starts (its last k rows are the true halo).
-                pl.BlockSpec(
-                    (hb, words),
-                    lambda i: ((i * (b // hb) - 1) % halo_blocks, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec((b, words), lambda i: (i, 0), memory_space=pltpu.VMEM),
-                # South halo: the hb-row block starting just below the center
-                # block (its first k rows are the true halo).
-                pl.BlockSpec(
-                    (hb, words),
-                    lambda i: (((i + 1) * (b // hb)) % halo_blocks, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-            ],
-            out_specs=pl.BlockSpec(
-                (b, words), lambda i: (i, 0), memory_space=pltpu.VMEM
-            ),
-        )
-        return pl.pallas_call(
-            kernel,
-            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-            grid_spec=grid_spec,
-            interpret=interpret,
-        )(x, x, x)
-
-    return sweep
+    return temporal_sweep_fn(
+        lambda ext: step_padded_rows(ext, rule),
+        n_prefix=0,
+        block_rows=block_rows,
+        steps_per_sweep=steps_per_sweep,
+        interpret=interpret,
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -150,13 +199,7 @@ def packed_multi_step_fn(
     """
     rule = resolve_rule(rule_key)
     if steps_per_sweep is None:
-        steps_per_sweep = max(
-            (
-                d
-                for d in range(1, DEFAULT_STEPS_PER_SWEEP + 1)
-                if n_steps % d == 0 and block_rows % _round_up8(d) == 0
-            ),
-        )
+        steps_per_sweep = auto_steps_per_sweep(n_steps, block_rows)
     if n_steps % steps_per_sweep:
         raise ValueError(
             f"n_steps={n_steps} not a multiple of steps_per_sweep={steps_per_sweep}"

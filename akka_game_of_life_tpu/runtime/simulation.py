@@ -222,18 +222,11 @@ class Simulation:
             # Generations rules: bit planes (0.25·m B/cell vs 1 B/cell dense).
             return "bitpack" if self.rule.states <= 256 else "dense"
         if kernel in ("bitpack", "pallas"):
-            if not self.rule.is_binary:
-                if kernel == "pallas":
-                    raise ValueError(
-                        f"kernel=pallas supports binary rules only; rule "
-                        f"{self.rule} is multi-state (use kernel=bitpack for "
-                        f"the bit-plane Generations path, or dense)"
-                    )
-                if self.rule.states > 256:
-                    raise ValueError(
-                        f"kernel=bitpack supports at most 256 states, rule "
-                        f"{self.rule} has {self.rule.states}"
-                    )
+            if not self.rule.is_binary and self.rule.states > 256:
+                raise ValueError(
+                    f"kernel={kernel} supports at most 256 states, rule "
+                    f"{self.rule} has {self.rule.states}"
+                )
             if cfg.width % 32:
                 raise ValueError(
                     f"kernel={kernel} requires width % 32 == 0, got {cfg.width}"
@@ -340,7 +333,19 @@ class Simulation:
         if k not in self._steppers:
             if self._gen:
                 if self.mesh is None:
-                    self._steppers[k] = bitpack_gen.gen_multi_step_fn(self.rule, k)
+                    if self.kernel == "pallas":
+                        from akka_game_of_life_tpu.ops import pallas_gen
+
+                        self._steppers[k] = pallas_gen.gen_pallas_multi_step_fn(
+                            self.rule,
+                            k,
+                            block_rows=self.config.pallas_block_rows,
+                            interpret=jax.default_backend() != "tpu",
+                        )
+                    else:
+                        self._steppers[k] = bitpack_gen.gen_multi_step_fn(
+                            self.rule, k
+                        )
                 else:
                     from akka_game_of_life_tpu.parallel.packed_halo2d import (
                         sharded_gen_step_fn,

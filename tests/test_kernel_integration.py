@@ -70,13 +70,14 @@ def test_auto_falls_back_to_dense_for_odd_width():
 
 
 def test_explicit_kernel_rejections():
-    with pytest.raises(ValueError, match="binary"):
-        Simulation(
-            _cfg("pallas", rule="brians-brain"),
-            observer=BoardObserver(out=io.StringIO()),
-        )
     with pytest.raises(ValueError, match="width"):
         Simulation(_cfg("bitpack", width=60), observer=BoardObserver(out=io.StringIO()))
+    # pallas + multi-state is supported (the bit-plane Generations kernel);
+    # a mesh is still rejected.
+    sim = Simulation(
+        _cfg("pallas", rule="brians-brain"), observer=BoardObserver(out=io.StringIO())
+    )
+    assert sim.kernel == "pallas" and sim._gen
 
 
 def test_gen_planes_sim_matches_dense_sim(tmp_path):

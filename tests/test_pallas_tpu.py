@@ -45,6 +45,21 @@ for rule in ("conway", "highlife"):
         )(x)
     )
     np.testing.assert_array_equal(got, oracle)
+
+# Generations bit planes through the Mosaic compiler too.
+from akka_game_of_life_tpu.ops import bitpack_gen, pallas_gen
+
+board = rng.integers(0, 3, size=(512, 4096), dtype=np.uint8)
+planes = bitpack_gen.pack_gen(jnp.asarray(board), 3)
+oracle_g = np.asarray(
+    bitpack_gen.gen_multi_step_fn(resolve_rule("brians-brain"), 16)(planes)
+)
+got_g = np.asarray(
+    pallas_gen.gen_pallas_multi_step_fn(
+        resolve_rule("brians-brain"), 16, block_rows=64, steps_per_sweep=4
+    )(planes)
+)
+np.testing.assert_array_equal(got_g, oracle_g)
 print("PALLAS-TPU-OK", backend)
 """
 
