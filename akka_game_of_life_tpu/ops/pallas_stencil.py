@@ -36,7 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from akka_game_of_life_tpu.ops.bitpack import LANE_BITS, step_padded_rows
-from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
+from akka_game_of_life_tpu.ops.rules import resolve_rule
 
 DEFAULT_BLOCK_ROWS = 256
 DEFAULT_STEPS_PER_SWEEP = 8

@@ -207,6 +207,45 @@ def bench_packed_gen(size: int, rule: str, config: str, steps: int = 32) -> None
     )
 
 
+def bench_pallas_gen(size: int, rule: str, config: str, steps: int = 32) -> None:
+    """Generations through the Mosaic temporal-blocking kernel (real TPU
+    only — interpret mode is orders of magnitude slower and not a perf
+    datum)."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return
+    from akka_game_of_life_tpu.ops import bitpack_gen, pallas_gen
+    from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+    from akka_game_of_life_tpu.ops.pallas_stencil import auto_steps_per_sweep
+
+    r = resolve_rule(rule)
+    # block_rows must divide the (32-quantum) scaled height; 128 when it
+    # fits, else the largest 8-multiple divisor (every 32-multiple has one).
+    block_rows = next(b for b in (128, 64, 32, 16, 8) if size % b == 0)
+    rng = np.random.default_rng(0)
+    board = rng.integers(0, r.states, size=(size, size), dtype=np.uint8)
+    planes = bitpack_gen.pack_gen(jnp.asarray(board), r.states)
+    run = pallas_gen.gen_pallas_multi_step_fn(r, steps, block_rows=block_rows)
+    population = lambda p: int(jnp.sum(jnp.bitwise_count(p[0])))
+    dt = _time_steps(run, planes, population)
+    rate = size * size * steps / dt
+    k = auto_steps_per_sweep(steps, block_rows)
+    m = bitpack_gen.n_planes(r.states)
+    _emit(
+        config,
+        f"cell-updates/sec/chip, {rule} {size}x{size} Pallas bit-plane "
+        f"Generations ({m} planes, {k} steps/sweep)",
+        rate,
+        "cell-updates/sec",
+        PER_CHIP_TARGET,
+        # One HBM read + write of the m-plane stack per k-step sweep.
+        bytes_per_cell=0.25 * m / k,
+    )
+
+
 def bench_sharded(size: int, steps: int = 64) -> None:
     import jax
     import jax.numpy as jnp
@@ -298,6 +337,7 @@ def main() -> None:
         bench_dense(s(8192), "brians-brain", "generations-8192", steps=16)
         bench_packed_gen(s(8192), "brians-brain", "generations-8192")
         bench_packed_gen(s(8192), "star-wars", "generations-8192")
+        bench_pallas_gen(s(8192), "brians-brain", "generations-8192")
     if 5 in args.config:
         bench_sharded(s(65536, 32 * 8))
 
