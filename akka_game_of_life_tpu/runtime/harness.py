@@ -1,0 +1,70 @@
+"""In-process cluster harness: frontend + N backend workers as threads.
+
+One process standing in for the reference's "start N backend JVMs on
+localhost" manual procedure (``README.md:3-12``) — used by the test suite
+(trajectory equivalence, chaos drills), by ``bench_suite.py``'s
+cluster-exchange config, and available to library users who want a local
+cluster without shell plumbing.  Real multi-process clusters use the CLI
+roles (``python -m akka_game_of_life_tpu frontend/backend``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from akka_game_of_life_tpu.runtime.backend import BackendWorker
+from akka_game_of_life_tpu.runtime.frontend import Frontend
+
+DONE_TIMEOUT = 60
+
+
+class ClusterHarness:
+    def __init__(self, config, n_backends, observer=None, engine="numpy"):
+        # numpy engine keeps test suites fast and portable; pass engine="jax"
+        # (or "swar") for the accelerator/native data paths.
+        self.engine = engine
+        config.port = 0  # ephemeral: parallel harnesses must not fight over 2551
+        self.frontend = Frontend(config, min_backends=n_backends, observer=observer)
+        self.frontend.start()
+        self.workers = []
+        self.threads = []
+        for i in range(n_backends):
+            self.add_worker(f"w{i}")
+
+    def add_worker(self, name):
+        w = BackendWorker(
+            "127.0.0.1",
+            self.frontend.port,
+            name=name,
+            engine=self.engine,
+            retry_s=0.5,
+        )
+        w.crash_hook = w.stop  # in-thread "process death": drop the connection
+        w.connect()
+        t = threading.Thread(target=w.run, daemon=True, name=f"worker-{name}")
+        t.start()
+        self.workers.append(w)
+        self.threads.append(t)
+        return w
+
+    def run_to_completion(self, timeout: float = DONE_TIMEOUT):
+        assert self.frontend.wait_for_backends(timeout=5)
+        self.frontend.start_simulation()
+        assert self.frontend.done.wait(timeout), "cluster did not finish"
+        assert self.frontend.error is None, self.frontend.error
+        return self.frontend.final_board
+
+    def shutdown(self):
+        self.frontend.stop()
+        for w in self.workers:
+            w.stop()
+
+
+@contextlib.contextmanager
+def cluster(config, n_backends, observer=None, engine="numpy"):
+    h = ClusterHarness(config, n_backends, observer=observer, engine=engine)
+    try:
+        yield h
+    finally:
+        h.shutdown()

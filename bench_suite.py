@@ -16,6 +16,9 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
                          this degenerates to a 1-device mesh; on CPU it uses
                          the virtual device mesh); plus sharded2d-65536, the
                          rows x word-columns 2-D mesh variant.
+  6. cluster-exchange    TCP-cluster width-k ring exchange, k=1 vs k=8
+                         (in-process frontend + 2 jax workers; the
+                         communication-avoiding ratio as a standing record).
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -308,9 +311,66 @@ def bench_sharded(size: int, steps: int = 64) -> None:
     )
 
 
+def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
+    """Config 6: the TCP cluster's width-k communication-avoiding exchange —
+    an in-process frontend + 2 workers (jax engines) stepping a size² board
+    to ``epochs`` at k=1 vs k=8, reporting both rates and the ratio.  This
+    reproduces the VERDICT round-2 #4 measurement (1.82x at 4096² on CPU)
+    as a standing artifact instead of an ad-hoc run.
+
+    Timing starts once every tile has passed the warm-up epochs (first
+    chunks compiled) so the jitted engines' one-time compile does not bias
+    the ratio toward 1."""
+    import io
+    import time as _time
+
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.runtime.harness import cluster
+    from akka_game_of_life_tpu.runtime.render import BoardObserver
+
+    warm = 8  # epochs absorbed before the timer starts (multiple of both k)
+    rates = {}
+    for k in (1, 8):
+        cfg = SimulationConfig(
+            height=size, width=size, seed=0, max_epochs=epochs + warm,
+            exchange_width=k,
+        )
+        with cluster(
+            cfg, 2, observer=BoardObserver(out=io.StringIO()), engine="jax"
+        ) as h:
+            assert h.frontend.wait_for_backends(timeout=10)
+            h.frontend.start_simulation()
+            while min(h.frontend.tile_epochs.values(), default=0) < warm:
+                _time.sleep(0.005)
+            t0 = time.perf_counter()
+            assert h.frontend.done.wait(600), "cluster bench did not finish"
+            assert h.frontend.error is None, h.frontend.error
+            rates[k] = size * size * epochs / (time.perf_counter() - t0)
+        _emit(
+            f"cluster-exchange-{size}",
+            f"cell-updates/sec aggregate, conway {size}x{size} TCP cluster "
+            f"(2 workers, jax engine, exchange_width={k})",
+            rates[k],
+            "cell-updates/sec",
+            REFERENCE_CEILING,
+        )
+    print(
+        json.dumps(
+            {
+                "config": f"cluster-exchange-{size}",
+                "metric": "width-8 / width-1 exchange throughput ratio",
+                "value": rates[8] / rates[1],
+                "unit": "x",
+                "vs_baseline": rates[8] / rates[1],
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", type=int, nargs="*", default=[1, 2, 3, 4, 5])
+    parser.add_argument("--config", type=int, nargs="*", default=[1, 2, 3, 4, 5, 6])
     parser.add_argument(
         "--scale", type=float, default=1.0,
         help="multiply grid sides by this (e.g. 0.125 for CPU smoke runs)",
@@ -340,6 +400,8 @@ def main() -> None:
         bench_pallas_gen(s(8192), "brians-brain", "generations-8192")
     if 5 in args.config:
         bench_sharded(s(65536, 32 * 8))
+    if 6 in args.config:
+        bench_cluster_exchange(s(4096))
 
 
 if __name__ == "__main__":

@@ -26,60 +26,17 @@ import jax.numpy as jnp
 
 DONE_TIMEOUT = 60
 
+# The harness lives in the package (shared with bench_suite's cluster config
+# and available to library users); re-exported here so tests keep importing
+# `cluster`/`ClusterHarness` from tests.test_cluster.
+from akka_game_of_life_tpu.runtime.harness import (  # noqa: E402
+    ClusterHarness,
+    cluster,
+)
+
 
 def dense_oracle(board, rule, steps):
     return np.asarray(get_model(rule).run(steps)(jnp.asarray(board)))
-
-
-class ClusterHarness:
-    def __init__(self, config, n_backends, observer=None, engine="numpy"):
-        # numpy engine keeps the suite fast; the jax path is covered by
-        # test_jax_engine_cluster
-        self.engine = engine
-        config.port = 0  # ephemeral: parallel tests must not fight over 2551
-        self.frontend = Frontend(config, min_backends=n_backends, observer=observer)
-        self.frontend.start()
-        self.workers = []
-        self.threads = []
-        for i in range(n_backends):
-            self.add_worker(f"w{i}")
-
-    def add_worker(self, name):
-        w = BackendWorker(
-            "127.0.0.1",
-            self.frontend.port,
-            name=name,
-            engine=self.engine,
-            retry_s=0.5,
-        )
-        w.crash_hook = w.stop  # in-thread "process death": drop the connection
-        w.connect()
-        t = threading.Thread(target=w.run, daemon=True, name=f"worker-{name}")
-        t.start()
-        self.workers.append(w)
-        self.threads.append(t)
-        return w
-
-    def run_to_completion(self):
-        assert self.frontend.wait_for_backends(timeout=5)
-        self.frontend.start_simulation()
-        assert self.frontend.done.wait(DONE_TIMEOUT), "cluster did not finish"
-        assert self.frontend.error is None, self.frontend.error
-        return self.frontend.final_board
-
-    def shutdown(self):
-        self.frontend.stop()
-        for w in self.workers:
-            w.stop()
-
-
-@contextlib.contextmanager
-def cluster(config, n_backends, observer=None, engine="numpy"):
-    h = ClusterHarness(config, n_backends, observer=observer, engine=engine)
-    try:
-        yield h
-    finally:
-        h.shutdown()
 
 
 def test_free_run_two_workers_matches_dense():
