@@ -24,3 +24,19 @@ __version__ = "0.1.0"
 
 from akka_game_of_life_tpu.ops.rules import Rule, parse_rule  # noqa: F401
 from akka_game_of_life_tpu.models.registry import get_model, list_models  # noqa: F401
+
+
+def __getattr__(name):  # lazy: keep `import akka_game_of_life_tpu` light
+    if name == "Simulation":
+        from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+        return Simulation
+    if name == "SimulationConfig":
+        from akka_game_of_life_tpu.runtime.config import SimulationConfig
+
+        return SimulationConfig
+    if name == "cluster":
+        from akka_game_of_life_tpu.runtime.harness import cluster
+
+        return cluster
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
