@@ -75,7 +75,12 @@ def load() -> Optional[ctypes.CDLL]:
         if _load_failed is not None:
             return None
         try:
+            flags = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread"]
             hasher = hashlib.sha256()
+            # The digest spans sources AND compiler argv: a flag-only change
+            # (e.g. adding -pthread) must invalidate the cached .so, or a
+            # stale binary built under the old flags loads silently.
+            hasher.update(" ".join(flags).encode())
             for src in _SRCS:
                 with open(src, "rb") as f:
                     hasher.update(f.read())
@@ -85,8 +90,7 @@ def load() -> Optional[ctypes.CDLL]:
                 os.makedirs(_BUILD_DIR, exist_ok=True)
                 tmp = so_path + f".tmp{os.getpid()}"
                 subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     *_SRCS, "-o", tmp],
+                    [*flags, *_SRCS, "-o", tmp],
                     check=True,
                     capture_output=True,
                     timeout=120,

@@ -20,8 +20,12 @@
 // Build: compiled into the shared native .so by native/__init__.py (g++ -O2,
 // no external deps).
 
+#include <algorithm>
+#include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -47,6 +51,59 @@ inline void row_triple(const uint64_t* x, uint64_t* s, uint64_t* c, int words) {
     s[i] = xw ^ e;
     c[i] = (x[i] & w) | (e & xw);
   }
+}
+
+// Row-band parallelism: both per-step phases (triple sums; combine) are
+// row-local over read-only inputs, so bands need no locks — only the join
+// between phases (phase B reads neighbor rows' phase-A output).  Threads
+// are (re)spawned per phase; at the slab sizes where threading is enabled
+// the spawn cost is noise next to the band compute.
+
+// Concurrent swar_chunk callers in this process (the in-process cluster
+// harness runs several workers as threads): each sizes its pool against
+// its share of the cores so N tiles don't spawn N * cores threads.
+std::atomic<int> g_active_chunks{0};
+
+inline int thread_count(int rows, int words) {
+  if ((int64_t)rows * words < (1 << 14)) return 1;  // small slabs: spawn cost wins
+  int t = (int)std::thread::hardware_concurrency();
+  if (const char* env = std::getenv("GOL_SWAR_THREADS")) {
+    int v = std::atoi(env);
+    if (v > 0) t = v;
+  }
+  int sharers = std::max(1, g_active_chunks.load(std::memory_order_relaxed));
+  return std::max(1, std::min({t / sharers, 16, rows / 8}));
+}
+
+template <typename Fn>
+inline void parallel_rows(int rows, int threads, const Fn& fn) {
+  if (threads <= 1) {
+    fn(0, rows);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(threads - 1);
+  int band = (rows + threads - 1) / threads;
+  try {
+    // Bands 1..n on spawned threads; band 0 runs on the calling thread
+    // below, so no core idles in join.
+    for (int t = 1; t < threads; ++t) {
+      int r0 = t * band, r1 = std::min(rows, r0 + band);
+      if (r0 >= r1) break;
+      pool.emplace_back([&, r0, r1] { fn(r0, r1); });
+    }
+  } catch (...) {
+    // Thread creation failed (e.g. cgroup task limits): join whatever
+    // started, then recompute everything serially — both phases write
+    // deterministic values from read-only inputs, so overlapping
+    // recomputation is idempotent and an exception never escapes the
+    // extern "C" boundary.
+    for (auto& th : pool) th.join();
+    fn(0, rows);
+    return;
+  }
+  fn(0, std::min(rows, band));
+  for (auto& th : pool) th.join();
 }
 
 }  // namespace
@@ -86,10 +143,18 @@ extern "C" void swar_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
   }
 
   std::vector<uint64_t> zero(words + 2, 0);
+  struct ActiveGuard {
+    ActiveGuard() { g_active_chunks.fetch_add(1, std::memory_order_relaxed); }
+    ~ActiveGuard() { g_active_chunks.fetch_sub(1, std::memory_order_relaxed); }
+  } guard;
+  const int threads = thread_count(ph, words);
   for (int step = 0; step < steps; ++step) {
-    for (int r = 0; r < ph; ++r)
-      row_triple(cur.row(r), S.row(r), C.row(r), words);
-    for (int r = 0; r < ph; ++r) {
+    parallel_rows(ph, threads, [&](int r0, int r1) {
+      for (int r = r0; r < r1; ++r)
+        row_triple(cur.row(r), S.row(r), C.row(r), words);
+    });
+    parallel_rows(ph, threads, [&](int band0, int band1) {
+    for (int r = band0; r < band1; ++r) {
       const uint64_t* sN = r > 0 ? S.row(r - 1) : zero.data() + 1;
       const uint64_t* cN = r > 0 ? C.row(r - 1) : zero.data() + 1;
       const uint64_t* sS = r < ph - 1 ? S.row(r + 1) : zero.data() + 1;
@@ -124,6 +189,7 @@ extern "C" void swar_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
       // bits >= pw must not become fake neighbors through later steps).
       if (pw & 63) o[words - 1] &= ((uint64_t)1 << (pw & 63)) - 1;
     }
+    });
     std::swap(cur.data, next.data);
   }
 

@@ -135,7 +135,7 @@ def bench_swar(size: int, steps: int = 8) -> None:
     _emit(
         f"conway-swar-{size}",
         f"cell-updates/sec, Conway {size}x{size} native C++ SWAR chunks "
-        f"({steps} steps/chunk, 1 core)",
+        f"({steps} steps/chunk, row-band threads)",
         size * size * steps / dt,
         "cell-updates/sec",
         REFERENCE_CEILING,
