@@ -92,3 +92,15 @@ def test_fault_injection_override_merging(tmp_path):
     assert cfg.fault_injection.enabled is True
     assert cfg.fault_injection.max_crashes == 7
     assert isinstance(cfg.fault_injection, FaultInjectionConfig)
+
+
+def test_top_level_lazy_exports():
+    import akka_game_of_life_tpu as gol
+
+    assert gol.Simulation.__name__ == "Simulation"
+    assert gol.SimulationConfig.__name__ == "SimulationConfig"
+    assert callable(gol.cluster)
+    import pytest
+
+    with pytest.raises(AttributeError):
+        gol.does_not_exist
