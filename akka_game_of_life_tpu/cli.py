@@ -67,6 +67,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "Mosaic temporal-blocking kernel (single device, fastest on TPU)",
     )
     p.add_argument("--pallas-block-rows", type=int)
+    p.add_argument(
+        "--pallas-vmem-limit-mb",
+        type=int,
+        help="Mosaic scoped-VMEM budget override in MB (0 = compiler default "
+        "16 MB); block_rows >= 256 at 65536-class widths needs ~20+ MB",
+    )
     p.add_argument("--halo-width", type=int)
     p.add_argument("--mesh", help="ROWSxCOLS device mesh, e.g. 4x2")
     p.add_argument("--backend", choices=["tpu", "actor", "actor-native"])
@@ -109,6 +115,7 @@ def _overrides(args: argparse.Namespace) -> dict:
         "steps_per_call": args.steps_per_call,
         "kernel": args.kernel,
         "pallas_block_rows": args.pallas_block_rows,
+        "pallas_vmem_limit_mb": args.pallas_vmem_limit_mb,
         "halo_width": args.halo_width,
         "mesh_shape": mesh,
         "backend": args.backend,

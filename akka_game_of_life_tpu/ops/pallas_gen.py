@@ -41,6 +41,7 @@ def gen_sweep_fn(
     block_rows: int = DEFAULT_BLOCK_ROWS,
     steps_per_sweep: int = DEFAULT_STEPS_PER_SWEEP,
     interpret: bool = False,
+    vmem_limit_bytes: Optional[int] = None,
 ) -> Callable[[jax.Array], jax.Array]:
     """One Pallas sweep advancing (m, H, W/32) packed planes by
     ``steps_per_sweep`` generations."""
@@ -52,6 +53,7 @@ def gen_sweep_fn(
         block_rows=block_rows,
         steps_per_sweep=steps_per_sweep,
         interpret=interpret,
+        vmem_limit_bytes=vmem_limit_bytes,
     )
 
     def sweep(planes: jax.Array) -> jax.Array:
@@ -70,6 +72,7 @@ def gen_pallas_multi_step_fn(
     block_rows: int = DEFAULT_BLOCK_ROWS,
     steps_per_sweep: Optional[int] = None,
     interpret: bool = False,
+    vmem_limit_bytes: Optional[int] = None,
 ) -> Callable[[jax.Array], jax.Array]:
     """Jitted n-step Generations advance from temporally-blocked sweeps
     (defaulting ``steps_per_sweep`` like the binary kernel)."""
@@ -85,6 +88,7 @@ def gen_pallas_multi_step_fn(
         block_rows=block_rows,
         steps_per_sweep=steps_per_sweep,
         interpret=interpret,
+        vmem_limit_bytes=vmem_limit_bytes,
     )
 
     @jax.jit

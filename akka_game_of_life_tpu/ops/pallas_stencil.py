@@ -70,6 +70,7 @@ def temporal_sweep_fn(
     block_rows: int,
     steps_per_sweep: int,
     interpret: bool,
+    vmem_limit_bytes: Optional[int] = None,
 ) -> Callable[[jax.Array], jax.Array]:
     """The shared temporally-blocked Pallas sweep over a row-tiled array
     whose LAST TWO axes are (rows, packed words), with ``n_prefix`` leading
@@ -81,6 +82,10 @@ def temporal_sweep_fn(
     the ``k`` rows actually adjacent to the center block (the last k of the
     north block, the first k of the south block).  The torus wraps through
     the halo BlockSpec ``index_map`` modulo.
+
+    ``vmem_limit_bytes`` raises Mosaic's scoped-VMEM budget past its 16 MB
+    default — required for large blocks (e.g. block_rows=256 at 65536²
+    wants ~20.5 MB of double-buffered blocks + scratch).
     """
     b, k = block_rows, steps_per_sweep
     if k < 1:
@@ -147,11 +152,17 @@ def temporal_sweep_fn(
                 memory_space=pltpu.VMEM,
             ),
         )
+        compiler_params = None
+        if vmem_limit_bytes is not None and not interpret:
+            compiler_params = pltpu.CompilerParams(
+                vmem_limit_bytes=vmem_limit_bytes
+            )
         return pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
             grid_spec=grid_spec,
             interpret=interpret,
+            compiler_params=compiler_params,
         )(x, x, x)
 
     return sweep
@@ -163,6 +174,7 @@ def packed_sweep_fn(
     block_rows: int = DEFAULT_BLOCK_ROWS,
     steps_per_sweep: int = DEFAULT_STEPS_PER_SWEEP,
     interpret: bool = False,
+    vmem_limit_bytes: Optional[int] = None,
 ) -> Callable[[jax.Array], jax.Array]:
     """One Pallas sweep advancing a packed (H, W/32) uint32 torus by
     ``steps_per_sweep`` generations.
@@ -179,6 +191,7 @@ def packed_sweep_fn(
         block_rows=block_rows,
         steps_per_sweep=steps_per_sweep,
         interpret=interpret,
+        vmem_limit_bytes=vmem_limit_bytes,
     )
 
 
@@ -190,6 +203,7 @@ def packed_multi_step_fn(
     block_rows: int = DEFAULT_BLOCK_ROWS,
     steps_per_sweep: Optional[int] = None,
     interpret: bool = False,
+    vmem_limit_bytes: Optional[int] = None,
 ) -> Callable[[jax.Array], jax.Array]:
     """Jitted n-step advance built from temporally-blocked Pallas sweeps.
 
@@ -209,6 +223,7 @@ def packed_multi_step_fn(
         block_rows=block_rows,
         steps_per_sweep=steps_per_sweep,
         interpret=interpret,
+        vmem_limit_bytes=vmem_limit_bytes,
     )
 
     @jax.jit

@@ -125,6 +125,11 @@ class SimulationConfig:
     #   auto    — bitpack when the rule/shape allow it, else dense
     kernel: str = "auto"
     pallas_block_rows: int = 64  # VMEM row-block for kernel="pallas"
+    # Mosaic scoped-VMEM budget override in MB (0 = compiler default, 16 MB).
+    # block_rows >= 256 at 65536-class widths needs ~20+ MB of double-buffered
+    # blocks, past the default limit.  Kernels take it via the
+    # pallas_vmem_limit_bytes property (None = default).
+    pallas_vmem_limit_mb: int = 0
     steps_per_call: int = 1
     halo_width: int = 1
     mesh_shape: Optional[Tuple[int, int]] = None  # None = auto-factor devices
@@ -203,6 +208,10 @@ class SimulationConfig:
                 f"pallas_block_rows={self.pallas_block_rows} must be a "
                 f"positive multiple of 8 (TPU sublane tile)"
             )
+        if self.pallas_vmem_limit_mb < 0:
+            raise ValueError(
+                f"pallas_vmem_limit_mb={self.pallas_vmem_limit_mb} must be >= 0"
+            )
         if self.role not in ("standalone", "frontend", "backend"):
             raise ValueError(f"unknown role {self.role!r}")
         if self.checkpoint_format not in ("npz", "orbax"):
@@ -225,6 +234,11 @@ class SimulationConfig:
     @property
     def shape(self) -> Tuple[int, int]:
         return (self.height, self.width)
+
+    @property
+    def pallas_vmem_limit_bytes(self) -> Optional[int]:
+        """The Mosaic VMEM budget in bytes, or None for the compiler default."""
+        return self.pallas_vmem_limit_mb * 2**20 or None
 
 
 _DURATION_FIELDS = {

@@ -340,6 +340,7 @@ class Simulation:
                             self.rule,
                             k,
                             block_rows=self.config.pallas_block_rows,
+                            vmem_limit_bytes=self.config.pallas_vmem_limit_bytes,
                             interpret=jax.default_backend() != "tpu",
                         )
                     else:
@@ -376,6 +377,7 @@ class Simulation:
                         self.rule,
                         k,
                         block_rows=self.config.pallas_block_rows,
+                        vmem_limit_bytes=self.config.pallas_vmem_limit_bytes,
                         # Mosaic needs a real TPU; everywhere else the kernel
                         # runs (slowly) in interpret mode, as documented on
                         # the config knob.

@@ -103,6 +103,11 @@ def main() -> None:
         help="pallas temporal-block depth (default: auto-pick a divisor)",
     )
     parser.add_argument(
+        "--vmem-limit-mb", type=int, default=0,
+        help="raise Mosaic's scoped-VMEM budget (MB; 0 = compiler default "
+        "16 MB) — needed for --block-rows >= 256 at 65536^2",
+    )
+    parser.add_argument(
         "--probe-timeout", type=float, default=150.0,
         help="seconds allowed for the subprocess device probe (first axon "
         "compile can take ~40s; 0 disables the probe)",
@@ -114,6 +119,8 @@ def main() -> None:
         "image's pinned platform (the real chip)",
     )
     args = parser.parse_args()
+    if args.vmem_limit_mb < 0:
+        parser.error(f"--vmem-limit-mb {args.vmem_limit_mb} must be >= 0")
 
     def _label(kernel: str) -> str:
         return (
@@ -210,6 +217,7 @@ def main() -> None:
                     args.steps_per_call,
                     block_rows=args.block_rows,
                     steps_per_sweep=args.steps_per_sweep,
+                    vmem_limit_bytes=args.vmem_limit_mb * 2**20 or None,
                 )
             else:
                 run = bitpack.packed_multi_step_fn(CONWAY, args.steps_per_call)

@@ -40,6 +40,8 @@ def test_validation():
         SimulationConfig(role="leader")
     with pytest.raises(ValueError):
         SimulationConfig(steps_per_call=3, halo_width=2)
+    with pytest.raises(ValueError):
+        SimulationConfig(pallas_vmem_limit_mb=-1)
 
 
 def test_load_toml_with_reference_spellings(tmp_path):

@@ -52,6 +52,29 @@ def test_auto_sweep_depth():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(oracle))
 
 
+def test_vmem_limit_passthrough():
+    """vmem_limit_bytes must not change results (it only resizes Mosaic's
+    scoped-VMEM budget; under interpret mode it is skipped entirely)."""
+    x = _random_packed(16, 8, seed=7)
+    oracle = bitpack.packed_multi_step_fn(resolve_rule("conway"), 4)(x)
+    got = pallas_stencil.packed_multi_step_fn(
+        resolve_rule("conway"), 4, block_rows=8, steps_per_sweep=2,
+        interpret=True, vmem_limit_bytes=64 * 2**20,
+    )(x)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(oracle))
+
+
+def test_compiler_params_api_guard():
+    """The non-interpret path builds pltpu.CompilerParams(vmem_limit_bytes=...)
+    only on real TPU hardware; guard the API surface here so a jax upgrade
+    that renames it (TPUCompilerParams -> CompilerParams happened once) fails
+    in CI, not at runtime on the chip."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    params = pltpu.CompilerParams(vmem_limit_bytes=64 * 2**20)
+    assert params.vmem_limit_bytes == 64 * 2**20
+
+
 def test_rejects_bad_configs():
     with pytest.raises(ValueError, match="binary"):
         pallas_stencil.packed_sweep_fn(BRIANS_BRAIN)
