@@ -62,9 +62,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--kernel",
         choices=["auto", "dense", "bitpack", "pallas"],
-        help="stencil kernel: auto picks bitpack (32 cells/uint32 SWAR) for "
-        "binary rules on 32-aligned widths, else dense uint8; pallas is the "
-        "Mosaic temporal-blocking kernel (single device, fastest on TPU)",
+        help="stencil kernel: auto picks the Mosaic temporal-blocking pallas "
+        "kernel on a real single-device TPU for binary rules (bitpack "
+        "fallback if Mosaic fails), else bitpack (32 cells/uint32 SWAR) on "
+        "32-aligned widths, else dense uint8",
     )
     p.add_argument("--pallas-block-rows", type=int)
     p.add_argument(

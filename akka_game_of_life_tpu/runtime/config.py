@@ -122,7 +122,10 @@ class SimulationConfig:
     #   bitpack — 32 cells/uint32 SWAR (binary rules, width % 32 == 0)
     #   pallas  — temporally-blocked Mosaic kernel (binary rules; fastest on
     #             real TPU hardware, interpret-mode elsewhere)
-    #   auto    — bitpack when the rule/shape allow it, else dense
+    #   auto    — pallas on a real single-device TPU for binary rules
+    #             (size-adaptive block rows, bitpack fallback if Mosaic
+    #             fails), else bitpack when the rule/shape allow it, else
+    #             dense
     kernel: str = "auto"
     pallas_block_rows: int = 64  # VMEM row-block for kernel="pallas"
     # Mosaic scoped-VMEM budget override in MB (0 = compiler default, 16 MB).
