@@ -64,7 +64,36 @@ print("PALLAS-TPU-OK", backend)
 """
 
 
-def test_pallas_mosaic_matches_bitpack_on_tpu():
+_AUTO_CODE = """
+import io
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+backend = jax.default_backend()
+assert backend != "cpu", f"expected a TPU backend, got {backend}"
+
+from akka_game_of_life_tpu.ops import bitpack
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+cfg = SimulationConfig(height=512, width=4096, rule="conway", seed=3,
+                       steps_per_call=16)
+sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+assert sim.kernel == "pallas", sim.kernel
+start = sim.board_host()
+sim.advance(32)
+assert sim.kernel == "pallas", "Mosaic run demoted to bitpack on real TPU"
+oracle = bitpack.unpack(
+    bitpack.packed_multi_step_fn("conway", 32)(bitpack.pack(jnp.asarray(start)))
+)
+np.testing.assert_array_equal(sim.board_host(), np.asarray(oracle))
+print("AUTO-PALLAS-TPU-OK", backend)
+"""
+
+
+def _run_on_tpu(code: str, want: str):
     env = dict(os.environ)
     env["PYTHONPATH"] = (
         str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
@@ -72,7 +101,7 @@ def test_pallas_mosaic_matches_bitpack_on_tpu():
     env.pop("JAX_PLATFORMS", None)  # default platform = the real chip
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", _CODE],
+            [sys.executable, "-c", code],
             capture_output=True,
             text=True,
             timeout=600,
@@ -84,4 +113,15 @@ def test_pallas_mosaic_matches_bitpack_on_tpu():
     if proc.returncode != 0 and "expected a TPU backend" in out:
         pytest.skip("no TPU backend available in this environment")
     assert proc.returncode == 0, out[-3000:]
-    assert "PALLAS-TPU-OK" in proc.stdout
+    assert want in proc.stdout
+
+
+def test_pallas_mosaic_matches_bitpack_on_tpu():
+    _run_on_tpu(_CODE, "PALLAS-TPU-OK")
+
+
+def test_simulation_auto_promotes_to_pallas_on_tpu():
+    """kernel=auto on the real chip must select pallas, NOT demote (a
+    demotion means the Mosaic path silently broke), and match the bitpack
+    oracle across a 32-epoch advance."""
+    _run_on_tpu(_AUTO_CODE, "AUTO-PALLAS-TPU-OK")
