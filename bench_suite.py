@@ -185,6 +185,40 @@ def bench_packed(size: int, rule: str, config: str, steps: int = 64) -> None:
     )
 
 
+def bench_pallas(size: int, rule: str, config: str, steps: int = 64) -> None:
+    """Binary rules through the Mosaic temporal-blocking kernel (real TPU
+    only — interpret mode is orders of magnitude slower and not a perf
+    datum).  The 65536² headline lives in bench.py; this line quantifies
+    the pallas-vs-bitpack gap at the mid-scale configs."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return
+    from akka_game_of_life_tpu.ops import pallas_stencil
+    from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+    block_rows = next(b for b in range(128, 7, -8) if size % b == 0)
+    rng = np.random.default_rng(0)
+    board = jnp.asarray(rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32))
+    run = pallas_stencil.packed_multi_step_fn(
+        resolve_rule(rule), steps, block_rows=block_rows
+    )
+    population = lambda x: int(jnp.sum(jnp.bitwise_count(x)))
+    dt = _time_steps(run, board, population)
+    rate = size * size * steps / dt
+    k = pallas_stencil.auto_steps_per_sweep(steps, block_rows)
+    _emit(
+        config,
+        f"cell-updates/sec/chip, {rule} {size}x{size} pallas temporal "
+        f"blocking (b={block_rows}, k={k})",
+        rate,
+        "cell-updates/sec",
+        PER_CHIP_TARGET,
+        bytes_per_cell=0.25 / k,  # one packed read+write per k generations
+    )
+
+
 def bench_packed_gen(size: int, rule: str, config: str, steps: int = 32) -> None:
     import jax.numpy as jnp
 
@@ -393,6 +427,7 @@ def main() -> None:
     if 3 in args.config:
         bench_packed(s(8192), "highlife", "lifelike-8192")
         bench_packed(s(8192), "day-and-night", "lifelike-8192")
+        bench_pallas(s(8192), "highlife", "lifelike-8192")
     if 4 in args.config:
         bench_dense(s(8192), "brians-brain", "generations-8192", steps=16)
         bench_packed_gen(s(8192), "brians-brain", "generations-8192")
