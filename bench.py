@@ -176,12 +176,18 @@ def main() -> None:
                 lambda: (
                     bench_suite.bench_packed(8192, "highlife", "lifelike-8192"),
                     bench_suite.bench_packed(8192, "day-and-night", "lifelike-8192"),
+                    bench_suite.bench_pallas(8192, "highlife", "lifelike-8192"),
                 ),
             ),
             (
                 "generations-8192",
-                lambda: bench_suite.bench_packed_gen(
-                    8192, "brians-brain", "generations-8192"
+                lambda: (
+                    bench_suite.bench_packed_gen(
+                        8192, "brians-brain", "generations-8192"
+                    ),
+                    bench_suite.bench_pallas_gen(
+                        8192, "brians-brain", "generations-8192"
+                    ),
                 ),
             ),
         ]
