@@ -1,0 +1,65 @@
+#!/bin/bash
+# One-shot TPU measurement session for the round's open hardware items.
+#
+# The axon tunnel on this image wedges for hours at a time (memory:
+# axon-tunnel-and-bench-gotchas), so every stage runs under its own hard
+# timeout and failures don't stop later stages; logs land in $OUT so a
+# killed pipe never loses output.  Run it the moment a probe succeeds:
+#
+#   bash tools/tpu_session.sh [outdir]
+#
+# Stages:
+#   0. probe        — tiny matmul; abort the session if the tunnel is wedged
+#   1. tpu-tests    — GOL_TPU_TESTS=1 (Mosaic binary + Generations kernels,
+#                     Simulation auto-promotion, all on the real chip)
+#   2. bench-full   — bench.py (all configs + pallas headline w/ fallback)
+#   3. sweep        — block_rows x vmem_limit x steps_per_sweep headline grid
+#                     (the BASELINE.md roofline question: is b=256 with a
+#                     raised Mosaic VMEM budget faster than the measured-best
+#                     b=128?)
+#   4. product-run  — the 65536^2 Conway torus through the PRODUCT CLI
+#                     (kernel=auto -> pallas) with strided render, metrics,
+#                     and packed checkpoints: the framework running its own
+#                     headline config end-to-end, not just benchmarking it.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_session}"
+mkdir -p "$OUT"
+
+stage() {  # stage <name> <timeout_s> <cmd...>
+  local name="$1" t="$2"; shift 2
+  echo "== $name (timeout ${t}s) $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
+  timeout "$t" "$@" > "$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "== $name rc=$rc $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
+  return $rc
+}
+
+stage probe 180 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((256,256), jnp.float32)
+assert float((x@x)[0,0]) == 256.0
+print('probe-ok', jax.default_backend(), jax.device_count())
+" || { echo 'tunnel wedged — aborting' | tee -a "$OUT/session.log"; exit 1; }
+
+stage tpu-tests 1800 env GOL_TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.py -v
+
+stage bench-full 2400 python bench.py
+
+# Headline sweep: measured-best b=128 vs the untried b=256 (needs the raised
+# Mosaic VMEM budget), and k=8 vs k=16 at the larger block.
+for cfg in "128 0 8" "256 64 8" "256 100 8" "256 64 16"; do
+  set -- $cfg
+  stage "sweep-b$1-v$2-k$3" 900 python bench.py --headline-only \
+    --kernel pallas --block-rows "$1" --vmem-limit-mb "$2" --steps-per-sweep "$3"
+done
+
+CKPT="$OUT/ckpt65536"
+rm -rf "$CKPT"
+stage product-run 3600 python -m akka_game_of_life_tpu run \
+  --height 65536 --width 65536 --max-epochs 256 --steps-per-call 64 \
+  --render-every 128 --metrics-every 64 \
+  --checkpoint-dir "$CKPT" --checkpoint-every 128
+
+echo "session done $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
+grep -h '"value"' "$OUT"/sweep-*.log "$OUT"/bench-full.log 2>/dev/null | tail -20
