@@ -62,3 +62,24 @@ def test_packed_gun_period_30():
 def test_packed_rejects_generations():
     with pytest.raises(ValueError):
         bitpack.step_packed(bitpack.pack(np.zeros((4, 32), np.uint8)), BRIANS_BRAIN)
+
+
+def test_random_rule_fuzz_packed_equals_dense():
+    """Seeded fuzz over the full B/S rule space: the SWAR kernel builds only
+    each rule's predicate planes (ops/bitpack.py), so coverage must not be
+    limited to the named rules — every birth/survive mask combination must
+    agree with the dense oracle, including degenerate ones (B empty, S all).
+    The pallas sweep shares step_padded_rows, so this also covers its math."""
+    rng = np.random.default_rng(11)
+    g = random_grid((16, 64), density=0.45, seed=12)
+    for trial in range(8):
+        birth = frozenset(int(i) for i in np.where(rng.random(9) < 0.4)[0])
+        survive = frozenset(int(i) for i in np.where(rng.random(9) < 0.4)[0])
+        from akka_game_of_life_tpu.ops.rules import Rule
+
+        rule = Rule(birth, survive)
+        got = np.asarray(
+            bitpack.unpack(bitpack.packed_multi_step_fn(rule, 4)(bitpack.pack(g)))
+        )
+        want = np.asarray(get_model(rule).run(4)(jnp.asarray(g)))
+        assert np.array_equal(got, want), (trial, rule.rulestring())
