@@ -24,7 +24,7 @@ connectivity costs 4 ppermutes per exchange (``parallel/halo.py``).
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -55,28 +55,49 @@ def _sharded_exchange_fn(
     steps_per_call: int,
     halo_rows: int,
     check_tile: Callable[[jax.Array], None],
+    steps_per_exchange: Optional[int] = None,
+    local_advance: Optional[Callable[[jax.Array], jax.Array]] = None,
+    halo_words: Optional[int] = None,
+    check_vma: bool = True,
 ) -> Callable[[jax.Array], jax.Array]:
-    """The shared width-k two-phase halo-exchange loop over a grid mesh.
+    """The shared two-phase halo-exchange loop over a grid mesh.
 
     Works on any array whose LAST TWO axes are (rows, word-cols) — the
     binary packed board (H, W/32) and the Generations plane stack
     (m, H, W/32) alike.  Per exchange: word-column ppermutes first, then
-    rows of the column-padded tile (corner words ride along), then ``s``
-    local steps of the *toroidal* ``step_one`` at constant shape — the
-    wraps only ever corrupt the outermost halo rows/words, which are cut
-    edges (their true neighbors live off-tile) and garbage-tolerant by
-    construction; both garbage fronts move 1 cell per step, so the
-    interior slice is exact.  Constant shapes keep the inner loop a scan —
-    compile cost is one step, not s unrolled bodies.
+    rows of the column-padded tile (corner words ride along), then the
+    local advance on the padded tile at constant shape.  All local stepping
+    is *toroidal*: the wraps only ever corrupt the outermost halo
+    rows/words, which are cut edges (their true neighbors live off-tile)
+    and garbage-tolerant by construction; both garbage fronts move 1 cell
+    per step, so the interior slice is exact.  Constant shapes keep the
+    inner loop a scan — compile cost is one step, not s unrolled bodies.
+
+    By default the local advance is ``steps_per_exchange`` applications of
+    ``step_one`` and the halo is exactly as deep as the step count; the
+    Pallas path (:mod:`..parallel.pallas_halo`) overrides ``local_advance``
+    (whole Mosaic sweeps), ``halo_rows`` (VMEM-block-aligned, deeper than
+    the step count), ``halo_words`` (0 on single-column meshes, where the
+    sweep's in-kernel word roll is the true torus wrap), and ``check_vma``
+    (the vma tracker cannot yet see through pallas_call's interpret-mode
+    discharge).
     """
-    s = halo_rows
+    s = steps_per_exchange if steps_per_exchange is not None else halo_rows
     if steps_per_call % s:
         raise ValueError(
-            f"steps_per_call={steps_per_call} must be a multiple of "
-            f"halo_rows={s}"
+            f"steps_per_call={steps_per_call} must be a multiple of the "
+            f"{s} steps per exchange"
         )
-    hw = word_halo_width(s)
+    hr = halo_rows
+    hw = word_halo_width(s) if halo_words is None else halo_words
     n_exchanges = steps_per_call // s
+    if local_advance is None:
+
+        def local_advance(padded: jax.Array) -> jax.Array:
+            out, _ = jax.lax.scan(
+                lambda p, _: (step_one(p), None), padded, None, length=s
+            )
+            return out
 
     def local(tile: jax.Array) -> jax.Array:
         check_tile(tile)
@@ -85,22 +106,24 @@ def _sharded_exchange_fn(
         def body(t, _):
             # Phase 1 — word columns; my west halo is my left neighbor's
             # easternmost words.
-            west = ring_shift(t[..., -hw:], COL_AXIS, +1)
-            east = ring_shift(t[..., :hw], COL_AXIS, -1)
-            t2 = jnp.concatenate([west, t, east], axis=col_ax)
+            if hw:
+                west = ring_shift(t[..., -hw:], COL_AXIS, +1)
+                east = ring_shift(t[..., :hw], COL_AXIS, -1)
+                t = jnp.concatenate([west, t, east], axis=col_ax)
             # Phase 2 — rows of the column-padded tile: corner words ride.
-            top = ring_shift(t2[..., -s:, :], ROW_AXIS, +1)
-            bottom = ring_shift(t2[..., :s, :], ROW_AXIS, -1)
-            padded = jnp.concatenate([top, t2, bottom], axis=row_ax)
-            padded, _ = jax.lax.scan(
-                lambda p, _: (step_one(p), None), padded, None, length=s
-            )
-            return padded[..., s:-s, hw:-hw], None
+            top = ring_shift(t[..., -hr:, :], ROW_AXIS, +1)
+            bottom = ring_shift(t[..., :hr, :], ROW_AXIS, -1)
+            padded = jnp.concatenate([top, t, bottom], axis=row_ax)
+            padded = local_advance(padded)
+            out = padded[..., hr:-hr, :]
+            return (out[..., hw:-hw] if hw else out), None
 
         out, _ = jax.lax.scan(body, tile, None, length=n_exchanges)
         return out
 
-    mapped = jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+    mapped = jax.shard_map(
+        local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=check_vma
+    )
     sharding = NamedSharding(mesh, spec)
     return jax.jit(mapped, in_shardings=sharding, out_shardings=sharding)
 

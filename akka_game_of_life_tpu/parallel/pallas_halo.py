@@ -1,0 +1,181 @@
+"""Sharded Mosaic stepping: the Pallas temporal-blocking sweep inside shard_map.
+
+The single-chip Pallas kernel (:mod:`akka_game_of_life_tpu.ops.pallas_stencil`)
+measured 8.5x the XLA bitpack path on a real v5e (BASELINE.md); this module
+carries that win to the multi-chip configuration.  The trick is the same
+garbage-front argument the XLA 2-D path uses (``parallel/packed_halo2d.py``):
+the *toroidal* sweep runs unchanged on a halo-padded tile, because its torus
+wraps only ever corrupt the outermost halo rows/words — cut edges whose true
+neighbors live off-tile and which the interior slice discards.  One Mosaic
+kernel therefore serves both the single-device path and every mesh shape.
+
+Communication-avoiding economics, per wire exchange:
+
+- the row halo is ``p = block_rows // 2`` packed rows per side — sized so the
+  padded tile stays a whole number of VMEM row blocks (``h_loc + 2p`` is a
+  multiple of ``block_rows``), which is what lets the torus sweep's BlockSpec
+  grid tile it exactly;
+- a p-row halo of current-generation rows stays valid at the interior for p
+  local steps (the garbage front advances one row per step), so each exchange
+  buys up to ``p`` generations — ``g`` back-to-back sweeps of ``k`` steps,
+  ``g*k <= p``.  At the default ``block_rows=128`` that is 64 generations per
+  ppermute round, 8x deeper than the XLA packed path's default;
+- along the column axis (only when the mesh has >1 column shard) whole uint32
+  words are exchanged; ``hw`` halo words survive ``32*hw - 1`` steps
+  (``packed_halo2d.word_halo_width``).
+
+Reference capability note: this is the end point of SURVEY.md §2 strategy 1 —
+the reference's one-actor-per-cell random scatter with ~18 network messages
+per cell per epoch (``NextStateCellGathererActor.scala:32-45``) becomes one
+4-ppermute halo round per 64 generations per tile, with all compute staged
+through VMEM by Mosaic.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax.sharding import Mesh
+
+from akka_game_of_life_tpu.ops.pallas_stencil import (
+    DEFAULT_STEPS_PER_SWEEP,
+    _round_up8,
+    packed_sweep_fn,
+)
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.parallel.mesh import COL_AXIS, GRID_SPEC
+from akka_game_of_life_tpu.parallel.packed_halo2d import (
+    _sharded_exchange_fn,
+    word_halo_width,
+)
+
+DEFAULT_BLOCK_ROWS = 128  # measured-best VMEM row block on v5e (BASELINE.md)
+
+
+def plan_exchange(
+    steps_per_call: int,
+    block_rows: int,
+    steps_per_sweep: Optional[int] = None,
+) -> tuple:
+    """Choose (k, g): sweep depth and sweeps per exchange.
+
+    ``k`` defaults to the largest divisor of ``steps_per_call`` that is <=
+    DEFAULT_STEPS_PER_SWEEP and keeps the sweep's halo blocks sublane-aligned
+    (``block_rows % round_up8(k) == 0``); ``g`` is the largest divisor of the
+    total sweep count with ``g*k <= block_rows // 2`` (the halo depth).
+    """
+    p = block_rows // 2
+    if steps_per_sweep is None:
+        candidates = [
+            d
+            for d in range(1, min(DEFAULT_STEPS_PER_SWEEP, p) + 1)
+            if steps_per_call % d == 0 and block_rows % _round_up8(d) == 0
+        ]
+        if not candidates:
+            raise ValueError(
+                f"no feasible steps_per_sweep for steps_per_call="
+                f"{steps_per_call}, block_rows={block_rows}"
+            )
+        k = max(candidates)
+    else:
+        k = steps_per_sweep
+        if steps_per_call % k:
+            raise ValueError(
+                f"steps_per_call={steps_per_call} not a multiple of "
+                f"steps_per_sweep={k}"
+            )
+        if block_rows % _round_up8(k):
+            raise ValueError(
+                f"block_rows={block_rows} must be a multiple of "
+                f"{_round_up8(k)} (steps_per_sweep={k} sublane-aligned)"
+            )
+        if k > p:
+            raise ValueError(
+                f"steps_per_sweep={k} exceeds the halo depth "
+                f"block_rows//2={p}"
+            )
+    n_sweeps = steps_per_call // k
+    g = max(d for d in range(1, n_sweeps + 1) if n_sweeps % d == 0 and d * k <= p)
+    return k, g
+
+
+def sharded_pallas_step_fn(
+    mesh: Mesh,
+    rule,
+    *,
+    steps_per_call: int = 1,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    steps_per_sweep: Optional[int] = None,
+    vmem_limit_bytes: Optional[int] = None,
+    interpret: bool = False,
+) -> Callable[[jax.Array], jax.Array]:
+    """A jitted multi-step advance of a (rows x cols)-sharded packed board
+    where the local compute is the Mosaic temporal-blocking sweep.
+
+    The board is (H, W/32) uint32 under ``GRID_SPEC``; per-shard tiles must
+    be a whole number of ``block_rows`` tall.  ``interpret=True`` runs the
+    Pallas kernel in interpret mode (CPU-testable, same numerics).
+    """
+    rule = resolve_rule(rule)
+    if not rule.is_binary:
+        raise ValueError("bit-packed kernel supports binary rules only")
+    k, g = plan_exchange(steps_per_call, block_rows, steps_per_sweep)
+    steps_per_exchange = k * g
+    p = block_rows // 2
+    cols = mesh.shape[COL_AXIS]
+    hw = word_halo_width(steps_per_exchange) if cols > 1 else 0
+    sweep = packed_sweep_fn(
+        rule,
+        block_rows=block_rows,
+        steps_per_sweep=k,
+        interpret=interpret,
+        vmem_limit_bytes=vmem_limit_bytes,
+    )
+
+    def check(tile: jax.Array) -> None:
+        h_loc, w_loc = tile.shape
+        if h_loc % block_rows:
+            raise ValueError(
+                f"per-shard tile height {h_loc} not a multiple of "
+                f"block_rows={block_rows}"
+            )
+        if hw and w_loc < hw:
+            raise ValueError(
+                f"per-shard tile has {w_loc} words < word halo {hw}; "
+                f"use fewer column shards or fewer steps per exchange"
+            )
+
+    def advance(padded: jax.Array) -> jax.Array:
+        # g back-to-back Mosaic sweeps of k generations each.  The padded
+        # tile is h_loc + 2p = h_loc + block_rows rows — a whole number of
+        # VMEM row blocks, which the torus sweep's BlockSpec grid tiles
+        # exactly.
+        out, _ = jax.lax.scan(lambda s, _: (sweep(s), None), padded, None, length=g)
+        return out
+
+    # check_vma=False: the vma tracker can't yet see through pallas_call's
+    # interpret-mode discharge (shift-by-literal mixes varying/unvarying
+    # operands and errors with "Primitive shift_left requires varying manual
+    # axes to match"); JAX's own error text prescribes this workaround.
+    # Correctness does not lean on the checker — every mesh shape is
+    # oracle-tested against the dense single-device step (test_pallas_halo).
+    jitted = _sharded_exchange_fn(
+        mesh,
+        GRID_SPEC,
+        None,
+        steps_per_call=steps_per_call,
+        halo_rows=p,
+        check_tile=check,
+        steps_per_exchange=steps_per_exchange,
+        local_advance=advance,
+        halo_words=hw,
+        check_vma=False,
+    )
+
+    def fn(board: jax.Array) -> jax.Array:
+        return jitted(board)
+
+    fn.steps_per_exchange = steps_per_exchange
+    fn.steps_per_sweep = k
+    return fn
