@@ -63,9 +63,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "--kernel",
         choices=["auto", "dense", "bitpack", "pallas"],
         help="stencil kernel: auto picks the Mosaic temporal-blocking pallas "
-        "kernel on a real single-device TPU for binary rules (bitpack "
-        "fallback if Mosaic fails), else bitpack (32 cells/uint32 SWAR) on "
-        "32-aligned widths, else dense uint8",
+        "kernel on a real TPU for binary rules, single-device or sharded "
+        "over the mesh (bitpack fallback if Mosaic fails), else bitpack "
+        "(32 cells/uint32 SWAR) on 32-aligned widths, else dense uint8",
     )
     p.add_argument("--pallas-block-rows", type=int)
     p.add_argument(

@@ -46,12 +46,16 @@ def _round_up8(n: int) -> int:
     return -(-n // 8) * 8
 
 
-def auto_steps_per_sweep(n_steps: int, block_rows: int) -> int:
-    """The largest feasible sweep depth <= DEFAULT_STEPS_PER_SWEEP that
-    divides ``n_steps`` with sublane-aligned halo blocks."""
+def auto_steps_per_sweep(
+    n_steps: int, block_rows: int, cap: int = DEFAULT_STEPS_PER_SWEEP
+) -> int:
+    """The largest feasible sweep depth <= ``cap`` that divides ``n_steps``
+    with sublane-aligned halo blocks.  The single feasibility rule lives
+    here; the sharded path (``parallel/pallas_halo.plan_exchange``) calls
+    this with its halo-depth cap rather than re-deriving the alignment."""
     candidates = [
         d
-        for d in range(1, DEFAULT_STEPS_PER_SWEEP + 1)
+        for d in range(1, cap + 1)
         if n_steps % d == 0 and block_rows % _round_up8(d) == 0
     ]
     if not candidates:

@@ -41,6 +41,7 @@ from jax.sharding import Mesh
 from akka_game_of_life_tpu.ops.pallas_stencil import (
     DEFAULT_STEPS_PER_SWEEP,
     _round_up8,
+    auto_steps_per_sweep,
     packed_sweep_fn,
 )
 from akka_game_of_life_tpu.ops.rules import resolve_rule
@@ -67,17 +68,9 @@ def plan_exchange(
     """
     p = block_rows // 2
     if steps_per_sweep is None:
-        candidates = [
-            d
-            for d in range(1, min(DEFAULT_STEPS_PER_SWEEP, p) + 1)
-            if steps_per_call % d == 0 and block_rows % _round_up8(d) == 0
-        ]
-        if not candidates:
-            raise ValueError(
-                f"no feasible steps_per_sweep for steps_per_call="
-                f"{steps_per_call}, block_rows={block_rows}"
-            )
-        k = max(candidates)
+        k = auto_steps_per_sweep(
+            steps_per_call, block_rows, cap=min(DEFAULT_STEPS_PER_SWEEP, p)
+        )
     else:
         k = steps_per_sweep
         if steps_per_call % k:

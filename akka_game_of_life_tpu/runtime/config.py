@@ -120,12 +120,14 @@ class SimulationConfig:
     # Stencil kernel on the tpu backend:
     #   dense   — uint8 roll-sum (any rule, incl. multi-state Generations)
     #   bitpack — 32 cells/uint32 SWAR (binary rules, width % 32 == 0)
-    #   pallas  — temporally-blocked Mosaic kernel (binary rules; fastest on
-    #             real TPU hardware, interpret-mode elsewhere)
-    #   auto    — pallas on a real single-device TPU for binary rules
-    #             (size-adaptive block rows, bitpack fallback if Mosaic
-    #             fails), else bitpack when the rule/shape allow it, else
-    #             dense
+    #   pallas  — temporally-blocked Mosaic kernel (fastest on real TPU
+    #             hardware, interpret-mode elsewhere); binary rules shard
+    #             over the mesh via parallel/pallas_halo.py, Generations
+    #             pallas is single-device
+    #   auto    — pallas on a real TPU for binary rules, single-device or
+    #             meshed (size-adaptive block rows, bitpack fallback if
+    #             Mosaic fails), else bitpack when the rule/shape allow it,
+    #             else dense
     kernel: str = "auto"
     pallas_block_rows: int = 64  # VMEM row-block for kernel="pallas"
     # Mosaic scoped-VMEM budget override in MB (0 = compiler default, 16 MB).

@@ -147,11 +147,14 @@ class Simulation:
 
         n_dev = len(jax.devices())
         self._n_dev = n_dev
-        # An explicit pallas kernel pins the run to one device (the Mosaic
-        # sweep owns the whole grid); an explicit mesh_shape then errors in
-        # _resolve_kernel rather than silently ignoring either request.
+        # Binary-rule pallas shards via the Mosaic sweep inside shard_map
+        # (parallel/pallas_halo.py); the Generations pallas sweep has no
+        # sharded form yet, so explicit gen pallas pins to one device — an
+        # explicit mesh_shape then errors in _resolve_kernel rather than
+        # silently ignoring either request.
+        gen_pallas = config.kernel == "pallas" and not self.rule.is_binary
         self._use_mesh = config.mesh_shape is not None or (
-            n_dev > 1 and config.kernel != "pallas"
+            n_dev > 1 and not gen_pallas
         )
         self._kernel_auto = config.kernel == "auto"
         self.kernel = self._resolve_kernel()
@@ -174,7 +177,11 @@ class Simulation:
                 # column-wise; the row ring is the natural 1-D layout
                 # (65536 rows / 8 devices = 8192-row shards on a v5e-8).
                 self.mesh = make_grid_mesh(self._packed_mesh_shape())
-                self._validate_packed_mesh()
+                if self.kernel != "pallas":
+                    # The pallas path plans its own exchange depth and was
+                    # validated by _meshed_pallas_error in _resolve_kernel;
+                    # halo_width is a bitpack-path knob irrelevant to it.
+                    self._validate_packed_mesh()
             else:
                 self.mesh = make_grid_mesh(config.mesh_shape)
                 validate_tile_shape(self.mesh, config.shape, config.halo_width)
@@ -214,28 +221,36 @@ class Simulation:
 
     def _resolve_kernel(self) -> str:
         """Pick the stencil kernel the tpu backend steps with.  ``auto``
-        prefers the Mosaic temporal-blocking Pallas kernel on a real
-        single-device TPU for binary rules (measured 8.5× the bitpack path
-        on v5e — BASELINE.md), with a call-time fallback to bitpack if the
-        Mosaic compile/run fails; elsewhere it prefers the bit-packed SWAR
-        kernel whenever the rule and shape allow, falling back to the dense
-        uint8 kernel for multi-state rules and odd widths; ``pallas`` is
-        explicit opt-in (Mosaic-compiled, single device)."""
+        prefers the Mosaic temporal-blocking Pallas kernel on a real TPU
+        for binary rules (measured 8.5× the bitpack path on v5e —
+        BASELINE.md) — single-device via the torus sweep, meshed via the
+        sharded sweep (``parallel/pallas_halo.py``) — with a call-time
+        fallback to bitpack if the Mosaic compile/run fails; elsewhere it
+        prefers the bit-packed SWAR kernel whenever the rule and shape
+        allow, falling back to the dense uint8 kernel for multi-state rules
+        and odd widths; ``pallas`` is explicit opt-in (Mosaic-compiled)."""
         cfg = self.config
         kernel = cfg.kernel
         if kernel == "auto":
             if cfg.width % 32:
                 return "dense"
             if self._use_mesh and not self._packed_mesh_fits():
+                # The bitpack feasibility gate applies even when pallas
+                # would fit: auto-pallas carries a call-time bitpack
+                # fallback, so the fallback path must be shardable too.
                 return "dense"
             if self.rule.is_binary:
                 # Generations stays on bitpack under auto: the gen Pallas
                 # kernel is interpret-verified but not yet measured faster
                 # on hardware, so only the proven binary win is defaulted.
+                b = self._auto_block_rows()
                 if (
-                    not self._use_mesh
-                    and jax.default_backend() == "tpu"
-                    and self._auto_block_rows() is not None
+                    jax.default_backend() == "tpu"
+                    and b is not None
+                    and (
+                        not self._use_mesh
+                        or self._meshed_pallas_error(b) is None
+                    )
                 ):
                     return "pallas"
                 return "bitpack"
@@ -253,24 +268,101 @@ class Simulation:
                 )
         if kernel == "pallas":
             if self._use_mesh:
-                raise ValueError(
-                    "kernel=pallas is single-device (the Mosaic sweep owns "
-                    "the whole grid); use kernel=bitpack for sharded runs"
-                )
-            if cfg.height % cfg.pallas_block_rows:
+                if not self.rule.is_binary:
+                    raise ValueError(
+                        "kernel=pallas on a mesh supports binary rules only "
+                        "(the sharded Mosaic sweep, parallel/pallas_halo.py); "
+                        "use kernel=bitpack for sharded Generations runs"
+                    )
+                err = self._meshed_pallas_error(cfg.pallas_block_rows)
+                if err is not None:
+                    if cfg.mesh_shape is None:
+                        # No mesh was asked for: a config the meshed sweep
+                        # can't shard but the single-device sweep can run
+                        # falls back to one device (the pre-sharding
+                        # behavior) instead of erroring on upgrade — and if
+                        # both forms are infeasible, the error talks about
+                        # the single-device constraint, not an implicit
+                        # mesh the user never configured.
+                        if cfg.height % cfg.pallas_block_rows:
+                            raise ValueError(
+                                f"kernel=pallas requires height % "
+                                f"pallas_block_rows ({cfg.pallas_block_rows}) "
+                                f"== 0, got {cfg.height}"
+                            )
+                        self._use_mesh = False
+                    else:
+                        raise ValueError(err)
+            elif cfg.height % cfg.pallas_block_rows:
                 raise ValueError(
                     f"kernel=pallas requires height % pallas_block_rows "
                     f"({cfg.pallas_block_rows}) == 0, got {cfg.height}"
                 )
         return kernel
 
+    def _meshed_pallas_error(self, block_rows: int) -> Optional[str]:
+        """Config-time feasibility of the sharded pallas path, or why not.
+
+        Checks everything ``sharded_pallas_step_fn`` would reject at trace
+        time — per-shard row-block alignment, a feasible exchange plan, and
+        the word-column halo fitting the per-shard words — so an invalid
+        config fails at __init__ with a ValueError, not mid-advance inside
+        jit tracing.  The word check uses the deepest exchange any chunk
+        could plan (``min(block_rows // 2, steps_per_call)``): trailing
+        partial chunks plan independently and may go deeper than the full
+        chunk's plan."""
+        from akka_game_of_life_tpu.parallel.pallas_halo import plan_exchange
+
+        cfg = self.config
+        rows, cols = self._packed_mesh_shape()
+        if cfg.height % rows:
+            return (
+                f"kernel=pallas on a {self._packed_mesh_shape()} mesh: "
+                f"height {cfg.height} does not divide evenly into {rows} "
+                f"row shards"
+            )
+        if (cfg.height // rows) % block_rows:
+            return (
+                f"kernel=pallas on a {self._packed_mesh_shape()} mesh "
+                f"requires per-shard height ({cfg.height}/{rows} = "
+                f"{cfg.height // rows}) to be a multiple of "
+                f"pallas_block_rows={block_rows}"
+            )
+        try:
+            plan_exchange(cfg.steps_per_call, block_rows)
+        except ValueError as e:
+            return f"kernel=pallas exchange plan infeasible: {e}"
+        if (cfg.width // 32) % cols:
+            return (
+                f"kernel=pallas on a {self._packed_mesh_shape()} mesh: "
+                f"{cfg.width // 32} packed words do not divide evenly "
+                f"into {cols} column shards"
+            )
+        if cols > 1:
+            hw = word_halo_width(min(block_rows // 2, cfg.steps_per_call))
+            if (cfg.width // 32) // cols < hw:
+                return (
+                    f"kernel=pallas on a {self._packed_mesh_shape()} mesh: "
+                    f"per-shard words {(cfg.width // 32) // cols} < word "
+                    f"halo {hw} (up to {min(block_rows // 2, cfg.steps_per_call)} "
+                    f"steps per exchange); use fewer column shards, a "
+                    f"smaller block, or fewer steps per call"
+                )
+        return None
+
     def _auto_block_rows(self) -> Optional[int]:
         """The VMEM row block auto-selected pallas sweeps use: the largest
-        8-multiple divisor of the grid height up to 128 (the measured-best
-        block at 65536² — BASELINE.md), or None if the height has none (then
-        auto stays on bitpack)."""
+        8-multiple divisor of the per-shard height up to 128 (the
+        measured-best block at 65536² — BASELINE.md), or None if the height
+        has none (then auto stays on bitpack)."""
+        h = self.config.height
+        if self._use_mesh:
+            rows = self._packed_mesh_shape()[0]
+            if h % rows:
+                return None
+            h //= rows
         for b in range(128, 7, -8):
-            if self.config.height % b == 0:
+            if h % b == 0:
                 return b
         return None
 
@@ -281,7 +373,10 @@ class Simulation:
         that *works*.  The first call is synced with a scalar fetch (on the
         axon platform ``block_until_ready`` does not actually block) so
         runtime failures surface here, inside the try, not at some later
-        observation fetch outside it."""
+        observation fetch outside it.  The fetch reads one element of the
+        first *addressable shard*, never the global array: on a mesh,
+        ``out.ravel()`` would force a full-board gather — and throw outright
+        on a multi-host mesh, demoting a working pallas kernel."""
         proven = False
 
         def run(x):
@@ -290,7 +385,9 @@ class Simulation:
                 return pallas_run(x)
             try:
                 out = pallas_run(x)
-                _ = np.asarray(jax.device_get(out.ravel()[0]))
+                shards = getattr(out, "addressable_shards", None)
+                probe = shards[0].data if shards else out
+                _ = np.asarray(jax.device_get(probe.ravel()[0]))
                 proven = True
                 return out
             except Exception as e:  # noqa: BLE001 — any Mosaic failure demotes
@@ -427,7 +524,23 @@ class Simulation:
                         halo_rows=self._halo_for(k),
                     )
             elif self._packed:
-                if self.mesh is not None:
+                if self.mesh is not None and self.kernel == "pallas":
+                    from akka_game_of_life_tpu.parallel.pallas_halo import (
+                        sharded_pallas_step_fn,
+                    )
+
+                    run = sharded_pallas_step_fn(
+                        self.mesh,
+                        self.rule,
+                        steps_per_call=k,
+                        block_rows=self._pallas_block_rows,
+                        vmem_limit_bytes=self.config.pallas_vmem_limit_bytes,
+                        interpret=jax.default_backend() != "tpu",
+                    )
+                    if self._kernel_auto:
+                        run = self._with_bitpack_fallback(run, k)
+                    self._steppers[k] = run
+                elif self.mesh is not None:
                     self._steppers[k] = sharded_packed2d_step_fn(
                         self.mesh,
                         self.rule,

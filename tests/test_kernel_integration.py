@@ -72,12 +72,18 @@ def test_auto_falls_back_to_dense_for_odd_width():
 def test_explicit_kernel_rejections():
     with pytest.raises(ValueError, match="width"):
         Simulation(_cfg("bitpack", width=60), observer=BoardObserver(out=io.StringIO()))
-    # pallas + multi-state is supported (the bit-plane Generations kernel);
-    # a mesh is still rejected.
+    # pallas + multi-state is supported (the bit-plane Generations kernel)
+    # but has no sharded form: it pins to one device even with 8 visible,
+    # and an explicit mesh_shape errors instead of being ignored.
     sim = Simulation(
         _cfg("pallas", rule="brians-brain"), observer=BoardObserver(out=io.StringIO())
     )
-    assert sim.kernel == "pallas" and sim._gen
+    assert sim.kernel == "pallas" and sim._gen and sim.mesh is None
+    with pytest.raises(ValueError, match="binary rules only"):
+        Simulation(
+            _cfg("pallas", rule="brians-brain", mesh_shape=(2, 1)),
+            observer=BoardObserver(out=io.StringIO()),
+        )
 
 
 def test_gen_planes_sim_matches_dense_sim(tmp_path):
@@ -207,13 +213,86 @@ def test_pack_unpack_np_roundtrip():
     assert np.array_equal(bitpack.unpack_np(words), board)
 
 
-def test_pallas_kernel_in_simulation_interpret():
-    """kernel=pallas through the Simulation surface (interpret-mode compile
-    on CPU is exercised by ops tests; here we only check selection plumbing
-    rejects meshes and accepts the single-device config)."""
-    with pytest.raises(ValueError, match="single-device"):
+def test_meshed_pallas_sim_matches_dense_sim(tmp_path):
+    """kernel=pallas on an explicit mesh: the sharded Mosaic sweep
+    (interpret mode on CPU) behind the full Simulation surface — board ≡
+    dense across render/metrics/checkpoint cadences, packed checkpoints
+    resumable by the bitpack engine."""
+    dense = Simulation(
+        _cfg("dense", tmp_path / "d", seed=31),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    meshed = Simulation(
+        _cfg(
+            "pallas",
+            tmp_path / "m",
+            seed=31,
+            mesh_shape=(8, 1),
+            pallas_block_rows=8,
+        ),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    assert meshed.kernel == "pallas" and meshed.mesh is not None
+    dense.advance(40)
+    meshed.advance(40)
+    assert np.array_equal(dense.board_host(), meshed.board_host())
+
+    # The packed checkpoint written mid-run resumes on the bitpack engine.
+    resumed = Simulation(
+        _cfg("bitpack", tmp_path / "m", seed=31),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    assert resumed.epoch == 32
+    resumed.advance(8)
+    assert np.array_equal(resumed.board_host(), dense.board_host())
+
+
+def test_meshed_pallas_rejects_misaligned_block_rows():
+    with pytest.raises(ValueError, match="per-shard height"):
         Simulation(
-            _cfg("pallas", mesh_shape=(2, 1)),
+            _cfg("pallas", mesh_shape=(8, 1), pallas_block_rows=48),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+
+
+def test_explicit_pallas_falls_back_to_single_device_when_unshardable():
+    # height=128 with the default auto (8,1) mesh gives 16-row shards that
+    # a 64-row block can't tile — but no mesh was asked for, and the
+    # single-device sweep handles 128 % 64 == 0 fine.  The pre-sharding
+    # behavior (pin to one device) must survive the upgrade.
+    sim = Simulation(
+        _cfg("pallas", height=128, width=64, pallas_block_rows=64),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    assert sim.kernel == "pallas" and sim.mesh is None
+    # An explicit mesh_shape with the same mismatch errors instead.
+    with pytest.raises(ValueError, match="pallas_block_rows"):
+        Simulation(
+            _cfg(
+                "pallas",
+                height=128,
+                width=64,
+                pallas_block_rows=64,
+                mesh_shape=(8, 1),
+            ),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+
+
+def test_meshed_pallas_rejects_word_halo_overflow():
+    # 256 cells wide / 4 column shards = 2 words per shard, but 64 steps
+    # per exchange need a 3-word halo — must fail at __init__, not at the
+    # first advance inside jit tracing.
+    with pytest.raises(ValueError, match="word halo"):
+        Simulation(
+            _cfg(
+                "pallas",
+                height=256,
+                width=256,
+                mesh_shape=(2, 4),
+                pallas_block_rows=128,
+                steps_per_call=64,
+            ),
             observer=BoardObserver(out=io.StringIO()),
         )
 

@@ -250,14 +250,36 @@ def test_auto_prefers_pallas_on_tpu_and_falls_back(monkeypatch, capsys):
     import jax
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    # The suite fakes an 8-device CPU host (conftest); auto-pallas is a
-    # single-device decision, so pin the device list down to one.
+    # The suite fakes an 8-device CPU host (conftest); pin the device list
+    # to one so this test exercises the single-device auto-pallas variant
+    # (the meshed variant has its own test below).
     one = jax.devices()[:1]
     monkeypatch.setattr(jax, "devices", lambda *a: one)
     cfg = SimulationConfig(height=48, width=64, rule="conway", seed=7, steps_per_call=4)
     sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
     assert sim.kernel == "pallas"
     assert sim._pallas_block_rows == 48  # largest 8-multiple divisor of 48
+    start = sim.board_host()
+    sim.advance(8)
+    assert sim.kernel == "bitpack"  # Mosaic can't run on CPU -> demoted
+    assert "falling back to bitpack" in capsys.readouterr().err
+    assert np.array_equal(sim.board_host(), _dense(start, "conway", 8))
+
+
+def test_auto_meshed_pallas_on_tpu_and_falls_back(monkeypatch, capsys):
+    """kernel=auto on a (faked) multi-device TPU selects the SHARDED pallas
+    path: a (8,1) row mesh, per-shard block rows, and the bitpack-fallback
+    wrapper around the sharded stepper (whose first-call probe reads one
+    addressable shard, never gathering the global board).  Mosaic then fails
+    on the CPU devices, demoting to the meshed bitpack path — trajectory
+    still ≡ dense."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    cfg = SimulationConfig(height=64, width=64, rule="conway", seed=7, steps_per_call=4)
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    assert sim.kernel == "pallas" and sim.mesh is not None
+    assert sim._pallas_block_rows == 8  # per-shard: 64 rows / 8 devices
     start = sim.board_host()
     sim.advance(8)
     assert sim.kernel == "bitpack"  # Mosaic can't run on CPU -> demoted
