@@ -40,10 +40,22 @@ from akka_game_of_life_tpu.ops.rules import resolve_rule
 
 DEFAULT_BLOCK_ROWS = 256
 DEFAULT_STEPS_PER_SWEEP = 8
+DEFAULT_BLOCK_ROWS_CAP = 128  # auto-sizing cap (measured-best; BASELINE.md)
 
 
 def _round_up8(n: int) -> int:
     return -(-n // 8) * 8
+
+
+def auto_block_rows(height: int, cap: int = DEFAULT_BLOCK_ROWS_CAP) -> Optional[int]:
+    """The VMEM row block auto-sizing rule, shared by the product runtime
+    and the bench suite: the largest 8-multiple divisor of ``height`` up to
+    ``cap`` (128 = the measured-best block at 65536² — BASELINE.md), or
+    None if the height has no 8-multiple divisor."""
+    for b in range(cap, 7, -8):
+        if height % b == 0:
+            return b
+    return None
 
 
 def auto_steps_per_sweep(

@@ -355,16 +355,15 @@ class Simulation:
         8-multiple divisor of the per-shard height up to 128 (the
         measured-best block at 65536² — BASELINE.md), or None if the height
         has none (then auto stays on bitpack)."""
+        from akka_game_of_life_tpu.ops.pallas_stencil import auto_block_rows
+
         h = self.config.height
         if self._use_mesh:
             rows = self._packed_mesh_shape()[0]
             if h % rows:
                 return None
             h //= rows
-        for b in range(128, 7, -8):
-            if h % b == 0:
-                return b
-        return None
+        return auto_block_rows(h)
 
     def _with_bitpack_fallback(self, pallas_run: Callable, k: int) -> Callable:
         """Wrap an auto-selected pallas stepper so a Mosaic compile/run

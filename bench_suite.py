@@ -198,7 +198,9 @@ def bench_pallas(size: int, rule: str, config: str, steps: int = 64) -> None:
     from akka_game_of_life_tpu.ops import pallas_stencil
     from akka_game_of_life_tpu.ops.rules import resolve_rule
 
-    block_rows = next(b for b in range(128, 7, -8) if size % b == 0)
+    block_rows = pallas_stencil.auto_block_rows(size)
+    if block_rows is None:
+        return
     rng = np.random.default_rng(0)
     board = jnp.asarray(rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32))
     run = pallas_stencil.packed_multi_step_fn(
@@ -258,10 +260,12 @@ def bench_pallas_gen(size: int, rule: str, config: str, steps: int = 32) -> None
 
     from akka_game_of_life_tpu.ops.pallas_stencil import auto_steps_per_sweep
 
+    from akka_game_of_life_tpu.ops.pallas_stencil import auto_block_rows
+
     r = resolve_rule(rule)
-    # block_rows must divide the (32-quantum) scaled height; 128 when it
-    # fits, else the largest 8-multiple divisor (every 32-multiple has one).
-    block_rows = next(b for b in (128, 64, 32, 16, 8) if size % b == 0)
+    # block_rows must divide the (32-quantum) scaled height; every
+    # 32-multiple has an 8-multiple divisor, so this never comes back None.
+    block_rows = auto_block_rows(size)
     rng = np.random.default_rng(0)
     board = rng.integers(0, r.states, size=(size, size), dtype=np.uint8)
     planes = bitpack_gen.pack_gen(jnp.asarray(board), r.states)
@@ -342,6 +346,40 @@ def bench_sharded(size: int, steps: int = 64) -> None:
         "cell-updates/sec",
         PER_CHIP_TARGET * n_dev,
         bytes_per_cell=0.25,
+    )
+
+    # Sharded Mosaic variant (real TPU only — interpret mode is not a perf
+    # datum): the same row ring stepping whole Pallas sweeps between
+    # ppermute rounds (parallel/pallas_halo.py).  On a 1-chip host this
+    # measures the shard_map wrapper's overhead over the bench.py headline.
+    if jax.default_backend() != "tpu":
+        return
+    from akka_game_of_life_tpu.parallel.pallas_halo import sharded_pallas_step_fn
+
+    from akka_game_of_life_tpu.ops.pallas_stencil import auto_block_rows
+
+    rows_mesh = make_grid_mesh((n_dev, 1))
+    block_rows = auto_block_rows(size // n_dev)
+    if block_rows is None:
+        return
+    stepp = sharded_pallas_step_fn(
+        rows_mesh, "conway", steps_per_call=steps, block_rows=block_rows
+    )
+    boardp = shard_packed2d(
+        jnp.asarray(rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32)),
+        rows_mesh,
+    )
+    dt = _time_steps(stepp, boardp, population)
+    rate = size * size * steps / dt
+    _emit(
+        "sharded-pallas-65536",
+        f"cell-updates/sec aggregate, conway {size}x{size} row-sharded "
+        f"Mosaic sweeps over {n_dev} device(s) (b={block_rows}, "
+        f"{stepp.steps_per_exchange} steps/exchange)",
+        rate,
+        "cell-updates/sec",
+        PER_CHIP_TARGET * n_dev,
+        bytes_per_cell=0.25 / stepp.steps_per_sweep,
     )
 
 
