@@ -179,6 +179,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "actor-native = the same engine compiled to machine code (C++ via "
         "ctypes)",
     )
+    be_p.add_argument(
+        "--pallas",
+        choices=["auto", "off", "interpret"],
+        default=None,
+        help="jax-engine Mosaic pin: auto (default) steps binary chunks "
+        "through the Pallas sweep on a real single-TPU worker (XLA-scan "
+        "demotion if Mosaic fails), off pins the XLA scan, interpret "
+        "forces the sweep CPU-side (testing)",
+    )
 
     args = parser.parse_args(argv)
     _apply_platform(getattr(args, "platform", None))
@@ -241,7 +250,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise SystemExit(f"backend role unavailable: {e}")
 
         return run_backend(
-            host=args.host, port=args.port, name=args.name, engine=args.engine
+            host=args.host,
+            port=args.port,
+            name=args.name,
+            engine=args.engine,
+            pallas=args.pallas,
         )
 
     return 2

@@ -64,7 +64,14 @@ class _Tile:
         self.retries = 0
 
 
-def _jax_engine(rule: Rule) -> Callable[[np.ndarray, int, int], np.ndarray]:
+# VMEM row block for the cluster's Mosaic chunk sweeps (the measured-best
+# block — BASELINE.md); slabs are junk-row-padded up to a multiple of it.
+_PALLAS_CHUNK_BLOCK = 128
+
+
+def _jax_engine(
+    rule: Rule, pallas: Optional[str] = None
+) -> Callable[[np.ndarray, int, int], np.ndarray]:
     """Jitted tile stepping on the worker's local accelerator(s).
 
     Takes a width-k halo-padded (h+2k, w+2k) slab and advances the (h, w)
@@ -75,6 +82,15 @@ def _jax_engine(rule: Rule) -> Callable[[np.ndarray, int, int], np.ndarray]:
     argument as ``parallel/packed_halo2d.py``).  This is the cluster's
     communication-avoiding engine: one exchange, k on-device epochs, zero
     per-epoch host round-trips inside the chunk.
+
+    On a single real-TPU device, binary multi-step chunks step through the
+    Mosaic temporal-blocking sweep (``ops/pallas_stencil.py``) instead of
+    the XLA packed scan — the slab is junk-row-padded up to a whole number
+    of VMEM row blocks (junk sits between the south halo and the wrapped
+    north halo, both cut edges, so with steps <= halo it never reaches the
+    interior) — with a one-time demotion to the XLA scan if Mosaic fails.
+    ``pallas`` pins the choice: None = auto, "off" disables,
+    "interpret" forces the sweep in interpret mode (CPU-testable).
 
     With more than one local device the slab is row-sharded over a 1-D local
     mesh and the scan jitted with sharding constraints — GSPMD inserts the
@@ -87,8 +103,23 @@ def _jax_engine(rule: Rule) -> Callable[[np.ndarray, int, int], np.ndarray]:
     from akka_game_of_life_tpu.ops import bitpack
     from akka_game_of_life_tpu.ops.stencil import step as stencil_step
 
+    if pallas not in (None, "auto", "off", "interpret"):
+        raise ValueError(
+            f"unknown pallas mode {pallas!r}; use auto, off, or interpret"
+        )
     devices = jax.local_devices()
-    compiled: Dict[tuple, Callable] = {}  # (steps, col_pad) → jitted chunk fn
+    if pallas == "interpret":
+        # Testing mode: force the single-device branch so the sweep really
+        # runs (the conftest's virtual 8-device host would otherwise route
+        # to the multi-device scan and silently skip the path under test).
+        devices = devices[:1]
+    compiled: Dict[tuple, Callable] = {}  # (steps, col_pad, row_pad) → chunk fn
+    use_pallas = (
+        pallas != "off"
+        and rule.is_binary
+        and len(devices) == 1
+        and (pallas == "interpret" or jax.default_backend() == "tpu")
+    )
     # Binary rules step BIT-PACKED on device (the certified-fast SWAR path —
     # VERDICT.md round-2 next #1: the cluster jax engine must run the packed
     # kernel, not only bench.py): the uint8 slab packs to uint32 words on
@@ -100,8 +131,20 @@ def _jax_engine(rule: Rule) -> Callable[[np.ndarray, int, int], np.ndarray]:
     def _use_packed(steps: int) -> bool:
         return rule.is_binary and steps >= 2
 
-    def _chunk_fn(steps: int, col_pad: int):
+    def _chunk_fn(steps: int, col_pad: int, row_pad: int = 0):
         packed = _use_packed(steps)
+        mosaic_steps = None
+        if packed and use_pallas:
+            from akka_game_of_life_tpu.ops import pallas_stencil
+
+            # The lru-cached Mosaic multi-step (sweep-count bookkeeping and
+            # validation live there); jit nesting inlines it into the chunk.
+            mosaic_steps = pallas_stencil.packed_multi_step_fn(
+                rule,
+                steps,
+                block_rows=_PALLAS_CHUNK_BLOCK,
+                interpret=pallas == "interpret",
+            )
 
         def chunk(padded):
             if packed:
@@ -112,16 +155,25 @@ def _jax_engine(rule: Rule) -> Callable[[np.ndarray, int, int], np.ndarray]:
                     # so with steps <= halo they never reach the interior
                     # slice, exactly like the junk rows below.
                     padded = jnp.pad(padded, ((0, 0), (0, col_pad)))
+                if mosaic_steps is not None and row_pad:
+                    # Junk rows up to a VMEM-block multiple for the Mosaic
+                    # sweep (same cut-edge argument, row-wise).
+                    padded = jnp.pad(padded, ((0, row_pad), (0, 0)))
                 state = bitpack.pack(padded)
                 step_one = lambda s: bitpack.step_packed(s, rule)
             else:
                 state = padded
                 step_one = lambda s: stencil_step(s, rule)
-            out, _ = jax.lax.scan(
-                lambda s, _: (step_one(s), None), state, None, length=steps
-            )
+            if mosaic_steps is not None:
+                out = mosaic_steps(state)
+            else:
+                out, _ = jax.lax.scan(
+                    lambda s, _: (step_one(s), None), state, None, length=steps
+                )
             if packed:
                 out = bitpack.unpack(out)
+                if mosaic_steps is not None and row_pad:
+                    out = out[:-row_pad]
                 if col_pad:
                     out = out[:, :-col_pad]
             return out
@@ -134,13 +186,36 @@ def _jax_engine(rule: Rule) -> Callable[[np.ndarray, int, int], np.ndarray]:
     if len(devices) == 1:
 
         def run(padded: np.ndarray, steps: int, halo: int) -> np.ndarray:
+            nonlocal use_pallas
             assert steps <= halo, (steps, halo)
-            key = (steps, _col_pad(padded.shape[1], steps))
+            mosaic = _use_packed(steps) and use_pallas
+            row_pad = (
+                (-padded.shape[0]) % _PALLAS_CHUNK_BLOCK if mosaic else 0
+            )
+            key = (steps, _col_pad(padded.shape[1], steps), row_pad)
             fn = compiled.get(key)
             if fn is None:
                 fn = compiled[key] = jax.jit(_chunk_fn(*key))
-            out = fn(jnp.asarray(padded))
-            return np.asarray(out[halo:-halo, halo:-halo])
+            try:
+                out = fn(jnp.asarray(padded))
+                return np.asarray(out[halo:-halo, halo:-halo])
+            except Exception as e:  # noqa: BLE001 — Mosaic failure demotes
+                if not mosaic:
+                    # This chunk never contained Pallas code; nothing to
+                    # demote — the error is the caller's to see.
+                    raise
+                import sys
+
+                print(
+                    f"cluster jax engine: Mosaic chunk failed "
+                    f"({type(e).__name__}: {e}); demoting this worker to "
+                    f"the XLA packed scan",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                use_pallas = False
+                compiled.clear()
+                return run(padded, steps, halo)
 
         return run
 
@@ -222,6 +297,7 @@ class BackendWorker:
         *,
         name: Optional[str] = None,
         engine: str = "jax",
+        pallas: Optional[str] = None,
         retry_s: float = 1.0,
         max_pull_retries: int = 10,
         peer_host: str = "0.0.0.0",
@@ -241,6 +317,11 @@ class BackendWorker:
         self.port = port
         self.name = name
         self.engine = engine
+        # Mosaic pin for the jax engine: None/"auto" promotes binary chunks
+        # to the Pallas sweep on a real single-TPU worker, "off" pins the
+        # XLA scan (the operator's escape hatch if Mosaic compiles but
+        # regresses), "interpret" forces the sweep CPU-side (tests).
+        self.pallas = pallas
         self.retry_s = retry_s
         self.max_pull_retries = max_pull_retries
         # DoCrashMsg → throw (CellActor.scala:95-96): default is an abrupt
@@ -556,7 +637,7 @@ class BackendWorker:
             if self.rule != rule:
                 self.rule = rule
                 if self.engine == "jax":
-                    self._step_chunk = _jax_engine(rule)
+                    self._step_chunk = _jax_engine(rule, pallas=self.pallas)
                 elif self.engine == "swar":
                     from akka_game_of_life_tpu.native.engine import swar_chunk_native
 
@@ -822,9 +903,13 @@ class BackendWorker:
 
 
 def run_backend(
-    host: str, port: int, name: Optional[str] = None, engine: str = "jax"
+    host: str,
+    port: int,
+    name: Optional[str] = None,
+    engine: str = "jax",
+    pallas: Optional[str] = None,
 ) -> int:
-    worker = BackendWorker(host, port, name=name, engine=engine)
+    worker = BackendWorker(host, port, name=name, engine=engine, pallas=pallas)
     worker.connect()
     print(f"backend {worker.name} joined {host}:{port}", flush=True)
     return worker.run()

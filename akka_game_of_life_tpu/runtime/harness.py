@@ -20,10 +20,14 @@ DONE_TIMEOUT = 60
 
 
 class ClusterHarness:
-    def __init__(self, config, n_backends, observer=None, engine="numpy"):
+    def __init__(
+        self, config, n_backends, observer=None, engine="numpy", pallas=None
+    ):
         # numpy engine keeps test suites fast and portable; pass engine="jax"
-        # (or "swar") for the accelerator/native data paths.
+        # (or "swar") for the accelerator/native data paths; pallas pins the
+        # jax engine's Mosaic mode (see BackendWorker).
         self.engine = engine
+        self.pallas = pallas
         config.port = 0  # ephemeral: parallel harnesses must not fight over 2551
         self.frontend = Frontend(config, min_backends=n_backends, observer=observer)
         self.frontend.start()
@@ -38,6 +42,7 @@ class ClusterHarness:
             self.frontend.port,
             name=name,
             engine=self.engine,
+            pallas=self.pallas,
             retry_s=0.5,
         )
         w.crash_hook = w.stop  # in-thread "process death": drop the connection
@@ -62,8 +67,10 @@ class ClusterHarness:
 
 
 @contextlib.contextmanager
-def cluster(config, n_backends, observer=None, engine="numpy"):
-    h = ClusterHarness(config, n_backends, observer=observer, engine=engine)
+def cluster(config, n_backends, observer=None, engine="numpy", pallas=None):
+    h = ClusterHarness(
+        config, n_backends, observer=observer, engine=engine, pallas=pallas
+    )
     try:
         yield h
     finally:

@@ -1,0 +1,112 @@
+"""The cluster jax engine's Mosaic chunk path (interpret mode) vs the numpy
+peeling oracle.
+
+On a real single-TPU worker, binary multi-step chunks step through the
+temporally-blocked Pallas sweep with junk-row padding up to a VMEM-block
+multiple (``runtime/backend.py _jax_engine``); these tests force that path
+with ``pallas="interpret"`` on CPU and pin it bit-exact against
+``_np_chunk`` across awkward slab shapes, then prove the one-time demotion
+path keeps the engine alive when Mosaic fails.
+"""
+
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.runtime.backend import _jax_engine, _np_chunk
+
+
+@pytest.mark.parametrize(
+    "h,w,steps,halo",
+    [
+        (40, 40, 4, 4),  # h+2k=48 rows -> 80 junk rows to reach 128
+        (120, 56, 8, 8),  # 136 rows -> 120 junk; odd width -> col junk too
+        (250, 70, 2, 5),  # steps < halo, non-multiple-of-anything slab
+    ],
+)
+@pytest.mark.parametrize("rule", ["conway", "highlife"])
+def test_pallas_chunk_matches_np_oracle(h, w, steps, halo, rule):
+    rng = np.random.default_rng(h + w + steps)
+    padded = rng.integers(0, 2, size=(h + 2 * halo, w + 2 * halo), dtype=np.uint8)
+    run = _jax_engine(resolve_rule(rule), pallas="interpret")
+    got = run(padded, steps, halo)
+    want = _np_chunk(padded, steps, halo, resolve_rule(rule))
+    assert got.shape == (h, w)
+    np.testing.assert_array_equal(got, want, err_msg=f"{rule} {h}x{w}")
+
+
+def test_pallas_chunk_engine_caches_and_repeats():
+    # Second call with the same shape reuses the compiled sweep; a different
+    # steps value compiles a sibling entry — both stay exact.
+    rule = resolve_rule("conway")
+    run = _jax_engine(rule, pallas="interpret")
+    rng = np.random.default_rng(0)
+    padded = rng.integers(0, 2, size=(48, 48), dtype=np.uint8)
+    for steps in (4, 4, 2):
+        got = run(padded, steps, 8)
+        np.testing.assert_array_equal(got, _np_chunk(padded, steps, 8, rule))
+
+
+def test_mosaic_failure_demotes_to_xla_scan(monkeypatch, capsys):
+    # Force the sweep to blow up at call time: the engine must log, demote
+    # once, and produce the exact XLA-scan result, not crash the worker.
+    # (Monkeypatch the lru-cached multi-step factory, not packed_sweep_fn —
+    # replacing the inner function would poison the cache for later tests.)
+    from akka_game_of_life_tpu.ops import pallas_stencil
+
+    def boom(*a, **kw):
+        def steps_fn(x):
+            raise RuntimeError("mosaic says no")
+
+        return steps_fn
+
+    monkeypatch.setattr(pallas_stencil, "packed_multi_step_fn", boom)
+    rule = resolve_rule("conway")
+    run = _jax_engine(rule, pallas="interpret")
+    rng = np.random.default_rng(1)
+    padded = rng.integers(0, 2, size=(40, 40), dtype=np.uint8)
+    got = run(padded, 4, 4)
+    np.testing.assert_array_equal(got, _np_chunk(padded, 4, 4, rule))
+    assert "demoting this worker" in capsys.readouterr().err
+
+
+def test_unknown_pallas_mode_rejected():
+    with pytest.raises(ValueError, match="pallas mode"):
+        _jax_engine(resolve_rule("conway"), pallas="interperet")
+
+
+def test_cluster_protocol_with_mosaic_chunks():
+    """The Mosaic chunk engine through the FULL cluster protocol (width-4
+    exchanges, 2 workers, interpret mode): trajectory ≡ dense oracle."""
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.runtime.harness import cluster
+    from akka_game_of_life_tpu.runtime.simulation import initial_board
+    from akka_game_of_life_tpu.models import get_model
+
+    import jax.numpy as jnp
+
+    cfg = SimulationConfig(
+        height=32, width=32, seed=17, max_epochs=16, exchange_width=4
+    )
+    with cluster(cfg, 2, engine="jax", pallas="interpret") as h:
+        final = h.run_to_completion()
+    oracle = np.asarray(
+        get_model("conway").run(16)(jnp.asarray(initial_board(cfg)))
+    )
+    np.testing.assert_array_equal(final, oracle)
+
+
+def test_pallas_off_and_gen_rules_keep_xla_path():
+    # pallas="off" and multi-state rules never touch the sweep.
+    rule = resolve_rule("brians-brain")
+    run = _jax_engine(rule, pallas="interpret")  # gen rule -> no pallas anyway
+    rng = np.random.default_rng(2)
+    padded = rng.integers(0, 3, size=(24, 24), dtype=np.uint8)
+    np.testing.assert_array_equal(run(padded, 2, 4), _np_chunk(padded, 2, 4, rule))
+
+    conway = resolve_rule("conway")
+    run_off = _jax_engine(conway, pallas="off")
+    padded2 = rng.integers(0, 2, size=(24, 24), dtype=np.uint8)
+    np.testing.assert_array_equal(
+        run_off(padded2, 2, 4), _np_chunk(padded2, 2, 4, conway)
+    )

@@ -156,6 +156,18 @@ got2 = np.asarray(
     )(x2)
 )
 np.testing.assert_array_equal(got2, oracle2)
+
+# 3) The cluster jax engine's Mosaic chunk path on the real chip (junk-row
+# padding to VMEM-block multiples, junk cols to a 32-multiple): the worker
+# data path must hold the pallas promotion, not silently demote.
+from akka_game_of_life_tpu.runtime.backend import _jax_engine, _np_chunk
+from akka_game_of_life_tpu.ops.rules import resolve_rule as _rr
+
+rule = _rr("conway")
+padded = rng.integers(0, 2, size=(250, 70), dtype=np.uint8).astype(np.uint8)
+chunk_run = _jax_engine(rule)
+got3 = chunk_run(padded, 5, 5)
+np.testing.assert_array_equal(got3, _np_chunk(padded, 5, 5, rule))
 print("SHARDED-PALLAS-TPU-OK", backend, n)
 """
 
