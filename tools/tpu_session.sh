@@ -8,19 +8,21 @@
 #
 #   bash tools/tpu_session.sh [outdir]
 #
-# Stages:
+# Already answered this round (first session, 2026-07-30, logs in
+# /tmp/tpu_session_r3 and BASELINE.md): headline b=128/k=8 = 1.79e12;
+# b=256 with raised VMEM budgets is slower; TPU tests green; bench-full
+# recorded every config line.  Remaining stages below:
 #   0. probe        — tiny matmul; abort the session if the tunnel is wedged
-#   1. tpu-tests    — GOL_TPU_TESTS=1 (Mosaic binary + Generations kernels,
-#                     Simulation auto-promotion, all on the real chip)
-#   2. bench-full   — bench.py (all configs + pallas headline w/ fallback)
-#   3. sweep        — block_rows x vmem_limit x steps_per_sweep headline grid
-#                     (the BASELINE.md roofline question: is b=256 with a
-#                     raised Mosaic VMEM budget faster than the measured-best
-#                     b=128?)
-#   4. product-run  — the 65536^2 Conway torus through the PRODUCT CLI
+#   1. tpu-tests    — GOL_TPU_TESTS=1, now incl. the SHARDED Mosaic paths
+#                     (shard_map + pallas_call, non-lane-aligned widths,
+#                     cluster Mosaic chunk engine) on the real chip
+#   2. bench-sharded— bench_suite config 5 (adds the sharded-pallas line)
+#   3. product-run  — the 65536^2 Conway torus through the PRODUCT CLI
 #                     (kernel=auto -> pallas) with strided render, metrics,
 #                     and packed checkpoints: the framework running its own
 #                     headline config end-to-end, not just benchmarking it.
+#                     (First session: tunnel wedged before this stage ran.)
+#   4. bench-full   — refresh the full bench.py record with the current tree
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-/tmp/tpu_session}"
@@ -44,15 +46,7 @@ print('probe-ok', jax.default_backend(), jax.device_count())
 
 stage tpu-tests 1800 env GOL_TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.py -v
 
-stage bench-full 2400 python bench.py
-
-# Headline sweep: measured-best b=128 vs the untried b=256 (needs the raised
-# Mosaic VMEM budget), and k=8 vs k=16 at the larger block.
-for cfg in "128 0 8" "256 64 8" "256 100 8" "256 64 16"; do
-  set -- $cfg
-  stage "sweep-b$1-v$2-k$3" 900 python bench.py --headline-only \
-    --kernel pallas --block-rows "$1" --vmem-limit-mb "$2" --steps-per-sweep "$3"
-done
+stage bench-sharded 1200 python bench_suite.py --config 5
 
 CKPT="$OUT/ckpt65536"
 rm -rf "$CKPT"
@@ -61,5 +55,7 @@ stage product-run 3600 python -m akka_game_of_life_tpu run \
   --render-every 128 --metrics-every 64 \
   --checkpoint-dir "$CKPT" --checkpoint-every 128
 
+stage bench-full 2400 python bench.py
+
 echo "session done $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
-grep -h '"value"' "$OUT"/sweep-*.log "$OUT"/bench-full.log 2>/dev/null | tail -20
+grep -h '"value"' "$OUT"/bench-*.log 2>/dev/null | tail -20
