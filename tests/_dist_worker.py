@@ -113,5 +113,27 @@ np.testing.assert_array_equal(
     np.asarray(multi_step(jnp.asarray(initial_board(chaos_cfg)), "conway", 12)),
 )
 
+# -- sharded Mosaic over the cross-host mesh ---------------------------------
+# The Pallas temporal-blocking sweep inside shard_map (interpret mode — same
+# numerics as the TPU Mosaic compile) with its halo ppermutes crossing the
+# process boundary via gloo: proves the multi-host + Mosaic composition the
+# pod-scale story needs (each host's devices sweep their tiles in VMEM-block
+# units while the ring exchange spans DCN).
+from akka_game_of_life_tpu.ops import bitpack  # noqa: E402
+from akka_game_of_life_tpu.parallel.pallas_halo import (  # noqa: E402
+    sharded_pallas_step_fn,
+)
+
+pboard = random_grid((32, 64), seed=9)  # (2,2) mesh: 16-row, 1-word shards
+pstep = sharded_pallas_step_fn(
+    mesh, "conway", steps_per_call=8, block_rows=16, interpret=True
+)
+parr = distributed.make_global_array(np.asarray(bitpack.pack_np(pboard)), mesh)
+pout = distributed.fetch(pstep(parr))
+np.testing.assert_array_equal(
+    bitpack.unpack_np(np.asarray(pout, dtype=np.uint32)),
+    np.asarray(multi_step(jnp.asarray(pboard), "conway", 8)),
+)
+
 distributed.barrier("done")
 print(f"DIST-OK rank={pid}", flush=True)

@@ -3,9 +3,11 @@
 The pod-scale path SURVEY.md §2 calls for (`jax.distributed` over DCN for
 multi-host meshes): two OS processes, each with 2 virtual CPU devices, form
 one 4-device global mesh; halo ppermutes cross the process boundary through
-gloo collectives — the CPU stand-in for ICI/DCN.  Asserts both the raw
-sharded kernel and the Simulation runtime produce the dense oracle's board
-(VERDICT.md missing #5 / next-round #8)."""
+gloo collectives — the CPU stand-in for ICI/DCN.  Asserts the raw sharded
+kernel, the Simulation runtime, epoch-indexed lockstep chaos, and the
+sharded Mosaic sweep (Pallas inside shard_map, interpret mode) all produce
+the dense oracle's board across the process boundary (VERDICT.md missing
+#5 / next-round #8)."""
 
 import socket
 import subprocess
