@@ -626,9 +626,9 @@ class Simulation:
                 jitted = jax.jit(auto_axes(core, out_sharding=PartitionSpec()))
                 mesh = self.mesh
 
-                def call(b):
+                def call(*args):
                     with jax.set_mesh(mesh):
-                        return jitted(b)
+                        return jitted(*args)
 
                 self._obs_fns[name] = call
             else:
@@ -792,6 +792,60 @@ class Simulation:
         if npz and jax.process_count() > 1:
             # Rank 0's side of the durability barrier (see the gated branch).
             dist.barrier(f"ckpt-{self.epoch}")
+
+    def board_window(self, y0: int, y1: int, x0: int, x1: int) -> np.ndarray:
+        """A (y1-y0, x1-x0) uint8 window of the board, computed device-side
+        and fetched O(window) — never O(board).  The at-scale correctness
+        probe: a Gosper-gun region at 65536², where ``board_host()`` would
+        gather 4 GiB, costs a few hundred bytes (the north-star criterion —
+        gun period preserved across kill/restart — stays checkable at the
+        headline size).  Works on every kernel/mesh combination; on a mesh
+        the slice runs under ``auto_axes`` with a replicated output like the
+        render sample."""
+        if not (0 <= y0 < y1 <= self.config.height):
+            raise ValueError(f"bad row window [{y0}, {y1})")
+        if not (0 <= x0 < x1 <= self.config.width):
+            raise ValueError(f"bad col window [{x0}, {x1})")
+        if self._actor_board is not None:
+            return np.asarray(self.board[y0:y1, x0:x1])
+        # The slice cores take the offsets as TRACED scalars and cache by
+        # window SHAPE only — a probe that moves across the board (glider
+        # tracking) reuses one compiled executable instead of leaking a
+        # fresh jit per position.
+        if self._packed or self._gen:
+            # Packed: slice whole uint32 word columns on device, unpack the
+            # tiny host copy, trim to the exact cell window.
+            w0, w1 = x0 // bitpack.LANE_BITS, -(-x1 // bitpack.LANE_BITS)
+            rows, wws = y1 - y0, w1 - w0
+            if self._gen:
+                m = bitpack_gen.n_planes(self.rule.states)
+                core = lambda b, r0, c0: jax.lax.dynamic_slice(
+                    b, (0, r0, c0), (m, rows, wws)
+                )
+                name = f"win_gen_{rows}x{wws}"
+            else:
+                core = lambda b, r0, c0: jax.lax.dynamic_slice(
+                    b, (r0, c0), (rows, wws)
+                )
+                name = f"win_packed_{rows}x{wws}"
+            words = np.asarray(
+                dist.fetch(self._obs_fn(name, core)(self.board, y0, w0)),
+                dtype=np.uint32,
+            )
+            cells = (
+                bitpack_gen.unpack_gen_np(words)
+                if self._gen
+                else bitpack.unpack_np(words)
+            )
+            off = x0 - w0 * bitpack.LANE_BITS
+            return cells[:, off : off + (x1 - x0)]
+        rows, cols = y1 - y0, x1 - x0
+        core = lambda b, r0, c0: jax.lax.dynamic_slice(b, (r0, c0), (rows, cols))
+        return np.asarray(
+            dist.fetch(
+                self._obs_fn(f"win_dense_{rows}x{cols}", core)(self.board, y0, x0)
+            )
+        )
 
     def board_host(self) -> np.ndarray:
         """The full board as host uint8 — O(board); for final renders, tests,
