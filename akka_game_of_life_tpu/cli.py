@@ -81,6 +81,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-every", type=int)
     p.add_argument("--checkpoint-format", choices=["npz", "orbax"])
     p.add_argument("--render-every", type=int)
+    p.add_argument(
+        "--probe-window",
+        default=None,
+        help="exact-cell probe window printed at render cadence, as "
+        "Y0:Y1,X0:X1 (e.g. 8:17,8:44 — the Gosper-gun bbox at offset 8,8); "
+        "fetched O(window), usable at 65536²",
+    )
     p.add_argument("--render-max-cells", type=int)
     p.add_argument("--metrics-every", type=int)
     p.add_argument("--log-file")
@@ -97,6 +104,21 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--coordinator", metavar="HOST:PORT")
     p.add_argument("--num-processes", type=int)
     p.add_argument("--process-id", type=int)
+
+
+def _parse_window(spec):
+    """"Y0:Y1,X0:X1" → (y0, y1, x0, x1); None passes through."""
+    if spec is None:
+        return None
+    try:
+        rows, cols = spec.split(",")
+        y0, y1 = (int(v) for v in rows.split(":"))
+        x0, x1 = (int(v) for v in cols.split(":"))
+    except ValueError:
+        raise SystemExit(
+            f"bad --probe-window {spec!r}; expected Y0:Y1,X0:X1 (e.g. 8:17,8:44)"
+        )
+    return (y0, y1, x0, x1)
 
 
 def _overrides(args: argparse.Namespace) -> dict:
@@ -125,6 +147,7 @@ def _overrides(args: argparse.Namespace) -> dict:
         "checkpoint_format": args.checkpoint_format,
         "render_every": args.render_every,
         "render_max_cells": args.render_max_cells,
+        "probe_window": _parse_window(args.probe_window),
         "metrics_every": args.metrics_every,
         "log_file": args.log_file,
         "distributed": args.distributed,
@@ -236,6 +259,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ),
         )
         cfg = load_config(args.config, overrides)
+        if cfg.probe_window is not None:
+            raise SystemExit(
+                "--probe-window is a standalone-run feature (Simulation."
+                "board_window); the cluster frontend renders sampled tile "
+                "frames instead"
+            )
         try:
             from akka_game_of_life_tpu.runtime.frontend import run_frontend
         except ImportError as e:  # pragma: no cover

@@ -191,6 +191,10 @@ class SimulationConfig:
     # Rendering / observability (LoggerActor capability).
     render_every: int = 0  # epochs between rendered frames; 0 = never
     render_max_cells: int = 128  # stride-sample larger boards down to this
+    # An exact-cell probe window (y0, y1, x0, x1) printed at render cadence —
+    # the at-scale correctness view (e.g. the Gosper-gun region of a 65536²
+    # run), fetched O(window) via Simulation.board_window.  None = off.
+    probe_window: Optional[Tuple[int, int, int, int]] = None
     log_file: Optional[str] = None  # reference renders to info.log
     metrics_every: int = 0
 
@@ -217,6 +221,13 @@ class SimulationConfig:
             raise ValueError(
                 f"pallas_vmem_limit_mb={self.pallas_vmem_limit_mb} must be >= 0"
             )
+        if self.probe_window is not None:
+            y0, y1, x0, x1 = self.probe_window
+            if not (0 <= y0 < y1 <= self.height and 0 <= x0 < x1 <= self.width):
+                raise ValueError(
+                    f"probe_window {self.probe_window} out of bounds for "
+                    f"{self.height}x{self.width}"
+                )
         if self.role not in ("standalone", "frontend", "backend"):
             raise ValueError(f"unknown role {self.role!r}")
         if self.checkpoint_format not in ("npz", "orbax"):
@@ -340,6 +351,8 @@ def load_config(
         merged["mesh_shape"] = tuple(merged["mesh_shape"])
     if "pattern_offset" in merged:
         merged["pattern_offset"] = tuple(merged["pattern_offset"])
+    if "probe_window" in merged and merged["probe_window"] is not None:
+        merged["probe_window"] = tuple(merged["probe_window"])
     return SimulationConfig(
         fault_injection=FaultInjectionConfig(**fi_kwargs), **merged
     )

@@ -179,6 +179,20 @@ class BoardObserver:
                 flush=True,
             )
 
+    def observe_window(
+        self, epoch: int, window: np.ndarray, bbox: Tuple[int, int, int, int]
+    ) -> None:
+        """An exact-cell probe window (``Simulation.board_window``) at render
+        cadence — the at-scale correctness view: e.g. the Gosper-gun region
+        of a 65536² run, bytes on the wire where a frame would be 4 GiB."""
+        y0, y1, x0, x1 = bbox
+        print(
+            f"epoch {epoch}: window [{y0}:{y1}, {x0}:{x1}] "
+            f"pop={int(np.count_nonzero(window))}\n" + ascii_rows(window),
+            file=self.out,
+            flush=True,
+        )
+
     # -- tiled path (distributed control plane) ------------------------------
 
     def expect_tiles(self, n: int) -> None:

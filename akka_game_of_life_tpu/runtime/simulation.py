@@ -635,6 +635,18 @@ class Simulation:
                 self._obs_fns[name] = jax.jit(core)
         return self._obs_fns[name]
 
+    def _probe_due(self, render: bool) -> bool:
+        """Window probes follow the same gate as rendered frames (an exact
+        ``render_every`` multiple) so probe epochs always line up with frame
+        epochs — and a suppressed frame never pays a window fetch."""
+        cfg = self.config
+        return (
+            render
+            and cfg.probe_window is not None
+            and cfg.render_every > 0
+            and self.epoch % cfg.render_every == 0
+        )
+
     def _observe(self, *, render: bool) -> None:
         """Population (always) and a strided render probe (at render cadence),
         both computed on device; only an (H,)-row-count vector and a
@@ -644,6 +656,12 @@ class Simulation:
         if self._actor_board is not None:
             if jax.process_index() == 0:
                 self.observer.observe(self.epoch, np.asarray(self.board))
+                if self._probe_due(render):
+                    self.observer.observe_window(
+                        self.epoch,
+                        self.board_window(*self.config.probe_window),
+                        self.config.probe_window,
+                    )
             return
         cfg = self.config
         from akka_game_of_life_tpu.runtime.render import sample_strides
@@ -681,10 +699,13 @@ class Simulation:
             view = dist.fetch(
                 self._obs_fn(f"sample_{sy}_{sx}", sample_core)(self.board)
             )
+        win = self.board_window(*cfg.probe_window) if self._probe_due(render) else None
         if jax.process_index() == 0:
             self.observer.observe_summary(
                 self.epoch, population, cfg.shape, view, (sy, sx)
             )
+            if win is not None:
+                self.observer.observe_window(self.epoch, win, cfg.probe_window)
 
     # -- failure & recovery --------------------------------------------------
 

@@ -57,6 +57,64 @@ def test_window_rejects_bad_bounds():
         sim.board_window(0, 8, 60, 70)
 
 
+def test_probe_window_through_observer():
+    # probe_window config: the window prints at render cadence with its
+    # bbox and population; contents equal the board slice.
+    out = io.StringIO()
+    sim = Simulation(
+        SimulationConfig(
+            height=64,
+            width=64,
+            pattern="gosper-glider-gun",
+            pattern_offset=(4, 4),
+            kernel="bitpack",
+            steps_per_call=30,
+            render_every=30,
+            probe_window=(4, 13, 4, 40),
+        ),
+        observer=BoardObserver(out=out, render_every=30),
+    )
+    sim.advance(30)
+    text = out.getvalue()
+    assert "window [4:13, 4:40]" in text and "pop=36" in text
+
+
+def test_probe_window_on_actor_backend_and_cadence_gate():
+    # The actor backends print windows too (no silent no-op), and a probe
+    # never fires at an epoch that is not a render_every multiple even when
+    # steps_per_call does not divide it.
+    out = io.StringIO()
+    sim = Simulation(
+        SimulationConfig(
+            height=24,
+            width=24,
+            pattern="glider",
+            backend="actor",
+            steps_per_call=7,
+            render_every=10,
+            probe_window=(0, 8, 0, 8),
+        ),
+        observer=BoardObserver(out=out, render_every=10),
+    )
+    sim.advance(21)  # crossings at 14 and 21 — neither is a multiple of 10
+    assert "window" not in out.getvalue()
+    sim.advance(9)  # epoch 30: exact multiple
+    assert "epoch 30: window [0:8, 0:8]" in out.getvalue()
+
+
+def test_probe_window_validation_and_cli_parse():
+    import pytest
+
+    with pytest.raises(ValueError, match="probe_window"):
+        SimulationConfig(height=32, width=32, probe_window=(0, 40, 0, 8))
+    from akka_game_of_life_tpu.cli import _parse_window
+
+    assert _parse_window("8:17,8:44") == (8, 17, 8, 44)
+    assert _parse_window(None) is None
+    with pytest.raises(SystemExit, match="probe-window"):
+        _parse_window("8-17")
+
+
 def test_gun_phase_at_scale_across_chaos(tmp_path):
     """The north-star criterion, probed the at-scale way: a Gosper gun in a
     2048² bit-packed torus, crash injected + replayed mid-run, gun window

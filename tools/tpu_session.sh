@@ -48,12 +48,17 @@ stage tpu-tests 1800 env GOL_TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.
 
 stage bench-sharded 1200 python bench_suite.py --config 5
 
+# The 65536^2 headline config through the product CLI with a Gosper gun and
+# an exact-cell probe window at its bbox (pattern offset defaults to 2,2):
+# every rendered window at a 60-epoch cadence (period 30 multiple) must show
+# the gun in phase — the north-star criterion verified AT the headline size.
 CKPT="$OUT/ckpt65536"
 rm -rf "$CKPT"
 stage product-run 3600 python -m akka_game_of_life_tpu run \
-  --height 65536 --width 65536 --max-epochs 256 --steps-per-call 64 \
-  --render-every 128 --metrics-every 64 \
-  --checkpoint-dir "$CKPT" --checkpoint-every 128
+  --height 65536 --width 65536 --max-epochs 240 --steps-per-call 60 \
+  --pattern gosper-glider-gun --probe-window 2:11,2:38 \
+  --render-every 60 --metrics-every 60 \
+  --checkpoint-dir "$CKPT" --checkpoint-every 120
 
 stage bench-full 2400 python bench.py
 
