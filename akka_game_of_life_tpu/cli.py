@@ -186,6 +186,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "per tile (communication-avoiding; cadences must be multiples of k)",
     )
 
+    st_p = sub.add_parser(
+        "selftest",
+        help="verify this machine end-to-end: gun phase, oracle equivalence, "
+        "checkpoint resume, chaos replay, sharding (the reference's manual "
+        "procedure, automated)",
+    )
+    _add_platform(st_p)
+    st_p.add_argument(
+        "--kernel",
+        choices=["auto", "dense", "bitpack", "pallas"],
+        default="auto",
+        help="kernel the checks drive (default auto — what `run` would pick)",
+    )
+
     be_p = sub.add_parser("backend", help="control-plane worker (RunBackend)")
     be_p.add_argument("--port", type=int, default=2551, help="frontend port to join")
     be_p.add_argument("--host", default="127.0.0.1")
@@ -271,6 +285,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise SystemExit(f"frontend role unavailable: {e}")
 
         return run_frontend(cfg, min_backends=args.min_backends)
+
+    if args.command == "selftest":
+        from akka_game_of_life_tpu.runtime.selftest import run_selftest
+
+        return 1 if run_selftest(kernel=args.kernel) else 0
 
     if args.command == "backend":
         try:

@@ -1,0 +1,41 @@
+"""The product selftest command: all checks green on the suite's 8-device
+CPU mesh, failures counted not raised, JSON lines parseable."""
+
+import json
+
+from akka_game_of_life_tpu.runtime import selftest
+
+
+def _run(kernel):
+    lines = []
+    failures = selftest.run_selftest(kernel=kernel, out=lines.append)
+    return failures, [json.loads(line) for line in lines]
+
+
+def test_selftest_green_on_bitpack():
+    failures, recs = _run("bitpack")
+    assert failures == 0
+    assert [r["check"] for r in recs] == [name for name, _ in selftest.CHECKS]
+    assert all(r["status"] == "pass" for r in recs), recs
+
+
+def test_selftest_green_on_dense_and_auto():
+    for kernel in ("dense", "auto"):
+        failures, recs = _run(kernel)
+        assert failures == 0, (kernel, recs)
+        # sharded may pass or skip depending on what auto resolves to, but
+        # nothing may fail.
+        assert all(r["status"] in ("pass", "skip") for r in recs), (kernel, recs)
+
+
+def test_selftest_counts_failures_without_raising(monkeypatch):
+    def bad(kernel):
+        raise AssertionError("intentional")
+
+    monkeypatch.setattr(
+        selftest, "CHECKS", [("boom", bad)] + selftest.CHECKS[1:2]
+    )
+    failures, recs = _run("bitpack")
+    assert failures == 1
+    assert recs[0]["status"] == "fail" and "intentional" in recs[0]["error"]
+    assert recs[1]["status"] == "pass"

@@ -48,6 +48,10 @@ stage tpu-tests 1800 env GOL_TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.
 
 stage bench-sharded 1200 python bench_suite.py --config 5
 
+# Product selftest on the real chip: kernel=auto resolves to pallas, so
+# gun phase / oracle / checkpoint / chaos all exercise the Mosaic kernel.
+stage selftest 900 python -m akka_game_of_life_tpu selftest
+
 # The 65536^2 headline config through the product CLI with a Gosper gun and
 # an exact-cell probe window at its bbox (pattern offset defaults to 2,2):
 # every rendered window at a 60-epoch cadence (period 30 multiple) must show
