@@ -273,12 +273,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ),
         )
         cfg = load_config(args.config, overrides)
-        if cfg.probe_window is not None:
-            raise SystemExit(
-                "--probe-window is a standalone-run feature (Simulation."
-                "board_window); the cluster frontend renders sampled tile "
-                "frames instead"
-            )
         try:
             from akka_game_of_life_tpu.runtime.frontend import run_frontend
         except ImportError as e:  # pragma: no cover

@@ -340,6 +340,7 @@ class BackendWorker:
         self.checkpoint_every = 0
         self.metrics_every = 0
         self.render_strides: Tuple[int, int] = (1, 1)
+        self.probe_window: Optional[Tuple[int, int, int, int]] = None
         self.origins: Dict[TileId, Tuple[int, int]] = {}
         self.paused = False
         self.channel: Optional[Channel] = None
@@ -666,6 +667,8 @@ class BackendWorker:
             self.checkpoint_every = int(msg.get("checkpoint_every", 0))
             self.metrics_every = int(msg.get("metrics_every", 0))
             self.render_strides = tuple(msg.get("render_strides", (1, 1)))
+            pw = msg.get("probe_window")
+            self.probe_window = tuple(pw) if pw is not None else None
             for spec in msg["tiles"]:
                 tid: TileId = tuple(spec["id"])
                 tile = _Tile(unpack_tile(spec["state"]), int(spec["epoch"]))
@@ -894,6 +897,19 @@ class BackendWorker:
                 (oy + sy - 1) // sy,
                 (ox + sx - 1) // sx,
             ]
+            if self.probe_window is not None:
+                # Exact cells of this tile's intersection with the probe
+                # window, origin given window-relative; the intersections
+                # over all reporting tiles tile the window exactly.
+                y0, y1, x0, x1 = self.probe_window
+                h, w = arr.shape
+                gy0, gy1 = max(y0, oy), min(y1, oy + h)
+                gx0, gx1 = max(x0, ox), min(x1, ox + w)
+                if gy0 < gy1 and gx0 < gx1:
+                    msg["window"] = arr[
+                        gy0 - oy : gy1 - oy, gx0 - ox : gx1 - ox
+                    ]
+                    msg["window_origin"] = [gy0 - y0, gx0 - x0]
         if "metrics" in reasons:
             msg["population"] = int((arr == 1).sum())
         try:

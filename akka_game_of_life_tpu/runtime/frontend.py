@@ -233,6 +233,18 @@ class Frontend:
             self.observer.set_cluster_layout(
                 len(self.layout.tile_ids), self.config.shape
             )
+            if self.config.probe_window is not None:
+                y0, y1, x0, x1 = self.config.probe_window
+                th2, tw2 = self.layout.tile_shape
+                n_hit = sum(
+                    1
+                    for t in self.layout.tile_ids
+                    if (oy := t[0] * th2) < y1
+                    and oy + th2 > y0
+                    and (ox := t[1] * tw2) < x1
+                    and ox + tw2 > x0
+                )
+                self.observer.expect_window(self.config.probe_window, n_hit)
 
             if self.config.tick_s > 0:
                 # Paced mode: announce epochs one tick at a time, like the
@@ -358,6 +370,11 @@ class Frontend:
                 "checkpoint_every": self._ckpt_cadence,
                 "metrics_every": self.config.metrics_every,
             }
+            if self.config.probe_window is not None:
+                # Workers attach their tile∩window cells to render-cadence
+                # TILE_STATE pushes; the observer stitches the exact window
+                # (O(window) on the wire at any board size).
+                msg["probe_window"] = list(self.config.probe_window)
         self._safe_send(member, msg)
 
     def _safe_send(self, member: Member, msg: dict) -> None:
@@ -575,6 +592,10 @@ class Frontend:
                 self.observer.add_sample(
                     epoch, tile, tuple(msg["scaled_origin"]), msg["sample"]
                 )
+                if "window" in msg:
+                    self.observer.add_window(
+                        epoch, tile, tuple(msg["window_origin"]), msg["window"]
+                    )
             if "metrics" in reasons:
                 self.observer.add_population(epoch, tile, int(msg["population"]))
 

@@ -117,6 +117,10 @@ class BoardObserver:
         self._pop_floor: Optional[int] = None
         self._sample_partial: Dict[int, Dict[Tuple[int, int], np.ndarray]] = {}
         self._sample_floor: Optional[int] = None
+        self._window_bbox: Optional[Tuple[int, int, int, int]] = None
+        self._expected_window_tiles = 0
+        self._window_partial: Dict[int, Dict] = {}
+        self._window_floor: Optional[int] = None
         self._last_time: Optional[float] = None
         self._last_epoch: Optional[int] = None
         # Bounded, unlike the reference's forever-growing per-epoch map
@@ -226,6 +230,38 @@ class BoardObserver:
             del self._pop_partial[e]
         h, w = self._board_shape
         self._note_progress(epoch, sum(d.values()), h * w)
+
+    def expect_window(
+        self, bbox: Tuple[int, int, int, int], n_tiles: int
+    ) -> None:
+        """Configure cluster window assembly: ``n_tiles`` workers' tiles
+        intersect ``bbox`` and each attaches its exact intersection to its
+        render-cadence report."""
+        self._window_bbox = tuple(bbox)
+        self._expected_window_tiles = n_tiles
+        self._window_partial: Dict[int, Dict] = {}
+        self._window_floor: Optional[int] = None
+
+    def add_window(
+        self, epoch: int, key, origin: Tuple[int, int], block: np.ndarray
+    ) -> None:
+        """One tile's window intersection (window-relative origin); stitches
+        and prints the exact window once every intersecting tile reported."""
+        if self._window_bbox is None:
+            return
+        if self._window_floor is not None and epoch <= self._window_floor:
+            return
+        tiles = self._window_partial.setdefault(epoch, {})
+        tiles[key] = (tuple(origin), np.asarray(block))
+        if len(tiles) < self._expected_window_tiles:
+            return
+        del self._window_partial[epoch]
+        self._window_floor = epoch
+        for e in [e for e in self._window_partial if e <= epoch]:
+            del self._window_partial[e]
+        from akka_game_of_life_tpu.runtime.tiles import stitch
+
+        self.observe_window(epoch, stitch(dict(tiles.values())), self._window_bbox)
 
     def add_sample(
         self,

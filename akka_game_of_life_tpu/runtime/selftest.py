@@ -62,6 +62,8 @@ def _check_gun_phase(kernel: str) -> str:
         kernel=kernel,
         steps_per_call=15,
     )
+    # Checks close their sims so stores/buffers never outlive the check
+    # (and, for tmp-dir checks, never race directory removal).
     g0 = sim.board_window(4, 13, 4, 40)
     pop0 = int(sim.board_host().sum())
     sim.advance(15)  # mid-period: the window MUST differ (frozen-stepper guard)
@@ -75,6 +77,7 @@ def _check_gun_phase(kernel: str) -> str:
     assert int(sim.board_host().sum()) == pop0 + 10, (
         "gun did not emit two gliders over two periods"
     )
+    sim.close()
     return sim.kernel
 
 
@@ -84,6 +87,7 @@ def _check_oracle(kernel: str) -> str:
     sim.advance(36)
     want = _dense(start, 36)
     assert np.array_equal(sim.board_host(), want), "kernel diverged from dense oracle"
+    sim.close()
     return sim.kernel
 
 
@@ -99,6 +103,7 @@ def _check_checkpoint(kernel: str) -> str:
         assert np.array_equal(resumed.board_host(), _dense(start, 36)), (
             "post-resume trajectory diverged"
         )
+        resumed.close()
         return resumed.kernel
 
 
@@ -119,6 +124,7 @@ def _check_chaos(kernel: str) -> str:
         assert np.array_equal(chaotic.board_host(), _dense(start, 36)), (
             "crash+replay diverged from uninterrupted trajectory"
         )
+        chaotic.close()
         return chaotic.kernel
 
 
@@ -135,6 +141,7 @@ def _check_sharded(kernel: str) -> str:
     assert np.array_equal(sim.board_host(), _dense(start, 36)), (
         "meshed trajectory diverged from dense oracle"
     )
+    sim.close()
     return sim.kernel
 
 

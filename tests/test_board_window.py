@@ -115,6 +115,33 @@ def test_probe_window_validation_and_cli_parse():
         _parse_window("8-17")
 
 
+def test_cluster_probe_window_across_tile_seams():
+    # 4 workers tile a 64² board 2x2 (tile seams at 32); the gun bbox at
+    # offset (28, 14) spans both seams, so every one of the 4 tiles
+    # contributes an intersection block — the stitched window must be the
+    # exact oracle cells at a period multiple.
+    from akka_game_of_life_tpu.runtime.harness import cluster
+
+    out = io.StringIO()
+    obs = BoardObserver(out=out, render_every=30, render_max_cells=16)
+    cfg = SimulationConfig(
+        height=64,
+        width=64,
+        pattern="gosper-glider-gun",
+        pattern_offset=(28, 14),
+        max_epochs=60,
+        render_every=30,
+        probe_window=(28, 37, 14, 50),
+    )
+    with cluster(cfg, 4, observer=obs) as h:
+        h.run_to_completion()
+    text = out.getvalue()
+    assert "epoch 30: window [28:37, 14:50]" in text
+    assert "epoch 60: window [28:37, 14:50]" in text
+    # Phase check: every window (epochs 0, 30, 60) shows the gun exactly.
+    assert text.count("window [28:37, 14:50] pop=36") == 3
+
+
 def test_gun_phase_at_scale_across_chaos(tmp_path):
     """The north-star criterion, probed the at-scale way: a Gosper gun in a
     2048² bit-packed torus, crash injected + replayed mid-run, gun window
