@@ -8,6 +8,9 @@ its execution profile; all BASELINE.json benchmark configs are registered:
 - ``highlife``         — HighLife B36/S23 (config 3)
 - ``day-and-night``    — Day & Night B3678/S34678 (config 3)
 - ``brians-brain``     — Brian's Brain /2/3, int8 Generations state (config 4)
+- ``wireworld``        — WireWorld, the non-totalistic 4-state digital-logic
+                         CA (``Rule.kind="wireworld"``; dense kernels + actor
+                         engines; packed kernels decline it)
 - plus seeds, life-without-death, star-wars, and any rulestring on demand.
 """
 

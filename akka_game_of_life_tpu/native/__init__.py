@@ -43,6 +43,7 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ae_create.argtypes = [
         ctypes.c_int32, ctypes.c_int32, u8p,
         ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32,
     ]
     lib.ae_destroy.argtypes = [ctypes.c_void_p]
     lib.ae_advance_to.argtypes = [ctypes.c_void_p, ctypes.c_int32]

@@ -67,6 +67,7 @@ struct Board {
   bool tile_mode = false;          // ghosts vs torus
   uint32_t birth_mask = 0, survive_mask = 0;
   int32_t states = 2;
+  int32_t kind = 0;  // 0 totalistic, 1 wireworld (ops/rules.py Rule.kind)
   int32_t global_epoch = 0;
   int64_t next_gid = 0;
   int64_t messages = 0;
@@ -105,6 +106,14 @@ void build_neighbors(Board& b) {
 }
 
 uint8_t apply_rule(const Board& b, uint8_t current, int32_t alive) {
+  if (b.kind == 1) {
+    // Wireworld: head -> tail, tail -> conductor, conductor -> head iff the
+    // head count hits the birth mask, empty stays (ops/stencil.apply_rule).
+    if (current == 1) return 2;
+    if (current == 2) return 3;
+    if (current == 3 && ((b.birth_mask >> alive) & 1u)) return 1;
+    return current;
+  }
   if (b.states == 2) {
     uint32_t mask = current == 1 ? b.survive_mask : b.birth_mask;
     return static_cast<uint8_t>((mask >> alive) & 1u);
@@ -231,7 +240,7 @@ extern "C" {
 
 void* ae_create(int32_t h, int32_t w, const uint8_t* board,
                 uint32_t birth_mask, uint32_t survive_mask, int32_t states,
-                int32_t tile_mode) {
+                int32_t tile_mode, int32_t kind) {
   // Flat cell indices are int32 throughout (Msg.a, nbr table); reject boards
   // whose (ghost-ring-padded) index space would overflow.  The per-cell
   // engine is the small-board parity path, so this is not a real limit.
@@ -248,6 +257,7 @@ void* ae_create(int32_t h, int32_t w, const uint8_t* board,
   b->birth_mask = birth_mask;
   b->survive_mask = survive_mask;
   b->states = states;
+  b->kind = kind;
   b->cells.assign(static_cast<size_t>(b->fh) * b->fw, Cell());
   for (int32_t y = 0; y < b->fh; ++y) {
     for (int32_t x = 0; x < b->fw; ++x) {

@@ -37,6 +37,7 @@ class NativeActorBoard:
         self._ptr = lib.ae_create(
             h, w, _as_u8p(board),
             self.rule.birth_mask, self.rule.survive_mask, self.rule.states, 0,
+            0 if self.rule.is_totalistic else 1,
         )
         if not self._ptr:
             raise ValueError(f"board {h}x{w} too large for the per-cell engine")
@@ -105,6 +106,7 @@ class NativeActorTileEngine:
                 h, w, _as_u8p(arr),
                 self.rule.birth_mask, self.rule.survive_mask,
                 self.rule.states, 1,
+                0 if self.rule.is_totalistic else 1,
             )
             if not self._ptr:
                 raise ValueError(

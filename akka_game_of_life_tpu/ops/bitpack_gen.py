@@ -41,6 +41,16 @@ from akka_game_of_life_tpu.ops.bitpack import (
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 
 
+def _require_totalistic(rule) -> None:
+    """The plane transition encodes Generations decay semantics; other
+    kinds (wireworld) ride the dense kernel instead."""
+    if not rule.is_totalistic:
+        raise ValueError(
+            f"bit-plane Generations kernel supports totalistic rules only, "
+            f"got {rule}"
+        )
+
+
 def n_planes(states: int) -> int:
     return max(1, (states - 1).bit_length())
 
@@ -147,6 +157,7 @@ def step_gen_padded_rows(padded: jax.Array, rule) -> jax.Array:
     :func:`akka_game_of_life_tpu.ops.bitpack.step_padded_rows`, used by the
     Pallas temporal-blocking kernel."""
     rule = resolve_rule(rule)
+    _require_totalistic(rule)
     m = n_planes(rule.states)
     if padded.shape[0] != m:
         raise ValueError(f"expected {m} planes for {rule.states} states")
@@ -165,6 +176,7 @@ def step_gen_padded_rows(padded: jax.Array, rule) -> jax.Array:
 def step_gen(planes: jax.Array, rule) -> jax.Array:
     """One toroidal Generations step on (m, H, W/32) packed planes."""
     rule = resolve_rule(rule)
+    _require_totalistic(rule)
     m = n_planes(rule.states)
     if planes.shape[0] != m:
         raise ValueError(f"expected {m} planes for {rule.states} states")

@@ -19,6 +19,18 @@ from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 def _apply_rule_np(state: np.ndarray, counts: np.ndarray, rule: Rule) -> np.ndarray:
     c = counts.astype(np.uint32)
     birth = ((np.uint32(rule.birth_mask) >> c) & 1).astype(np.uint8)
+    if not rule.is_totalistic:  # wireworld: see ops/stencil.apply_rule
+        # (survive plane skipped — unused by this kind, and unlike the jax
+        # twin there is no compiler to dead-code-eliminate it.)
+        return np.where(
+            state == 1,
+            np.uint8(2),
+            np.where(
+                state == 2,
+                np.uint8(3),
+                np.where((state == 3) & (birth == 1), np.uint8(1), state),
+            ),
+        ).astype(np.uint8)
     survive = ((np.uint32(rule.survive_mask) >> c) & 1).astype(np.uint8)
     if rule.is_binary:
         return np.where(state == 1, survive, birth).astype(np.uint8)

@@ -42,8 +42,20 @@ class Rule:
     # Cosmetic only: excluded from __eq__/__hash__ so semantically identical
     # rules share one jit-compilation cache entry in step_fn/multi_step_fn.
     name: Optional[str] = dataclasses.field(default=None, compare=False)
+    # Rule family.  "totalistic" covers life-like + Generations via the
+    # birth/survive masks above; "wireworld" reuses the same machinery with
+    # shifted meanings: state 1 = electron head (the counted state), 2 =
+    # tail, 3 = conductor; ``birth`` holds the head-neighbor counts ({1, 2})
+    # at which a CONDUCTOR excites to a head; heads always become tails,
+    # tails conductors, empty stays empty.  Every kernel's neighbor-count
+    # pipeline (alive = state == 1) is shared; only the transition differs.
+    kind: str = "totalistic"
 
     def __post_init__(self) -> None:
+        if self.kind not in ("totalistic", "wireworld"):
+            raise ValueError(f"unknown rule kind {self.kind!r}")
+        if self.kind == "wireworld" and self.states != 4:
+            raise ValueError("wireworld has exactly 4 states")
         if not (2 <= self.states <= 255):
             # State arrays are uint8 (ops.stencil.STATE_DTYPE).
             raise ValueError(f"states must be in 2..255, got {self.states}")
@@ -71,7 +83,16 @@ class Rule:
     def is_binary(self) -> bool:
         return self.states == 2
 
+    @property
+    def is_totalistic(self) -> bool:
+        return self.kind == "totalistic"
+
     def rulestring(self) -> str:
+        if not self.is_totalistic:
+            # Non-totalistic families have no B/S encoding; the registered
+            # name is the canonical round-trippable spelling (checkpoint
+            # metadata resolves it back through NAMED_RULES).
+            return self.name or self.kind
         b = "".join(str(i) for i in sorted(self.birth))
         s = "".join(str(i) for i in sorted(self.survive))
         if self.is_binary:
@@ -126,6 +147,12 @@ SEEDS = Rule(frozenset({2}), frozenset(), name="seeds")
 LIFE_WITHOUT_DEATH = Rule(frozenset({3}), frozenset(range(9)), name="life-without-death")
 BRIANS_BRAIN = Rule(frozenset({2}), frozenset(), states=3, name="brians-brain")
 STAR_WARS = Rule(frozenset({2}), frozenset({3, 4, 5}), states=4, name="star-wars")
+# WireWorld (Silverman 1987): 0 empty, 1 electron head, 2 tail, 3 conductor;
+# a conductor becomes a head iff it has 1 or 2 head neighbors.  The classic
+# non-totalistic digital-logic CA — wires, diodes, gates.
+WIREWORLD = Rule(
+    frozenset({1, 2}), frozenset(), states=4, name="wireworld", kind="wireworld"
+)
 
 NAMED_RULES = {
     r.name: r
@@ -137,6 +164,7 @@ NAMED_RULES = {
         LIFE_WITHOUT_DEATH,
         BRIANS_BRAIN,
         STAR_WARS,
+        WIREWORLD,
     )
 }
 

@@ -79,6 +79,19 @@ def apply_rule(state: jax.Array, counts: jax.Array, rule: Rule) -> jax.Array:
     c = counts.astype(jnp.uint32)
     birth = ((jnp.uint32(rule.birth_mask) >> c) & 1).astype(STATE_DTYPE)
     survive = ((jnp.uint32(rule.survive_mask) >> c) & 1).astype(STATE_DTYPE)
+    if not rule.is_totalistic:  # wireworld (the only non-totalistic kind)
+        # head → tail, tail → conductor, conductor → head iff the head
+        # count hits the birth mask, empty stays.  counts already tallies
+        # state==1 (heads) — the same pipeline as every other rule.
+        return jnp.where(
+            state == 1,
+            jnp.asarray(2, STATE_DTYPE),
+            jnp.where(
+                state == 2,
+                jnp.asarray(3, STATE_DTYPE),
+                jnp.where((state == 3) & (birth == 1), jnp.asarray(1, STATE_DTYPE), state),
+            ),
+        )
     if rule.is_binary:
         return jnp.where(state == 1, survive, birth)
     one = jnp.asarray(1, STATE_DTYPE)

@@ -65,6 +65,14 @@ class Gatherer:
 
     def result(self, rule: Rule) -> int:
         alive = sum(1 for p in self.neighbors if self.got[p] == 1)
+        if not rule.is_totalistic:  # wireworld: see ops/stencil.apply_rule
+            if self.current_state == 1:
+                return 2
+            if self.current_state == 2:
+                return 3
+            if self.current_state == 3 and (rule.birth_mask >> alive) & 1:
+                return 1
+            return self.current_state
         mask = rule.survive_mask if self.current_state == 1 else rule.birth_mask
         if rule.is_binary:
             return (mask >> alive) & 1

@@ -232,6 +232,10 @@ class Simulation:
         cfg = self.config
         kernel = cfg.kernel
         if kernel == "auto":
+            if not self.rule.is_totalistic:
+                # Non-totalistic kinds (wireworld) have no packed/Mosaic
+                # form; the dense kernel carries them on every topology.
+                return "dense"
             if cfg.width % 32:
                 return "dense"
             if self._use_mesh and not self._packed_mesh_fits():
@@ -257,6 +261,11 @@ class Simulation:
             # Generations rules: bit planes (0.25·m B/cell vs 1 B/cell dense).
             return "bitpack" if self.rule.states <= 256 else "dense"
         if kernel in ("bitpack", "pallas"):
+            if not self.rule.is_totalistic:
+                raise ValueError(
+                    f"kernel={kernel} supports totalistic rules only; "
+                    f"{self.rule} runs on kernel=dense"
+                )
             if not self.rule.is_binary and self.rule.states > 256:
                 raise ValueError(
                     f"kernel={kernel} supports at most 256 states, rule "

@@ -70,11 +70,34 @@ def decode_rle(rle: str) -> np.ndarray:
     return grid
 
 
+# Multi-state patterns (state digits), for families RLE's b/o can't encode.
+# Wireworld states: 0 empty, 1 electron head, 2 tail, 3 conductor.
+DIGIT_PATTERNS: Dict[str, Tuple[str, ...]] = {
+    # A 10-cell octagonal wire loop (corners cut so every path cell has
+    # exactly 2 path neighbors — square corners would double the electron
+    # through Moore diagonals) with one electron circulating: period 10.
+    "wireworld-clock": (
+        "02330",
+        "10003",
+        "30003",
+        "03330",
+    ),
+}
+
+
 def get_pattern(name: str) -> np.ndarray:
     """Look up a canonical pattern by name as a (H, W) uint8 array."""
     key = name.strip().lower()
+    if key in DIGIT_PATTERNS:
+        return np.array(
+            [[int(ch) for ch in row] for row in DIGIT_PATTERNS[key]],
+            dtype=np.uint8,
+        )
     if key not in RLE_PATTERNS:
-        raise KeyError(f"unknown pattern {name!r}; have {sorted(RLE_PATTERNS)}")
+        raise KeyError(
+            f"unknown pattern {name!r}; have "
+            f"{sorted(RLE_PATTERNS) + sorted(DIGIT_PATTERNS)}"
+        )
     return decode_rle(RLE_PATTERNS[key])
 
 

@@ -81,7 +81,7 @@ def test_native_engine_rejects_overflowing_boards():
 
     lib = load_lib()
     one = (ctypes.c_uint8 * 1)(0)
-    ptr = lib.ae_create(70000, 70000, one, 8, 12, 2, 0)
+    ptr = lib.ae_create(70000, 70000, one, 8, 12, 2, 0, 0)
     assert not ptr
 
 

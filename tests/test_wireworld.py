@@ -1,0 +1,135 @@
+"""WireWorld — the non-totalistic model family.
+
+States: 0 empty, 1 electron head, 2 tail, 3 conductor; a conductor excites
+to a head iff it has 1 or 2 head neighbors.  Not expressible in the B/S +
+Generations rule space, so it exercises the ``Rule.kind`` seam: the dense
+kernels (jax + numpy) and both actor engines implement it; the packed
+kernels reject it and ``kernel=auto`` routes it to dense.
+"""
+
+import io
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.ops.npkernel import step_np
+from akka_game_of_life_tpu.ops.rules import WIREWORLD, resolve_rule
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import Simulation
+from akka_game_of_life_tpu.utils.patterns import pattern_board
+
+
+def test_resolve_and_rulestring_roundtrip():
+    r = resolve_rule("wireworld")
+    assert r is WIREWORLD and not r.is_totalistic and r.states == 4
+    assert resolve_rule(r.rulestring()) is WIREWORLD  # checkpoint meta path
+
+
+def test_straight_wire_propagation():
+    # head(1) tail(2) on a straight conductor run: the electron travels one
+    # cell per generation, hand-computed.
+    row = np.array([[2, 1, 3, 3, 3]], dtype=np.uint8)
+    board = np.zeros((3, 7), dtype=np.uint8)
+    board[1, 1:6] = row
+    m = get_model("wireworld")
+    b1 = np.asarray(m.step(jnp.asarray(board)))
+    want = np.zeros_like(board)
+    want[1, 1:6] = [3, 2, 1, 3, 3]
+    np.testing.assert_array_equal(b1, want)
+    b2 = np.asarray(m.step(jnp.asarray(b1)))
+    want[1, 1:6] = [3, 3, 2, 1, 3]
+    np.testing.assert_array_equal(b2, want)
+
+
+def test_clock_period_10_and_charge_conservation():
+    board = pattern_board("wireworld-clock", (12, 12), (4, 4))
+    m = get_model("wireworld")
+    states = [board]
+    s = jnp.asarray(board)
+    for _ in range(10):
+        s = m.step(s)
+        states.append(np.asarray(s))
+    for t, st in enumerate(states[1:10], start=1):
+        assert not np.array_equal(st, board), f"early repeat at t={t}"
+        assert (st == 1).sum() == 1, f"charge not conserved at t={t}"
+    np.testing.assert_array_equal(states[10], board)  # full period
+
+
+def test_two_heads_block_excitation():
+    # A conductor with THREE head neighbors must not excite (birth mask is
+    # {1, 2}).
+    board = np.zeros((5, 5), dtype=np.uint8)
+    board[1, 1] = board[1, 3] = board[3, 2] = 1  # three heads around (2,2)
+    board[2, 2] = 3
+    out = np.asarray(get_model("wireworld").step(jnp.asarray(board)))
+    assert out[2, 2] == 3  # still conductor
+    assert out[1, 1] == out[1, 3] == out[3, 2] == 2  # heads became tails
+
+
+def test_numpy_and_actor_engines_match_stencil():
+    from akka_game_of_life_tpu.runtime.actor_engine import ActorBoard
+
+    board = pattern_board("wireworld-clock", (8, 8), (2, 2))
+    m = get_model("wireworld")
+    jax_out = board
+    for _ in range(7):
+        jax_out = np.asarray(m.step(jnp.asarray(jax_out)))
+    np_out = board
+    for _ in range(7):
+        np_out = step_np(np_out, WIREWORLD)
+    np.testing.assert_array_equal(np_out, jax_out)
+
+    actor = ActorBoard(board, "wireworld")
+    actor.advance_to(7)
+    np.testing.assert_array_equal(actor.board_at_current(), jax_out)
+
+    from akka_game_of_life_tpu.native import available
+
+    if available():
+        from akka_game_of_life_tpu.native.engine import NativeActorBoard
+
+        native = NativeActorBoard(board, "wireworld")
+        native.advance_to(7)
+        np.testing.assert_array_equal(native.board_at_current(), jax_out)
+
+
+def test_simulation_auto_routes_to_dense_and_packed_rejects():
+    sim = Simulation(
+        SimulationConfig(
+            height=32, width=32, rule="wireworld", pattern="wireworld-clock",
+            pattern_offset=(8, 8), steps_per_call=5,
+        ),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    assert sim.kernel == "dense"
+    start = sim.board_host()
+    sim.advance(10)
+    np.testing.assert_array_equal(sim.board_host(), start)  # clock period
+
+    with pytest.raises(ValueError, match="totalistic"):
+        Simulation(
+            SimulationConfig(height=32, width=32, rule="wireworld", kernel="bitpack"),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+
+
+def test_wireworld_cluster_trajectory():
+    # The whole cluster protocol carries the non-totalistic family: tiles,
+    # halo rings, render — trajectory ≡ the dense oracle.
+    from akka_game_of_life_tpu.runtime.harness import cluster
+    from akka_game_of_life_tpu.runtime.simulation import initial_board
+
+    cfg = SimulationConfig(
+        height=16, width=16, rule="wireworld", pattern="wireworld-clock",
+        pattern_offset=(6, 6), max_epochs=10,
+    )
+    with cluster(cfg, 2, engine="jax") as h:
+        final = h.run_to_completion()
+    oracle = np.asarray(
+        get_model("wireworld").run(10)(jnp.asarray(initial_board(cfg)))
+    )
+    np.testing.assert_array_equal(final, oracle)
+    np.testing.assert_array_equal(final, initial_board(cfg))  # period 10
