@@ -1,0 +1,29 @@
+"""The shipped example configs must always parse against the live config
+schema (unknown-key rejection makes silent drift impossible — a renamed
+field breaks these files loudly, and this test catches it)."""
+
+from pathlib import Path
+
+import pytest
+
+from akka_game_of_life_tpu.runtime.config import load_config
+
+EXAMPLES = sorted(
+    (Path(__file__).resolve().parents[1] / "examples").glob("*.toml")
+)
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 3
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=lambda p: p.name)
+def test_example_config_parses(path):
+    cfg = load_config(str(path))
+    assert cfg.height > 0 and cfg.max_epochs
+    # Cadences must respect the exchange width (config validates; this
+    # asserts the examples stay self-consistent).
+    if cfg.exchange_width > 1:
+        for name in ("render_every", "metrics_every", "checkpoint_every"):
+            cadence = getattr(cfg, name)
+            assert cadence % cfg.exchange_width == 0
