@@ -11,6 +11,9 @@ its execution profile; all BASELINE.json benchmark configs are registered:
 - ``wireworld``        — WireWorld, the non-totalistic 4-state digital-logic
                          CA (``Rule.kind="wireworld"``; dense kernels + actor
                          engines; packed kernels decline it)
+- ``bugs``             — Larger-than-Life (Evans), radius-5 Moore; counts run
+                         as bf16 MXU convolutions (``ops/ltl.py``); any
+                         ``"R<r>,B<ranges>,S<ranges>"`` rulestring works
 - plus seeds, life-without-death, star-wars, and any rulestring on demand.
 """
 

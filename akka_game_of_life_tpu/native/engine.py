@@ -31,6 +31,11 @@ class NativeActorBoard:
             raise RuntimeError(f"native engine unavailable: {load_error()}")
         self._lib = lib
         self.rule = resolve_rule(rule)
+        if self.rule.radius != 1:
+            raise ValueError(
+                "the native per-cell engine is Moore-8 (radius 1); "
+                "radius-R ltl rules run on the dense kernel"
+            )
         board = np.ascontiguousarray(board, dtype=np.uint8)
         self.shape = board.shape
         h, w = board.shape
@@ -80,6 +85,11 @@ class NativeActorTileEngine:
 
     def __init__(self, rule) -> None:
         self.rule = resolve_rule(rule)
+        if self.rule.radius != 1:
+            raise ValueError(
+                "the native per-cell engine is Moore-8 (radius 1); "
+                "radius-R ltl rules run on the dense kernel"
+            )
         self._lib = load()
         if self._lib is None:
             from akka_game_of_life_tpu.native import load_error

@@ -29,6 +29,18 @@ LANE_BITS = 32
 _U = jnp.uint32
 
 
+def require_packed_support(rule: Rule) -> None:
+    """The SWAR kernels encode binary radius-1 outer-totalistic semantics;
+    everything else (Generations planes, wireworld, radius-R ltl) has its
+    own path.  ltl rules ARE binary, so an is_binary check alone would let
+    them through and silently compute radius-1 — hence the shared guard."""
+    if not (rule.is_binary and rule.is_totalistic):
+        raise ValueError(
+            f"bit-packed kernel supports binary radius-1 totalistic rules "
+            f"only, got {rule}"
+        )
+
+
 def pack(grid) -> jax.Array:
     """(H, W) 0/1 uint8 → (H, W/32) uint32, LSB-first.
 
@@ -145,8 +157,7 @@ def step_padded_rows(padded: jax.Array, rule) -> jax.Array:
 def step_packed(x: jax.Array, rule) -> jax.Array:
     """One toroidal step on a packed (H, W/32) uint32 grid."""
     rule = resolve_rule(rule)
-    if not rule.is_binary:
-        raise ValueError("bit-packed kernel supports binary rules only")
+    require_packed_support(rule)
     s, c = _row_triple_sum(x)
     return _combine_rows(
         x,

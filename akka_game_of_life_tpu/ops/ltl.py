@@ -1,0 +1,139 @@
+"""Larger-than-Life: radius-R neighborhoods through the MXU.
+
+Every other kernel in this framework is VPU work — bitwise SWAR adders and
+byte stencils, because a Moore-8 count is too small to feed a matrix unit.
+Larger than Life (Evans) scales the neighborhood to a (2R+1)² box, and a
+box-sum over a grid IS a convolution: here it runs as two separable
+``lax.conv_general_dilated`` passes (a (2R+1)×1 column conv then a 1×(2R+1)
+row conv) in bfloat16 — the MXU's native diet — so the TPU's main compute
+unit finally carries a CA family.  Counts ≤ (2R+1)² − 1 ≤ 440 are exact in
+bf16 (integers to 256) for R ≤ 7 and in f32 beyond, chosen automatically.
+
+The birth/survive sets are arbitrary subsets of 0..(2R+1)²−1, applied as a
+table gather (XLA lowers the tiny lookup into the fused epilogue).  With
+R=1 this reduces exactly to the classic outer-totalistic step — the
+cross-validation anchor ``tests/test_ltl.py`` pins against the VPU kernel.
+
+Reference capability note: radius generalization is pure surplus over the
+reference (one hard-coded radius-1 rule, ``NextStateCellGathererActor.scala:44``)
+— it is here because the TPU-native design makes it nearly free, and it is
+the configuration where the MXU (not the VPU or HBM) sets the roofline.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
+
+STATE_DTYPE = jnp.uint8
+
+
+def _count_dtype(rule: Rule):
+    # bf16 holds integers exactly to 256: enough for R<=7 ((2R+1)^2 <= 225).
+    return jnp.bfloat16 if rule.max_neighbors < 255 else jnp.float32
+
+
+def _box_counts(alive_2d: jax.Array, radius: int, dtype) -> jax.Array:
+    """(H+2R, W+2R) 0/1 halo-padded alive plane → (H, W) box sums INCLUDING
+    the center, as two separable convs (column pass then row pass)."""
+    r = radius
+    x = alive_2d.astype(dtype)[None, None]  # NCHW
+    col = jnp.ones((1, 1, 2 * r + 1, 1), dtype)
+    row = jnp.ones((1, 1, 1, 2 * r + 1), dtype)
+    x = jax.lax.conv_general_dilated(x, col, (1, 1), "VALID")
+    x = jax.lax.conv_general_dilated(x, row, (1, 1), "VALID")
+    return x[0, 0]
+
+
+def _tables(rule: Rule):
+    n = rule.max_neighbors + 1
+    birth = np.zeros(n, np.uint8)
+    survive = np.zeros(n, np.uint8)
+    for b in rule.birth:
+        birth[b] = 1
+    for s in rule.survive:
+        survive[s] = 1
+    return jnp.asarray(birth), jnp.asarray(survive)
+
+
+def _apply(state: jax.Array, neighbor_counts: jax.Array, rule: Rule) -> jax.Array:
+    birth_t, survive_t = _tables(rule)
+    c = neighbor_counts.astype(jnp.int32)
+    return jnp.where(state == 1, jnp.take(survive_t, c), jnp.take(birth_t, c))
+
+
+def step_padded_ltl(padded: jax.Array, rule) -> jax.Array:
+    """One LtL step on an R-halo-padded tile: (H+2R, W+2R) → (H, W).
+
+    The halo carries the off-tile neighborhood; no wrap happens here — the
+    sharded halo path and the toroidal step below both feed it."""
+    rule = resolve_rule(rule)
+    r = rule.radius
+    alive = (padded == 1).astype(STATE_DTYPE)
+    counts = _box_counts(alive, r, _count_dtype(rule))
+    interior = padded[r:-r, r:-r]
+    # The box sum includes the center; neighbor count excludes it.
+    neighbors = counts - alive[r:-r, r:-r].astype(counts.dtype)
+    return _apply(interior, neighbors, rule)
+
+
+def step_ltl(state: jax.Array, rule) -> jax.Array:
+    """One toroidal LtL step on an (H, W) uint8 board."""
+    rule = resolve_rule(rule)
+    r = rule.radius
+    return step_padded_ltl(jnp.pad(state, r, mode="wrap"), rule)
+
+
+@functools.lru_cache(maxsize=None)
+def ltl_multi_step_fn(rule_key, n_steps: int) -> Callable[[jax.Array], jax.Array]:
+    rule = resolve_rule(rule_key)
+
+    @jax.jit
+    def _run(state: jax.Array) -> jax.Array:
+        def body(s, _):
+            return step_ltl(s, rule), None
+
+        out, _ = jax.lax.scan(body, state, None, length=n_steps)
+        return out
+
+    return _run
+
+
+def step_padded_ltl_np(padded: np.ndarray, rule) -> np.ndarray:
+    """Host-side twin of :func:`step_padded_ltl` via an integral image —
+    the numpy oracle for tests and CPU-parity workers."""
+    rule = resolve_rule(rule)
+    r = rule.radius
+    alive = (padded == 1).astype(np.int32)
+    ii = np.zeros((padded.shape[0] + 1, padded.shape[1] + 1), np.int32)
+    ii[1:, 1:] = alive.cumsum(0).cumsum(1)
+    h, w = padded.shape[0] - 2 * r, padded.shape[1] - 2 * r
+    d = 2 * r + 1
+    box = (
+        ii[d : d + h, d : d + w]
+        - ii[0:h, d : d + w]
+        - ii[d : d + h, 0:w]
+        + ii[0:h, 0:w]
+    )
+    interior = padded[r : r + h, r : r + w]
+    neighbors = box - alive[r : r + h, r : r + w]
+    birth = np.zeros(rule.max_neighbors + 1, np.uint8)
+    survive = np.zeros(rule.max_neighbors + 1, np.uint8)
+    for b in rule.birth:
+        birth[b] = 1
+    for s in rule.survive:
+        survive[s] = 1
+    return np.where(interior == 1, survive[neighbors], birth[neighbors]).astype(
+        np.uint8
+    )
+
+
+def step_ltl_np(board: np.ndarray, rule) -> np.ndarray:
+    rule = resolve_rule(rule)
+    return step_padded_ltl_np(np.pad(board, rule.radius, mode="wrap"), rule)

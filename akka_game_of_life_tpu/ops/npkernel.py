@@ -53,8 +53,12 @@ def neighbor_counts_padded_np(padded_alive: np.ndarray) -> np.ndarray:
 
 
 def step_padded_np(padded: np.ndarray, rule) -> np.ndarray:
-    """One step on a 1-cell-halo-padded tile: (h+2, w+2) → (h, w)."""
+    """One step on a radius-deep halo-padded tile: (h+2R, w+2R) → (h, w)."""
     rule = resolve_rule(rule)
+    if rule.kind == "ltl":
+        from akka_game_of_life_tpu.ops.ltl import step_padded_ltl_np
+
+        return step_padded_ltl_np(padded, rule)
     alive = (padded == 1).astype(np.uint8)
     counts = neighbor_counts_padded_np(alive)
     return _apply_rule_np(padded[1:-1, 1:-1], counts, rule)
@@ -62,4 +66,5 @@ def step_padded_np(padded: np.ndarray, rule) -> np.ndarray:
 
 def step_np(board: np.ndarray, rule) -> np.ndarray:
     """One toroidal step on a full board (numpy oracle / CPU engine)."""
-    return step_padded_np(np.pad(board, 1, mode="wrap"), rule)
+    rule = resolve_rule(rule)
+    return step_padded_np(np.pad(board, rule.radius, mode="wrap"), rule)

@@ -35,7 +35,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from akka_game_of_life_tpu.ops.bitpack import LANE_BITS, step_padded_rows
+from akka_game_of_life_tpu.ops.bitpack import (
+    LANE_BITS,
+    step_padded_rows,
+    require_packed_support,
+)
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 
 DEFAULT_BLOCK_ROWS = 256
@@ -199,8 +203,7 @@ def packed_sweep_fn(
     :func:`temporal_sweep_fn`).
     """
     rule = resolve_rule(rule)
-    if not rule.is_binary:
-        raise ValueError("bit-packed kernel supports binary rules only")
+    require_packed_support(rule)
     return temporal_sweep_fn(
         lambda ext: step_padded_rows(ext, rule),
         n_prefix=0,

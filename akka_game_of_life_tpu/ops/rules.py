@@ -47,21 +47,36 @@ class Rule:
     # shifted meanings: state 1 = electron head (the counted state), 2 =
     # tail, 3 = conductor; ``birth`` holds the head-neighbor counts ({1, 2})
     # at which a CONDUCTOR excites to a head; heads always become tails,
-    # tails conductors, empty stays empty.  Every kernel's neighbor-count
-    # pipeline (alive = state == 1) is shared; only the transition differs.
+    # tails conductors, empty stays empty.  "ltl" is Larger than Life:
+    # the same outer-totalistic birth/survive semantics on a radius-R
+    # Moore neighborhood ((2R+1)² - 1 neighbors) — counts come from an MXU
+    # convolution instead of the VPU adder network (ops/ltl.py).  Every
+    # kernel's neighbor-count pipeline (alive = state == 1) is shared;
+    # only the transition/count-geometry differs per kind.
     kind: str = "totalistic"
+    radius: int = 1  # neighborhood radius; >1 only for kind="ltl"
 
     def __post_init__(self) -> None:
-        if self.kind not in ("totalistic", "wireworld"):
+        if self.kind not in ("totalistic", "wireworld", "ltl"):
             raise ValueError(f"unknown rule kind {self.kind!r}")
         if self.kind == "wireworld" and self.states != 4:
             raise ValueError("wireworld has exactly 4 states")
+        if self.kind != "ltl" and self.radius != 1:
+            raise ValueError(f"radius {self.radius} requires kind='ltl'")
+        if self.kind == "ltl":
+            if not (1 <= self.radius <= 10):
+                raise ValueError(f"ltl radius must be in 1..10, got {self.radius}")
+            if self.states != 2:
+                raise ValueError("ltl rules are binary")
         if not (2 <= self.states <= 255):
             # State arrays are uint8 (ops.stencil.STATE_DTYPE).
             raise ValueError(f"states must be in 2..255, got {self.states}")
+        max_n = self.max_neighbors
         for s in self.birth | self.survive:
-            if not (0 <= s <= _MAX_NEIGHBORS):
-                raise ValueError(f"neighbor count out of range 0..8: {s}")
+            if not (0 <= s <= max_n):
+                raise ValueError(
+                    f"neighbor count out of range 0..{max_n}: {s}"
+                )
 
     @property
     def birth_mask(self) -> int:
@@ -87,7 +102,19 @@ class Rule:
     def is_totalistic(self) -> bool:
         return self.kind == "totalistic"
 
+    @property
+    def max_neighbors(self) -> int:
+        """Largest possible neighbor count: 8 for radius 1, (2R+1)² - 1
+        beyond (the radius-R Moore neighborhood)."""
+        return (2 * self.radius + 1) ** 2 - 1
+
     def rulestring(self) -> str:
+        if self.kind == "ltl":
+            # Range notation, round-trippable through parse_rule:
+            # "R5,B34-45,S33-57" (counts exclude the center cell).
+            return (
+                f"R{self.radius},B{_ranges(self.birth)},S{_ranges(self.survive)}"
+            )
         if not self.is_totalistic:
             # Non-totalistic families have no B/S encoding; the registered
             # name is the canonical round-trippable spelling (checkpoint
@@ -103,6 +130,49 @@ class Rule:
         return self.name or self.rulestring()
 
 
+def _ranges(counts: FrozenSet[int]) -> str:
+    """Collapse a count set to comma-separated values/ranges: {3,4,5,9} →
+    "3-5,9"."""
+    out = []
+    run = []
+    for v in sorted(counts):
+        if run and v == run[-1] + 1:
+            run.append(v)
+        else:
+            if run:
+                out.append(run)
+            run = [v]
+    if run:
+        out.append(run)
+    return ",".join(
+        f"{r[0]}-{r[-1]}" if len(r) > 1 else str(r[0]) for r in out
+    )
+
+
+def _parse_ranges(spec: str) -> FrozenSet[int]:
+    vals = set()
+    for part in spec.split(","):
+        if not part:
+            continue
+        try:
+            if "-" in part:
+                lo_s, hi_s = part.split("-")
+                lo, hi = int(lo_s), int(hi_s)
+                if lo > hi:
+                    raise ValueError(f"descending range {part!r}")
+                vals.update(range(lo, hi + 1))
+            else:
+                vals.add(int(part))
+        except ValueError as e:
+            raise ValueError(
+                f"bad count spec {part!r} in {spec!r}: {e}"
+            ) from None
+    return frozenset(vals)
+
+
+_LTL_RE = re.compile(
+    r"^R(?P<r>\d+),B(?P<b>[\d,\-]*),S(?P<s>[\d,\-]*)$", re.IGNORECASE
+)
 _BS_RE = re.compile(r"^B(?P<b>\d*)/S(?P<s>\d*)$", re.IGNORECASE)
 _SB_RE = re.compile(r"^(?P<s>\d*)/(?P<b>\d*)$")
 _GEN_RE = re.compile(r"^(?P<s>\d*)/(?P<b>\d*)/(?P<c>\d+)$")
@@ -124,6 +194,15 @@ def parse_rule(rulestring: str, name: Optional[str] = None) -> Rule:
     - ``"B2/S/3"``, ``"B2/S/C3"`` — Generations, B/S-first variant
     """
     s = rulestring.strip().replace(" ", "")
+    m = _LTL_RE.match(s)
+    if m:
+        return Rule(
+            birth=_parse_ranges(m.group("b")),
+            survive=_parse_ranges(m.group("s")),
+            radius=int(m.group("r")),
+            kind="ltl",
+            name=name,
+        )
     for rx, has_states in ((_BSG_RE, True), (_GEN_RE, True), (_BS_RE, False), (_SB_RE, False)):
         m = rx.match(s)
         if m:
@@ -153,6 +232,17 @@ STAR_WARS = Rule(frozenset({2}), frozenset({3, 4, 5}), states=4, name="star-wars
 WIREWORLD = Rule(
     frozenset({1, 2}), frozenset(), states=4, name="wireworld", kind="wireworld"
 )
+# Bugs (Evans 1996): the canonical Larger-than-Life rule, radius-5 Moore.
+# Golly's "R5,C0,M1,S34..58,B34..45,NM" counts the center for survival
+# (M1); our survive set is in neighbors-excluding-center terms, hence the
+# -1 shift: S34..58 with self → {33..57} without.
+BUGS = Rule(
+    frozenset(range(34, 46)),
+    frozenset(range(33, 58)),
+    radius=5,
+    kind="ltl",
+    name="bugs",
+)
 
 NAMED_RULES = {
     r.name: r
@@ -165,6 +255,7 @@ NAMED_RULES = {
         BRIANS_BRAIN,
         STAR_WARS,
         WIREWORLD,
+        BUGS,
     )
 }
 

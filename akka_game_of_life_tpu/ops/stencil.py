@@ -114,12 +114,21 @@ def step(state: jax.Array, rule) -> jax.Array:
     """One toroidal CA step.  ``state`` is (H, W) uint8; rule may be a Rule,
     a known name, or a rulestring."""
     rule = resolve_rule(rule)
+    if rule.kind == "ltl":
+        from akka_game_of_life_tpu.ops import ltl
+
+        return ltl.step_ltl(state, rule)
     counts = neighbor_counts(alive_mask(state))
     return apply_rule(state, counts, rule)
 
 
 def step_padded(padded_state: jax.Array, rule: Rule) -> jax.Array:
-    """One step on a tile pre-padded with a 1-cell halo: (H+2, W+2) → (H, W)."""
+    """One step on a tile pre-padded with a radius-deep halo:
+    (H+2R, W+2R) → (H, W).  R is 1 for every kind except ltl."""
+    if rule.kind == "ltl":
+        from akka_game_of_life_tpu.ops import ltl
+
+        return ltl.step_padded_ltl(padded_state, rule)
     counts = neighbor_counts_padded(alive_mask(padded_state))
     interior = padded_state[..., 1:-1, 1:-1]
     return apply_rule(interior, counts, rule)

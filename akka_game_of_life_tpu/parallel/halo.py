@@ -69,10 +69,11 @@ def exchange_halo(tile: jax.Array, width: int = 1) -> jax.Array:
 
 
 def _local_steps(tile: jax.Array, rule: Rule, k: int) -> jax.Array:
-    """k CA steps on a k-halo-padded tile, shrinking the halo by 1 per step.
+    """k CA steps on a (k·R)-halo-padded tile, shrinking the halo by the
+    rule's radius R per step (R=1 for every kind except ltl).
 
-    (h+2k, w+2k) → (h, w).  The loop is unrolled (k is static and small); each
-    iteration's valid region is exactly what the next needs.
+    (h+2kR, w+2kR) → (h, w).  The loop is unrolled (k is static and small);
+    each iteration's valid region is exactly what the next needs.
     """
     for _ in range(k):
         tile = step_padded(tile, rule)
@@ -101,10 +102,13 @@ def sharded_step_fn(
             f"halo_width={halo_width}"
         )
     n_exchanges = steps_per_call // halo_width
+    # halo_width counts STEPS per exchange; the exchanged pad is deeper for
+    # radius-R rules (each step consumes R halo cells per side).
+    pad = halo_width * rule.radius
 
     def local(tile: jax.Array) -> jax.Array:
         def body(t, _):
-            return _local_steps(exchange_halo(t, halo_width), rule, halo_width), None
+            return _local_steps(exchange_halo(t, pad), rule, halo_width), None
 
         out, _ = jax.lax.scan(body, tile, None, length=n_exchanges)
         return out
@@ -119,12 +123,17 @@ def sharded_step_fn(
     return jax.jit(stepped, in_shardings=sharding, out_shardings=sharding)
 
 
-def validate_tile_shape(mesh: Mesh, board_shape, halo_width: int) -> None:
-    """Halo exchange needs tiles at least as tall/wide as the halo."""
+def validate_tile_shape(
+    mesh: Mesh, board_shape, halo_width: int, radius: int = 1
+) -> None:
+    """Halo exchange needs tiles at least as tall/wide as the exchanged pad
+    (``halo_width`` steps × the rule's radius in cells per side)."""
+    pad = halo_width * radius
     h = board_shape[-2] // mesh.shape[ROW_AXIS]
     w = board_shape[-1] // mesh.shape[COL_AXIS]
-    if h < halo_width or w < halo_width:
+    if h < pad or w < pad:
         raise ValueError(
-            f"tile {(h, w)} smaller than halo width {halo_width}; "
+            f"tile {(h, w)} smaller than the {pad}-cell halo "
+            f"({halo_width} steps x radius {radius}); "
             f"use a smaller mesh or halo"
         )

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from akka_game_of_life_tpu.ops.bitpack import step_padded_rows
+from akka_game_of_life_tpu.ops.bitpack import require_packed_support
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 from akka_game_of_life_tpu.parallel.halo import ring_shift
 
@@ -52,8 +53,7 @@ def sharded_packed_step_fn(
 ) -> Callable[[jax.Array], jax.Array]:
     """A jitted multi-step advance of a row-sharded packed board."""
     rule = resolve_rule(rule)
-    if not rule.is_binary:
-        raise ValueError("bit-packed kernel supports binary rules only")
+    require_packed_support(rule)
     if steps_per_call % halo_width:
         raise ValueError(
             f"steps_per_call={steps_per_call} must be a multiple of "

@@ -30,7 +30,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 
-from akka_game_of_life_tpu.ops.bitpack import LANE_BITS, step_packed
+from akka_game_of_life_tpu.ops.bitpack import (
+    LANE_BITS,
+    step_packed,
+    require_packed_support,
+)
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 from akka_game_of_life_tpu.parallel.halo import ring_shift
 from akka_game_of_life_tpu.parallel.mesh import (
@@ -142,8 +146,7 @@ def sharded_packed2d_step_fn(
     (:func:`word_halo_width`).
     """
     rule = resolve_rule(rule)
-    if not rule.is_binary:
-        raise ValueError("bit-packed kernel supports binary rules only")
+    require_packed_support(rule)
     s, hw = halo_rows, word_halo_width(halo_rows)
 
     def check(tile: jax.Array) -> None:

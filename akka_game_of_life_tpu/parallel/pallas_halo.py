@@ -44,6 +44,7 @@ from akka_game_of_life_tpu.ops.pallas_stencil import (
     auto_steps_per_sweep,
     packed_sweep_fn,
 )
+from akka_game_of_life_tpu.ops.bitpack import require_packed_support
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 from akka_game_of_life_tpu.parallel.mesh import COL_AXIS, GRID_SPEC
 from akka_game_of_life_tpu.parallel.packed_halo2d import (
@@ -111,8 +112,7 @@ def sharded_pallas_step_fn(
     Pallas kernel in interpret mode (CPU-testable, same numerics).
     """
     rule = resolve_rule(rule)
-    if not rule.is_binary:
-        raise ValueError("bit-packed kernel supports binary rules only")
+    require_packed_support(rule)
     k, g = plan_exchange(steps_per_call, block_rows, steps_per_sweep)
     steps_per_exchange = k * g
     p = block_rows // 2

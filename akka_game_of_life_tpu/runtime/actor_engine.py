@@ -117,6 +117,11 @@ class ActorBoard:
 
     def __init__(self, board: np.ndarray, rule) -> None:
         self.rule = resolve_rule(rule)
+        if self.rule.radius != 1:
+            raise ValueError(
+                "the per-cell actor engine is Moore-8 (radius 1); "
+                "radius-R ltl rules run on the dense kernel"
+            )
         board = np.asarray(board, dtype=np.uint8)
         self.shape = board.shape
         h, w = self.shape

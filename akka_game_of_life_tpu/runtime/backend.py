@@ -635,6 +635,15 @@ class BackendWorker:
         outbound: List[Tuple[TileId, np.ndarray, int]] = []
         with self._lock:
             rule = resolve_rule(msg["rule"])
+            if rule.radius != 1:
+                # The invariant lives here, not only at the Frontend: every
+                # chunk engine below (swar C++, np peel, jax scan) assumes a
+                # one-cell-per-step garbage front; a radius-R rule reaching
+                # them would be silently wrong, not slow.
+                raise ValueError(
+                    f"cluster workers exchange radius-1 rings; cannot host "
+                    f"{rule}"
+                )
             if self.rule != rule:
                 self.rule = rule
                 if self.engine == "jax":

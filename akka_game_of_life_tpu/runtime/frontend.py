@@ -76,6 +76,12 @@ class Frontend:
             raise ValueError("frontend requires max_epochs")
         self.config = config
         self.rule = resolve_rule(config.rule)
+        if self.rule.radius != 1:
+            raise ValueError(
+                "the TCP cluster exchanges radius-1 boundary rings; "
+                "radius-R ltl rules run standalone (single-chip or a "
+                "jax.distributed mesh, where the halo is radius-aware)"
+            )
         self.min_backends = min_backends
         self.observer = observer or BoardObserver(
             render_every=config.render_every,

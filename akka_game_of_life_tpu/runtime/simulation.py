@@ -184,7 +184,9 @@ class Simulation:
                     self._validate_packed_mesh()
             else:
                 self.mesh = make_grid_mesh(config.mesh_shape)
-                validate_tile_shape(self.mesh, config.shape, config.halo_width)
+                validate_tile_shape(
+                    self.mesh, config.shape, config.halo_width, self.rule.radius
+                )
         else:
             self.mesh = None
         self._steppers: Dict[int, Callable] = {}

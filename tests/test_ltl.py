@@ -1,0 +1,130 @@
+"""Larger-than-Life: the MXU conv family.
+
+Correctness anchors: (1) an R=1 ltl rule with Conway's B/S sets must be
+bit-identical to the classic VPU kernel — same math, different compute
+unit; (2) the numpy integral-image oracle must match the conv kernel at
+every radius; (3) the sharded dense path must carry radius-R halos
+(k steps x R cells per exchange) and still match single-device.
+"""
+
+import io
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from akka_game_of_life_tpu.ops import ltl
+from akka_game_of_life_tpu.ops.rules import BUGS, Rule, parse_rule, resolve_rule
+from akka_game_of_life_tpu.ops.stencil import multi_step
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import Simulation
+from akka_game_of_life_tpu.utils.patterns import random_grid
+
+CONWAY_AS_LTL = Rule(
+    frozenset({3}), frozenset({2, 3}), radius=1, kind="ltl", name=None
+)
+
+
+def test_rulestring_roundtrip_and_parse():
+    assert BUGS.rulestring() == "R5,B34-45,S33-57"
+    r = parse_rule("R5,B34-45,S33-57")
+    assert r.birth == BUGS.birth and r.survive == BUGS.survive
+    assert r.radius == 5 and r.kind == "ltl"
+    assert resolve_rule("bugs") is BUGS
+    # Non-contiguous sets survive the range collapse.
+    odd = Rule(frozenset({3, 7, 8}), frozenset({2}), kind="ltl", radius=2)
+    assert resolve_rule(odd.rulestring()) == odd
+
+
+def test_radius1_ltl_equals_classic_kernel():
+    # Same rule, two compute units: the MXU conv path must be bit-identical
+    # to the VPU roll-sum path.
+    board = random_grid((64, 96), seed=3)
+    classic = np.asarray(multi_step(jnp.asarray(board), "conway", 16))
+    via_mxu = np.asarray(ltl.ltl_multi_step_fn(CONWAY_AS_LTL, 16)(jnp.asarray(board)))
+    np.testing.assert_array_equal(via_mxu, classic)
+
+
+@pytest.mark.parametrize("radius", [2, 3, 5])
+def test_conv_kernel_matches_integral_image_oracle(radius):
+    max_n = (2 * radius + 1) ** 2 - 1
+    rule = Rule(
+        frozenset(range(max_n // 3, max_n // 2)),
+        frozenset(range(max_n // 4, max_n // 2 + 4)),
+        radius=radius,
+        kind="ltl",
+    )
+    board = random_grid((48, 64), seed=radius, density=0.35)
+    jx = jnp.asarray(board)
+    npb = board
+    for _ in range(4):
+        jx = ltl.step_ltl(jx, rule)
+        npb = ltl.step_ltl_np(npb, rule)
+    np.testing.assert_array_equal(np.asarray(jx), npb)
+
+
+def test_bugs_blob_lives():
+    # A dense random blob under Bugs forms gliding "bugs"; the precise shapes
+    # are chaotic, so assert liveness + the numpy oracle agreement.
+    rng = np.random.default_rng(0)
+    board = np.zeros((128, 128), np.uint8)
+    board[40:80, 40:80] = (rng.random((40, 40)) < 0.5).astype(np.uint8)
+    out = np.asarray(ltl.ltl_multi_step_fn(BUGS, 8)(jnp.asarray(board)))
+    assert out.sum() > 100, "bugs died out unexpectedly"
+    npb = board
+    for _ in range(8):
+        npb = ltl.step_ltl_np(npb, BUGS)
+    np.testing.assert_array_equal(out, npb)
+
+
+def test_sharded_ltl_matches_single_device():
+    from akka_game_of_life_tpu.parallel import make_grid_mesh, shard_board
+    from akka_game_of_life_tpu.parallel.halo import sharded_step_fn
+
+    rule = Rule(frozenset({3, 4}), frozenset({2, 3, 4}), radius=2, kind="ltl")
+    mesh = make_grid_mesh((4, 2), devices=jax.devices()[:8])
+    board = random_grid((64, 64), seed=9)
+    # 8 steps, 2 per exchange -> 4-cell halos (2 steps x radius 2).
+    step = sharded_step_fn(mesh, rule, steps_per_call=8, halo_width=2)
+    out = np.asarray(step(shard_board(jnp.asarray(board), mesh)))
+    dense = np.asarray(multi_step(jnp.asarray(board), rule, 8))
+    np.testing.assert_array_equal(out, dense)
+
+
+def test_simulation_routes_ltl_to_dense_and_guards():
+    sim = Simulation(
+        SimulationConfig(height=64, width=64, rule="bugs", steps_per_call=4, seed=2),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    assert sim.kernel == "dense"
+    start = sim.board_host()
+    sim.advance(8)
+    np.testing.assert_array_equal(
+        sim.board_host(), np.asarray(multi_step(jnp.asarray(start), "bugs", 8))
+    )
+
+    with pytest.raises(ValueError, match="totalistic"):
+        Simulation(
+            SimulationConfig(height=64, width=64, rule="bugs", kernel="bitpack"),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+    # The packed kernels' guard catches ltl even though it IS binary.
+    from akka_game_of_life_tpu.ops import bitpack
+
+    with pytest.raises(ValueError, match="radius-1"):
+        bitpack.step_packed(jnp.zeros((8, 2), jnp.uint32), BUGS)
+
+    from akka_game_of_life_tpu.runtime.frontend import Frontend
+
+    with pytest.raises(ValueError, match="radius-1 boundary rings"):
+        Frontend(
+            SimulationConfig(height=64, width=64, rule="bugs", max_epochs=8),
+            min_backends=1,
+        )
+
+    from akka_game_of_life_tpu.runtime.actor_engine import ActorBoard
+
+    with pytest.raises(ValueError, match="Moore-8"):
+        ActorBoard(np.zeros((8, 8), np.uint8), "bugs")
