@@ -190,6 +190,7 @@ def main() -> None:
                     ),
                 ),
             ),
+            ("ltl-8192", lambda: bench_suite.bench_ltl(8192, "bugs", "ltl-8192")),
         ]
         for name, fn in aux:
             try:

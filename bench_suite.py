@@ -19,6 +19,8 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
   6. cluster-exchange    TCP-cluster width-k ring exchange, k=1 vs k=8
                          (in-process frontend + 2 jax workers; the
                          communication-avoiding ratio as a standing record).
+  7. ltl-8192            Bugs (radius-5 Larger than Life) through the bf16
+                         conv kernel — the MXU-path family.
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -142,24 +144,33 @@ def bench_swar(size: int, steps: int = 8) -> None:
     )
 
 
-def bench_dense(size: int, rule: str, config: str, steps: int = 32) -> None:
+def bench_dense(
+    size: int,
+    rule: str,
+    config: str,
+    steps: int = 32,
+    *,
+    density: float = 0.5,
+    flavor: str = "dense stencil",
+    bytes_per_cell: float = 2.0,  # uint8 read + write per step
+) -> None:
     import jax.numpy as jnp
 
     from akka_game_of_life_tpu.models import get_model
 
     model = get_model(rule)
-    board = jnp.asarray(model.init((size, size), density=0.5, seed=0))
+    board = jnp.asarray(model.init((size, size), density=density, seed=0))
     run = model.run(steps)
     population = lambda x: int(jnp.sum(x != 0))
     dt = _time_steps(run, board, population)
     rate = size * size * steps / dt
     _emit(
         config,
-        f"cell-updates/sec/chip, {rule} {size}x{size} dense stencil",
+        f"cell-updates/sec/chip, {rule} {size}x{size} {flavor}",
         rate,
         "cell-updates/sec",
         PER_CHIP_TARGET,
-        bytes_per_cell=2.0,  # uint8 read + write per step
+        bytes_per_cell=bytes_per_cell,
     )
 
 
@@ -284,6 +295,30 @@ def bench_pallas_gen(size: int, rule: str, config: str, steps: int = 32) -> None
         PER_CHIP_TARGET,
         # One HBM read + write of the m-plane stack per k-step sweep.
         bytes_per_cell=0.25 * m / k,
+    )
+
+
+def bench_ltl(size: int, rule: str, config: str, steps: int = 16) -> None:
+    """Larger-than-Life through the conv kernel — the MXU-path family
+    (get_model dispatches kind=ltl to ops/ltl.py, so this is bench_dense
+    with honest traffic accounting: the conv path upcasts to bf16 and
+    round-trips a full bf16 intermediate between the separable passes,
+    ~6 B/cell/step — u8 read + bf16 write+read + u8 write — not the plain
+    stencil's 2)."""
+    from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+    r = resolve_rule(rule)
+    bench_dense(
+        size,
+        rule,
+        config,
+        steps,
+        density=0.4,
+        flavor=(
+            f"radius-{r.radius} LtL conv (bf16, "
+            f"{2 * (2 * r.radius + 1)} MACs/cell)"
+        ),
+        bytes_per_cell=6.0,
     )
 
 
@@ -442,7 +477,7 @@ def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", type=int, nargs="*", default=[1, 2, 3, 4, 5, 6])
+    parser.add_argument("--config", type=int, nargs="*", default=[1, 2, 3, 4, 5, 6, 7])
     parser.add_argument(
         "--scale", type=float, default=1.0,
         help="multiply grid sides by this (e.g. 0.125 for CPU smoke runs)",
@@ -475,6 +510,8 @@ def main() -> None:
         bench_sharded(s(65536, 32 * 8))
     if 6 in args.config:
         bench_cluster_exchange(s(4096))
+    if 7 in args.config:
+        bench_ltl(s(8192), "bugs", "ltl-8192")
 
 
 if __name__ == "__main__":
