@@ -215,19 +215,40 @@ class BoardObserver:
         to its origin so the union is the canonical strided probe)."""
         return self._render_strides
 
+    def _complete_epoch(
+        self, store: Dict, floor_name: str, expected: int, epoch: int, key, item
+    ):
+        """The shared per-tile accumulation mechanism behind populations,
+        sampled frames, and probe windows: collect items per epoch, and once
+        every expected tile reported, advance the monotone completion floor
+        (re-reports from replaying tiles are recognized by it), prune stale
+        epochs, and hand back the complete dict — else None."""
+        floor = getattr(self, floor_name)
+        if floor is not None and epoch <= floor:
+            return None
+        tiles = store.setdefault(epoch, {})
+        tiles[key] = item
+        if len(tiles) < expected:
+            return None
+        del store[epoch]
+        setattr(self, floor_name, epoch)
+        for e in [e for e in store if e <= epoch]:
+            del store[e]
+        return tiles
+
     def add_population(self, epoch: int, key, population: int) -> None:
         """One tile's population at a metrics-cadence epoch; emits the
         metrics line when every tile has reported."""
-        if self._pop_floor is not None and epoch <= self._pop_floor:
+        d = self._complete_epoch(
+            self._pop_partial,
+            "_pop_floor",
+            self._expected_tiles or 0,
+            epoch,
+            key,
+            int(population),
+        )
+        if d is None:
             return
-        d = self._pop_partial.setdefault(epoch, {})
-        d[key] = int(population)
-        if len(d) < (self._expected_tiles or 0):
-            return
-        del self._pop_partial[epoch]
-        self._pop_floor = epoch
-        for e in [e for e in self._pop_partial if e <= epoch]:
-            del self._pop_partial[e]
         h, w = self._board_shape
         self._note_progress(epoch, sum(d.values()), h * w)
 
@@ -249,16 +270,16 @@ class BoardObserver:
         and prints the exact window once every intersecting tile reported."""
         if self._window_bbox is None:
             return
-        if self._window_floor is not None and epoch <= self._window_floor:
+        tiles = self._complete_epoch(
+            self._window_partial,
+            "_window_floor",
+            self._expected_window_tiles,
+            epoch,
+            key,
+            (tuple(origin), np.asarray(block)),
+        )
+        if tiles is None:
             return
-        tiles = self._window_partial.setdefault(epoch, {})
-        tiles[key] = (tuple(origin), np.asarray(block))
-        if len(tiles) < self._expected_window_tiles:
-            return
-        del self._window_partial[epoch]
-        self._window_floor = epoch
-        for e in [e for e in self._window_partial if e <= epoch]:
-            del self._window_partial[e]
         from akka_game_of_life_tpu.runtime.tiles import stitch
 
         self.observe_window(epoch, stitch(dict(tiles.values())), self._window_bbox)
@@ -274,16 +295,16 @@ class BoardObserver:
         and prints the frame when every tile has reported.  ``key`` is the
         tile's identity (completion is counted by tile, since a tile smaller
         than the stride contributes an empty sample)."""
-        if self._sample_floor is not None and epoch <= self._sample_floor:
+        tiles = self._complete_epoch(
+            self._sample_partial,
+            "_sample_floor",
+            self._expected_tiles or 0,
+            epoch,
+            key,
+            (tuple(scaled_origin), np.asarray(sample)),
+        )
+        if tiles is None:
             return
-        tiles = self._sample_partial.setdefault(epoch, {})
-        tiles[key] = (tuple(scaled_origin), np.asarray(sample))
-        if len(tiles) < (self._expected_tiles or 0):
-            return
-        del self._sample_partial[epoch]
-        self._sample_floor = epoch
-        for e in [e for e in self._sample_partial if e <= epoch]:
-            del self._sample_partial[e]
         from akka_game_of_life_tpu.runtime.tiles import stitch
 
         view = stitch(
