@@ -7,6 +7,9 @@ at board sizes where ``board_host()`` would gather gigabytes.
 """
 
 import io
+import os
+
+import pytest
 
 import numpy as np
 import jax.numpy as jnp
@@ -113,6 +116,38 @@ def test_probe_window_validation_and_cli_parse():
     assert _parse_window(None) is None
     with pytest.raises(SystemExit, match="probe-window"):
         _parse_window("8-17")
+
+
+@pytest.mark.skipif(
+    not os.environ.get("GOL_SCALE_TESTS"),
+    reason="16384² standalone run (minutes on CPU); set GOL_SCALE_TESTS=1",
+)
+def test_gun_phase_at_16384_with_chaos(tmp_path):
+    """The headline-class standalone drill on CPU: 16384² packed torus, gun
+    embedded, crash injected + replayed, phase verified through window
+    probes only — nothing O(board) ever crosses to the host."""
+    cfg = SimulationConfig(
+        height=16384,
+        width=16384,
+        pattern="gosper-glider-gun",
+        pattern_offset=(8, 8),
+        kernel="bitpack",
+        steps_per_call=30,
+        checkpoint_dir=str(tmp_path),
+        checkpoint_every=30,
+        fault_injection=FaultInjectionConfig(
+            enabled=True, first_after_epochs=30, every_epochs=60, max_crashes=1
+        ),
+    )
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    gun = initial_board(
+        SimulationConfig(
+            height=256, width=256, pattern="gosper-glider-gun", pattern_offset=(8, 8)
+        )
+    )[8:17, 8:44]
+    sim.advance(60)
+    assert sim.crash_log, "injector never fired"
+    np.testing.assert_array_equal(sim.board_window(8, 17, 8, 44), gun)
 
 
 def test_cluster_probe_window_across_tile_seams():
