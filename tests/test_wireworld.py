@@ -126,10 +126,13 @@ def test_wireworld_cluster_trajectory():
         height=16, width=16, rule="wireworld", pattern="wireworld-clock",
         pattern_offset=(6, 6), max_epochs=10,
     )
-    with cluster(cfg, 2, engine="jax") as h:
-        final = h.run_to_completion()
     oracle = np.asarray(
         get_model("wireworld").run(10)(jnp.asarray(initial_board(cfg)))
     )
-    np.testing.assert_array_equal(final, oracle)
-    np.testing.assert_array_equal(final, initial_board(cfg))  # period 10
+    # Both the jitted tile engine and the per-cell actor engine (ghost-ring
+    # halos feeding 4-state cells) must carry the family.
+    for engine in ("jax", "actor"):
+        with cluster(cfg, 2, engine=engine) as h:
+            final = h.run_to_completion()
+        np.testing.assert_array_equal(final, oracle, err_msg=engine)
+        np.testing.assert_array_equal(final, initial_board(cfg))  # period 10
