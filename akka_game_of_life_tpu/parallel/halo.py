@@ -118,6 +118,10 @@ def sharded_step_fn(
 
     @functools.wraps(mapped)
     def stepped(board: jax.Array) -> jax.Array:
+        # Trace-time guard: without it, exchange_halo's tile[-pad:] would
+        # silently clamp on undersized tiles and ship a wrong halo
+        # (surfacing later as a cryptic scan carry-shape mismatch).
+        validate_tile_shape(mesh, board.shape, halo_width, rule.radius)
         return mapped(board)
 
     return jax.jit(stepped, in_shardings=sharding, out_shardings=sharding)

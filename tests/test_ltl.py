@@ -93,6 +93,48 @@ def test_sharded_ltl_matches_single_device():
     np.testing.assert_array_equal(out, dense)
 
 
+def test_seeded_fuzz_sharded_ltl():
+    # Random radii, count sets, and mesh shapes: the radius-aware halo
+    # exchange must stay exact everywhere the dense oracle goes.
+    from akka_game_of_life_tpu.parallel import make_grid_mesh, shard_board
+    from akka_game_of_life_tpu.parallel.halo import sharded_step_fn
+
+    rng = np.random.default_rng(23)
+    for trial, mesh_shape in enumerate([(2, 2), (8, 1), (2, 4)]):
+        radius = int(rng.integers(2, 5))
+        max_n = (2 * radius + 1) ** 2 - 1
+        birth = frozenset(
+            int(v) for v in rng.choice(max_n, size=max_n // 3, replace=False)
+        )
+        survive = frozenset(
+            int(v) for v in rng.choice(max_n, size=max_n // 2, replace=False)
+        )
+        rule = Rule(birth, survive, radius=radius, kind="ltl")
+        n = mesh_shape[0] * mesh_shape[1]
+        mesh = make_grid_mesh(mesh_shape, devices=jax.devices()[:n])
+        board = random_grid((48, 48), seed=50 + trial, density=0.4)
+        steps = 4
+        # Exchange depth bounded by the per-shard tile (pad = k*R must fit).
+        tile_min = min(48 // mesh_shape[0], 48 // mesh_shape[1])
+        per_exchange = 2 if 2 * radius <= tile_min else 1
+        step = sharded_step_fn(
+            mesh, rule, steps_per_call=steps, halo_width=per_exchange
+        )
+        out = np.asarray(step(shard_board(jnp.asarray(board), mesh)))
+        dense = np.asarray(multi_step(jnp.asarray(board), rule, steps))
+        np.testing.assert_array_equal(
+            out, dense, err_msg=f"{mesh_shape} {rule.rulestring()}"
+        )
+
+    # Oversized halos fail loudly at trace time, not as a cryptic scan error.
+    big = Rule(frozenset({9}), frozenset({8, 9}), radius=4, kind="ltl")
+    mesh = make_grid_mesh((8, 1), devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="smaller than the 8-cell halo"):
+        sharded_step_fn(mesh, big, steps_per_call=4, halo_width=2)(
+            shard_board(jnp.asarray(random_grid((48, 48), seed=1)), mesh)
+        )
+
+
 def test_simulation_routes_ltl_to_dense_and_guards():
     sim = Simulation(
         SimulationConfig(height=64, width=64, rule="bugs", steps_per_call=4, seed=2),
