@@ -2,14 +2,16 @@
 
 Every other kernel in this framework is VPU work — bitwise SWAR adders and
 byte stencils, because a Moore-8 count is too small to feed a matrix unit.
-Larger than Life (Evans) scales the neighborhood to a (2R+1)² box, and a
-box-sum over a grid IS a convolution: here it runs as two separable
+Larger than Life (Evans) scales the neighborhood to a radius-R window —
+the (2R+1)² Moore box (Golly NM) or the von Neumann diamond (NN) — and a
+window-sum over a grid IS a convolution: the box runs as two separable
 ``lax.conv_general_dilated`` passes (a (2R+1)×1 column conv then a 1×(2R+1)
-row conv) in bfloat16 — the MXU's native diet — so the TPU's main compute
-unit finally carries a CA family.  Counts ≤ (2R+1)² − 1 ≤ 440 are exact in
-bf16 (integers to 256) for R ≤ 7 and in f32 beyond, chosen automatically.
+row conv), the non-separable diamond as one direct masked conv, all in
+bfloat16 — the MXU's native diet — so the TPU's main compute unit finally
+carries a CA family.  Counts ≤ max_neighbors ≤ 440 are exact in bf16
+(integers to 256) when they fit and in f32 beyond, chosen automatically.
 
-The birth/survive sets are arbitrary subsets of 0..(2R+1)²−1, applied as a
+The birth/survive sets are arbitrary subsets of 0..max_neighbors, applied as a
 table gather (XLA lowers the tiny lookup into the fused epilogue).  With
 R=1 this reduces exactly to the classic outer-totalistic step — the
 cross-validation anchor ``tests/test_ltl.py`` pins against the VPU kernel.
@@ -39,16 +41,34 @@ def _count_dtype(rule: Rule):
     return jnp.bfloat16 if rule.max_neighbors < 255 else jnp.float32
 
 
-def _box_counts(alive_2d: jax.Array, radius: int, dtype) -> jax.Array:
-    """(H+2R, W+2R) 0/1 halo-padded alive plane → (H, W) box sums INCLUDING
-    the center, as two separable convs (column pass then row pass)."""
+def neighborhood_mask(radius: int, neighborhood: str) -> np.ndarray:
+    """(2R+1, 2R+1) 0/1 window mask INCLUDING the center: the full box, or
+    the von Neumann diamond (L1 ball)."""
+    d = 2 * radius + 1
+    if neighborhood == "diamond":
+        yy, xx = np.mgrid[-radius : radius + 1, -radius : radius + 1]
+        return (np.abs(yy) + np.abs(xx) <= radius).astype(np.uint8)
+    return np.ones((d, d), np.uint8)
+
+
+def _window_counts(
+    alive_2d: jax.Array, radius: int, neighborhood: str, dtype
+) -> jax.Array:
+    """(H+2R, W+2R) 0/1 halo-padded alive plane → (H, W) window sums
+    INCLUDING the center.  The box is two separable convs (column pass then
+    row pass); the diamond is not separable, so it runs as one direct
+    (2R+1)² masked conv — still a single conv_general_dilated the TPU conv
+    unit eats whole."""
     r = radius
     x = alive_2d.astype(dtype)[None, None]  # NCHW
-    col = jnp.ones((1, 1, 2 * r + 1, 1), dtype)
-    row = jnp.ones((1, 1, 1, 2 * r + 1), dtype)
-    x = jax.lax.conv_general_dilated(x, col, (1, 1), "VALID")
-    x = jax.lax.conv_general_dilated(x, row, (1, 1), "VALID")
-    return x[0, 0]
+    if neighborhood == "box":
+        col = jnp.ones((1, 1, 2 * r + 1, 1), dtype)
+        row = jnp.ones((1, 1, 1, 2 * r + 1), dtype)
+        x = jax.lax.conv_general_dilated(x, col, (1, 1), "VALID")
+        x = jax.lax.conv_general_dilated(x, row, (1, 1), "VALID")
+        return x[0, 0]
+    k = jnp.asarray(neighborhood_mask(r, neighborhood), dtype)[None, None]
+    return jax.lax.conv_general_dilated(x, k, (1, 1), "VALID")[0, 0]
 
 
 def _tables(rule: Rule):
@@ -76,9 +96,9 @@ def step_padded_ltl(padded: jax.Array, rule) -> jax.Array:
     rule = resolve_rule(rule)
     r = rule.radius
     alive = (padded == 1).astype(STATE_DTYPE)
-    counts = _box_counts(alive, r, _count_dtype(rule))
+    counts = _window_counts(alive, r, rule.neighborhood, _count_dtype(rule))
     interior = padded[r:-r, r:-r]
-    # The box sum includes the center; neighbor count excludes it.
+    # The window sum includes the center; neighbor count excludes it.
     neighbors = counts - alive[r:-r, r:-r].astype(counts.dtype)
     return _apply(interior, neighbors, rule)
 
@@ -111,18 +131,27 @@ def step_padded_ltl_np(padded: np.ndarray, rule) -> np.ndarray:
     rule = resolve_rule(rule)
     r = rule.radius
     alive = (padded == 1).astype(np.int32)
-    ii = np.zeros((padded.shape[0] + 1, padded.shape[1] + 1), np.int32)
-    ii[1:, 1:] = alive.cumsum(0).cumsum(1)
     h, w = padded.shape[0] - 2 * r, padded.shape[1] - 2 * r
     d = 2 * r + 1
-    box = (
-        ii[d : d + h, d : d + w]
-        - ii[0:h, d : d + w]
-        - ii[d : d + h, 0:w]
-        + ii[0:h, 0:w]
-    )
+    if rule.neighborhood == "box":
+        ii = np.zeros((padded.shape[0] + 1, padded.shape[1] + 1), np.int32)
+        ii[1:, 1:] = alive.cumsum(0).cumsum(1)
+        window = (
+            ii[d : d + h, d : d + w]
+            - ii[0:h, d : d + w]
+            - ii[d : d + h, 0:w]
+            + ii[0:h, 0:w]
+        )
+    else:
+        # Diamond: direct masked sliding sum (independent of the conv path).
+        mask = neighborhood_mask(r, rule.neighborhood)
+        window = np.zeros((h, w), np.int32)
+        for dy in range(d):
+            for dx in range(d):
+                if mask[dy, dx]:
+                    window += alive[dy : dy + h, dx : dx + w]
     interior = padded[r : r + h, r : r + w]
-    neighbors = box - alive[r : r + h, r : r + w]
+    neighbors = window - alive[r : r + h, r : r + w]
     birth = np.zeros(rule.max_neighbors + 1, np.uint8)
     survive = np.zeros(rule.max_neighbors + 1, np.uint8)
     for b in rule.birth:

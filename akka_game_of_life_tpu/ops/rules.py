@@ -55,6 +55,10 @@ class Rule:
     # only the transition/count-geometry differs per kind.
     kind: str = "totalistic"
     radius: int = 1  # neighborhood radius; >1 only for kind="ltl"
+    # Neighborhood norm for kind="ltl": "box" = radius-R Moore (Golly NM),
+    # "diamond" = von Neumann L1 ball (Golly NN).  Radius-1 families always
+    # use the Moore box.
+    neighborhood: str = "box"
 
     def __post_init__(self) -> None:
         if self.kind not in ("totalistic", "wireworld", "ltl"):
@@ -63,6 +67,10 @@ class Rule:
             raise ValueError("wireworld has exactly 4 states")
         if self.kind != "ltl" and self.radius != 1:
             raise ValueError(f"radius {self.radius} requires kind='ltl'")
+        if self.neighborhood not in ("box", "diamond"):
+            raise ValueError(f"unknown neighborhood {self.neighborhood!r}")
+        if self.neighborhood != "box" and self.kind != "ltl":
+            raise ValueError("neighborhood='diamond' requires kind='ltl'")
         if self.kind == "ltl":
             if not (1 <= self.radius <= 10):
                 raise ValueError(f"ltl radius must be in 1..10, got {self.radius}")
@@ -104,16 +112,20 @@ class Rule:
 
     @property
     def max_neighbors(self) -> int:
-        """Largest possible neighbor count: 8 for radius 1, (2R+1)² - 1
-        beyond (the radius-R Moore neighborhood)."""
+        """Largest possible neighbor count: (2R+1)² - 1 for the Moore box,
+        2R(R+1) for the von Neumann diamond (L1 ball minus center)."""
+        if self.neighborhood == "diamond":
+            return 2 * self.radius * (self.radius + 1)
         return (2 * self.radius + 1) ** 2 - 1
 
     def rulestring(self) -> str:
         if self.kind == "ltl":
             # Range notation, round-trippable through parse_rule:
-            # "R5,B34-45,S33-57" (counts exclude the center cell).
+            # "R5,B34-45,S33-57" (counts exclude the center cell);
+            # diamond neighborhoods append ",NN" (Golly's von Neumann tag).
+            nn = ",NN" if self.neighborhood == "diamond" else ""
             return (
-                f"R{self.radius},B{_ranges(self.birth)},S{_ranges(self.survive)}"
+                f"R{self.radius},B{_ranges(self.birth)},S{_ranges(self.survive)}{nn}"
             )
         if not self.is_totalistic:
             # Non-totalistic families have no B/S encoding; the registered
@@ -171,7 +183,8 @@ def _parse_ranges(spec: str) -> FrozenSet[int]:
 
 
 _LTL_RE = re.compile(
-    r"^R(?P<r>\d+),B(?P<b>[\d,\-]*),S(?P<s>[\d,\-]*)$", re.IGNORECASE
+    r"^R(?P<r>\d+),B(?P<b>[\d,\-]*),S(?P<s>[\d,\-]*)(?:,N(?P<n>[NM]))?$",
+    re.IGNORECASE
 )
 _BS_RE = re.compile(r"^B(?P<b>\d*)/S(?P<s>\d*)$", re.IGNORECASE)
 _SB_RE = re.compile(r"^(?P<s>\d*)/(?P<b>\d*)$")
@@ -201,6 +214,8 @@ def parse_rule(rulestring: str, name: Optional[str] = None) -> Rule:
             survive=_parse_ranges(m.group("s")),
             radius=int(m.group("r")),
             kind="ltl",
+            # Golly tags: NM = Moore box (the default), NN = von Neumann.
+            neighborhood="diamond" if (m.group("n") or "M").upper() == "N" else "box",
             name=name,
         )
     for rx, has_states in ((_BSG_RE, True), (_GEN_RE, True), (_BS_RE, False), (_SB_RE, False)):

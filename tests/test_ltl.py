@@ -65,6 +65,31 @@ def test_conv_kernel_matches_integral_image_oracle(radius):
     np.testing.assert_array_equal(np.asarray(jx), npb)
 
 
+def test_diamond_neighborhood_parse_counts_and_oracle():
+    # Golly's NN tag: von Neumann L1 ball.  max_neighbors = 2R(R+1); the
+    # conv kernel (direct masked conv) must match the independent numpy
+    # sliding-sum oracle; radius-1 diamond counts exactly 4 neighbors.
+    r = parse_rule("R3,B6-10,S5-12,NN")
+    assert r.neighborhood == "diamond" and r.max_neighbors == 24
+    assert resolve_rule(r.rulestring()) == r
+
+    board = random_grid((40, 56), seed=8, density=0.4)
+    jx, npb = jnp.asarray(board), board
+    for _ in range(4):
+        jx = ltl.step_ltl(jx, r)
+        npb = ltl.step_ltl_np(npb, r)
+    np.testing.assert_array_equal(np.asarray(jx), npb)
+
+    # Radius-1 diamond: a lone cross of 4 neighbors around a dead center
+    # births iff 4 is in B (here: B4 -> born; box-Moore would count 8 and
+    # not birth).
+    lone = np.zeros((7, 7), np.uint8)
+    lone[2, 3] = lone[4, 3] = lone[3, 2] = lone[3, 4] = 1
+    vn = Rule(frozenset({4}), frozenset(), radius=1, kind="ltl", neighborhood="diamond")
+    out = np.asarray(ltl.step_ltl(jnp.asarray(lone), vn))
+    assert out[3, 3] == 1
+
+
 def test_bugs_blob_lives():
     # A dense random blob under Bugs forms gliding "bugs"; the precise shapes
     # are chaotic, so assert liveness + the numpy oracle agreement.
