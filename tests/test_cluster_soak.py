@@ -1,0 +1,81 @@
+"""Everything-at-once cluster soak: the reference's manual chaos drill
+(`README.md:3-12`) with every subsystem engaged simultaneously.
+
+One seeded run combines width-3 communication-avoiding rings, durable
+checkpoints, sampled render + probe windows, a mid-run worker kill, a spare
+joining late, and a pause/resume cycle — and the final board must still be
+bit-identical to the dense oracle.  The individual behaviors all have
+focused tests; this one exists to catch interactions between them (the
+class of bug that only appears when recovery, pacing, and observation race
+each other).
+"""
+
+import io
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.harness import cluster
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import initial_board
+
+
+def test_combined_chaos_soak(tmp_path):
+    epochs = 90
+    out = io.StringIO()
+    obs = BoardObserver(out=out, render_every=30, render_max_cells=24)
+    cfg = SimulationConfig(
+        height=96,
+        width=96,
+        seed=29,
+        pattern="gosper-glider-gun",
+        pattern_offset=(10, 10),
+        max_epochs=epochs,
+        exchange_width=3,
+        tick_s=0.02,  # paced: gives the chaos below real time windows
+        start_delay_s=0.01,
+        render_every=30,
+        probe_window=(10, 19, 10, 46),
+        checkpoint_dir=str(tmp_path),
+        checkpoint_every=30,
+    )
+    with cluster(cfg, 3, observer=obs, engine="jax") as h:
+        assert h.frontend.wait_for_backends(timeout=10)
+        h.frontend.start_simulation()
+
+        def wait_epoch(e, timeout=30.0):
+            t0 = time.monotonic()
+            while min(h.frontend.tile_epochs.values(), default=0) < e:
+                assert time.monotonic() - t0 < timeout, f"stalled before {e}"
+                assert h.frontend.error is None, h.frontend.error
+                time.sleep(0.005)
+
+        # Mid-run: pause, verify progress stops, resume.
+        wait_epoch(12)
+        h.frontend.pause()
+        time.sleep(0.15)
+        frozen = dict(h.frontend.tile_epochs)
+        time.sleep(0.25)
+        assert h.frontend.tile_epochs == frozen, "epochs advanced while paused"
+        h.frontend.resume()
+
+        # A worker dies abruptly after the first durable checkpoint exists;
+        # a spare joins around the same time.
+        wait_epoch(33)
+        h.workers[0].crash_hook()
+        h.add_worker("spare")
+
+        assert h.frontend.done.wait(60), "cluster did not finish"
+        assert h.frontend.error is None, h.frontend.error
+        final = h.frontend.final_board
+
+    oracle = np.asarray(
+        get_model("conway").run(epochs)(jnp.asarray(initial_board(cfg)))
+    )
+    np.testing.assert_array_equal(final, oracle)
+    text = out.getvalue()
+    # The gun window printed in phase at every render epoch that completed.
+    assert "window [10:19, 10:46]" in text
