@@ -29,6 +29,9 @@ RLE_PATTERNS: Dict[str, str] = {
         "$2b3o3b3o2b$o4bobo4bo$o4bobo4bo$o4bobo4bo$13b$2b3o3b3o2b!"
     ),
     "r-pentomino": "b2o$2o$bo!",
+    "pentadecathlon": "2bo4bo$2ob4ob2o$2bo4bo!",  # period-15 oscillator
+    "diehard": "6bob$2o6b$bo3b3o!",  # vanishes after exactly 130 generations
+    "acorn": "bo5b$3bo3b$2o2b3o!",  # 5206-gen methuselah (pop 633 stable)
     "gosper-glider-gun": (
         "24bo$22bobo$12b2o6b2o12b2o$11bo3bo4b2o12b2o$2o8bo5bo3b2o$2o8bo3bob2o4b"
         "obo$10bo5bo7bo$11bo3bo$12b2o!"

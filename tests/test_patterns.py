@@ -73,3 +73,37 @@ def test_place_rejects_oversized_pattern():
 
     with pytest.raises(ValueError):
         place(np.zeros((3, 3), dtype=np.uint8), get_pattern("gosper-glider-gun"))
+
+
+def test_pentadecathlon_period_15():
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.models import get_model
+    from akka_game_of_life_tpu.utils.patterns import pattern_board
+
+    board = pattern_board("pentadecathlon", (24, 24), (8, 8))
+    m = get_model("conway")
+    s = jnp.asarray(board)
+    import numpy as np
+
+    for t in range(1, 15):
+        s = m.step(s)
+        assert not np.array_equal(np.asarray(s), board), f"early repeat t={t}"
+    s = m.step(s)
+    np.testing.assert_array_equal(np.asarray(s), board)
+
+
+def test_diehard_dies_at_130():
+    import numpy as np
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.models import get_model
+    from akka_game_of_life_tpu.utils.patterns import pattern_board
+
+    # Big enough that nothing wraps into the action within 130 generations.
+    board = pattern_board("diehard", (96, 96), (44, 44))
+    m = get_model("conway")
+    at129 = np.asarray(m.run(129)(jnp.asarray(board)))
+    assert at129.sum() > 0
+    at130 = np.asarray(m.run(130)(jnp.asarray(board)))
+    assert at130.sum() == 0, "diehard failed to die at generation 130"
