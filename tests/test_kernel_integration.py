@@ -313,3 +313,27 @@ def test_gen_mesh_misfit_falls_back_or_errors():
             _cfg("bitpack", rule="brians-brain", height=36, width=32),
             observer=BoardObserver(out=io.StringIO()),
         )
+
+
+def test_acorn_5000_generation_kernel_equivalence():
+    """Long-horizon drift check: the acorn methuselah stepped 5000
+    generations through the bitpack SWAR kernel must remain bit-identical
+    to the dense path (one wrong carry anywhere in 5000 chained steps would
+    diverge the boards irreversibly)."""
+    from akka_game_of_life_tpu.ops.stencil import multi_step_fn
+    from akka_game_of_life_tpu.utils.patterns import pattern_board
+
+    board = pattern_board("acorn", (256, 256), (120, 120))
+    dense = jnp.asarray(board)
+    packed = bitpack.pack(jnp.asarray(board))
+    run_dense = multi_step_fn(get_model("conway").rule, 500)
+    from akka_game_of_life_tpu.ops.bitpack import packed_multi_step_fn
+
+    run_packed = packed_multi_step_fn(get_model("conway").rule, 500)
+    for chunk in range(10):
+        dense = run_dense(dense)
+        packed = run_packed(packed)
+        assert np.array_equal(
+            np.asarray(bitpack.unpack(packed)), np.asarray(dense)
+        ), f"kernels diverged by generation {(chunk + 1) * 500}"
+    assert int(np.asarray(dense).sum()) > 0
