@@ -15,6 +15,7 @@ Checks:
   checkpoint       save → crash → restore → replay ≡ uninterrupted run
   chaos            injected crash mid-run leaves the trajectory bit-identical
   sharded          (multi-device only) meshed stepping ≡ single-device
+  families         wireworld clock phase + LtL-R1 ≡ classic (cross-unit)
 """
 
 from __future__ import annotations
@@ -145,6 +146,35 @@ def _check_sharded(kernel: str) -> str:
     return sim.kernel
 
 
+def _check_families(kernel: str) -> str:
+    """The non-Conway rule families on this machine's dense path: the
+    wireworld clock must hold its period-10 phase, and a radius-1 LtL
+    Conway must be bit-identical to the classic kernel (the conv-vs-VPU
+    cross-unit anchor)."""
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.models import get_model
+    from akka_game_of_life_tpu.ops.rules import Rule
+    from akka_game_of_life_tpu.utils.patterns import pattern_board  # noqa: F401
+
+    ww = _sim(rule="wireworld", pattern="wireworld-clock", pattern_offset=(8, 8),
+              height=64, width=64, steps_per_call=5)
+    start = ww.board_window(8, 12, 8, 13)
+    assert start.sum() > 0
+    ww.advance(10)
+    assert np.array_equal(ww.board_window(8, 12, 8, 13), start), (
+        "wireworld clock lost phase"
+    )
+    ww.close()
+
+    board = pattern_board("acorn", (128, 128), (60, 60))
+    classic = _dense(board, 32)
+    as_ltl = Rule(frozenset({3}), frozenset({2, 3}), kind="ltl")
+    via_conv = np.asarray(get_model(as_ltl).run(32)(jnp.asarray(board)))
+    assert np.array_equal(via_conv, classic), "conv path diverged from classic"
+    return "dense"
+
+
 class _Skip(Exception):
     pass
 
@@ -155,6 +185,7 @@ CHECKS: List[tuple] = [
     ("checkpoint", _check_checkpoint),
     ("chaos", _check_chaos),
     ("sharded", _check_sharded),
+    ("families", _check_families),
 ]
 
 
