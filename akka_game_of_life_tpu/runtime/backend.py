@@ -12,8 +12,9 @@ advanced by a stencil engine:
   TPU path; within a multi-device worker the tile itself is mesh-sharded by
   :mod:`akka_game_of_life_tpu.parallel` — ICI inside, control plane outside);
 - ``engine="swar"``: C++ 64-cells-per-uint64 SWAR chunks
-  (``native/swar_kernel.cpp``) — host machine code for binary rules,
-  falling back to the numpy chunk for Generations rules;
+  (``native/swar_kernel.cpp``) — host machine code for binary radius-1
+  totalistic rules, falling back to the numpy chunk for everything else
+  (Generations, wireworld);
 - ``engine="actor"`` / ``"actor-native"``: the per-cell actor engine
   (:mod:`akka_game_of_life_tpu.runtime.actor_engine` and its C++ twin) —
   the reference's own architecture, swappable at role config (BASELINE
