@@ -42,6 +42,8 @@ def _run_sharded(board, mesh, rule, steps_per_call, **kw):
         ((4, 2), (64, 64), 16, 8),  # 2-D: word halos engage
         ((2, 2), (32, 128), 16, 12),  # non-power-of-two step count
         ((2, 4), (32, 256), 16, 8),  # wide word sharding
+        ((2, 1), (32, 64), 16, 1),  # single-step calls: one exchange per step
+        ((2, 2), (32, 128), 16, 6),  # k=6: sublane round-up without pow2
     ],
 )
 @pytest.mark.parametrize("rule", ["conway", "highlife"])
