@@ -376,6 +376,10 @@ class BackendWorker:
                 # The frontend rejects engines that can't honor the cluster's
                 # exchange width (actor engines need per-epoch halos).
                 "engine": self.engine,
+                # Observability: the jax engine's Mosaic pin, so the
+                # frontend's join line shows whether workers will step
+                # Pallas chunks (auto resolves at first deploy).
+                "pallas": self.pallas or "auto",
             }
         )
         welcome = self.channel.recv()

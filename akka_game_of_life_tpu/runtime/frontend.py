@@ -476,6 +476,13 @@ class Frontend:
                     "exchange_width": self.config.exchange_width,
                 }
             )
+            engine = hello.get("engine", "?")
+            detail = (
+                f" (engine {engine}, pallas {hello.get('pallas', 'auto')})"
+                if engine == "jax"
+                else f" (engine {engine})"
+            )
+            print(f"backend {member.name} joined{detail}", flush=True)
             while not self._stop.is_set():
                 msg = channel.recv()
                 if msg is None:
