@@ -245,6 +245,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.trace_dir:
             for dev, stats in profiling.device_memory_stats().items():
                 print(f"[profile] {dev}: {stats}", flush=True)
+        stats = sim.observer.summary()
+        if stats is not None:
+            import json as _json
+
+            print(
+                "run summary: "
+                + _json.dumps({"kernel": sim.kernel, "epoch": sim.epoch, **stats}),
+                flush=True,
+            )
         if cfg.render_every == 0 and cfg.metrics_every == 0:
             # Always show something at the end, like the reference's info.log.
             # board_host() is a collective in multi-host runs — every rank

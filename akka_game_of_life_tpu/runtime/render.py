@@ -344,6 +344,22 @@ class BoardObserver:
         self.observe(epoch, board)
         return board
 
+    def summary(self) -> Optional[dict]:
+        """Aggregate run statistics from the (bounded) metrics history:
+        epochs covered, wall seconds, mean rate, last population.  None if
+        no intervals were observed."""
+        if not self.history:
+            return None
+        epochs = sum(m.epochs for m in self.history)
+        seconds = sum(m.seconds for m in self.history)
+        cells = sum(m.cells for m in self.history)
+        return {
+            "epochs_observed": epochs,
+            "seconds": round(seconds, 3),
+            "cell_updates_per_sec": cells / seconds if seconds > 0 else None,
+            "final_population": self.history[-1].population,
+        }
+
     def close(self) -> None:
         if self._own_file is not None:
             self._own_file.close()
