@@ -242,18 +242,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # --max-epochs is the absolute end epoch: a resumed run (from a
             # checkpoint at epoch E) advances the remaining max_epochs - E.
             sim.advance(max(0, cfg.max_epochs - sim.epoch))
+            stats = sim.observer.summary()
+            if stats is not None:
+                import json as _json
+
+                # Inside the with block so the line reaches the observer's
+                # sink (e.g. --log-file) before close(); out is stdout by
+                # default.
+                print(
+                    "run summary: "
+                    + _json.dumps(
+                        {"kernel": sim.kernel, "epoch": sim.epoch, **stats}
+                    ),
+                    file=sim.observer.out,
+                    flush=True,
+                )
         if args.trace_dir:
             for dev, stats in profiling.device_memory_stats().items():
                 print(f"[profile] {dev}: {stats}", flush=True)
-        stats = sim.observer.summary()
-        if stats is not None:
-            import json as _json
-
-            print(
-                "run summary: "
-                + _json.dumps({"kernel": sim.kernel, "epoch": sim.epoch, **stats}),
-                flush=True,
-            )
         if cfg.render_every == 0 and cfg.metrics_every == 0:
             # Always show something at the end, like the reference's info.log.
             # board_host() is a collective in multi-host runs — every rank

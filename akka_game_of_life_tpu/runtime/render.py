@@ -126,6 +126,10 @@ class BoardObserver:
         # Bounded, unlike the reference's forever-growing per-epoch map
         # (LoggerActor.scala:27,34).
         self.history: Deque[StepMetrics] = deque(maxlen=1024)
+        # Running totals for summary() — the deque is a window, not the run.
+        self._total_epochs = 0
+        self._total_seconds = 0.0
+        self._total_cells = 0
 
     # -- complete-board path (standalone runner) -----------------------------
 
@@ -143,6 +147,9 @@ class BoardObserver:
                 population=population,
             )
             self.history.append(m)
+            self._total_epochs += m.epochs
+            self._total_seconds += m.seconds
+            self._total_cells += m.cells
             if self.metrics_every and epoch % self.metrics_every == 0:
                 print(
                     f"epoch {epoch}: pop={m.population} "
@@ -345,18 +352,20 @@ class BoardObserver:
         return board
 
     def summary(self) -> Optional[dict]:
-        """Aggregate run statistics from the (bounded) metrics history:
-        epochs covered, wall seconds, mean rate, last population.  None if
-        no intervals were observed."""
+        """Aggregate run statistics over ALL observed intervals (running
+        totals — the bounded history deque is only a window): epochs
+        covered, wall seconds, mean rate, last population.  None if no
+        intervals were observed."""
         if not self.history:
             return None
-        epochs = sum(m.epochs for m in self.history)
-        seconds = sum(m.seconds for m in self.history)
-        cells = sum(m.cells for m in self.history)
         return {
-            "epochs_observed": epochs,
-            "seconds": round(seconds, 3),
-            "cell_updates_per_sec": cells / seconds if seconds > 0 else None,
+            "epochs_observed": self._total_epochs,
+            "seconds": round(self._total_seconds, 3),
+            "cell_updates_per_sec": (
+                self._total_cells / self._total_seconds
+                if self._total_seconds > 0
+                else None
+            ),
             "final_population": self.history[-1].population,
         }
 
