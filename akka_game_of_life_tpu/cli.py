@@ -200,6 +200,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="kernel the checks drive (default auto — what `run` would pick)",
     )
 
+    sub.add_parser(
+        "models",
+        help="list the registered rule families (name, rulestring, kind, "
+        "states, radius) as JSON lines",
+    )
+
     be_p = sub.add_parser("backend", help="control-plane worker (RunBackend)")
     be_p.add_argument("--port", type=int, default=2551, help="frontend port to join")
     be_p.add_argument("--host", default="127.0.0.1")
@@ -294,6 +300,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise SystemExit(f"frontend role unavailable: {e}")
 
         return run_frontend(cfg, min_backends=args.min_backends)
+
+    if args.command == "models":
+        import json
+
+        from akka_game_of_life_tpu.ops.rules import NAMED_RULES
+
+        for name in sorted(NAMED_RULES):
+            r = NAMED_RULES[name]
+            print(
+                json.dumps(
+                    {
+                        "name": name,
+                        "rulestring": r.rulestring(),
+                        "kind": r.kind,
+                        "states": r.states,
+                        "radius": r.radius,
+                        "neighborhood": r.neighborhood,
+                    }
+                )
+            )
+        return 0
 
     if args.command == "selftest":
         from akka_game_of_life_tpu.runtime.selftest import run_selftest

@@ -106,3 +106,16 @@ def test_top_level_lazy_exports():
 
     with pytest.raises(AttributeError):
         gol.does_not_exist
+
+
+def test_models_subcommand_lists_registry(capsys):
+    import json
+
+    from akka_game_of_life_tpu.cli import main
+
+    assert main(["models"]) == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    by_name = {r["name"]: r for r in lines}
+    assert by_name["conway"]["rulestring"] == "B3/S23"
+    assert by_name["wireworld"]["kind"] == "wireworld"
+    assert by_name["bugs"]["radius"] == 5 and by_name["bugs"]["kind"] == "ltl"
