@@ -1,0 +1,12 @@
+#!/bin/bash
+# Archive a TPU measurement session's logs into artifacts/ (the in-repo
+# hardware evidence trail) and print its JSON value/check lines.
+#
+#   bash tools/harvest_session.sh /tmp/tpu_session_r3b [artifacts/tpu_session_r3b]
+set -u
+SRC="${1:?usage: harvest_session.sh <session-dir> [dest-dir]}"
+DST="${2:-artifacts/$(basename "$SRC")}"
+mkdir -p "$DST"
+cp "$SRC"/*.log "$DST"/ 2>/dev/null || true
+echo "== archived $(ls "$DST" | wc -l) logs to $DST"
+grep -h '"value"\|"check"' "$DST"/*.log 2>/dev/null | tail -40
