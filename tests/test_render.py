@@ -93,3 +93,15 @@ def test_observer_drops_unfinishable_partials():
     assert obs.observe_tile(20, (0, 0), t) is None
     assert obs.observe_tile(20, (0, 2), t) is not None
     assert obs._partial == {}
+
+
+def test_summary_totals_outlive_the_history_window():
+    # 1500 observed intervals overflow the 1024-deque; summary() must
+    # report run totals, not the window (the review's truncation scenario).
+    obs = BoardObserver(out=io.StringIO())
+    for epoch in range(0, 1501):
+        obs._note_progress(epoch, population=7, total_cells=100)
+    s = obs.summary()
+    assert s["epochs_observed"] == 1500
+    assert len(obs.history) == 1024
+    assert s["final_population"] == 7
