@@ -55,7 +55,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--width", type=int)
     p.add_argument("--density", type=float)
     p.add_argument("--seed", type=int)
-    p.add_argument("--pattern", help="named pattern instead of random board")
+    p.add_argument(
+        "--pattern",
+        help="initial board: a built-in pattern name or a path to a "
+        "Golly/LifeWiki .rle file (header rule checked against --rule)",
+    )
     p.add_argument("--max-epochs", type=int)
     p.add_argument("--tick", help="wall-clock pacing per epoch (e.g. 3000ms); 0 = free-run")
     p.add_argument("--steps-per-call", type=int)
@@ -171,6 +175,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="capture a jax.profiler trace of the run into this directory "
         "(view with TensorBoard/Perfetto)",
     )
+    run_p.add_argument(
+        "--dump-rle",
+        metavar="PATH",
+        help="write the final board as a Golly/LifeWiki .rle file "
+        "(O(board) host fetch — meant for boards you would also render)",
+    )
 
     fe_p = sub.add_parser("frontend", help="control-plane coordinator (RunFrontend)")
     _add_common(fe_p)
@@ -237,6 +247,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.command == "run":
         cfg = load_config(args.config, _overrides(args))
+        if args.dump_rle:
+            # Fail BEFORE the run, not after hours of compute: RLE's
+            # multi-state alphabet stops at state 24 (encode_rle raises),
+            # and an unwritable path would lose the board at the very end.
+            from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+            states = resolve_rule(cfg.rule).states
+            if states - 1 > 24:
+                raise SystemExit(
+                    f"--dump-rle: rule {cfg.rule!r} has {states} states; "
+                    "RLE's alphabet stops at 24 (25 states incl. dead)"
+                )
+            try:
+                with open(args.dump_rle, "a", encoding="utf-8"):
+                    pass
+            except OSError as e:
+                raise SystemExit(f"--dump-rle: cannot write {args.dump_rle!r}: {e}")
         from akka_game_of_life_tpu.runtime.simulation import Simulation
 
         if cfg.max_epochs is None:
@@ -266,13 +293,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.trace_dir:
             for dev, stats in profiling.device_memory_stats().items():
                 print(f"[profile] {dev}: {stats}", flush=True)
-        if cfg.render_every == 0 and cfg.metrics_every == 0:
-            # Always show something at the end, like the reference's info.log.
-            # board_host() is a collective in multi-host runs — every rank
-            # calls it; only rank 0 prints.
-            from akka_game_of_life_tpu.runtime.render import render_ascii
+        # board_host() is an O(board) collective in multi-host runs — every
+        # rank calls it, at most once, shared by the dump and the fallback
+        # render; only rank 0 writes/prints.
+        final = None
+        if args.dump_rle:
+            from akka_game_of_life_tpu.ops.rules import resolve_rule
+            from akka_game_of_life_tpu.utils.patterns import encode_rle
 
             final = sim.board_host()
+            import jax
+
+            if jax.process_index() == 0:
+                with open(args.dump_rle, "w", encoding="utf-8") as f:
+                    f.write(encode_rle(final, resolve_rule(cfg.rule).rulestring()))
+                print(f"wrote {args.dump_rle}", flush=True)
+        if cfg.render_every == 0 and cfg.metrics_every == 0:
+            # Always show something at the end, like the reference's info.log.
+            from akka_game_of_life_tpu.runtime.render import render_ascii
+
+            if final is None:
+                final = sim.board_host()
             import jax
 
             if jax.process_index() == 0:

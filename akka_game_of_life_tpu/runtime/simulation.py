@@ -42,12 +42,38 @@ from akka_game_of_life_tpu.runtime.chaos import CrashInjector
 from akka_game_of_life_tpu.runtime.checkpoint import make_store
 from akka_game_of_life_tpu.runtime.config import SimulationConfig
 from akka_game_of_life_tpu.runtime.render import BoardObserver
-from akka_game_of_life_tpu.utils.patterns import pattern_board, random_grid
+from akka_game_of_life_tpu.utils.patterns import (
+    place,
+    random_grid,
+    resolve_pattern,
+)
 
 
 def initial_board(config: SimulationConfig) -> np.ndarray:
     if config.pattern is not None:
-        return pattern_board(config.pattern, config.shape, config.pattern_offset)
+        pattern, declared = resolve_pattern(config.pattern)
+        if declared is not None:
+            # An .rle file's header names the rule the pattern was designed
+            # for; running it under a different rule is legal (exploration)
+            # but usually a config mistake, so say so.
+            try:
+                mismatch = (
+                    resolve_rule(declared).rulestring()
+                    != resolve_rule(config.rule).rulestring()
+                )
+            except ValueError:
+                mismatch = True  # header rule outside our rule space
+            if mismatch:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pattern %s declares rule %r but this run uses %r",
+                    config.pattern,
+                    declared,
+                    config.rule,
+                )
+        board = np.zeros(config.shape, dtype=np.uint8)
+        return place(board, pattern, config.pattern_offset)
     return random_grid(config.shape, density=config.density, seed=config.seed)
 
 

@@ -1,16 +1,23 @@
-"""Pattern library: RLE decoding, canonical Life patterns, random boards.
+"""Pattern library: RLE codec, canonical Life patterns, random boards.
 
 The reference has no pattern machinery at all — its only initial condition is
 a Bernoulli(1/2) random board (``BoardCreator.scala:23,47-53``).  Patterns are
 needed here because the framework's correctness north star (BASELINE.json) is
 *pattern-level*: blinker period 2, glider translation, Gosper glider-gun
 period 30 preserved across backend kill/restart.
+
+Beyond the built-in names, any Golly/LifeWiki ``.rle`` file loads directly
+(``--pattern path/to/thing.rle``): ``#`` comment lines, the ``x = …, y = …,
+rule = …`` header, and multi-state bodies (``.``/``A``–``X`` for states
+0–24, as Generations/WireWorld patterns are published) are all understood,
+and ``encode_rle`` writes the same format back out (``run --dump-rle``).
 """
 
 from __future__ import annotations
 
+import os
 import re
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -40,27 +47,42 @@ RLE_PATTERNS: Dict[str, str] = {
     "replicator": "2b3o$bo2bo$o3bo$o2bob$3o!",
 }
 
-_RLE_TOKEN = re.compile(r"(\d*)([bo$!])")
+# Body tokens: binary ``b``/``o`` plus the multi-state alphabet ``.`` (dead)
+# and ``A``–``X`` (states 1–24).  Golly's two-letter ``pA``-style encodings
+# for states >24 are detected and rejected loudly rather than misread.
+_RLE_TOKEN = re.compile(r"(\d*)([bo$!.A-X]|[p-y][A-X])")
 
 
 def decode_rle(rle: str) -> np.ndarray:
-    """Decode an RLE body string into a (H, W) uint8 0/1 array."""
+    """Decode an RLE body string into a (H, W) uint8 state array."""
     rows = []
     row = []
-    for count_s, tag in _RLE_TOKEN.findall(rle.replace("\n", "").replace(" ", "")):
+    body = rle.replace("\n", "").replace(" ", "")
+    for count_s, tag in _RLE_TOKEN.findall(body):
         count = int(count_s) if count_s else 1
-        if tag == "b":
+        if len(tag) == 2:
+            raise ValueError(
+                f"multi-plane RLE token {tag!r}: states above 24 are not "
+                "supported (max rule family here is 24-state Generations)"
+            )
+        if tag in ("b", "."):
             row.extend([0] * count)
         elif tag == "o":
             row.extend([1] * count)
+        elif "A" <= tag <= "X":
+            row.extend([ord(tag) - ord("A") + 1] * count)
         elif tag == "$":
             rows.append(row)
             # A multi-count `$` encodes blank rows.
             rows.extend([[]] * (count - 1))
             row = []
         elif tag == "!":
-            rows.append(row)
-            row = []
+            # Only flush a non-empty in-progress row: a trailing `$` before
+            # `!` (a style some writers emit) already flushed it, and must
+            # not add a phantom blank row past the declared extent.
+            if row:
+                rows.append(row)
+                row = []
             break
     if row:
         # Tolerate a missing '!' terminator (truncated paste) rather than
@@ -71,6 +93,158 @@ def decode_rle(rle: str) -> np.ndarray:
     for y, r in enumerate(rows):
         grid[y, : len(r)] = r
     return grid
+
+
+# A Golly/LifeWiki RLE header: "x = W, y = H" with an optional trailing
+# ", rule = ...".  The rule is the header's final field and the rulestring
+# itself may contain commas (LtL: "R5,B34-45,S33-57", Golly "R5,C0,M1,..."),
+# so it captures to end of line.
+_RLE_HEADER = re.compile(
+    r"^\s*x\s*=\s*(\d+)\s*,\s*y\s*=\s*(\d+)\s*(?:,\s*rule\s*=\s*(.+?))?\s*$",
+    re.IGNORECASE,
+)
+
+
+def parse_rle(text: str) -> Tuple[np.ndarray, Optional[str]]:
+    """Parse a full RLE *file* (comments + header + body).
+
+    Returns ``(grid, rule)`` where ``rule`` is the header's declared
+    rulestring (or None when absent).  The grid is padded out to the
+    header's declared ``x``/``y`` extent — RLE omits trailing dead cells
+    and rows, but the declared bounding box is part of the pattern.
+    """
+    rule: Optional[str] = None
+    size: Optional[Tuple[int, int]] = None
+    body_lines = []
+    for line in text.splitlines():
+        s = line.strip()
+        if not s or s.startswith("#"):
+            continue
+        if size is None and not body_lines:
+            m = _RLE_HEADER.match(s)
+            if m:
+                size = (int(m.group(2)), int(m.group(1)))  # (H, W)
+                rule = m.group(3)
+                continue
+        body_lines.append(s)
+    grid = decode_rle("".join(body_lines))
+    if size is not None:
+        h, w = size
+        gh, gw = grid.shape
+        if gh > h or gw > w:
+            raise ValueError(
+                f"RLE body extent {gh}x{gw} exceeds declared header "
+                f"x = {w}, y = {h}"
+            )
+        if (gh, gw) != (h, w):
+            padded = np.zeros((h, w), dtype=np.uint8)
+            padded[:gh, :gw] = grid
+            grid = padded
+    return grid, rule
+
+
+def encode_rle(
+    grid: np.ndarray, rule: Optional[str] = None, line_width: int = 70
+) -> str:
+    """Encode a (H, W) state array as a full RLE file string.
+
+    Binary grids use ``b``/``o``; grids with states >1 use the multi-state
+    ``.``/``A``–``X`` alphabet.  Round-trips through :func:`parse_rle`.
+    """
+    grid = np.asarray(grid)
+    if grid.ndim != 2:
+        raise ValueError(f"expected a 2-D grid, got shape {grid.shape}")
+    h, w = grid.shape
+    peak = int(grid.max(initial=0))
+    if peak > 24:
+        raise ValueError(f"state {peak} exceeds RLE's 24-state alphabet")
+    multi = peak > 1
+
+    def sym(v: int) -> str:
+        if v == 0:
+            return "." if multi else "b"
+        if multi:
+            return chr(ord("A") + v - 1)
+        return "o"
+
+    row_toks = []
+    for y in range(h):
+        row = grid[y]
+        nz = np.nonzero(row)[0]
+        if nz.size == 0:
+            row_toks.append("")
+            continue
+        # Vectorized run segmentation (cost scales with the number of runs,
+        # not cells): pattern-class boards encode fast at any size.  A dense
+        # *random* board at headline sizes is not a target use — its RLE is
+        # gigabytes of one-cell runs no matter how this is built.
+        seg = row[: int(nz[-1]) + 1]
+        bounds = np.flatnonzero(seg[1:] != seg[:-1]) + 1
+        starts = np.concatenate(([0], bounds))
+        ends = np.concatenate((bounds, [seg.size]))
+        toks = []
+        for n, v in zip((ends - starts).tolist(), seg[starts].tolist()):
+            toks.append((str(n) if n > 1 else "") + sym(v))
+        row_toks.append(toks)
+    while row_toks and not row_toks[-1]:
+        row_toks.pop()
+    # Rows separate with `$`; blank rows collapse into the separator count
+    # (dollars = separators owed before the next non-blank row lands).
+    # toks stays a flat stream of small run tokens so line wrapping can
+    # break inside long rows (the spec's 70-char line limit is per line,
+    # not per row — a dense 65536-wide row far exceeds it).
+    toks = []
+    dollars = 0
+    for r in row_toks:
+        if r:
+            if dollars:
+                toks.append(f"{dollars}$" if dollars > 1 else "$")
+            toks.extend(r)
+            dollars = 1
+        else:
+            dollars += 1
+    toks.append("!")
+    lines = []
+    cur = ""
+    for t in toks:
+        if cur and len(cur) + len(t) > line_width:
+            lines.append(cur)
+            cur = ""
+        cur += t
+    if cur:
+        lines.append(cur)
+    header = f"x = {w}, y = {h}"
+    if rule:
+        header += f", rule = {rule}"
+    return header + "\n" + "\n".join(lines) + "\n"
+
+
+def load_rle_file(path: str) -> Tuple[np.ndarray, Optional[str]]:
+    """Load a ``.rle`` pattern file → ``(grid, declared_rule_or_None)``."""
+    with open(path, "r", encoding="utf-8") as f:
+        return parse_rle(f.read())
+
+
+def _looks_like_file(name: str) -> bool:
+    return name.lower().endswith(".rle") or os.sep in name
+
+
+def resolve_pattern(name: str) -> Tuple[np.ndarray, Optional[str]]:
+    """Resolve a pattern name or ``.rle`` path → ``(grid, declared_rule)``.
+
+    Only ``.rle`` files declare a rule (the header's ``rule =`` field);
+    built-in named patterns return None there.  One call, one file read —
+    this is the primitive behind :func:`get_pattern`, and what callers that
+    also want the declared rule (e.g. the run-vs-pattern rule-mismatch
+    warning) should use.
+    """
+    if _looks_like_file(name):
+        if not os.path.exists(name):
+            raise KeyError(f"pattern file not found: {name!r}")
+        return load_rle_file(name)
+    return get_pattern(name), None
+
+
 
 
 # Multi-state patterns (state digits), for families RLE's b/o can't encode.
@@ -89,7 +263,14 @@ DIGIT_PATTERNS: Dict[str, Tuple[str, ...]] = {
 
 
 def get_pattern(name: str) -> np.ndarray:
-    """Look up a canonical pattern by name as a (H, W) uint8 array."""
+    """Look up a pattern as a (H, W) uint8 array.
+
+    ``name`` is either a built-in canonical name or a path to a Golly/
+    LifeWiki ``.rle`` file (anything ending in ``.rle`` or containing a
+    path separator).
+    """
+    if _looks_like_file(name):
+        return resolve_pattern(name)[0]
     key = name.strip().lower()
     if key in DIGIT_PATTERNS:
         return np.array(

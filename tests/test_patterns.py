@@ -107,3 +107,159 @@ def test_diehard_dies_at_130():
     assert at129.sum() > 0
     at130 = np.asarray(m.run(130)(jnp.asarray(board)))
     assert at130.sum() == 0, "diehard failed to die at generation 130"
+
+
+# ---- RLE file codec (Golly/LifeWiki interchange) ----
+
+
+def test_parse_rle_file_with_comments_header_and_rule(tmp_path):
+    from akka_game_of_life_tpu.utils.patterns import load_rle_file
+
+    p = tmp_path / "glider.rle"
+    p.write_text(
+        "#N Glider\n"
+        "#C the smallest spaceship\n"
+        "x = 3, y = 3, rule = B3/S23\n"
+        "bob$2bo$\n3o!\n"
+    )
+    grid, rule = load_rle_file(str(p))
+    assert rule == "B3/S23"
+    assert np.array_equal(grid, get_pattern("glider"))
+
+
+def test_parse_rle_pads_to_declared_extent():
+    from akka_game_of_life_tpu.utils.patterns import parse_rle
+
+    # Body covers 1x1 but the header declares 4x3: RLE omits trailing dead
+    # cells/rows, so the declared bounding box must be restored.
+    grid, rule = parse_rle("x = 4, y = 3\no!")
+    assert rule is None
+    assert grid.shape == (3, 4)
+    assert grid.sum() == 1 and grid[0, 0] == 1
+
+
+def test_parse_rle_rejects_oversized_body():
+    import pytest
+
+    from akka_game_of_life_tpu.utils.patterns import parse_rle
+
+    with pytest.raises(ValueError, match="exceeds declared"):
+        parse_rle("x = 2, y = 1\n3o!")
+
+
+def test_encode_rle_round_trips_all_named_patterns():
+    from akka_game_of_life_tpu.utils.patterns import (
+        RLE_PATTERNS,
+        encode_rle,
+        parse_rle,
+    )
+
+    for name in RLE_PATTERNS:
+        grid = get_pattern(name)
+        back, rule = parse_rle(encode_rle(grid, "B3/S23"))
+        assert rule == "B3/S23"
+        assert np.array_equal(back, grid), name
+
+
+def test_encode_rle_blank_row_runs_and_leading_blanks():
+    from akka_game_of_life_tpu.utils.patterns import encode_rle, parse_rle
+
+    grid = np.zeros((5, 3), dtype=np.uint8)
+    grid[1, 0] = 1  # leading blank row
+    grid[4, 2] = 1  # two blank rows between, content in last row
+    text = encode_rle(grid)
+    assert "$o" in text and "3$" in text
+    back, _ = parse_rle(text)
+    assert np.array_equal(back, grid)
+
+
+def test_multistate_rle_round_trip():
+    from akka_game_of_life_tpu.utils.patterns import encode_rle, parse_rle
+
+    ww = get_pattern("wireworld-clock")  # states 0..3
+    text = encode_rle(ww, "WireWorld")
+    # Multi-state bodies use the ./A-X alphabet, not b/o.
+    body = text.splitlines()[1]
+    assert "o" not in body and "C" in body
+    back, rule = parse_rle(text)
+    assert rule == "WireWorld"
+    assert np.array_equal(back, ww)
+
+
+def test_decode_rle_multistate_letters_and_dots():
+    got = decode_rle(".A2B$3C!")
+    want = np.array([[0, 1, 2, 2], [3, 3, 3, 0]], dtype=np.uint8)
+    assert np.array_equal(got, want)
+
+
+def test_decode_rle_rejects_multiplane_tokens():
+    import pytest
+
+    with pytest.raises(ValueError, match="multi-plane"):
+        decode_rle("pA!")
+
+
+def test_encode_rle_wraps_long_lines():
+    from akka_game_of_life_tpu.utils.patterns import encode_rle, parse_rle
+
+    rng = np.random.default_rng(7)
+    grid = (rng.random((40, 40)) < 0.5).astype(np.uint8)
+    text = encode_rle(grid)
+    assert all(len(line) <= 70 for line in text.splitlines()[1:])
+    back, _ = parse_rle(text)
+    assert np.array_equal(back, grid)
+
+
+def test_get_pattern_from_file_and_missing_file(tmp_path):
+    import pytest
+
+    p = tmp_path / "blinker.rle"
+    p.write_text("x = 3, y = 1, rule = B3/S23\n3o!\n")
+    assert np.array_equal(get_pattern(str(p)), decode_rle("3o!"))
+    with pytest.raises(KeyError, match="not found"):
+        get_pattern(str(tmp_path / "nope.rle"))
+
+
+def test_parse_rle_header_keeps_comma_rulestrings():
+    from akka_game_of_life_tpu.utils.patterns import parse_rle
+
+    # rule is the header's FINAL field, and LtL rulestrings contain commas;
+    # the whole rest of the line is the rulestring.
+    grid, rule = parse_rle("x = 3, y = 1, rule = R5,B34-45,S33-57\n3o!")
+    assert rule == "R5,B34-45,S33-57"
+    assert grid.shape == (1, 3)
+
+
+def test_encode_rle_wraps_inside_long_rows():
+    from akka_game_of_life_tpu.utils.patterns import encode_rle, parse_rle
+
+    # One alternating 300-cell row: wrapping must break INSIDE the row,
+    # not treat the whole row as an unsplittable token.
+    grid = (np.arange(300, dtype=np.uint8) % 2).reshape(1, -1)
+    text = encode_rle(grid)
+    assert all(len(line) <= 70 for line in text.splitlines()[1:])
+    back, _ = parse_rle(text)
+    assert np.array_equal(back, grid)
+
+
+def test_parse_rle_trailing_row_terminator_before_bang():
+    from akka_game_of_life_tpu.utils.patterns import parse_rle
+
+    # Some writers emit a `$` after the last row; it must not become a
+    # phantom blank row that busts the declared extent.
+    grid, _ = parse_rle("x = 3, y = 1\n3o$!")
+    assert grid.shape == (1, 3)
+    # ...but an explicit blank-row run before `!` is real content.
+    grid2, _ = parse_rle("o2$!")
+    assert grid2.shape == (2, 1)
+
+
+def test_resolve_pattern_single_call(tmp_path):
+    from akka_game_of_life_tpu.utils.patterns import resolve_pattern
+
+    p = tmp_path / "g.rle"
+    p.write_text("x = 3, y = 3, rule = B3/S23\nbob$2bo$3o!\n")
+    grid, rule = resolve_pattern(str(p))
+    assert rule == "B3/S23" and np.array_equal(grid, get_pattern("glider"))
+    grid2, rule2 = resolve_pattern("glider")
+    assert rule2 is None and np.array_equal(grid2, grid)

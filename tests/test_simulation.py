@@ -303,3 +303,108 @@ def test_auto_stays_bitpack_off_tpu_and_for_gen_rules(monkeypatch):
     assert (
         Simulation(cfg2, observer=BoardObserver(out=io.StringIO())).kernel == "bitpack"
     )
+
+
+def test_cli_run_pattern_file_and_dump_rle(tmp_path, capsys):
+    from akka_game_of_life_tpu.cli import main
+    from akka_game_of_life_tpu.utils.patterns import (
+        encode_rle,
+        get_pattern,
+        load_rle_file,
+        pattern_board,
+    )
+
+    src = tmp_path / "glider.rle"
+    src.write_text(encode_rle(get_pattern("glider"), "B3/S23"))
+    out = tmp_path / "final.rle"
+    rc = main(
+        [
+            "run",
+            "--platform",
+            "cpu",
+            "--rule",
+            "conway",
+            "--height",
+            "16",
+            "--width",
+            "16",
+            "--pattern",
+            str(src),
+            "--max-epochs",
+            "4",
+            "--dump-rle",
+            str(out),
+        ]
+    )
+    assert rc == 0
+    # After 4 generations a glider has translated one cell down-right.
+    final, rule = load_rle_file(str(out))
+    assert rule == "B3/S23"
+    want = pattern_board("glider", (16, 16), (3, 3))  # pattern_offset (2,2)+1
+    assert np.array_equal(final, want)
+
+
+def test_pattern_file_rule_mismatch_warns(tmp_path, caplog):
+    import logging
+
+    from akka_game_of_life_tpu.runtime.simulation import initial_board
+    from akka_game_of_life_tpu.utils.patterns import encode_rle, get_pattern
+
+    src = tmp_path / "rep.rle"
+    src.write_text(encode_rle(get_pattern("replicator"), "B36/S23"))
+    cfg = SimulationConfig(height=32, width=32, rule="conway", pattern=str(src))
+    with caplog.at_level(logging.WARNING):
+        initial_board(cfg)
+    assert any("declares rule" in r.message for r in caplog.records)
+
+    caplog.clear()
+    cfg2 = SimulationConfig(height=32, width=32, rule="highlife", pattern=str(src))
+    with caplog.at_level(logging.WARNING):
+        initial_board(cfg2)
+    assert not any("declares rule" in r.message for r in caplog.records)
+
+
+def test_cli_dump_rle_rejects_wide_state_rules_up_front(tmp_path):
+    import pytest
+
+    from akka_game_of_life_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="alphabet stops at 24"):
+        main(
+            [
+                "run", "--platform", "cpu", "--rule", "345/2/50",
+                "--height", "16", "--width", "16", "--max-epochs", "1",
+                "--dump-rle", str(tmp_path / "x.rle"),
+            ]
+        )
+
+
+def test_cli_dump_rle_rejects_unwritable_path_up_front(tmp_path):
+    import pytest
+
+    from akka_game_of_life_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="cannot write"):
+        main(
+            [
+                "run", "--platform", "cpu", "--rule", "conway",
+                "--height", "16", "--width", "16", "--max-epochs", "1",
+                "--dump-rle", str(tmp_path / "no" / "such" / "dir" / "x.rle"),
+            ]
+        )
+
+
+def test_ltl_pattern_file_rule_comma_no_false_warning(tmp_path, caplog):
+    import logging
+
+    from akka_game_of_life_tpu.runtime.simulation import initial_board
+    from akka_game_of_life_tpu.utils.patterns import encode_rle
+
+    # LtL rulestrings contain commas ("R5,B34-45,S33-57" = bugs); a file
+    # declaring one must not truncate at the comma and spuriously warn.
+    src = tmp_path / "bugs.rle"
+    src.write_text(encode_rle(np.ones((3, 3), np.uint8), "R5,B34-45,S33-57"))
+    cfg = SimulationConfig(height=64, width=64, rule="bugs", pattern=str(src))
+    with caplog.at_level(logging.WARNING):
+        initial_board(cfg)
+    assert not any("declares rule" in r.message for r in caplog.records)
