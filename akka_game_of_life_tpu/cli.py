@@ -84,6 +84,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-dir")
     p.add_argument("--checkpoint-every", type=int)
     p.add_argument("--checkpoint-format", choices=["npz", "orbax"])
+    p.add_argument(
+        "--checkpoint-sync",
+        action="store_true",
+        default=None,
+        help="block at each checkpoint until the save is durable (default: "
+        "single-process npz saves overlap compute on a writer thread)",
+    )
     p.add_argument("--render-every", type=int)
     p.add_argument(
         "--probe-window",
@@ -149,6 +156,7 @@ def _overrides(args: argparse.Namespace) -> dict:
         "checkpoint_dir": args.checkpoint_dir,
         "checkpoint_every": args.checkpoint_every,
         "checkpoint_format": args.checkpoint_format,
+        "checkpoint_async": False if args.checkpoint_sync else None,
         "render_every": args.render_every,
         "render_max_cells": args.render_max_cells,
         "probe_window": _parse_window(args.probe_window),

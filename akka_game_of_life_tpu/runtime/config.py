@@ -184,7 +184,15 @@ class SimulationConfig:
     # Checkpoint / resume (capability the reference lacks — SURVEY.md §5).
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # epochs between checkpoints; 0 = disabled
-    checkpoint_format: str = "npz"  # "npz" (host, sync) | "orbax" (async, device)
+    checkpoint_format: str = "npz"  # "npz" (host) | "orbax" (async, device)
+    # Overlap npz checkpoint writes with compute: the save (device fetch +
+    # file write) runs on a writer thread while stepping continues, with at
+    # most one save in flight (single-process runs only — the multi-host npz
+    # path keeps its durability barrier, and orbax is already async).  At
+    # the 65536² headline config a save costs ~25 s that would otherwise
+    # stall the run.  False = block at each checkpoint (every save durable
+    # the moment checkpoint() returns).
+    checkpoint_async: bool = True
     # (Boundary-ring history is bounded by the checkpoint-cadence PRUNE
     # floor, not a separate window — see frontend._on_tile_state.)
 

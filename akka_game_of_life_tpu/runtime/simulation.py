@@ -124,6 +124,11 @@ class Simulation:
             if config.checkpoint_dir is not None
             else None
         )
+        # Async npz saves: at most one in flight, on a single writer thread
+        # (see SimulationConfig.checkpoint_async).  The pending entry is
+        # (future, epoch); _ckpt_wait() drains it and surfaces write errors.
+        self._ckpt_executor = None
+        self._ckpt_pending = None
         if config.fault_injection.enabled and self.store is None:
             raise ValueError(
                 "fault injection requires checkpoint_dir: a crash with no "
@@ -750,6 +755,9 @@ class Simulation:
         """An injected crash: in-memory state is lost; recover from the
         latest checkpoint and deterministically replay the missed epochs."""
         assert self.store is not None
+        # A save still in flight must land before the restore reads the
+        # store — the crash loses device state, not the writer thread.
+        self._ckpt_wait()
         target = self.epoch
         self.crash_log.append(target)
         self.board = None  # the crash: live state gone
@@ -802,25 +810,31 @@ class Simulation:
             dist.barrier(f"ckpt-{self.epoch}")
             return
 
+        # Bind the snapshot NOW: an async save runs while the main loop
+        # replaces self.board/self.epoch, and jax arrays are immutable, so
+        # capturing the references (not self) is what makes the overlap
+        # correct — the checkpoint is of this epoch, whatever runs next.
+        epoch, board = self.epoch, self.board
+        rulestr = self.rule.rulestring()
         if self._packed and host_board is None:
             # Packed runs never unpack for a checkpoint: npz receives the
             # (H, W/32) uint32 words (0.25 B/cell host transfer); orbax saves
             # the packed device array in place, tagged so load() can decode.
             def _save():
                 if npz:
-                    words = np.asarray(dist.fetch(self.board), dtype=np.uint32)
+                    words = np.asarray(dist.fetch(board), dtype=np.uint32)
                     self.store.save_packed32(
-                        self.epoch,
+                        epoch,
                         words,
                         self.config.shape,
-                        self.rule.rulestring(),
+                        rulestr,
                         meta=meta,
                     )
                 else:
                     self.store.save(
-                        self.epoch,
-                        self.board,
-                        self.rule.rulestring(),
+                        epoch,
+                        board,
+                        rulestr,
                         meta={**meta, "layout": "packed32"},
                     )
 
@@ -833,23 +847,65 @@ class Simulation:
                 # The store decides where the bytes come from: the orbax
                 # store saves the (possibly sharded) device array without
                 # host gather; the npz store gathers internally.
-                host_board = self.board
+                host_board = board
 
             def _save():
-                self.store.save(
-                    self.epoch, host_board, self.rule.rulestring(), meta=meta
+                self.store.save(epoch, host_board, rulestr, meta=meta)
+
+        if npz and self.config.checkpoint_async and jax.process_count() == 1:
+            # Overlap the save (device fetch + file write) with compute.
+            # One save in flight at a time: draining the previous one first
+            # bounds memory (one extra board snapshot alive) and keeps the
+            # store's write+GC single-threaded.
+            self._ckpt_wait()
+            if self._ckpt_executor is None:
+                import concurrent.futures
+
+                self._ckpt_executor = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ckpt"
                 )
 
-        if self.config.metrics_every:
+            def _timed_save():
+                t0 = time.perf_counter()
+                _save()
+                return (time.perf_counter() - t0) * 1e3
+
+            self._ckpt_pending = (self._ckpt_executor.submit(_timed_save), epoch)
+        elif self.config.metrics_every:
             # Checkpoint cost is an operational metric: surface it alongside
             # the throughput lines.
-            with profiling.timed(f"checkpoint@{self.epoch}", out=self.observer.out):
+            with profiling.timed(f"checkpoint@{epoch}", out=self.observer.out):
                 _save()
         else:
             _save()
         if npz and jax.process_count() > 1:
             # Rank 0's side of the durability barrier (see the gated branch).
-            dist.barrier(f"ckpt-{self.epoch}")
+            dist.barrier(f"ckpt-{epoch}")
+
+    def flush(self) -> None:
+        """Make every requested checkpoint durable without closing: block
+        until the in-flight async save (if any) is on disk.  The supported
+        durability point for embedders that resume a second Simulation from
+        the same directory, or inspect the store, while this one stays
+        live.  Raises the writer's error, if any, here."""
+        self._ckpt_wait()
+        if self.store is not None:
+            self.store.wait()
+
+    def _ckpt_wait(self) -> None:
+        """Drain the in-flight async save (no-op if none).  Raises the
+        writer's exception here, on the thread that asked for durability."""
+        if self._ckpt_pending is None:
+            return
+        future, epoch = self._ckpt_pending
+        self._ckpt_pending = None
+        ms = future.result()
+        if self.config.metrics_every:
+            print(
+                f"[profile] checkpoint@{epoch} (async write): {ms:.2f} ms",
+                file=self.observer.out,
+                flush=True,
+            )
 
     def board_window(self, y0: int, y1: int, x0: int, x1: int) -> np.ndarray:
         """A (y1-y0, x1-x0) uint8 window of the board, computed device-side
@@ -921,10 +977,20 @@ class Simulation:
     def close(self) -> None:
         """Finalize: block until async checkpoint saves are durable.  Must be
         called before process exit when checkpointing is enabled — an async
-        (orbax) save still in flight at interpreter shutdown is lost."""
-        if self.store is not None:
-            self.store.close()
-        self.observer.close()
+        (npz writer-thread or orbax) save still in flight at interpreter
+        shutdown is lost."""
+        try:
+            self._ckpt_wait()
+        finally:
+            # Even when the drained save failed, release everything: the
+            # writer pool must not leak and the observer's log-file sink
+            # must flush before the error propagates.
+            if self._ckpt_executor is not None:
+                self._ckpt_executor.shutdown(wait=True)
+                self._ckpt_executor = None
+            if self.store is not None:
+                self.store.close()
+            self.observer.close()
 
     def __enter__(self):
         return self

@@ -102,6 +102,7 @@ def test_gen_planes_sim_matches_dense_sim(tmp_path):
         dense.advance(40)
         packed.advance(40)
         assert np.array_equal(dense.board_host(), packed.board_host()), rule
+        packed.flush()  # durability point: async saves land by flush()/close()
 
         resumed = Simulation(
             _cfg("bitpack", tmp_path / f"p-{rule}", rule=rule, seed=21),
@@ -168,6 +169,7 @@ def test_packed_checkpoint_roundtrip_and_resume(tmp_path):
     start = sim.board_host()
     sim.advance(32)
     want = sim.board_host()
+    sim.flush()  # durability point: async saves land by flush()/close()
 
     resumed = Simulation(
         _cfg("bitpack", tmp_path), observer=BoardObserver(out=io.StringIO())
@@ -236,6 +238,7 @@ def test_meshed_pallas_sim_matches_dense_sim(tmp_path):
     dense.advance(40)
     meshed.advance(40)
     assert np.array_equal(dense.board_host(), meshed.board_host())
+    meshed.flush()  # durability point: async saves land by flush()/close()
 
     # The packed checkpoint written mid-run resumes on the bitpack engine.
     resumed = Simulation(

@@ -52,6 +52,7 @@ def test_kill_and_resume_is_deterministic(tmp_path):
     sim = Simulation(mk(), observer=BoardObserver(out=io.StringIO()))
     sim.advance(30)
     reference = sim.board_host()
+    sim.flush()  # durability point: async saves land by flush()/close()
 
     # "Kill": discard the live object; resume a fresh one from disk at 30.
     resumed = Simulation(mk(), observer=BoardObserver(out=io.StringIO()))
@@ -117,6 +118,7 @@ def test_checkpoint_cadence_fires_on_crossing(tmp_path):
     )
     sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
     sim.advance(90)
+    sim.flush()  # durability point: async saves land by flush()/close()
     epochs = [e for e, _ in sim.store._epochs()]
     assert epochs == [30, 60, 90]
 
@@ -408,3 +410,78 @@ def test_ltl_pattern_file_rule_comma_no_false_warning(tmp_path, caplog):
     with caplog.at_level(logging.WARNING):
         initial_board(cfg)
     assert not any("declares rule" in r.message for r in caplog.records)
+
+
+def test_async_checkpoint_runs_off_main_thread_and_is_durable(tmp_path):
+    import threading
+
+    from akka_game_of_life_tpu.runtime.checkpoint import make_store
+
+    cfg = SimulationConfig(
+        height=64, width=64, rule="conway", seed=3, steps_per_call=5,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=5,
+    )
+    threads = []
+    with Simulation(cfg, observer=BoardObserver(out=io.StringIO())) as sim:
+        orig = sim.store.save_packed32
+        sim.store.save_packed32 = lambda *a, **k: (
+            threads.append(threading.current_thread().name), orig(*a, **k)
+        )
+        sim.advance(20)
+        want = sim.board_host()
+    assert threads and all(t.startswith("ckpt") for t in threads)
+    # Durable by close(): a fresh sim resumes from epoch 20 exactly.
+    store = make_store(str(tmp_path / "ck"))
+    assert store.latest_epoch() == 20
+    with Simulation(cfg, observer=BoardObserver(out=io.StringIO())) as sim2:
+        assert sim2.epoch == 20
+        assert np.array_equal(sim2.board_host(), want)
+
+
+def test_async_checkpoint_matches_sync_trajectory(tmp_path):
+    boards = {}
+    for mode, use_async in (("async", True), ("sync", False)):
+        cfg = SimulationConfig(
+            height=48, width=48, rule="conway", seed=9, steps_per_call=4,
+            checkpoint_dir=str(tmp_path / mode), checkpoint_every=8,
+            checkpoint_async=use_async,
+        )
+        with Simulation(cfg, observer=BoardObserver(out=io.StringIO())) as sim:
+            sim.advance(24)
+            boards[mode] = sim.board_host()
+    assert np.array_equal(boards["async"], boards["sync"])
+
+
+def test_crash_recovery_drains_pending_async_save(tmp_path):
+    cfg = SimulationConfig(
+        height=32, width=32, rule="conway", seed=5, steps_per_call=4,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4,
+    )
+    with Simulation(cfg, observer=BoardObserver(out=io.StringIO())) as sim:
+        sim.advance(8)
+        sim.checkpoint()  # async save of epoch 8 in flight
+        clean = sim.board_host()
+        sim._crash_and_recover()  # must restore epoch 8, not an older one
+        assert sim.epoch == 8
+        assert np.array_equal(sim.board_host(), clean)
+
+
+def test_async_checkpoint_write_errors_surface(tmp_path):
+    import pytest
+
+    cfg = SimulationConfig(
+        height=32, width=32, rule="conway", seed=5, steps_per_call=4,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=0,
+    )
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    sim.advance(4)
+
+    def boom(*a, **k):
+        raise OSError("disk gone")
+
+    sim.store.save_packed32 = boom
+    sim.checkpoint()  # submits; the failure lands on the writer thread
+    with pytest.raises(OSError, match="disk gone"):
+        sim.close()
+    # close() released its resources even though the drained save failed.
+    assert sim._ckpt_executor is None and sim._ckpt_pending is None
