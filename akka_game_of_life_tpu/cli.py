@@ -224,6 +224,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "states, radius) as JSON lines",
     )
 
+    ck_p = sub.add_parser(
+        "checkpoints",
+        help="inspect a checkpoint directory: one JSON line per durable "
+        "epoch (epoch, layout, rule, shape, bytes on disk)",
+    )
+    ck_p.add_argument("dir")
+    ck_p.add_argument(
+        "--validate",
+        action="store_true",
+        help="additionally load each epoch in full and report ok/error "
+        "(exit 1 if any epoch fails)",
+    )
+    _add_platform(ck_p)
+
     be_p = sub.add_parser("backend", help="control-plane worker (RunBackend)")
     be_p.add_argument("--port", type=int, default=2551, help="frontend port to join")
     be_p.add_argument("--host", default="127.0.0.1")
@@ -349,6 +363,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise SystemExit(f"frontend role unavailable: {e}")
 
         return run_frontend(cfg, min_backends=args.min_backends)
+
+    if args.command == "checkpoints":
+        import json
+
+        from akka_game_of_life_tpu.runtime.checkpoint import describe_store
+
+        n = failed = 0
+        for info in describe_store(args.dir, validate=args.validate):
+            print(json.dumps(info), flush=True)
+            n += 1
+            # Unreadable metadata fails the health check even without
+            # --validate; ok=False only exists when --validate ran.
+            failed += ("error" in info) or (info.get("ok") is False)
+        if n == 0:
+            print(f"no checkpoints found in {args.dir}", file=sys.stderr)
+            return 1
+        return 1 if failed else 0
 
     if args.command == "models":
         import json

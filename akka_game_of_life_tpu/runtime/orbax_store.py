@@ -66,6 +66,11 @@ class OrbaxCheckpointStore:
         step = self._mgr.latest_step()
         return int(step) if step is not None else None
 
+    def epochs(self):
+        """Every durable epoch, sorted (the inspection surface)."""
+        self.wait()
+        return sorted(int(s) for s in self._mgr.all_steps())
+
     def load(
         self, epoch: Optional[int] = None, *, keep_packed: bool = False
     ) -> Checkpoint:

@@ -133,6 +133,20 @@ class BoardObserver:
 
     # -- complete-board path (standalone runner) -----------------------------
 
+    def start_clock(self, epoch: int) -> None:
+        """Anchor the metrics clock at ``epoch`` if it has not started yet.
+
+        Without an anchor the first cadence crossing only *sets* the clock,
+        so the first interval is invisible: totals miss it, and a resumed
+        run whose remaining span contains a single crossing observes
+        nothing at all (no metrics line, no run summary).  Anchoring at
+        advance() entry makes totals span the whole run — including, on a
+        TPU, the first chunk's jit compile in the first interval (the
+        steady-state per-interval lines are unaffected)."""
+        if self._last_time is None:
+            self._last_time = time.perf_counter()
+            self._last_epoch = epoch
+
     def _note_progress(self, epoch: int, population: int, total_cells: int) -> None:
         """Advance the metrics clock and emit a metrics line at cadence."""
         now = time.perf_counter()

@@ -623,6 +623,10 @@ class Simulation:
         the on-device scan in between has zero host round-trips."""
         cfg = self.config
         target = self.epoch + (epochs if epochs is not None else (cfg.max_epochs or 0))
+        # Anchor the metrics clock so the FIRST cadence crossing measures a
+        # real interval (resumed runs with one remaining crossing would
+        # otherwise observe nothing — no metrics line, no run summary).
+        self.observer.start_clock(self.epoch)
         next_tick = time.monotonic()
         while self.epoch < target:
             if cfg.tick_s > 0:

@@ -136,3 +136,80 @@ def test_tile_store_gc_and_mixed_formats(tmp_path):
     store.save(41, t, "B3/S23")
     assert (tmp_path / "ckpt_000000000050.d").exists()
     assert store.latest_epoch() == 41
+
+
+# ---- store inspection (the `checkpoints` subcommand surface) ----
+
+
+def test_describe_store_all_layouts(tmp_path):
+    from akka_game_of_life_tpu.runtime.checkpoint import (
+        CheckpointStore,
+        describe_store,
+    )
+
+    store = CheckpointStore(str(tmp_path), keep=10)
+    rng = np.random.default_rng(0)
+    board = (rng.random((32, 64)) < 0.5).astype(np.uint8)
+    store.save(10, board, "B3/S23", meta={"height": 32, "width": 64})
+    from akka_game_of_life_tpu.ops.bitpack import pack_np
+
+    store.save_packed32(20, pack_np(board), (32, 64), "B3/S23")
+    # A per-tile streamed epoch (2x1 grid).
+    store.save_tile(30, (0, 0), board[:16])
+    store.save_tile(30, (1, 0), board[16:])
+    store.finalize_epoch(30, "B3/S23", (2, 1), (32, 64))
+
+    infos = list(describe_store(str(tmp_path), validate=True))
+    assert [i["epoch"] for i in infos] == [10, 20, 30]
+    by_epoch = {i["epoch"]: i for i in infos}
+    assert by_epoch[10]["layout"] == "packbits"  # binary boards pack to bits
+    assert by_epoch[20]["layout"] == "packed32"
+    assert by_epoch[30]["layout"] == "tiles" and by_epoch[30]["tiles"] == 2
+    assert all(i["ok"] for i in infos)
+    assert all(i["rule"] == "B3/S23" for i in infos)
+    assert all(i["shape"] == [32, 64] for i in infos)
+    assert all(i["bytes"] > 0 for i in infos)
+
+
+def test_describe_store_flags_corruption(tmp_path):
+    from akka_game_of_life_tpu.runtime.checkpoint import (
+        CheckpointStore,
+        describe_store,
+    )
+
+    store = CheckpointStore(str(tmp_path), keep=10)
+    board = np.zeros((16, 32), np.uint8)
+    store.save(5, board, "B3/S23")
+    p = store.save(9, board, "B3/S23")
+    p.write_bytes(p.read_bytes()[: p.stat().st_size // 2])  # truncate epoch 9
+    infos = list(describe_store(str(tmp_path), validate=True))
+    by_epoch = {i["epoch"]: i for i in infos}
+    assert by_epoch[5]["ok"] is True
+    assert by_epoch[9]["ok"] is False and "error" in by_epoch[9]
+
+
+def test_cli_checkpoints_subcommand(tmp_path, capsys):
+    import json
+
+    from akka_game_of_life_tpu.cli import main
+    from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path), keep=10)
+    store.save(7, np.zeros((8, 8), np.uint8), "B36/S23")
+    assert main(["checkpoints", str(tmp_path), "--validate"]) == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert lines[0]["epoch"] == 7 and lines[0]["ok"] is True
+
+    assert main(["checkpoints", str(tmp_path / "empty")]) == 1
+
+
+def test_cli_checkpoints_flags_unreadable_metadata_without_validate(tmp_path, capsys):
+    from akka_game_of_life_tpu.cli import main
+    from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path), keep=10)
+    p = store.save(3, np.zeros((8, 8), np.uint8), "B3/S23")
+    p.write_bytes(b"not a zip at all")
+    assert main(["checkpoints", str(tmp_path)]) == 1  # no --validate needed
+    out = capsys.readouterr().out
+    assert '"error"' in out

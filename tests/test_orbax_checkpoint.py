@@ -161,3 +161,23 @@ def test_orbax_packed_roundtrip_binary_and_gen(tmp_path):
         )
         assert np.array_equal(dense.board_host(), oracle), rule
         dense.close()
+
+
+def test_describe_store_orbax(tmp_path):
+    from akka_game_of_life_tpu.runtime.checkpoint import describe_store
+
+    store = make_store(str(tmp_path), "orbax", keep=5)
+    board = np.arange(64, dtype=np.uint8).reshape(8, 8) % 2
+    store.save(4, board, "B3/S23")
+    store.save(8, board, "B3/S23")
+    store.close()
+
+    # rule/shape/layout are present even WITHOUT validate (documented fields).
+    infos = list(describe_store(str(tmp_path)))
+    assert [i["epoch"] for i in infos] == [4, 8]
+    assert all(i["store"] == "orbax" and i["layout"] == "device-native" for i in infos)
+    assert all(i["rule"] == "B3/S23" and i["shape"] == [8, 8] for i in infos)
+    assert all("ok" not in i for i in infos)
+
+    infos = list(describe_store(str(tmp_path), validate=True))
+    assert all(i["ok"] for i in infos)

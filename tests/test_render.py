@@ -105,3 +105,30 @@ def test_summary_totals_outlive_the_history_window():
     assert s["epochs_observed"] == 1500
     assert len(obs.history) == 1024
     assert s["final_population"] == 7
+
+
+def test_metrics_clock_anchored_at_advance_entry(tmp_path):
+    """A resumed run whose remaining span holds a single metrics crossing
+    must still observe it (metrics line + run summary), and a fresh run's
+    totals must span the WHOLE run, first interval included."""
+    import io as _io
+
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+    cfg = lambda: SimulationConfig(
+        height=32, width=32, seed=6, steps_per_call=5, metrics_every=30,
+        checkpoint_dir=str(tmp_path), checkpoint_every=20,
+    )
+    with Simulation(cfg(), observer=BoardObserver(out=_io.StringIO(), metrics_every=30)) as sim:
+        sim.advance(60)
+        s = sim.observer.summary()
+        assert s is not None and s["epochs_observed"] == 60  # not 30
+
+    # Resume at 60 (checkpoint cadence 20), advance to 90: one crossing.
+    with Simulation(cfg(), observer=BoardObserver(out=_io.StringIO(), metrics_every=30)) as sim2:
+        assert sim2.epoch == 60
+        sim2.advance(30)
+        s = sim2.observer.summary()
+        assert s is not None and s["epochs_observed"] == 30
+        assert "epoch 90: pop=" in sim2.observer.out.getvalue()
