@@ -131,15 +131,31 @@ def _combine_rows(x, sN, cN, sC, cC, sS, cS, rule: Rule) -> jax.Array:
 
     Because the center is included in the count, survive thresholds shift by
     +1: for a B/S rule, next = (~x & [count ∈ B]) | (x & [count-1 ∈ S]).
+    Counts in B ∩ (S+1) make the cell alive *regardless* of x (count == n
+    means n neighbors when dead, n-1 when alive), so those predicates skip
+    the x masking entirely — for Conway the combine collapses to
+    ``eq(3) | (x & eq(4))``, saving a ~x/&/| chain the compiler's CSE
+    cannot fold on its own.
     """
     eq = count_eq_fn(*_count_bits(sN, cN, sC, cC, sS, cS))
-    birth = jnp.uint32(0)
-    for n in rule.birth:
-        birth = birth | eq(n)
-    survive = jnp.uint32(0)
-    for n in rule.survive:
-        survive = survive | eq(n + 1)  # +1: count includes the live center
-    return (~x & birth) | (x & survive)
+
+    def union(ns):
+        acc = None
+        for n in sorted(ns):
+            acc = eq(n) if acc is None else acc | eq(n)
+        return acc
+
+    survive_counts = {n + 1 for n in rule.survive}  # count includes the center
+    always = rule.birth & survive_counts
+    terms = [union(always)]
+    birth = union(rule.birth - always)
+    if birth is not None:
+        terms.append(~x & birth)
+    survive = union(survive_counts - always)
+    if survive is not None:
+        terms.append(x & survive)
+    terms = [t for t in terms if t is not None]
+    return functools.reduce(jnp.bitwise_or, terms) if terms else jnp.zeros_like(x)
 
 
 def step_padded_rows(padded: jax.Array, rule) -> jax.Array:
