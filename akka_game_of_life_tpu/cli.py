@@ -293,10 +293,45 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         sim = Simulation(cfg)
         from akka_game_of_life_tpu.runtime import profiling
 
+        interrupted = False
         with sim, profiling.trace(args.trace_dir):
             # --max-epochs is the absolute end epoch: a resumed run (from a
             # checkpoint at epoch E) advances the remaining max_epochs - E.
-            sim.advance(max(0, cfg.max_epochs - sim.epoch))
+            try:
+                sim.advance(max(0, cfg.max_epochs - sim.epoch))
+            except KeyboardInterrupt:
+                # Graceful ^C: the board is consistent at the last completed
+                # chunk; make it durable so the run is resumable from HERE
+                # rather than the last cadence point.  (The reference's
+                # Pause/Resume protocol was dead code, Run.scala had no
+                # shutdown path at all; this is the standalone analog of the
+                # cluster frontend's pause+checkpoint.)
+                interrupted = True
+                import jax
+
+                if sim.store is not None and jax.process_count() == 1:
+                    # Multi-host runs are excluded: checkpoint() is a
+                    # collective + barrier the uninterrupted ranks never
+                    # enter, so it would hang, not save.
+                    sim.checkpoint()
+                    sim.flush()
+                    print(
+                        f"interrupted at epoch {sim.epoch}; checkpoint written",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                else:
+                    print(
+                        f"interrupted at epoch {sim.epoch} (no durable save: "
+                        + (
+                            "multi-host run"
+                            if sim.store is not None
+                            else "no checkpoint dir"
+                        )
+                        + ")",
+                        file=sys.stderr,
+                        flush=True,
+                    )
             stats = sim.observer.summary()
             if stats is not None:
                 import json as _json
@@ -317,9 +352,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 print(f"[profile] {dev}: {stats}", flush=True)
         # board_host() is an O(board) collective in multi-host runs — every
         # rank calls it, at most once, shared by the dump and the fallback
-        # render; only rank 0 writes/prints.
+        # render; only rank 0 writes/prints.  An interrupted run skips the
+        # whole epilogue: the checkpoint already preserves the state, and a
+        # minutes-long fetch after ^C invites a second ^C mid-write.
         final = None
-        if args.dump_rle:
+        if args.dump_rle and not interrupted:
             from akka_game_of_life_tpu.ops.rules import resolve_rule
             from akka_game_of_life_tpu.utils.patterns import encode_rle
 
@@ -330,7 +367,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 with open(args.dump_rle, "w", encoding="utf-8") as f:
                     f.write(encode_rle(final, resolve_rule(cfg.rule).rulestring()))
                 print(f"wrote {args.dump_rle}", flush=True)
-        if cfg.render_every == 0 and cfg.metrics_every == 0:
+        if cfg.render_every == 0 and cfg.metrics_every == 0 and not interrupted:
             # Always show something at the end, like the reference's info.log.
             from akka_game_of_life_tpu.runtime.render import render_ascii
 
@@ -341,7 +378,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if jax.process_index() == 0:
                 print(f"epoch {sim.epoch}:")
                 print(render_ascii(final, cfg.render_max_cells))
-        return 0
+        return 130 if interrupted else 0
 
     if args.command == "frontend":
         overrides = _overrides(args)

@@ -15,6 +15,9 @@ version of the reference's replay-from-neighbor-histories recovery
 
 from __future__ import annotations
 
+import contextlib
+import signal
+import threading
 import time
 from typing import Callable, Dict, Optional
 
@@ -80,6 +83,34 @@ def initial_board(config: SimulationConfig) -> np.ndarray:
 def _crosses(prev_epoch: int, epoch: int, every: int) -> bool:
     """Did the cadence boundary get crossed in (prev_epoch, epoch]?"""
     return every > 0 and (epoch // every) > (prev_epoch // every)
+
+
+@contextlib.contextmanager
+def _shield_sigint():
+    """Defer a ^C across a critical section so (board, epoch) never tears.
+
+    ``advance`` updates the board and the epoch as two separate statements;
+    a KeyboardInterrupt landing between them would leave a stepped board
+    labeled with the previous epoch, and an interrupt-checkpoint would then
+    durably save that lie — a resumed run silently replays extra
+    generations.  The shield swallows SIGINT for the few bytecodes of the
+    update and re-raises it at the section's end, where state is
+    consistent.  No-op off the main thread (signal() would raise there)."""
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    received = []
+    try:
+        old = signal.signal(signal.SIGINT, lambda s, f: received.append(1))
+    except ValueError:  # no signal support in this context
+        yield
+        return
+    try:
+        yield
+    finally:
+        signal.signal(signal.SIGINT, old)
+    if received:
+        raise KeyboardInterrupt
 
 
 class Simulation:
@@ -644,8 +675,12 @@ class Simulation:
             chunk = min(cfg.steps_per_call, target - self.epoch)
             prev = self.epoch
             with profiling.annotate_epochs("advance_chunk", self.epoch):
-                self.board = self._stepper(chunk)(self.board)
-            self.epoch += chunk
+                new_board = self._stepper(chunk)(self.board)
+            with _shield_sigint():
+                # Atomic wrt ^C: an interrupt-checkpoint must never see a
+                # stepped board still labeled with the previous epoch.
+                self.board = new_board
+                self.epoch += chunk
 
             if _crosses(prev, self.epoch, cfg.render_every) or _crosses(
                 prev, self.epoch, cfg.metrics_every

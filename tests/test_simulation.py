@@ -485,3 +485,66 @@ def test_async_checkpoint_write_errors_surface(tmp_path):
         sim.close()
     # close() released its resources even though the drained save failed.
     assert sim._ckpt_executor is None and sim._ckpt_pending is None
+
+
+def test_cli_sigint_checkpoints_and_resumes(tmp_path):
+    """^C mid-run writes a durable checkpoint at the interrupt epoch (not
+    the last cadence point) and exits 130; a rerun resumes from it."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    from akka_game_of_life_tpu.runtime.checkpoint import make_store
+
+    env = {**os.environ, "GOL_PLATFORM": "cpu"}
+    progress = tmp_path / "progress.log"
+    cmd = [
+        sys.executable, "-m", "akka_game_of_life_tpu", "run",
+        "--platform", "cpu", "--rule", "conway", "--height", "32",
+        "--width", "32", "--seed", "3", "--steps-per-call", "1",
+        "--tick", "20ms", "--max-epochs", "100000",
+        "--metrics-every", "5", "--log-file", str(progress),
+        "--checkpoint-dir", str(tmp_path), "--checkpoint-every", "10000",
+    ]
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True
+    )
+    # Interrupt only once the run has provably advanced past epoch 0 (slow
+    # interpreter start / first-compile must not race the signal).
+    deadline = _time.time() + 120
+    while _time.time() < deadline:
+        if progress.exists() and "epoch" in progress.read_text():
+            break
+        if proc.poll() is not None:
+            raise AssertionError(f"run exited early: {proc.communicate()}")
+        _time.sleep(0.1)
+    else:
+        proc.kill()
+        raise AssertionError("run never made observable progress")
+    proc.send_signal(signal.SIGINT)
+    try:
+        _, err = proc.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise
+    assert proc.returncode == 130, err
+    assert "checkpoint written" in err
+    store = make_store(str(tmp_path))
+    epoch = store.latest_epoch()
+    assert epoch is not None and 0 < epoch < 100000
+
+    # Resume continues from the interrupt epoch.
+    from akka_game_of_life_tpu.cli import main
+
+    rc = main(
+        [
+            "run", "--platform", "cpu", "--rule", "conway", "--height", "32",
+            "--width", "32", "--seed", "3", "--steps-per-call", "1",
+            "--max-epochs", str(epoch + 5),
+            "--checkpoint-dir", str(tmp_path), "--checkpoint-every", "10000",
+            "--render-every", "0", "--metrics-every", "0",
+        ]
+    )
+    assert rc == 0
