@@ -291,94 +291,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if cfg.max_epochs is None:
             cfg.max_epochs = 100
         sim = Simulation(cfg)
-        from akka_game_of_life_tpu.runtime import profiling
 
-        interrupted = False
-        with sim, profiling.trace(args.trace_dir):
-            # --max-epochs is the absolute end epoch: a resumed run (from a
-            # checkpoint at epoch E) advances the remaining max_epochs - E.
-            try:
-                sim.advance(max(0, cfg.max_epochs - sim.epoch))
-            except KeyboardInterrupt:
-                # Graceful ^C: the board is consistent at the last completed
-                # chunk; make it durable so the run is resumable from HERE
-                # rather than the last cadence point.  (The reference's
-                # Pause/Resume protocol was dead code, Run.scala had no
-                # shutdown path at all; this is the standalone analog of the
-                # cluster frontend's pause+checkpoint.)
-                interrupted = True
-                import jax
+        # Container orchestrators stop jobs with SIGTERM: give it the same
+        # graceful checkpoint-and-exit path as ^C.  Main thread only; the
+        # previous handler is restored on every exit path (finally below).
+        # A C-installed handler (getsignal() → None) cannot be saved or
+        # re-installed through the signal module at all, so in that embedded
+        # case ours is never installed and SIGTERM behavior is untouched.
+        import signal as _signal
 
-                if sim.store is not None and jax.process_count() == 1:
-                    # Multi-host runs are excluded: checkpoint() is a
-                    # collective + barrier the uninterrupted ranks never
-                    # enter, so it would hang, not save.
-                    sim.checkpoint()
-                    sim.flush()
-                    print(
-                        f"interrupted at epoch {sim.epoch}; checkpoint written",
-                        file=sys.stderr,
-                        flush=True,
-                    )
-                else:
-                    print(
-                        f"interrupted at epoch {sim.epoch} (no durable save: "
-                        + (
-                            "multi-host run"
-                            if sim.store is not None
-                            else "no checkpoint dir"
-                        )
-                        + ")",
-                        file=sys.stderr,
-                        flush=True,
-                    )
-            stats = sim.observer.summary()
-            if stats is not None:
-                import json as _json
+        def _sigterm(signum, frame):
+            raise KeyboardInterrupt
 
-                # Inside the with block so the line reaches the observer's
-                # sink (e.g. --log-file) before close(); out is stdout by
-                # default.
-                print(
-                    "run summary: "
-                    + _json.dumps(
-                        {"kernel": sim.kernel, "epoch": sim.epoch, **stats}
-                    ),
-                    file=sim.observer.out,
-                    flush=True,
-                )
-        if args.trace_dir:
-            for dev, stats in profiling.device_memory_stats().items():
-                print(f"[profile] {dev}: {stats}", flush=True)
-        # board_host() is an O(board) collective in multi-host runs — every
-        # rank calls it, at most once, shared by the dump and the fallback
-        # render; only rank 0 writes/prints.  An interrupted run skips the
-        # whole epilogue: the checkpoint already preserves the state, and a
-        # minutes-long fetch after ^C invites a second ^C mid-write.
-        final = None
-        if args.dump_rle and not interrupted:
-            from akka_game_of_life_tpu.ops.rules import resolve_rule
-            from akka_game_of_life_tpu.utils.patterns import encode_rle
-
-            final = sim.board_host()
-            import jax
-
-            if jax.process_index() == 0:
-                with open(args.dump_rle, "w", encoding="utf-8") as f:
-                    f.write(encode_rle(final, resolve_rule(cfg.rule).rulestring()))
-                print(f"wrote {args.dump_rle}", flush=True)
-        if cfg.render_every == 0 and cfg.metrics_every == 0 and not interrupted:
-            # Always show something at the end, like the reference's info.log.
-            from akka_game_of_life_tpu.runtime.render import render_ascii
-
-            if final is None:
-                final = sim.board_host()
-            import jax
-
-            if jax.process_index() == 0:
-                print(f"epoch {sim.epoch}:")
-                print(render_ascii(final, cfg.render_max_cells))
-        return 130 if interrupted else 0
+        _NOT_INSTALLED = object()
+        prev_sigterm = _NOT_INSTALLED
+        try:
+            if _signal.getsignal(_signal.SIGTERM) is not None:
+                prev_sigterm = _signal.signal(_signal.SIGTERM, _sigterm)
+        except ValueError:  # not the main thread (embedded use)
+            pass
+        try:
+            return _run_simulation(args, cfg, sim)
+        except KeyboardInterrupt:
+            # Signal landed outside advance()'s graceful window (startup
+            # compile, summary, epilogue): exit 130 without a save — the
+            # cadence checkpoints are the durable state.
+            print(
+                f"interrupted outside the run loop at epoch {sim.epoch}",
+                file=sys.stderr,
+                flush=True,
+            )
+            return 130
+        finally:
+            if prev_sigterm is not _NOT_INSTALLED:
+                _signal.signal(_signal.SIGTERM, prev_sigterm)
 
     if args.command == "frontend":
         overrides = _overrides(args)
@@ -401,6 +347,103 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         return run_frontend(cfg, min_backends=args.min_backends)
 
+    return _other_commands(args)
+
+
+def _run_simulation(args, cfg, sim) -> int:
+    """The `run` body between SIGTERM-handler install and restore."""
+    from akka_game_of_life_tpu.runtime import profiling
+
+    interrupted = False
+    with sim, profiling.trace(args.trace_dir):
+        # --max-epochs is the absolute end epoch: a resumed run (from a
+        # checkpoint at epoch E) advances the remaining max_epochs - E.
+        try:
+            sim.advance(max(0, cfg.max_epochs - sim.epoch))
+        except KeyboardInterrupt:
+            # Graceful ^C: the board is consistent at the last completed
+            # chunk; make it durable so the run is resumable from HERE
+            # rather than the last cadence point.  (The reference's
+            # Pause/Resume protocol was dead code, Run.scala had no
+            # shutdown path at all; this is the standalone analog of the
+            # cluster frontend's pause+checkpoint.)
+            interrupted = True
+            import jax
+
+            if sim.store is not None and jax.process_count() == 1:
+                # Multi-host runs are excluded: checkpoint() is a
+                # collective + barrier the uninterrupted ranks never
+                # enter, so it would hang, not save.
+                sim.checkpoint()
+                sim.flush()
+                print(
+                    f"interrupted at epoch {sim.epoch}; checkpoint written",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            else:
+                print(
+                    f"interrupted at epoch {sim.epoch} (no durable save: "
+                    + (
+                        "multi-host run"
+                        if sim.store is not None
+                        else "no checkpoint dir"
+                    )
+                    + ")",
+                    file=sys.stderr,
+                    flush=True,
+                )
+        stats = sim.observer.summary()
+        if stats is not None:
+            import json as _json
+
+            # Inside the with block so the line reaches the observer's
+            # sink (e.g. --log-file) before close(); out is stdout by
+            # default.
+            print(
+                "run summary: "
+                + _json.dumps(
+                    {"kernel": sim.kernel, "epoch": sim.epoch, **stats}
+                ),
+                file=sim.observer.out,
+                flush=True,
+            )
+    if args.trace_dir:
+        for dev, stats in profiling.device_memory_stats().items():
+            print(f"[profile] {dev}: {stats}", flush=True)
+    # board_host() is an O(board) collective in multi-host runs — every
+    # rank calls it, at most once, shared by the dump and the fallback
+    # render; only rank 0 writes/prints.  An interrupted run skips the
+    # whole epilogue: the checkpoint already preserves the state, and a
+    # minutes-long fetch after ^C invites a second ^C mid-write.
+    final = None
+    if args.dump_rle and not interrupted:
+        from akka_game_of_life_tpu.ops.rules import resolve_rule
+        from akka_game_of_life_tpu.utils.patterns import encode_rle
+
+        final = sim.board_host()
+        import jax
+
+        if jax.process_index() == 0:
+            with open(args.dump_rle, "w", encoding="utf-8") as f:
+                f.write(encode_rle(final, resolve_rule(cfg.rule).rulestring()))
+            print(f"wrote {args.dump_rle}", flush=True)
+    if cfg.render_every == 0 and cfg.metrics_every == 0 and not interrupted:
+        # Always show something at the end, like the reference's info.log.
+        from akka_game_of_life_tpu.runtime.render import render_ascii
+
+        if final is None:
+            final = sim.board_host()
+        import jax
+
+        if jax.process_index() == 0:
+            print(f"epoch {sim.epoch}:")
+            print(render_ascii(final, cfg.render_max_cells))
+    return 130 if interrupted else 0
+
+
+def _other_commands(args) -> int:
+    """Dispatch for the non-run, non-frontend subcommands."""
     if args.command == "checkpoints":
         import json
 

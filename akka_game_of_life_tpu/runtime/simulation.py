@@ -87,28 +87,48 @@ def _crosses(prev_epoch: int, epoch: int, every: int) -> bool:
 
 @contextlib.contextmanager
 def _shield_sigint():
-    """Defer a ^C across a critical section so (board, epoch) never tears.
+    """Defer ^C / SIGTERM across a critical section so (board, epoch) never
+    tears.
 
     ``advance`` updates the board and the epoch as two separate statements;
-    a KeyboardInterrupt landing between them would leave a stepped board
-    labeled with the previous epoch, and an interrupt-checkpoint would then
-    durably save that lie — a resumed run silently replays extra
-    generations.  The shield swallows SIGINT for the few bytecodes of the
-    update and re-raises it at the section's end, where state is
-    consistent.  No-op off the main thread (signal() would raise there)."""
+    an interrupt landing between them would leave a stepped board labeled
+    with the previous epoch, and an interrupt-checkpoint would then durably
+    save that lie — a resumed run silently replays extra generations.  The
+    shield swallows SIGINT/SIGTERM for the few bytecodes of the update and
+    re-raises KeyboardInterrupt at the section's end, where state is
+    consistent (the CLI maps SIGTERM to KeyboardInterrupt, so both signals
+    share one graceful-shutdown path).  No-op off the main thread (signal()
+    would raise there)."""
     if threading.current_thread() is not threading.main_thread():
         yield
         return
     received = []
+    shielded = []
     try:
-        old = signal.signal(signal.SIGINT, lambda s, f: received.append(1))
-    except ValueError:  # no signal support in this context
-        yield
-        return
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            if signal.getsignal(sig) is None:
+                # C-installed handler: it cannot be saved or re-installed
+                # through the signal module (signal() would return None and
+                # restoring None raises TypeError) — leave it untouched.
+                continue
+            shielded.append(
+                (sig, signal.signal(sig, lambda s, f: received.append(s)))
+            )
+    except BaseException as e:
+        # Roll back whatever was installed — including when an interrupt
+        # from an already-shielded signal fires between the two installs —
+        # so no shield lambda ever outlives this context.
+        for sig, old_h in shielded:
+            signal.signal(sig, old_h)
+        if isinstance(e, ValueError):  # no signal support in this context
+            yield
+            return
+        raise
     try:
         yield
     finally:
-        signal.signal(signal.SIGINT, old)
+        for sig, old_h in shielded:
+            signal.signal(sig, old_h)
     if received:
         raise KeyboardInterrupt
 

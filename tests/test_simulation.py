@@ -487,9 +487,9 @@ def test_async_checkpoint_write_errors_surface(tmp_path):
     assert sim._ckpt_executor is None and sim._ckpt_pending is None
 
 
-def test_cli_sigint_checkpoints_and_resumes(tmp_path):
-    """^C mid-run writes a durable checkpoint at the interrupt epoch (not
-    the last cadence point) and exits 130; a rerun resumes from it."""
+def _interrupt_run_and_check(tmp_path, sig):
+    """Send ``sig`` to a live run; expect a durable interrupt checkpoint,
+    exit 130, and a clean resume."""
     import os
     import signal
     import subprocess
@@ -523,7 +523,7 @@ def test_cli_sigint_checkpoints_and_resumes(tmp_path):
     else:
         proc.kill()
         raise AssertionError("run never made observable progress")
-    proc.send_signal(signal.SIGINT)
+    proc.send_signal(sig)
     try:
         _, err = proc.communicate(timeout=60)
     except subprocess.TimeoutExpired:
@@ -548,3 +548,32 @@ def test_cli_sigint_checkpoints_and_resumes(tmp_path):
         ]
     )
     assert rc == 0
+
+
+def test_cli_sigint_checkpoints_and_resumes(tmp_path):
+    import signal
+
+    _interrupt_run_and_check(tmp_path, signal.SIGINT)
+
+
+def test_cli_sigterm_checkpoints_and_resumes(tmp_path):
+    # Container orchestrators stop jobs with SIGTERM; same graceful path.
+    import signal
+
+    _interrupt_run_and_check(tmp_path, signal.SIGTERM)
+
+
+def test_shield_skips_c_installed_handlers():
+    """getsignal() → None means a C-installed handler: it cannot be saved or
+    re-installed via the signal module, so the shield must leave it alone
+    (restoring None would raise TypeError)."""
+    import signal
+    from unittest import mock
+
+    from akka_game_of_life_tpu.runtime.simulation import _shield_sigint
+
+    before = signal.getsignal(signal.SIGINT)
+    with mock.patch.object(signal, "getsignal", return_value=None):
+        with _shield_sigint():
+            pass
+    assert signal.getsignal(signal.SIGINT) is before
