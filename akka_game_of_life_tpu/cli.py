@@ -13,6 +13,7 @@ The reference ships two mains: ``RunFrontend [port]`` and ``RunBackend
 from __future__ import annotations
 
 import argparse
+import contextlib
 import sys
 from typing import Optional, Sequence
 
@@ -172,6 +173,34 @@ def _overrides(args: argparse.Namespace) -> dict:
     return out
 
 
+@contextlib.contextmanager
+def _sigterm_as_interrupt():
+    """Map SIGTERM to KeyboardInterrupt for the duration of a role's serve
+    loop, so orchestrator stops share the ^C graceful-shutdown path.
+
+    Main thread only; the previous handler is restored on every exit path.
+    A C-installed handler (getsignal() → None) cannot be saved or
+    re-installed through the signal module, so in that embedded case ours is
+    never installed and SIGTERM behavior is untouched."""
+    import signal as _signal
+
+    def _handler(signum, frame):
+        raise KeyboardInterrupt
+
+    _NOT_INSTALLED = object()
+    prev = _NOT_INSTALLED
+    try:
+        if _signal.getsignal(_signal.SIGTERM) is not None:
+            prev = _signal.signal(_signal.SIGTERM, _handler)
+    except ValueError:  # not the main thread (embedded use)
+        pass
+    try:
+        yield
+    finally:
+        if prev is not _NOT_INSTALLED:
+            _signal.signal(_signal.SIGTERM, prev)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(prog="akka_game_of_life_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -292,39 +321,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             cfg.max_epochs = 100
         sim = Simulation(cfg)
 
-        # Container orchestrators stop jobs with SIGTERM: give it the same
-        # graceful checkpoint-and-exit path as ^C.  Main thread only; the
-        # previous handler is restored on every exit path (finally below).
-        # A C-installed handler (getsignal() → None) cannot be saved or
-        # re-installed through the signal module at all, so in that embedded
-        # case ours is never installed and SIGTERM behavior is untouched.
-        import signal as _signal
-
-        def _sigterm(signum, frame):
-            raise KeyboardInterrupt
-
-        _NOT_INSTALLED = object()
-        prev_sigterm = _NOT_INSTALLED
-        try:
-            if _signal.getsignal(_signal.SIGTERM) is not None:
-                prev_sigterm = _signal.signal(_signal.SIGTERM, _sigterm)
-        except ValueError:  # not the main thread (embedded use)
-            pass
-        try:
-            return _run_simulation(args, cfg, sim)
-        except KeyboardInterrupt:
-            # Signal landed outside advance()'s graceful window (startup
-            # compile, summary, epilogue): exit 130 without a save — the
-            # cadence checkpoints are the durable state.
-            print(
-                f"interrupted outside the run loop at epoch {sim.epoch}",
-                file=sys.stderr,
-                flush=True,
-            )
-            return 130
-        finally:
-            if prev_sigterm is not _NOT_INSTALLED:
-                _signal.signal(_signal.SIGTERM, prev_sigterm)
+        with _sigterm_as_interrupt():
+            try:
+                return _run_simulation(args, cfg, sim)
+            except KeyboardInterrupt:
+                # Signal landed outside advance()'s graceful window (startup
+                # compile, summary, epilogue): exit 130 without a save — the
+                # cadence checkpoints are the durable state.
+                print(
+                    f"interrupted outside the run loop at epoch {sim.epoch}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                return 130
 
     if args.command == "frontend":
         overrides = _overrides(args)
@@ -345,7 +354,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         except ImportError as e:  # pragma: no cover
             raise SystemExit(f"frontend role unavailable: {e}")
 
-        return run_frontend(cfg, min_backends=args.min_backends)
+        with _sigterm_as_interrupt():
+            try:
+                return run_frontend(cfg, min_backends=args.min_backends)
+            except KeyboardInterrupt:
+                # run_frontend handles interrupts inside its serve loop; this
+                # covers startup (bind/quorum/deploy) windows.
+                return 130
 
     return _other_commands(args)
 
@@ -373,9 +388,13 @@ def _run_simulation(args, cfg, sim) -> int:
             if sim.store is not None and jax.process_count() == 1:
                 # Multi-host runs are excluded: checkpoint() is a
                 # collective + barrier the uninterrupted ranks never
-                # enter, so it would hang, not save.
-                sim.checkpoint()
-                sim.flush()
+                # enter, so it would hang, not save.  Masked so a second
+                # signal cannot abort the save it was promised.
+                from akka_game_of_life_tpu.runtime.signals import mask_interrupts
+
+                with mask_interrupts():
+                    sim.checkpoint()
+                    sim.flush()
                 print(
                     f"interrupted at epoch {sim.epoch}; checkpoint written",
                     file=sys.stderr,
@@ -493,13 +512,19 @@ def _other_commands(args) -> int:
         except ImportError as e:  # pragma: no cover
             raise SystemExit(f"backend role unavailable: {e}")
 
-        return run_backend(
-            host=args.host,
-            port=args.port,
-            name=args.name,
-            engine=args.engine,
-            pallas=args.pallas,
-        )
+        with _sigterm_as_interrupt():
+            try:
+                return run_backend(
+                    host=args.host,
+                    port=args.port,
+                    name=args.name,
+                    engine=args.engine,
+                    pallas=args.pallas,
+                )
+            except KeyboardInterrupt:
+                # run_backend handles interrupts inside its serve loop; this
+                # covers the connect/join window.
+                return 130
 
     return 2
 

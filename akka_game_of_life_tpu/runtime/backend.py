@@ -942,4 +942,15 @@ def run_backend(
     worker = BackendWorker(host, port, name=name, engine=engine, pallas=pallas)
     worker.connect()
     print(f"backend {worker.name} joined {host}:{port}", flush=True)
-    return worker.run()
+    try:
+        return worker.run()
+    except KeyboardInterrupt:
+        # Graceful operator stop: GOODBYE tells the frontend this is a
+        # deliberate leave, so tiles redeploy immediately instead of after
+        # the heartbeat-timeout a kill -9 needs to be detected.  Masked so
+        # a second signal cannot abort the GOODBYE/close half-way.
+        from akka_game_of_life_tpu.runtime.signals import mask_interrupts
+
+        with mask_interrupts():
+            worker.stop()
+        return 130

@@ -820,7 +820,22 @@ def run_frontend(config: SimulationConfig, *, min_backends: int = 1) -> int:
         print(f"error: {e}", flush=True)
         fe.stop()
         return 1
-    fe.done.wait()
+    try:
+        fe.done.wait()
+    except KeyboardInterrupt:
+        # Graceful operator stop (^C / SIGTERM via the CLI mapping): send
+        # SHUTDOWN to every worker so they leave rc=0, drain queued
+        # checkpoint writes, close the store.  Durable state = the cadence
+        # checkpoints; a restarted frontend resumes from them
+        # (tests/test_cluster.py frontend-restart-resumes).  The drain is
+        # masked against a second signal — aborting it half-way would drop
+        # queued checkpoint writes while still exiting 130.
+        from akka_game_of_life_tpu.runtime.signals import mask_interrupts
+
+        print("interrupted; shutting the cluster down", flush=True)
+        with mask_interrupts():
+            fe.stop()
+        return 130
     fe.stop()
     if fe.error:
         print(f"error: {fe.error}", flush=True)

@@ -1,0 +1,46 @@
+"""Signal plumbing shared by the graceful-shutdown paths.
+
+The CLI maps SIGTERM onto KeyboardInterrupt so ^C and orchestrator stops
+share one shutdown path (``cli._sigterm_as_interrupt``); the pieces here
+protect the *cleanup* that path runs.  The reference has no analog — its
+JVMs die where they stand (``README.md:12`` tells the operator to ctrl+c a
+backend and watch the survivors cope).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+import threading
+
+
+@contextlib.contextmanager
+def mask_interrupts():
+    """Ignore SIGINT/SIGTERM for the duration of a graceful drain.
+
+    Once shutdown cleanup has started (SHUTDOWN fan-out, checkpoint-queue
+    drain, store close), a second ^C/SIGTERM would abort it half-way while
+    still exiting with the "clean" status code — worse than either outcome
+    alone.  Cleanup is bounded work, so the signals are ignored rather than
+    deferred; an operator who truly needs an immediate stop has SIGKILL.
+    No-op off the main thread, and C-installed handlers (getsignal() →
+    None — unrestorable through the signal module) are left untouched.
+    """
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    masked = []
+    try:
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            if signal.getsignal(sig) is None:
+                continue
+            masked.append((sig, signal.signal(sig, signal.SIG_IGN)))
+    except BaseException:
+        for sig, old in masked:
+            signal.signal(sig, old)
+        raise
+    try:
+        yield
+    finally:
+        for sig, old in masked:
+            signal.signal(sig, old)

@@ -167,3 +167,60 @@ def test_kill9_backend_process_redeploys_and_matches_oracle(tmp_path):
                 p.kill()
         for p in procs:
             p.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_sigterm_frontend_shuts_cluster_down_gracefully(tmp_path):
+    """SIGTERM on the frontend (the orchestrator-stop path — exercises the
+    CLI's SIGTERM→KeyboardInterrupt mapping, which a SIGINT test would not)
+    sends SHUTDOWN to every worker: frontend exits 130, workers exit 0
+    ('shutdown'), and the cadence checkpoints survive for a later resume."""
+    from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+
+    ckpt_dir = tmp_path / "ck"
+    sim_args = [
+        "--pattern", "gosper-glider-gun", "--height", "48", "--width", "48",
+        "--max-epochs", "100000", "--tick", "20ms",
+        "--checkpoint-dir", str(ckpt_dir), "--checkpoint-every", "10",
+    ]
+    env = _child_env()
+    fe_log = tmp_path / "frontend.log"
+    logs = []
+    procs = []
+    try:
+        with open(fe_log, "w") as f:
+            fe = _spawn(
+                ["frontend", "--port", "0", "--min-backends", "2",
+                 "--wait-for-backends", "90s", *sim_args],
+                f,
+                env,
+            )
+        procs.append(fe)
+        port = _listening_port(fe_log)
+        for name in ("alpha", "beta"):
+            log = open(tmp_path / f"{name}.log", "w")
+            logs.append(log)
+            procs.append(
+                _spawn(["backend", "--port", str(port), "--name", name], log, env)
+            )
+        # Wait for durable progress, then interrupt the coordinator.
+        store = CheckpointStore(str(ckpt_dir))
+        _wait_for(
+            lambda: (store.latest_epoch() or 0) > 0, "a durable checkpoint"
+        )
+        fe.send_signal(signal.SIGTERM)
+        _wait_for(lambda: fe.poll() is not None, "frontend exit")
+        assert fe.returncode == 130, fe_log.read_text()
+        for p in procs[1:]:
+            _wait_for(lambda p=p: p.poll() is not None, "backend exit")
+            assert p.returncode == 0  # SHUTDOWN => graceful worker exit
+        assert "shutting the cluster down" in fe_log.read_text()
+        assert (store.latest_epoch() or 0) > 0  # durable state survives
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=10)
+        for log in logs:
+            log.close()
