@@ -804,29 +804,50 @@ def run_frontend(config: SimulationConfig, *, min_backends: int = 1) -> int:
     fe = Frontend(config, min_backends=min_backends)
     fe.start()
     print(f"frontend listening on {config.host}:{fe.port}", flush=True)
-    if not fe.wait_for_backends():
-        print(
-            f"error: only {len(fe.membership.alive_members())} of "
-            f"{min_backends} backends joined within "
-            f"{config.wait_for_backends_s}s",
-            flush=True,
-        )
-        fe.stop()
-        return 1
     try:
-        # A worker may die between quorum and deployment.
-        fe.start_simulation()
-    except RuntimeError as e:
-        print(f"error: {e}", flush=True)
-        fe.stop()
-        return 1
-    try:
+        if not fe.wait_for_backends():
+            print(
+                f"error: only {len(fe.membership.alive_members())} of "
+                f"{min_backends} backends joined within "
+                f"{config.wait_for_backends_s}s",
+                flush=True,
+            )
+            fe.stop()
+            return 1
+        # SIGUSR1 toggles pause/resume — the reference's PauseSimulation/
+        # ResumeSimulation messages existed but nothing ever sent them
+        # (BoardCreator.scala:109-112, dead code); here an operator can.  The
+        # handler runs on the main thread (blocked in done.wait(), holding no
+        # locks), so calling pause()/resume() directly is safe.
+        import signal as _signal
+
+        def _toggle_pause(signum, frame):
+            if fe.paused:
+                print("resuming (SIGUSR1)", flush=True)
+                fe.resume()
+            else:
+                print("pausing (SIGUSR1)", flush=True)
+                fe.pause()
+
+        try:
+            _signal.signal(_signal.SIGUSR1, _toggle_pause)
+        except (ValueError, AttributeError):  # non-main thread / no SIGUSR1
+            pass
+
+        try:
+            # A worker may die between quorum and deployment.
+            fe.start_simulation()
+        except RuntimeError as e:
+            print(f"error: {e}", flush=True)
+            fe.stop()
+            return 1
         fe.done.wait()
     except KeyboardInterrupt:
-        # Graceful operator stop (^C / SIGTERM via the CLI mapping): send
-        # SHUTDOWN to every worker so they leave rc=0, drain queued
-        # checkpoint writes, close the store.  Durable state = the cadence
-        # checkpoints; a restarted frontend resumes from them
+        # Graceful operator stop (^C / SIGTERM via the CLI mapping), in ANY
+        # post-start window — quorum wait, tile deployment, or the serve
+        # loop: send SHUTDOWN to every worker so they leave rc=0, drain
+        # queued checkpoint writes, close the store.  Durable state = the
+        # cadence checkpoints; a restarted frontend resumes from them
         # (tests/test_cluster.py frontend-restart-resumes).  The drain is
         # masked against a second signal — aborting it half-way would drop
         # queued checkpoint writes while still exiting 130.

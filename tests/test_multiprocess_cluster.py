@@ -14,6 +14,7 @@ drops that variable and pins ``JAX_PLATFORMS=cpu`` (one real TPU chip cannot
 be shared by three processes anyway).
 """
 
+import contextlib
 import os
 import signal
 import subprocess
@@ -68,6 +69,54 @@ def _listening_port(path: Path) -> int:
     return _wait_for(probe, "frontend to listen")
 
 
+@contextlib.contextmanager
+def _cluster(tmp_path, sim_args, backend_names=("alpha", "beta"), backend_args=()):
+    """Spawn a frontend + N backends as real processes, wait for every
+    backend to join, and yield (fe, fe_log, backends: name -> (proc, log)).
+    Teardown kills and REAPS every child and closes the log handles."""
+    env = _child_env()
+    fe_log = tmp_path / "frontend.log"
+    procs = []
+    handles = []
+    try:
+        with open(fe_log, "w") as f:
+            fe = _spawn(
+                ["frontend", "--port", "0", "--min-backends",
+                 str(len(backend_names)), "--wait-for-backends", "90s",
+                 *sim_args],
+                f,
+                env,
+            )
+        procs.append(fe)
+        port = _listening_port(fe_log)
+        backends = {}
+        for name in backend_names:
+            log = tmp_path / f"{name}.log"
+            fh = open(log, "w")
+            handles.append(fh)
+            p = _spawn(
+                ["backend", "--port", str(port), "--name", name, *backend_args],
+                fh,
+                env,
+            )
+            procs.append(p)
+            backends[name] = (p, log)
+        for name, (_, log) in backends.items():
+            _wait_for(
+                lambda log=log: log.exists() and "joined" in log.read_text(),
+                f"backend {name} to join",
+            )
+        yield fe, fe_log, backends
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=10)
+        for fh in handles:
+            fh.close()
+
+
 @pytest.mark.slow
 def test_kill9_backend_process_redeploys_and_matches_oracle(tmp_path):
     from akka_game_of_life_tpu.models import get_model
@@ -80,55 +129,13 @@ def test_kill9_backend_process_redeploys_and_matches_oracle(tmp_path):
     max_epochs = 120
     ckpt_dir = tmp_path / "ck"
     sim_args = [
-        "--pattern",
-        "gosper-glider-gun",
-        "--height",
-        "48",
-        "--width",
-        "48",
-        "--max-epochs",
-        str(max_epochs),
-        "--tick",
-        "20ms",
-        "--checkpoint-dir",
-        str(ckpt_dir),
-        "--checkpoint-every",
-        "20",
+        "--pattern", "gosper-glider-gun", "--height", "48", "--width", "48",
+        "--max-epochs", str(max_epochs), "--tick", "20ms",
+        "--checkpoint-dir", str(ckpt_dir), "--checkpoint-every", "20",
     ]
-    env = _child_env()
-    fe_log = tmp_path / "frontend.log"
-    procs = []
-    try:
-        with open(fe_log, "w") as f:
-            fe = _spawn(
-                ["frontend", "--port", "0", "--min-backends", "2",
-                 "--wait-for-backends", "90s", *sim_args],
-                f,
-                env,
-            )
-        procs.append(fe)
-        port = _listening_port(fe_log)
-
-        be_logs = {}
-        backends = {}
-        for name in ("alpha", "beta"):
-            log = tmp_path / f"{name}.log"
-            be_logs[name] = log
-            with open(log, "w") as f:
-                backends[name] = _spawn(
-                    ["backend", "--port", str(port), "--name", name,
-                     "--engine", "numpy"],
-                    f,
-                    env,
-                )
-            procs.append(backends[name])
-
-        for name, log in be_logs.items():
-            _wait_for(
-                lambda log=log: log.exists() and "joined" in log.read_text(),
-                f"backend {name} to join",
-            )
-
+    with _cluster(
+        tmp_path, sim_args, backend_args=("--engine", "numpy")
+    ) as (fe, fe_log, backends):
         # Let the run get past the first durable checkpoint (a finalized
         # per-tile epoch dir), then kill -9 a worker mid-flight — the
         # reference's ctrl+c, without the courtesy.
@@ -136,7 +143,7 @@ def test_kill9_backend_process_redeploys_and_matches_oracle(tmp_path):
             lambda: list(ckpt_dir.glob("ckpt_*.d/COMPLETE.json")),
             "first checkpoint",
         )
-        backends["beta"].send_signal(signal.SIGKILL)
+        backends["beta"][0].send_signal(signal.SIGKILL)
 
         _wait_for(lambda: fe.poll() is not None, "frontend to finish")
         out = fe_log.read_text()
@@ -161,12 +168,6 @@ def test_kill9_backend_process_redeploys_and_matches_oracle(tmp_path):
             get_model("conway").run(max_epochs)(jnp.asarray(initial_board(cfg)))
         )
         np.testing.assert_array_equal(ckpt.board, oracle)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        for p in procs:
-            p.wait(timeout=10)
 
 
 @pytest.mark.slow
@@ -183,26 +184,7 @@ def test_sigterm_frontend_shuts_cluster_down_gracefully(tmp_path):
         "--max-epochs", "100000", "--tick", "20ms",
         "--checkpoint-dir", str(ckpt_dir), "--checkpoint-every", "10",
     ]
-    env = _child_env()
-    fe_log = tmp_path / "frontend.log"
-    logs = []
-    procs = []
-    try:
-        with open(fe_log, "w") as f:
-            fe = _spawn(
-                ["frontend", "--port", "0", "--min-backends", "2",
-                 "--wait-for-backends", "90s", *sim_args],
-                f,
-                env,
-            )
-        procs.append(fe)
-        port = _listening_port(fe_log)
-        for name in ("alpha", "beta"):
-            log = open(tmp_path / f"{name}.log", "w")
-            logs.append(log)
-            procs.append(
-                _spawn(["backend", "--port", str(port), "--name", name], log, env)
-            )
+    with _cluster(tmp_path, sim_args) as (fe, fe_log, backends):
         # Wait for durable progress, then interrupt the coordinator.
         store = CheckpointStore(str(ckpt_dir))
         _wait_for(
@@ -211,16 +193,43 @@ def test_sigterm_frontend_shuts_cluster_down_gracefully(tmp_path):
         fe.send_signal(signal.SIGTERM)
         _wait_for(lambda: fe.poll() is not None, "frontend exit")
         assert fe.returncode == 130, fe_log.read_text()
-        for p in procs[1:]:
+        for p, _ in backends.values():
             _wait_for(lambda p=p: p.poll() is not None, "backend exit")
             assert p.returncode == 0  # SHUTDOWN => graceful worker exit
         assert "shutting the cluster down" in fe_log.read_text()
         assert (store.latest_epoch() or 0) > 0  # durable state survives
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        for p in procs:
-            p.wait(timeout=10)
-        for log in logs:
-            log.close()
+
+
+@pytest.mark.slow
+def test_sigusr1_toggles_pause_and_resume(tmp_path):
+    """SIGUSR1 on the frontend pauses the whole cluster (checkpoint epochs
+    stop advancing); a second SIGUSR1 resumes and the run completes — the
+    reference's Pause/Resume protocol (dead code there,
+    BoardCreator.scala:109-112) made operator-reachable."""
+    from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+
+    ckpt_dir = tmp_path / "ck"
+    sim_args = [
+        "--pattern", "gosper-glider-gun", "--height", "48", "--width", "48",
+        "--max-epochs", "600", "--tick", "10ms",
+        "--checkpoint-dir", str(ckpt_dir), "--checkpoint-every", "10",
+    ]
+    with _cluster(tmp_path, sim_args) as (fe, fe_log, backends):
+        store = CheckpointStore(str(ckpt_dir))
+        _wait_for(lambda: (store.latest_epoch() or 0) > 0, "durable progress")
+
+        fe.send_signal(signal.SIGUSR1)
+        _wait_for(lambda: "pausing (SIGUSR1)" in fe_log.read_text(), "pause ack")
+        # Paused: give in-flight chunks a moment to land, then the durable
+        # epoch must stop moving (unpaused it advances every ~100 ms).
+        time.sleep(1.0)
+        frozen = store.latest_epoch()
+        time.sleep(1.5)
+        assert store.latest_epoch() == frozen, "epochs advanced while paused"
+        assert fe.poll() is None
+
+        fe.send_signal(signal.SIGUSR1)
+        _wait_for(lambda: "resuming (SIGUSR1)" in fe_log.read_text(), "resume ack")
+        _wait_for(lambda: fe.poll() is not None, "run completion", timeout=180)
+        assert fe.returncode == 0, fe_log.read_text()
+        assert "simulation complete at epoch 600" in fe_log.read_text()
