@@ -130,16 +130,29 @@ extern "C" void swar_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
   // Conway that is {3, 4} instead of all ten — roughly halving the hottest
   // loop's ALU work.  Precomputed once; the inner loop never consults the
   // runtime masks.
+  // A count in BOTH sets makes the cell alive regardless of its current
+  // state (count n = n neighbors when dead, n-1 when alive), so those
+  // predicate planes skip the x masking entirely — for Conway the combine
+  // collapses to eq3 | (x & eq4), mirroring ops/bitpack.py _combine_rows.
   struct Need {
     int n;
-    bool birth, survive;
+    enum { ALWAYS, BIRTH, SURVIVE } kind;
   };
   std::vector<Need> needs;
+  bool any_birth = false, any_survive = false;
   for (int n = 0; n <= 9; ++n) {
     bool b = (birth_mask >> n) & 1;
     // Count includes the live center: survive threshold n matches count n+1.
     bool s = n > 0 && ((survive_mask >> (n - 1)) & 1);
-    if (b || s) needs.push_back({n, b, s});
+    if (b && s)
+      needs.push_back({n, Need::ALWAYS});
+    else if (b) {
+      needs.push_back({n, Need::BIRTH});
+      any_birth = true;
+    } else if (s) {
+      needs.push_back({n, Need::SURVIVE});
+      any_survive = true;
+    }
   }
 
   std::vector<uint64_t> zero(words + 2, 0);
@@ -175,15 +188,24 @@ extern "C" void swar_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
         uint64_t r2 = p1 & q0;
         uint64_t b2 = q1 ^ r2;
         uint64_t b3 = q1 & r2;
-        uint64_t birth = 0, survive = 0;
+        uint64_t always = 0, birth = 0, survive = 0;
         for (const Need& nd : needs) {
           // Predicate plane: count == nd.n.
           uint64_t t = (nd.n & 8 ? b3 : ~b3) & (nd.n & 4 ? b2 : ~b2) &
                        (nd.n & 2 ? b1 : ~b1) & (nd.n & 1 ? b0 : ~b0);
-          if (nd.birth) birth |= t;
-          if (nd.survive) survive |= t;
+          if (nd.kind == Need::ALWAYS)
+            always |= t;
+          else if (nd.kind == Need::BIRTH)
+            birth |= t;
+          else
+            survive |= t;
         }
-        o[i] = (~x[i] & birth) | (x[i] & survive);
+        uint64_t v = always;
+        // Loop-invariant branches: hoisted by the compiler, so rules with
+        // no birth-only / survive-only counts pay nothing for the masks.
+        if (any_birth) v |= ~x[i] & birth;
+        if (any_survive) v |= x[i] & survive;
+        o[i] = v;
       }
       // Keep the out-of-slab columns dead (shift guards must stay zero and
       // bits >= pw must not become fake neighbors through later steps).
