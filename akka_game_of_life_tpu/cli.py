@@ -253,6 +253,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "states, radius) as JSON lines",
     )
 
+    tune_p = sub.add_parser(
+        "tune",
+        help="autotune the Pallas kernel's (block_rows, steps_per_sweep) on "
+        "this device: one JSON line per measured point (best first), then "
+        "the winning flags",
+    )
+    tune_p.add_argument("--size", type=int, default=65536)
+    tune_p.add_argument("--steps-per-call", type=int, default=64)
+    tune_p.add_argument("--blocks", default="64,128,192,256", metavar="B1,B2,...")
+    tune_p.add_argument("--sweeps", default="4,8,16", metavar="K1,K2,...")
+    tune_p.add_argument("--timed-calls", type=int, default=2)
+    tune_p.add_argument("--vmem-limit-mb", type=int, default=0)
+    tune_p.add_argument("--rule", default="conway")
+    tune_p.add_argument("--interpret", action="store_true", help=argparse.SUPPRESS)
+    _add_platform(tune_p)
+
     ck_p = sub.add_parser(
         "checkpoints",
         help="inspect a checkpoint directory: one JSON line per durable "
@@ -463,6 +479,30 @@ def _run_simulation(args, cfg, sim) -> int:
 
 def _other_commands(args) -> int:
     """Dispatch for the non-run, non-frontend subcommands."""
+    if args.command == "tune":
+        import json
+
+        from akka_game_of_life_tpu.runtime.autotune import best_flags, sweep
+
+        results = sweep(
+            args.size,
+            steps_per_call=args.steps_per_call,
+            blocks=[int(v) for v in args.blocks.split(",")],
+            sweeps=[int(v) for v in args.sweeps.split(",")],
+            timed_calls=args.timed_calls,
+            vmem_limit_mb=args.vmem_limit_mb,
+            interpret=args.interpret,
+            rule=args.rule,
+        )
+        for p in results:
+            print(json.dumps(p), flush=True)
+        flags = best_flags(results)
+        if flags is None:
+            print("no feasible point succeeded", file=sys.stderr)
+            return 1
+        print(f"best: {flags}")
+        return 0
+
     if args.command == "checkpoints":
         import json
 

@@ -1,0 +1,119 @@
+"""Pallas sweep autotuner: measure (block_rows, steps_per_sweep) on device.
+
+BASELINE.md's round-3 sweeps found the 65536² optimum (b=128, k=8) by hand;
+this makes that measurement a command so other board sizes / future chips
+can find theirs: time each feasible configuration on the real device and
+report the best as ready-to-paste flags.  The reference has no benchmarking
+machinery at all (SURVEY.md §6), so this surface is net-new capability.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def feasible(size: int, steps_per_call: int, b: int, k: int) -> bool:
+    """The kernel's own feasibility rules (alignment helper imported from
+    ops/pallas_stencil so this cannot silently diverge from what the kernel
+    accepts): blocks tile the height, halo blocks are sublane-aligned,
+    sweeps divide the chunk."""
+    from akka_game_of_life_tpu.ops.pallas_stencil import _round_up8
+
+    if k < 1 or b < 8 or b % 8:
+        return False
+    return size % b == 0 and b % _round_up8(k) == 0 and steps_per_call % k == 0
+
+
+def sweep(
+    size: int,
+    *,
+    steps_per_call: int = 64,
+    blocks: Sequence[int] = (64, 128, 192, 256),
+    sweeps: Sequence[int] = (4, 8, 16),
+    timed_calls: int = 2,
+    vmem_limit_mb: int = 0,
+    interpret: bool = False,
+    rule="conway",
+) -> List[dict]:
+    """Time every feasible (block_rows, steps_per_sweep) point; return one
+    record per point (cells/s, seconds, or the error that disqualified it),
+    best first.  A failing point (Mosaic compile error, VMEM OOM) is a
+    recorded result, not a crash — exactly the shape of the round-3 manual
+    sweep in BASELINE.md."""
+    import jax
+
+    from akka_game_of_life_tpu.ops.pallas_stencil import packed_multi_step_fn
+    from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+    rule = resolve_rule(rule)
+    # Generate the packed words directly: uniform random uint32s ARE a
+    # density-1/2 random board, and 0.25 B/cell scratch (512 MiB at 65536²)
+    # instead of the tens of GiB a float sample + pack would cost.
+    rng = np.random.default_rng(0)
+    words = jax.device_put(
+        rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32)
+    )
+    results: List[dict] = []
+    for b in blocks:
+        for k in sweeps:
+            point = {"block_rows": int(b), "steps_per_sweep": int(k)}
+            if not feasible(size, steps_per_call, b, k):
+                continue  # silently skip: not a failure, just not a point
+            try:
+                fn = packed_multi_step_fn(
+                    rule,
+                    steps_per_call,
+                    block_rows=b,
+                    steps_per_sweep=k,
+                    interpret=interpret,
+                    vmem_limit_bytes=(
+                        vmem_limit_mb * 2**20 if vmem_limit_mb else None
+                    ),
+                )
+                out = fn(words)  # compile + warm
+                np.asarray(out[0])  # force completion (host fetch of a row)
+                t0 = time.perf_counter()
+                cur = out
+                for _ in range(timed_calls):
+                    cur = fn(cur)
+                np.asarray(cur[0])
+                dt = time.perf_counter() - t0
+                cells = size * size * steps_per_call * timed_calls
+                point.update(
+                    seconds=round(dt, 4),
+                    cells_per_sec=cells / dt,
+                )
+            except Exception as e:
+                point["error"] = f"{type(e).__name__}: {e}"
+            results.append(point)
+    results.sort(key=lambda p: p.get("cells_per_sec", -1.0), reverse=True)
+    return results
+
+
+def best_flags(results: List[dict]) -> Optional[str]:
+    """The winning point as ready-to-paste flags.
+
+    bench.py can pin both knobs; the product runtime exposes block_rows and
+    auto-picks the sweep depth with a cap of DEFAULT_STEPS_PER_SWEEP, so a
+    deeper winning k is flagged as bench-only rather than silently
+    misreported as reproducible through `run`."""
+    from akka_game_of_life_tpu.ops.pallas_stencil import DEFAULT_STEPS_PER_SWEEP
+
+    for p in results:
+        if "cells_per_sec" not in p:
+            continue
+        b, k = p["block_rows"], p["steps_per_sweep"]
+        flags = (
+            f"bench.py --block-rows {b} --steps-per-sweep {k}; "
+            f"run --pallas-block-rows {b}"
+        )
+        if k > DEFAULT_STEPS_PER_SWEEP:
+            flags += (
+                f" (run auto-caps steps_per_sweep at "
+                f"{DEFAULT_STEPS_PER_SWEEP}, so k={k} is bench-only)"
+            )
+        return flags
+    return None

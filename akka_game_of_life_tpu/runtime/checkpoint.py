@@ -464,10 +464,24 @@ def describe_store(directory: str, validate: bool = False):
             continue
         if validate:
             try:
-                ck = store.load(epoch)
-                info["ok"] = ck.board is not None and list(ck.board.shape) == list(
-                    info.get("shape") or ck.board.shape
-                )
+                # Packed epochs validate in packed form: keep_packed skips
+                # the O(board) host unpack, so a 65536² packed32 checkpoint
+                # validates through its 512 MiB of words, not 4 GiB of
+                # cells.  Dense/tile epochs still load fully.
+                ck = store.load(epoch, keep_packed=True)
+                if ck.packed32 is not None:
+                    shape = info.get("shape")
+                    h, words = (
+                        ck.packed32.shape[-2],
+                        ck.packed32.shape[-1],
+                    )
+                    info["ok"] = shape is None or (
+                        list(shape) == [h, words * 32]
+                    )
+                else:
+                    info["ok"] = ck.board is not None and list(
+                        ck.board.shape
+                    ) == list(info.get("shape") or ck.board.shape)
             except Exception as e:
                 info.update(ok=False, error=f"{type(e).__name__}: {e}")
         yield info

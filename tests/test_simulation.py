@@ -577,3 +577,38 @@ def test_shield_skips_c_installed_handlers():
         with _shield_sigint():
             pass
     assert signal.getsignal(signal.SIGINT) is before
+
+
+def test_cli_tune_interpret_smoke(capsys):
+    """The autotuner sweeps feasible (block_rows, steps_per_sweep) points,
+    emits a JSON line per point best-first, and prints winning flags."""
+    import json
+
+    from akka_game_of_life_tpu.cli import main
+
+    rc = main(
+        [
+            "tune", "--platform", "cpu", "--size", "128",
+            "--steps-per-call", "4", "--blocks", "8,16,24",
+            "--sweeps", "1,2,3", "--timed-calls", "1", "--interpret",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    points = [json.loads(l) for l in out if l.startswith("{")]
+    # size 128: blocks 8/16 divide, 24 doesn't; k=3 doesn't divide 4.
+    combos = {(p["block_rows"], p["steps_per_sweep"]) for p in points}
+    assert combos == {(8, 1), (8, 2), (16, 1), (16, 2)}
+    rates = [p["cells_per_sec"] for p in points if "cells_per_sec" in p]
+    assert rates == sorted(rates, reverse=True)
+    assert any(l.startswith("best: bench.py --block-rows") for l in out)
+
+
+def test_tune_feasibility_guards():
+    from akka_game_of_life_tpu.runtime.autotune import feasible
+
+    assert not feasible(128, 4, 8, 0)  # k=0 must not divide-by-zero
+    assert not feasible(128, 4, 0, 1)
+    assert not feasible(128, 4, 12, 1)  # not an 8-multiple
+    assert feasible(128, 4, 8, 4)
+    assert not feasible(128, 4, 8, 16)  # halo block 16 > block_rows 8
