@@ -429,3 +429,47 @@ def test_ring_traffic_is_peer_to_peer():
         for w in h.workers:
             assert not w.tiles or w._peers, f"{w.name} never dialed a peer"
     assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 20))
+
+
+def test_garbage_connections_do_not_disturb_the_cluster():
+    """Port scans / bad clients against the frontend's listener — raw junk
+    bytes, a bad-magic frame, an oversize frame claim, a malformed REGISTER
+    — must each be dropped without disturbing a live simulation (the
+    reference inherits this from Akka's framing; our wire.py must earn it)."""
+    import socket
+
+    cfg = SimulationConfig(height=32, width=32, seed=13, max_epochs=40, tick_s=0.01)
+    with cluster(cfg, 2) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        port = h.frontend.port
+
+        def poke(data):
+            with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+                s.sendall(data)
+                # Read whatever the frontend says (likely nothing / EOF).
+                s.settimeout(1.0)
+                with contextlib.suppress(OSError):
+                    s.recv(64)
+
+        from akka_game_of_life_tpu.runtime.wire import _HDR, _MAGIC
+
+        # HTTP junk ("G" happens to BE the magic byte, so this parses as a
+        # valid-magic frame with garbage lengths and is dropped downstream).
+        poke(b"GET / HTTP/1.1\r\n\r\n")
+        poke(_HDR.pack(0xBA, 10, 0))  # wrong magic byte
+        # Correct magic but an absurd frame-length claim (MAX_FRAME guard).
+        poke(_HDR.pack(_MAGIC, 2**31 - 1, 0))
+        # A well-framed but non-REGISTER hello: politely ignored.
+        from akka_game_of_life_tpu.runtime.wire import Channel
+
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            ch = Channel(s)
+            ch.send({"type": "progress", "tile": [0, 0], "epoch": 1})
+            with contextlib.suppress(OSError, ValueError):
+                ch.recv()
+
+        assert h.frontend.done.wait(DONE_TIMEOUT), "cluster did not finish"
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 40))
