@@ -409,8 +409,11 @@ class BackendWorker:
                     self.stopped_reason = self.stopped_reason or "disconnected"
                     break
                 self._dispatch(msg)
-        except OSError:
-            self.stopped_reason = self.stopped_reason or "connection error"
+        except (OSError, ValueError) as e:
+            # ValueError = a malformed frame from wire.recv (bad magic,
+            # oversize claim, bad payload structure): same clean shutdown
+            # as a connection error, with the reason on record.
+            self.stopped_reason = self.stopped_reason or f"connection error ({e})"
         finally:
             self._stop.set()
         return 0 if self.stopped_reason == "shutdown" else 1

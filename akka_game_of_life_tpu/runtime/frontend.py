@@ -60,6 +60,68 @@ _MEMORY_CKPT_EVERY = 32
 _ASSEMBLE_LIMIT = 1 << 28
 
 
+class MalformedMessage(Exception):
+    """A structurally invalid message from a registered worker — grounds to
+    drop the connection (tiles redeploy), never to crash a serve thread."""
+
+
+# Required fields per message type, checked BEFORE dispatch so a missing
+# field can never surface as a KeyError inside cluster bookkeeping.
+_MSG_REQUIRED = {
+    P.PROGRESS: ("tile", "epoch"),
+    P.TILE_STATE: ("tile", "epoch"),
+    P.REDEPLOY_REQUEST: ("tile",),
+    P.GATHER_FAILED: ("tile", "epoch"),
+}
+# TILE_STATE carries per-reason payloads; each declared reason needs its key.
+_REASON_PAYLOAD = {
+    "final": ("state",),
+    "checkpoint": ("state",),
+    "render": ("scaled_origin", "sample"),
+    "metrics": ("population",),
+}
+
+
+def _validate_msg(msg) -> None:
+    if not isinstance(msg, dict):
+        raise MalformedMessage(f"non-dict payload ({type(msg).__name__})")
+    kind = msg.get("type")
+    if not isinstance(kind, str):
+        raise MalformedMessage(f"message type {kind!r} is not a string")
+    required = _MSG_REQUIRED.get(kind, ())
+    for field in required:
+        if field not in msg:
+            raise MalformedMessage(f"{kind} message missing {field!r}")
+    if "tile" in required:
+        tile = msg["tile"]
+        if not (
+            isinstance(tile, (list, tuple))
+            and len(tile) == 2
+            and all(isinstance(v, int) for v in tile)
+        ):
+            raise MalformedMessage(
+                f"{kind} tile {tile!r} is not an integer (row, col) pair"
+            )
+    if "epoch" in required and not isinstance(msg["epoch"], int):
+        raise MalformedMessage(f"{kind} epoch {msg['epoch']!r} is not an int")
+    if kind == P.TILE_STATE:
+        reasons = msg.get("reasons", [])
+        if not isinstance(reasons, (list, tuple)) or not all(
+            isinstance(r, str) for r in reasons
+        ):
+            raise MalformedMessage(
+                f"tile_state reasons {reasons!r} not a list of strings"
+            )
+        for reason in reasons:
+            for field in _REASON_PAYLOAD.get(reason, ()):
+                if field not in msg:
+                    raise MalformedMessage(
+                        f"tile_state[{reason}] missing {field!r}"
+                    )
+        if "window" in msg and "window_origin" not in msg:
+            raise MalformedMessage("tile_state window missing 'window_origin'")
+
+
 class Frontend:
     """Coordinator state machine.  Thread layout: one acceptor, one reader
     thread per worker connection, one maintenance thread (ticks, heartbeat
@@ -440,7 +502,15 @@ class Frontend:
         member: Optional[Member] = None
         try:
             hello = channel.recv()
-            if not hello or hello.get("type") != P.REGISTER:
+            # The listener is an open TCP port: a hello that is not a
+            # well-typed REGISTER (port scan, wrong peer, wrong version) is
+            # closed without ceremony — and without a thread traceback.
+            if (
+                not isinstance(hello, dict)
+                or hello.get("type") != P.REGISTER
+                or not isinstance(hello.get("name"), (str, type(None)))
+                or not isinstance(hello.get("peer_port", 0), int)
+            ):
                 channel.close()
                 return
             engine = hello.get("engine", "jax")
@@ -487,9 +557,23 @@ class Frontend:
                 msg = channel.recv()
                 if msg is None:
                     break
+                try:
+                    # Validate structure BEFORE dispatch: a malformed message
+                    # drops the worker with a one-line reason (tiles
+                    # redeploy), while a bug inside _dispatch itself still
+                    # surfaces as a real traceback instead of being
+                    # misattributed to the worker.
+                    _validate_msg(msg)
+                except MalformedMessage as e:
+                    print(f"dropping {member.name}: {e}", flush=True)
+                    break
                 self._dispatch(member, msg)
-        except (OSError, ValueError):
-            pass
+        except (OSError, ValueError) as e:
+            if member is not None and isinstance(e, ValueError):
+                # A malformed FRAME (bad magic / oversize / bad payload
+                # structure, raised by wire.recv) gets the same one-line
+                # drop note the malformed-MESSAGE path prints.
+                print(f"dropping {member.name}: {e}", flush=True)
         finally:
             if member is not None:
                 self._on_member_lost(member.name)

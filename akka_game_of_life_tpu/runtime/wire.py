@@ -132,7 +132,15 @@ class Channel:
             if b is None:
                 return None
             blobs.append(b)
-        return _decode(json.loads(payload), blobs)
+        try:
+            return _decode(json.loads(payload), blobs)
+        except (KeyError, IndexError, TypeError) as e:
+            # A structurally bad payload (blob reference out of range, wrong
+            # nesting) is a malformed FRAME, same class as a bad magic:
+            # surface it as the ValueError the serve loops already handle.
+            raise ValueError(
+                f"malformed frame payload: {type(e).__name__}: {e}"
+            ) from e
 
     def close(self) -> None:
         try:

@@ -473,3 +473,71 @@ def test_garbage_connections_do_not_disturb_the_cluster():
         assert h.frontend.error is None
         final = h.frontend.final_board
     assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 40))
+
+
+def test_malformed_message_from_registered_worker_drops_it_cleanly(capsys):
+    """A registered connection sending a structurally malformed message
+    (missing fields) is dropped with a one-line reason — not a serve-thread
+    traceback — its tiles redeploy, and the run still matches the oracle."""
+    import socket
+
+    from akka_game_of_life_tpu.runtime.protocol import (
+        PROGRESS,
+        REGISTER,
+        WELCOME,
+    )
+    from akka_game_of_life_tpu.runtime.wire import Channel
+
+    cfg = SimulationConfig(height=32, width=32, seed=17, max_epochs=40, tick_s=0.01)
+    with cluster(cfg, 2) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+
+        # A third party registers properly, then talks garbage.
+        with socket.create_connection(("127.0.0.1", h.frontend.port), timeout=5) as s:
+            ch = Channel(s)
+            ch.send({"type": REGISTER, "name": "mallory", "peer_port": 0})
+            hello = ch.recv()
+            assert hello["type"] == WELCOME
+            ch.send({"type": PROGRESS})  # no tile, no epoch
+            time.sleep(0.3)
+
+        assert h.frontend.done.wait(DONE_TIMEOUT), "cluster did not finish"
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+    out = capsys.readouterr().out
+    assert "dropping mallory: progress message missing 'tile'" in out
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 40))
+
+
+def test_validate_msg_rejects_hostile_shapes():
+    """Unit coverage for the pre-dispatch validator: every hostile shape the
+    wire can deliver raises MalformedMessage (never TypeError/KeyError)."""
+    import pytest
+
+    from akka_game_of_life_tpu.runtime.frontend import (
+        MalformedMessage,
+        _validate_msg,
+    )
+
+    good = {"type": "progress", "tile": [0, 1], "epoch": 3}
+    _validate_msg(good)  # sanity: well-formed passes
+    _validate_msg({"type": "heartbeat"})
+    bad = [
+        [1, 2, 3],  # non-dict payload
+        {"type": [1]},  # unhashable type
+        {"type": "progress", "epoch": 3},  # missing tile
+        {"type": "progress", "tile": [[], 0], "epoch": 3},  # unhashable tile
+        {"type": "progress", "tile": [0, 1, 2], "epoch": 3},  # 3-tuple
+        {"type": "progress", "tile": [0, 1], "epoch": "3"},  # str epoch
+        {"type": "tile_state", "tile": [0, 1], "epoch": 3, "reasons": 7},
+        {"type": "tile_state", "tile": [0, 1], "epoch": 3,
+         "reasons": [["final"]]},  # unhashable reason
+        {"type": "tile_state", "tile": [0, 1], "epoch": 3,
+         "reasons": ["metrics"]},  # missing population
+        {"type": "tile_state", "tile": [0, 1], "epoch": 3, "reasons": [],
+         "window": b""},  # window without origin
+    ]
+    for msg in bad:
+        with pytest.raises(MalformedMessage):
+            _validate_msg(msg)
