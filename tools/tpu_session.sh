@@ -64,7 +64,10 @@ stage product-run 3600 python -m akka_game_of_life_tpu run \
   --render-every 60 --metrics-every 60 \
   --checkpoint-dir "$CKPT" --checkpoint-every 120
 
-stage bench-full 2400 python bench.py
+# The session's own probe stage already proved the tunnel alive, so cap the
+# bench's retry window well under the stage budget (the 1500s default is for
+# the driver's standalone end-of-round run, where nothing probed first).
+stage bench-full 2400 python bench.py --probe-retry-window 300
 
 echo "session done $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
 grep -h '"value"' "$OUT"/bench-*.log 2>/dev/null | tail -20
