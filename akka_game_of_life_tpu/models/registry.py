@@ -10,10 +10,11 @@ its execution profile; all BASELINE.json benchmark configs are registered:
 - ``brians-brain``     — Brian's Brain /2/3, int8 Generations state (config 4)
 - ``wireworld``        — WireWorld, the non-totalistic 4-state digital-logic
                          CA (``Rule.kind="wireworld"``; dense kernels + actor
-                         engines; packed kernels decline it)
+                         engines per-cell, bit-plane SWAR packed — 2
+                         bits/cell through ``ops/bitpack_gen``)
 - ``bugs``             — Larger-than-Life (Evans), radius-5 Moore; counts run
-                         as bf16 MXU convolutions (``ops/ltl.py``); any
-                         ``"R<r>,B<ranges>,S<ranges>"`` rulestring works
+                         as separable shift-add window sums (``ops/ltl.py``);
+                         any ``"R<r>,B<ranges>,S<ranges>"`` rulestring works
 - plus seeds, life-without-death, star-wars, and any rulestring on demand.
 """
 

@@ -1,15 +1,27 @@
-"""Larger-than-Life: radius-R neighborhoods through the MXU.
+"""Larger-than-Life: radius-R window sums as separable VPU shift-adds.
 
-Every other kernel in this framework is VPU work — bitwise SWAR adders and
-byte stencils, because a Moore-8 count is too small to feed a matrix unit.
 Larger than Life (Evans) scales the neighborhood to a radius-R window —
-the (2R+1)² Moore box (Golly NM) or the von Neumann diamond (NN) — and a
-window-sum over a grid IS a convolution: the box runs as two separable
-``lax.conv_general_dilated`` passes (a (2R+1)×1 column conv then a 1×(2R+1)
-row conv), the non-separable diamond as one direct masked conv, all in
-bfloat16 — the MXU's native diet — so the TPU's main compute unit finally
-carries a CA family.  Counts ≤ max_neighbors ≤ 440 are exact in bf16
-(integers to 256) when they fit and in f32 beyond, chosen automatically.
+the (2R+1)² Moore box (Golly NM) or the von Neumann diamond (NN).  The
+obvious TPU mapping is a convolution on the MXU, and an earlier revision
+of this module did exactly that — but a single-feature conv is the one
+shape the TPU conv unit handles *badly*: XLA pads the lone channel to the
+128-lane register width, so an 8192² radius-5 board materialized a 17.2 GB
+intermediate and OOMed HBM (`artifacts/tpu_session_r3b/bench-full.log`).
+A window sum is separable arithmetic, not matrix math, so it now runs the
+way the rest of this framework computes — on the VPU with board-sized
+intermediates:
+
+- **box**: two separable shift-add passes (a (2R+1)-term column sum of
+  row slices, then a (2R+1)-term row sum of column slices) — 2(2R+1)
+  adds/cell that XLA fuses into single passes, peak scratch ≈ 2 planes
+  of the count dtype;
+- **diamond**: not separable, but each of its 2R+1 rows is a contiguous
+  run, so one f32 row-cumsum turns every row's contribution into a
+  two-slice difference — 2(2R+1) ops/cell instead of the O(R²) masked
+  window, and exact (0/1 partial sums stay far below 2²⁴).
+
+Counts ≤ max_neighbors ≤ 440 are exact in bf16 (integers to 256) when
+they fit and in f32 beyond, chosen automatically.
 
 The birth/survive sets are arbitrary subsets of 0..max_neighbors, applied as a
 table gather (XLA lowers the tiny lookup into the fused epilogue).  With
@@ -18,8 +30,7 @@ cross-validation anchor ``tests/test_ltl.py`` pins against the VPU kernel.
 
 Reference capability note: radius generalization is pure surplus over the
 reference (one hard-coded radius-1 rule, ``NextStateCellGathererActor.scala:44``)
-— it is here because the TPU-native design makes it nearly free, and it is
-the configuration where the MXU (not the VPU or HBM) sets the roofline.
+— it is here because the TPU-native design makes it nearly free.
 """
 
 from __future__ import annotations
@@ -55,20 +66,40 @@ def _window_counts(
     alive_2d: jax.Array, radius: int, neighborhood: str, dtype
 ) -> jax.Array:
     """(H+2R, W+2R) 0/1 halo-padded alive plane → (H, W) window sums
-    INCLUDING the center.  The box is two separable convs (column pass then
-    row pass); the diamond is not separable, so it runs as one direct
-    (2R+1)² masked conv — still a single conv_general_dilated the TPU conv
-    unit eats whole."""
+    INCLUDING the center.
+
+    Box: two separable shift-add passes over static slices (column sum then
+    row sum) — no conv, so no TPU single-channel 128-lane padding and the
+    peak intermediate is one (H, W+2R) plane of ``dtype``.
+
+    Diamond: row dy of the L1 ball is a contiguous run of width
+    2(R−|dy|)+1, so a single exclusive row-cumsum (f32 — exact: partial
+    sums ≤ W+2R ≪ 2²⁴) turns each row's contribution into a two-slice
+    difference; 2R+1 differences sum to the window.
+    """
     r = radius
-    x = alive_2d.astype(dtype)[None, None]  # NCHW
+    d = 2 * r + 1
+    ph, pw = alive_2d.shape
+    h, w = ph - 2 * r, pw - 2 * r
     if neighborhood == "box":
-        col = jnp.ones((1, 1, 2 * r + 1, 1), dtype)
-        row = jnp.ones((1, 1, 1, 2 * r + 1), dtype)
-        x = jax.lax.conv_general_dilated(x, col, (1, 1), "VALID")
-        x = jax.lax.conv_general_dilated(x, row, (1, 1), "VALID")
-        return x[0, 0]
-    k = jnp.asarray(neighborhood_mask(r, neighborhood), dtype)[None, None]
-    return jax.lax.conv_general_dilated(x, k, (1, 1), "VALID")[0, 0]
+        x = alive_2d.astype(dtype)
+        col = x[0:h, :]
+        for dy in range(1, d):
+            col = col + x[dy : dy + h, :]  # (H, W+2R)
+        out = col[:, 0:w]
+        for dx in range(1, d):
+            out = out + col[:, dx : dx + w]
+        return out
+    # Diamond (von Neumann L1 ball), via an exclusive row-cumsum.
+    c = jnp.cumsum(alive_2d.astype(jnp.float32), axis=1)
+    c = jnp.pad(c, ((0, 0), (1, 0)))  # c[i, j] = sum of alive[i, :j]
+    out = jnp.zeros((h, w), jnp.float32)
+    for dy in range(-r, r + 1):
+        width = 2 * (r - abs(dy)) + 1
+        lo = abs(dy)  # run starts at padded column x + |dy|
+        rows = slice(r + dy, r + dy + h)
+        out = out + (c[rows, lo + width : lo + width + w] - c[rows, lo : lo + w])
+    return out.astype(dtype)
 
 
 def _tables(rule: Rule):

@@ -49,10 +49,11 @@ class Rule:
     # at which a CONDUCTOR excites to a head; heads always become tails,
     # tails conductors, empty stays empty.  "ltl" is Larger than Life:
     # the same outer-totalistic birth/survive semantics on a radius-R
-    # Moore neighborhood ((2R+1)² - 1 neighbors) — counts come from an MXU
-    # convolution instead of the VPU adder network (ops/ltl.py).  Every
-    # kernel's neighbor-count pipeline (alive = state == 1) is shared;
-    # only the transition/count-geometry differs per kind.
+    # Moore neighborhood ((2R+1)² - 1 neighbors) — counts come from
+    # separable shift-add window sums instead of the Moore-8 adder
+    # network (ops/ltl.py).  Every kernel's neighbor-count pipeline
+    # (alive = state == 1) is shared; only the transition/count-geometry
+    # differs per kind.
     kind: str = "totalistic"
     radius: int = 1  # neighborhood radius; >1 only for kind="ltl"
     # Neighborhood norm for kind="ltl": "box" = radius-R Moore (Golly NM),

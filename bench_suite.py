@@ -19,8 +19,10 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
   6. cluster-exchange    TCP-cluster width-k ring exchange, k=1 vs k=8
                          (in-process frontend + 2 jax workers; the
                          communication-avoiding ratio as a standing record).
-  7. ltl-8192            Bugs (radius-5 Larger than Life) through the bf16
-                         conv kernel — the MXU-path family.
+  7. ltl-8192            Bugs (radius-5 Larger than Life) through the
+                         separable shift-add window-sum kernel.
+  8. wireworld-8192      WireWorld dense baseline vs the 2-bit-plane SWAR
+                         kernel (heads counted by the shared adder network).
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -248,7 +250,7 @@ def bench_packed_gen(size: int, rule: str, config: str, steps: int = 32) -> None
     rate = size * size * steps / dt
     _emit(
         config,
-        f"cell-updates/sec/chip, {rule} {size}x{size} bit-plane Generations "
+        f"cell-updates/sec/chip, {rule} {size}x{size} bit-plane SWAR "
         f"({bitpack_gen.n_planes(r.states)} planes)",
         rate,
         "cell-updates/sec",
@@ -299,12 +301,15 @@ def bench_pallas_gen(size: int, rule: str, config: str, steps: int = 32) -> None
 
 
 def bench_ltl(size: int, rule: str, config: str, steps: int = 16) -> None:
-    """Larger-than-Life through the conv kernel — the MXU-path family
-    (get_model dispatches kind=ltl to ops/ltl.py, so this is bench_dense
-    with honest traffic accounting: the conv path upcasts to bf16 and
-    round-trips a full bf16 intermediate between the separable passes,
-    ~6 B/cell/step — u8 read + bf16 write+read + u8 write — not the plain
-    stencil's 2)."""
+    """Larger-than-Life through the separable shift-add kernel (get_model
+    dispatches kind=ltl to ops/ltl.py, so this is bench_dense with honest
+    traffic accounting: the count path upcasts to the count dtype and
+    round-trips one count-dtype plane between the separable passes,
+    ~6 B/cell/step at bf16 — u8 read + bf16 write+read + u8 write — not
+    the plain stencil's 2.  The former conv formulation OOMed at this very
+    config on the v5e: XLA pads a single-channel conv to the 128-lane
+    width, a 17.2 GB intermediate at 8192² — the shift-add form keeps
+    intermediates board-sized)."""
     from akka_game_of_life_tpu.ops.rules import resolve_rule
 
     r = resolve_rule(rule)
@@ -315,8 +320,8 @@ def bench_ltl(size: int, rule: str, config: str, steps: int = 16) -> None:
         steps,
         density=0.4,
         flavor=(
-            f"radius-{r.radius} LtL conv (bf16, "
-            f"{2 * (2 * r.radius + 1)} MACs/cell)"
+            f"radius-{r.radius} LtL shift-add (bf16, "
+            f"{2 * (2 * r.radius + 1)} adds/cell)"
         ),
         bytes_per_cell=6.0,
     )
@@ -477,7 +482,9 @@ def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", type=int, nargs="*", default=[1, 2, 3, 4, 5, 6, 7])
+    parser.add_argument(
+        "--config", type=int, nargs="*", default=[1, 2, 3, 4, 5, 6, 7, 8]
+    )
     parser.add_argument(
         "--scale", type=float, default=1.0,
         help="multiply grid sides by this (e.g. 0.125 for CPU smoke runs)",
@@ -512,6 +519,12 @@ def main() -> None:
         bench_cluster_exchange(s(4096))
     if 7 in args.config:
         bench_ltl(s(8192), "bugs", "ltl-8192")
+    if 8 in args.config:
+        # WireWorld: dense baseline vs the 2-bit-plane SWAR kernel
+        # (VERDICT.md round-3 weak #6: the family no longer pays the ~4×
+        # dense toll).
+        bench_dense(s(8192), "wireworld", "wireworld-8192", steps=16, density=0.5)
+        bench_packed_gen(s(8192), "wireworld", "wireworld-8192")
 
 
 if __name__ == "__main__":
