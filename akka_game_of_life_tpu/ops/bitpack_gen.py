@@ -1,4 +1,4 @@
-"""Bit-plane SWAR stepping for multi-state *Generations* CA.
+"""Bit-plane SWAR stepping for multi-state CA: *Generations* and *WireWorld*.
 
 The binary bit-packed kernel (:mod:`akka_game_of_life_tpu.ops.bitpack`)
 cannot express refractory states, so Generations rules (Brian's Brain /2/3,
@@ -20,6 +20,21 @@ dense kernel): dead → 1 on birth-hit else 0; alive → 1 on survive-hit else
 state+1 (=2); refractory → state+1, wrapping S-1 → 0.  The alive center
 contributes +1 to its own count, so survive thresholds shift by +1 exactly
 as in the binary kernel; a dead or refractory center contributes 0.
+
+*WireWorld* (``Rule.kind="wireworld"``, 4 states: 0 empty, 1 electron head,
+2 tail, 3 conductor) shares the whole pipeline — the counted plane is
+state==1 (heads) either way — and its transition is *cheaper* than
+Generations': with the state's two bits as planes (p0, p1), head=01,
+tail=10, conductor=11, the rules "head→tail, tail→conductor,
+conductor→head iff head-count ∈ birth, empty stays" collapse to::
+
+    next_p0 = p1                                    # tail|conductor gain p0
+    next_p1 = (p0 ^ p1) | (p0 & p1 & ~excite)       # head|tail | calm conductor
+
+where ``excite`` is the birth-count predicate with NO +1 shift (a conductor
+center is not a head, so it never contributes to its own count).  The dense
+kernel (``ops/stencil.py apply_rule``) and the actor engines implement the
+same transition per-cell; ``tests/test_wireworld.py`` pins all three equal.
 """
 
 from __future__ import annotations
@@ -41,13 +56,14 @@ from akka_game_of_life_tpu.ops.bitpack import (
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 
 
-def _require_totalistic(rule) -> None:
-    """The plane transition encodes Generations decay semantics; other
-    kinds (wireworld) ride the dense kernel instead."""
-    if not rule.is_totalistic:
+def _require_plane_support(rule) -> None:
+    """The plane steppers encode Generations decay and WireWorld transition
+    semantics; radius-R LtL (binary, but wider than the Moore-8 adders)
+    rides :mod:`akka_game_of_life_tpu.ops.ltl` instead."""
+    if not (rule.is_totalistic or rule.kind == "wireworld"):
         raise ValueError(
-            f"bit-plane Generations kernel supports totalistic rules only, "
-            f"got {rule}"
+            f"bit-plane kernel supports totalistic and wireworld rules "
+            f"only, got {rule}"
         )
 
 
@@ -149,41 +165,55 @@ def _transition(
     )
 
 
+def _transition_wire(ps_center: List[jax.Array], eq, rule) -> jax.Array:
+    """Next-state WireWorld planes from center-row plane slices plus count
+    predicates (see the module docstring's derivation).  Far cheaper than
+    the Generations transition: two plane expressions on top of the shared
+    head count."""
+    p0, p1 = ps_center
+    excite = jnp.uint32(0)
+    for n in rule.birth:  # {1, 2}: conductor center never self-counts
+        excite = excite | eq(n)
+    return jnp.stack([p1, (p0 ^ p1) | (p0 & p1 & ~excite)])
+
+
 def step_gen_padded_rows(padded: jax.Array, rule) -> jax.Array:
-    """One Generations step on a row-padded plane slab: (m, h+2, words) with
-    one halo row top and bottom → (m, h, words).  Row triple sums of the
-    alive plane are computed once per slab row and shared across the three
-    output rows each feeds — the Generations twin of
+    """One plane step (Generations or WireWorld) on a row-padded slab:
+    (m, h+2, words) with one halo row top and bottom → (m, h, words).  Row
+    triple sums of the counted plane (state==1: alive / electron heads) are
+    computed once per slab row and shared across the three output rows each
+    feeds — the multi-state twin of
     :func:`akka_game_of_life_tpu.ops.bitpack.step_padded_rows`, used by the
     Pallas temporal-blocking kernel."""
     rule = resolve_rule(rule)
-    _require_totalistic(rule)
+    _require_plane_support(rule)
     m = n_planes(rule.states)
     if padded.shape[0] != m:
         raise ValueError(f"expected {m} planes for {rule.states} states")
     ps = [padded[k] for k in range(m)]
     alive = _eq_const(ps, 1)
-    dead = _eq_const(ps, 0)
     s, c = _row_triple_sum(alive)
     eq = count_eq_fn(
         *_count_bits(s[:-2], c[:-2], s[1:-1], c[1:-1], s[2:], c[2:])
     )
-    return _transition(
-        [p[1:-1] for p in ps], alive[1:-1], dead[1:-1], eq, rule
-    )
+    center = [p[1:-1] for p in ps]
+    if rule.kind == "wireworld":
+        return _transition_wire(center, eq, rule)
+    dead = _eq_const(ps, 0)
+    return _transition(center, alive[1:-1], dead[1:-1], eq, rule)
 
 
 def step_gen(planes: jax.Array, rule) -> jax.Array:
-    """One toroidal Generations step on (m, H, W/32) packed planes."""
+    """One toroidal plane step (Generations or WireWorld) on (m, H, W/32)
+    packed planes."""
     rule = resolve_rule(rule)
-    _require_totalistic(rule)
+    _require_plane_support(rule)
     m = n_planes(rule.states)
     if planes.shape[0] != m:
         raise ValueError(f"expected {m} planes for {rule.states} states")
     ps = [planes[k] for k in range(m)]
 
     alive = _eq_const(ps, 1)
-    dead = _eq_const(ps, 0)
 
     s, c = _row_triple_sum(alive)
     eq = count_eq_fn(
@@ -196,6 +226,9 @@ def step_gen(planes: jax.Array, rule) -> jax.Array:
             jnp.roll(c, -1, axis=0),
         )
     )
+    if rule.kind == "wireworld":
+        return _transition_wire(ps, eq, rule)
+    dead = _eq_const(ps, 0)
     return _transition(ps, alive, dead, eq, rule)
 
 

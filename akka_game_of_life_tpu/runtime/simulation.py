@@ -316,9 +316,9 @@ class Simulation:
         cfg = self.config
         kernel = cfg.kernel
         if kernel == "auto":
-            if not self.rule.is_totalistic:
-                # Non-totalistic kinds (wireworld) have no packed/Mosaic
-                # form; the dense kernel carries them on every topology.
+            if self.rule.kind == "ltl":
+                # Radius-R counts live in ops/ltl.py's shift-add path; the
+                # dense kernel slot carries them on every topology.
                 return "dense"
             if cfg.width % 32:
                 return "dense"
@@ -345,10 +345,10 @@ class Simulation:
             # Generations rules: bit planes (0.25·m B/cell vs 1 B/cell dense).
             return "bitpack" if self.rule.states <= 256 else "dense"
         if kernel in ("bitpack", "pallas"):
-            if not self.rule.is_totalistic:
+            if self.rule.kind == "ltl":
                 raise ValueError(
-                    f"kernel={kernel} supports totalistic rules only; "
-                    f"{self.rule} runs on kernel=dense"
+                    f"kernel={kernel} supports totalistic and wireworld "
+                    f"rules only; {self.rule} runs on kernel=dense"
                 )
             if not self.rule.is_binary and self.rule.states > 256:
                 raise ValueError(

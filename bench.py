@@ -272,6 +272,12 @@ def main() -> None:
                 ),
             ),
             ("ltl-8192", lambda: bench_suite.bench_ltl(8192, "bugs", "ltl-8192")),
+            (
+                "wireworld-8192",
+                lambda: bench_suite.bench_packed_gen(
+                    8192, "wireworld", "wireworld-8192"
+                ),
+            ),
         ]
         for name, fn in aux:
             try:

@@ -3,8 +3,11 @@
 States: 0 empty, 1 electron head, 2 tail, 3 conductor; a conductor excites
 to a head iff it has 1 or 2 head neighbors.  Not expressible in the B/S +
 Generations rule space, so it exercises the ``Rule.kind`` seam: the dense
-kernels (jax + numpy) and both actor engines implement it; the packed
-kernels reject it and ``kernel=auto`` routes it to dense.
+kernels (jax + numpy) and both actor engines implement it per-cell, and the
+bit-plane SWAR path (``ops/bitpack_gen``) carries it packed — 2 bits/cell,
+two plane expressions over the shared head-count adders — on single device,
+mesh, and Pallas sweeps alike.  ``kernel=auto`` promotes it to the packed
+planes on 32-aligned widths.
 """
 
 import io
@@ -14,6 +17,7 @@ import pytest
 import jax.numpy as jnp
 
 from akka_game_of_life_tpu.models import get_model
+from akka_game_of_life_tpu.ops import bitpack_gen
 from akka_game_of_life_tpu.ops.npkernel import step_np
 from akka_game_of_life_tpu.ops.rules import WIREWORLD, resolve_rule
 from akka_game_of_life_tpu.runtime.config import SimulationConfig
@@ -96,7 +100,49 @@ def test_numpy_and_actor_engines_match_stencil():
         np.testing.assert_array_equal(native.board_at_current(), jax_out)
 
 
-def test_simulation_auto_routes_to_dense_and_packed_rejects():
+def test_packed_wireworld_matches_dense():
+    """The bit-plane kernel vs the dense oracle on a random conductor soup
+    (toroidal): heads racing along random wires, colliding, dying out —
+    the excitation predicate and both plane expressions under fuzz."""
+    rng = np.random.default_rng(11)
+    board = rng.choice(
+        np.arange(4, dtype=np.uint8), size=(32, 64), p=[0.4, 0.05, 0.05, 0.5]
+    )
+    steps = 8
+    planes = bitpack_gen.pack_gen(jnp.asarray(board), 4)
+    got = bitpack_gen.unpack_gen(
+        bitpack_gen.gen_multi_step_fn(WIREWORLD, steps)(planes)
+    )
+    oracle = np.asarray(get_model("wireworld").run(steps)(jnp.asarray(board)))
+    np.testing.assert_array_equal(np.asarray(got), oracle)
+
+
+def test_packed_wireworld_padded_rows_matches_toroidal_interior():
+    # The slab form (the Pallas sweep's inner step): interior rows of the
+    # padded step must equal the toroidal step's same rows.
+    rng = np.random.default_rng(12)
+    board = rng.integers(0, 4, size=(16, 32), dtype=np.uint8)
+    planes = bitpack_gen.pack_gen(jnp.asarray(board), 4)
+    toroidal = bitpack_gen.step_gen(planes, "wireworld")
+    padded = jnp.concatenate([planes[:, -1:], planes, planes[:, :1]], axis=1)
+    slab = bitpack_gen.step_gen_padded_rows(padded, "wireworld")
+    np.testing.assert_array_equal(np.asarray(slab), np.asarray(toroidal))
+
+
+def test_wireworld_pallas_sweep_interpret_matches_dense():
+    from akka_game_of_life_tpu.ops import pallas_gen
+
+    board = pattern_board("wireworld-clock", (16, 32), (4, 4))
+    steps = 10
+    planes = bitpack_gen.pack_gen(jnp.asarray(board), 4)
+    run = pallas_gen.gen_pallas_multi_step_fn(
+        WIREWORLD, steps, block_rows=8, interpret=True
+    )
+    got = np.asarray(bitpack_gen.unpack_gen(run(planes)))
+    np.testing.assert_array_equal(got, board)  # clock period 10
+
+
+def test_simulation_auto_promotes_to_packed_planes():
     sim = Simulation(
         SimulationConfig(
             height=32, width=32, rule="wireworld", pattern="wireworld-clock",
@@ -104,14 +150,22 @@ def test_simulation_auto_routes_to_dense_and_packed_rejects():
         ),
         observer=BoardObserver(out=io.StringIO()),
     )
-    assert sim.kernel == "dense"
+    assert sim.kernel == "bitpack"
     start = sim.board_host()
     sim.advance(10)
     np.testing.assert_array_equal(sim.board_host(), start)  # clock period
 
-    with pytest.raises(ValueError, match="totalistic"):
+    # Odd widths still fall back to the dense kernel...
+    sim_odd = Simulation(
+        SimulationConfig(height=32, width=30, rule="wireworld"),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    assert sim_odd.kernel == "dense"
+    # ...and the packed kernels still reject the one family they cannot
+    # express (radius-R LtL).
+    with pytest.raises(ValueError, match="wireworld|dense"):
         Simulation(
-            SimulationConfig(height=32, width=32, rule="wireworld", kernel="bitpack"),
+            SimulationConfig(height=32, width=32, rule="bugs", kernel="bitpack"),
             observer=BoardObserver(out=io.StringIO()),
         )
 
