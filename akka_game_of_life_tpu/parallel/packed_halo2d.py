@@ -85,6 +85,16 @@ def _sharded_exchange_fn(
     sweep's in-kernel word roll is the true torus wrap), and ``check_vma``
     (the vma tracker cannot yet see through pallas_call's interpret-mode
     discharge).
+
+    The scan carries the *padded* tile and refreshes only the halo strips
+    in place (``.at[].set`` → donated dynamic-update-slices), rather than
+    re-assembling ``concat(halo, tile, halo)`` and re-slicing the interior
+    every exchange.  At 65536² with 64-row Mosaic halos those two copies
+    were ~2 GB of extra HBM traffic per 64-generation exchange — ~25% on
+    top of the sweep's own read+write, the bulk of the measured 1.32 vs
+    1.82×10¹² sharded-vs-torus gap (BASELINE.md round-3).  The strips are
+    always read from the carried tile's *interior* rows/words, so the
+    initial padding's halo content is never observed.
     """
     s = steps_per_exchange if steps_per_exchange is not None else halo_rows
     if steps_per_call % s:
@@ -105,25 +115,30 @@ def _sharded_exchange_fn(
 
     def local(tile: jax.Array) -> jax.Array:
         check_tile(tile)
-        row_ax, col_ax = tile.ndim - 2, tile.ndim - 1
+        h_loc, w_loc = tile.shape[-2], tile.shape[-1]
+        pad_width = [(0, 0)] * (tile.ndim - 2) + [(hr, hr), (hw, hw)]
 
-        def body(t, _):
+        def body(p, _):
             # Phase 1 — word columns; my west halo is my left neighbor's
-            # easternmost words.
+            # easternmost INTERIOR words (cols -2hw:-hw of the padded tile).
             if hw:
-                west = ring_shift(t[..., -hw:], COL_AXIS, +1)
-                east = ring_shift(t[..., :hw], COL_AXIS, -1)
-                t = jnp.concatenate([west, t, east], axis=col_ax)
-            # Phase 2 — rows of the column-padded tile: corner words ride.
-            top = ring_shift(t[..., -hr:, :], ROW_AXIS, +1)
-            bottom = ring_shift(t[..., :hr, :], ROW_AXIS, -1)
-            padded = jnp.concatenate([top, t, bottom], axis=row_ax)
-            padded = local_advance(padded)
-            out = padded[..., hr:-hr, :]
-            return (out[..., hw:-hw] if hw else out), None
+                west = ring_shift(p[..., hr:-hr, -2 * hw : -hw], COL_AXIS, +1)
+                east = ring_shift(p[..., hr:-hr, hw : 2 * hw], COL_AXIS, -1)
+                p = p.at[..., hr : hr + h_loc, :hw].set(west)
+                p = p.at[..., hr : hr + h_loc, hw + w_loc :].set(east)
+            # Phase 2 — full-width rows (the col halos just refreshed on the
+            # neighbor ride along, so corner words arrive valid).
+            top = ring_shift(p[..., -2 * hr : -hr, :], ROW_AXIS, +1)
+            bottom = ring_shift(p[..., hr : 2 * hr, :], ROW_AXIS, -1)
+            p = p.at[..., :hr, :].set(top)
+            p = p.at[..., hr + h_loc :, :].set(bottom)
+            return local_advance(p), None
 
-        out, _ = jax.lax.scan(body, tile, None, length=n_exchanges)
-        return out
+        padded, _ = jax.lax.scan(
+            body, jnp.pad(tile, pad_width), None, length=n_exchanges
+        )
+        out = padded[..., hr:-hr, :]
+        return out[..., hw:-hw] if hw else out
 
     mapped = jax.shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=check_vma

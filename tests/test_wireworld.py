@@ -132,14 +132,20 @@ def test_packed_wireworld_padded_rows_matches_toroidal_interior():
 def test_wireworld_pallas_sweep_interpret_matches_dense():
     from akka_game_of_life_tpu.ops import pallas_gen
 
-    board = pattern_board("wireworld-clock", (16, 32), (4, 4))
+    # A random conductor soup, not just the periodic clock: a no-op stepper
+    # would pass a period test but not an oracle comparison.
+    rng = np.random.default_rng(13)
+    board = rng.choice(
+        np.arange(4, dtype=np.uint8), size=(16, 32), p=[0.35, 0.08, 0.07, 0.5]
+    )
     steps = 10
     planes = bitpack_gen.pack_gen(jnp.asarray(board), 4)
     run = pallas_gen.gen_pallas_multi_step_fn(
         WIREWORLD, steps, block_rows=8, interpret=True
     )
     got = np.asarray(bitpack_gen.unpack_gen(run(planes)))
-    np.testing.assert_array_equal(got, board)  # clock period 10
+    oracle = np.asarray(get_model("wireworld").run(steps)(jnp.asarray(board)))
+    np.testing.assert_array_equal(got, oracle)
 
 
 def test_simulation_auto_promotes_to_packed_planes():
