@@ -44,18 +44,45 @@ from akka_game_of_life_tpu.ops.rules import resolve_rule
 
 DEFAULT_BLOCK_ROWS = 256
 DEFAULT_STEPS_PER_SWEEP = 8
-DEFAULT_BLOCK_ROWS_CAP = 128  # auto-sizing cap (measured-best; BASELINE.md)
+DEFAULT_BLOCK_ROWS_CAP = 128  # fallback cap when no measured band applies
+
+# Measured-best VMEM row blocks by board height, from on-device `tune`
+# sweeps (the autotuner, runtime/autotune.py; raw logs in artifacts/ and
+# BASELINE.md).  auto_block_rows consults the nearest band so auto-sizing
+# tracks measurements instead of one hardcoded constant; unmeasured heights
+# fall back to the nearest measured band's cap (scheduling behavior changes
+# slowly with size and every cap is still validated for divisibility).
+MEASURED_BLOCK_ROWS_CAPS = {
+    65536: 128,  # round-3 manual sweep + round-4 tune: b=128/k=8 optimum
+}
 
 
 def _round_up8(n: int) -> int:
     return -(-n // 8) * 8
 
 
-def auto_block_rows(height: int, cap: int = DEFAULT_BLOCK_ROWS_CAP) -> Optional[int]:
+def measured_cap(height: int) -> int:
+    """The block-rows cap for ``height``: the measured band nearest in log
+    scale, or DEFAULT_BLOCK_ROWS_CAP if the table is somehow empty."""
+    if not MEASURED_BLOCK_ROWS_CAPS:
+        return DEFAULT_BLOCK_ROWS_CAP
+    import math
+
+    band = min(
+        MEASURED_BLOCK_ROWS_CAPS,
+        key=lambda h: abs(math.log2(max(height, 1)) - math.log2(h)),
+    )
+    return MEASURED_BLOCK_ROWS_CAPS[band]
+
+
+def auto_block_rows(height: int, cap: Optional[int] = None) -> Optional[int]:
     """The VMEM row block auto-sizing rule, shared by the product runtime
     and the bench suite: the largest 8-multiple divisor of ``height`` up to
-    ``cap`` (128 = the measured-best block at 65536² — BASELINE.md), or
-    None if the height has no 8-multiple divisor."""
+    ``cap`` (default: the measured cap for this height band — see
+    MEASURED_BLOCK_ROWS_CAPS), or None if the height has no 8-multiple
+    divisor."""
+    if cap is None:
+        cap = measured_cap(height)
     for b in range(cap, 7, -8):
         if height % b == 0:
             return b

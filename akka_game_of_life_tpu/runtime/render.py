@@ -64,6 +64,10 @@ class StepMetrics:
     epochs: int  # generations covered by that interval
     cells: int  # cell-updates in the interval (board.size * epochs)
     population: int
+    # Wall time the observation itself spent (device obs dispatch + host
+    # fetches) inside ``seconds`` — the product-vs-bench breakdown: the
+    # stepper's own share of the interval is seconds - obs_seconds.
+    obs_seconds: float = 0.0
 
     @property
     def updates_per_sec(self) -> float:
@@ -147,7 +151,13 @@ class BoardObserver:
             self._last_time = time.perf_counter()
             self._last_epoch = epoch
 
-    def _note_progress(self, epoch: int, population: int, total_cells: int) -> None:
+    def _note_progress(
+        self,
+        epoch: int,
+        population: int,
+        total_cells: int,
+        obs_seconds: float = 0.0,
+    ) -> None:
         """Advance the metrics clock and emit a metrics line at cadence."""
         now = time.perf_counter()
         if self._last_time is not None and epoch > (self._last_epoch or 0):
@@ -159,16 +169,26 @@ class BoardObserver:
                 epochs=epochs,
                 cells=total_cells * epochs,
                 population=population,
+                obs_seconds=obs_seconds,
             )
             self.history.append(m)
             self._total_epochs += m.epochs
             self._total_seconds += m.seconds
             self._total_cells += m.cells
             if self.metrics_every and epoch % self.metrics_every == 0:
+                # obs = the observation's own share of the interval (device
+                # obs dispatch + host fetches): ms/epoch minus obs/epochs is
+                # the stepper's true per-epoch cost — the measured breakdown
+                # behind any product-vs-bench throughput gap.
+                obs = (
+                    f" (obs {m.obs_seconds * 1e3:.1f} ms)"
+                    if m.obs_seconds > 0
+                    else ""
+                )
                 print(
                     f"epoch {epoch}: pop={m.population} "
                     f"{m.updates_per_sec:.3e} cell-updates/s "
-                    f"({m.seconds_per_epoch * 1e3:.2f} ms/epoch)",
+                    f"({m.seconds_per_epoch * 1e3:.2f} ms/epoch)" + obs,
                     file=self.out,
                     flush=True,
                 )
@@ -188,14 +208,17 @@ class BoardObserver:
         board_shape: Tuple[int, int],
         view: Optional[np.ndarray] = None,
         strides: Tuple[int, int] = (1, 1),
+        obs_seconds: float = 0.0,
     ) -> None:
         """Device-side observation: the caller computed the population and
         (at render cadence) a stride-sampled view on the accelerator, so only
-        a scalar and a <=max_cells² probe ever reached the host — the
-        standalone analog of the cluster's sampled TILE_STATE path (nothing
-        here is O(board))."""
+        a chunk-sum vector and a <=max_cells² probe ever reached the host —
+        the standalone analog of the cluster's sampled TILE_STATE path
+        (nothing here is O(board)).  ``obs_seconds`` is the caller-measured
+        wall cost of that observation (dispatch + fetches), surfaced on the
+        metrics line."""
         h, w = board_shape
-        self._note_progress(epoch, population, h * w)
+        self._note_progress(epoch, population, h * w, obs_seconds=obs_seconds)
         if self.render_every and epoch % self.render_every == 0 and view is not None:
             print(f"epoch {epoch}:", file=self.out)
             print(

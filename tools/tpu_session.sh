@@ -1,28 +1,37 @@
 #!/bin/bash
 # One-shot TPU measurement session for the round's open hardware items.
 #
-# The axon tunnel on this image wedges for hours at a time (memory:
-# axon-tunnel-and-bench-gotchas), so every stage runs under its own hard
-# timeout and failures don't stop later stages; logs land in $OUT so a
-# killed pipe never loses output.  Run it the moment a probe succeeds:
+# The axon tunnel on this image wedges for hours at a time, so every stage
+# runs under its own hard timeout and failures don't stop later stages;
+# logs land in $OUT so a killed pipe never loses output.  Run it the
+# moment a probe succeeds:
 #
 #   bash tools/tpu_session.sh [outdir]
 #
-# Already answered this round (first session, 2026-07-30, logs in
-# /tmp/tpu_session_r3 and BASELINE.md): headline b=128/k=8 = 1.79e12;
-# b=256 with raised VMEM budgets is slower; TPU tests green; bench-full
-# recorded every config line.  Remaining stages below:
-#   0. probe        — tiny matmul; abort the session if the tunnel is wedged
-#   1. tpu-tests    — GOL_TPU_TESTS=1, now incl. the SHARDED Mosaic paths
-#                     (shard_map + pallas_call, non-lane-aligned widths,
-#                     cluster Mosaic chunk engine) on the real chip
-#   2. bench-sharded— bench_suite config 5 (adds the sharded-pallas line)
-#   3. product-run  — the 65536^2 Conway torus through the PRODUCT CLI
-#                     (kernel=auto -> pallas) with strided render, metrics,
-#                     and packed checkpoints: the framework running its own
-#                     headline config end-to-end, not just benchmarking it.
-#                     (First session: tunnel wedged before this stage ran.)
-#   4. bench-full   — refresh the full bench.py record with the current tree
+# Round-4 agenda (VERDICT.md round-3 "Next round"):
+#   0. probe         — tiny matmul; abort the session if the tunnel is wedged
+#   1. tpu-tests     — GOL_TPU_TESTS=1 Pallas suite on the real chip:
+#                      validates the in-place halo-strip exchange rewrite,
+#                      wireworld planes, and the LtL shift-add kernel on HW
+#   2. bench-full    — every config incl. ltl-8192 (the round-3 OOM config —
+#                      must now emit a number) and wireworld-8192 (dense vs
+#                      2-plane SWAR; target >= 4x dense), plus the
+#                      generations pallas-vs-planes A/B (config 4)
+#   3. bench-sharded — config 5 after the dus-carry exchange fix: is
+#                      sharded-pallas at 1 device now within ~10% of the
+#                      1.82e12 torus sweep?
+#   4. tune          — the autotuner on the real chip at 65536^2 and 8192^2:
+#                      the on-device sweep artifact VERDICT #6 asks for;
+#                      feed the winners back into
+#                      ops/pallas_stencil.MEASURED_BLOCK_ROWS_CAPS
+#   5. selftest      — kernel=auto on the chip (resolves to pallas)
+#   6. product-run   — the 65536^2 headline through the product CLI, now at
+#                      steps-per-call 64 (sweep-aligned, k=8 not k=6) with
+#                      cadence 128; metrics lines carry the new obs-ms
+#                      breakdown, so the product-vs-bench gap becomes a
+#                      measured number (VERDICT #3)
+#   7. product-run-60— the round-3 config verbatim (steps-per-call 60,
+#                      cadence 60) for a direct A/B against #6
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-/tmp/tpu_session}"
@@ -46,28 +55,46 @@ print('probe-ok', jax.default_backend(), jax.device_count())
 
 stage tpu-tests 1800 env GOL_TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.py -v
 
+# The session's own probe stage already proved the tunnel alive, so cap the
+# bench's retry window well under the stage budget (the 1500s default is for
+# the driver's standalone end-of-round run, where nothing probed first).
+stage bench-full 2400 python bench.py --probe-retry-window 300
+
 stage bench-sharded 1200 python bench_suite.py --config 5
+
+stage tune-65536 1800 python -m akka_game_of_life_tpu tune --size 65536
+stage tune-8192 1200 python -m akka_game_of_life_tpu tune --size 8192 \
+  --blocks 32,64,128,192,256,512 --sweeps 4,8,16
 
 # Product selftest on the real chip: kernel=auto resolves to pallas, so
 # gun phase / oracle / checkpoint / chaos all exercise the Mosaic kernel.
 stage selftest 900 python -m akka_game_of_life_tpu selftest
 
 # The 65536^2 headline config through the product CLI with a Gosper gun and
-# an exact-cell probe window at its bbox (pattern offset defaults to 2,2):
-# every rendered window at a 60-epoch cadence (period 30 multiple) must show
-# the gun in phase — the north-star criterion verified AT the headline size.
+# an exact-cell probe window at its bbox (pattern offset defaults to 2,2).
+# steps-per-call 64 aligns the Mosaic sweep at its measured-best k=8 (60
+# forced k=6 in round 3); obs-ms on each metrics line separates observation
+# cost from stepper cost.  With 64-epoch chunks the only epochs that are
+# both chunk-aligned and gun-period (30) multiples are multiples of
+# lcm(64,30)=960 — so the phase-checked probe window fires at 960/1920 and
+# the run spans 1920 epochs (~5 s of steady-state compute at the round-3
+# rate; 30 metrics intervals).
 CKPT="$OUT/ckpt65536"
 rm -rf "$CKPT"
 stage product-run 3600 python -m akka_game_of_life_tpu run \
+  --height 65536 --width 65536 --max-epochs 1920 --steps-per-call 64 \
+  --pattern gosper-glider-gun --probe-window 2:11,2:38 \
+  --render-every 960 --metrics-every 64 \
+  --checkpoint-dir "$CKPT" --checkpoint-every 960
+
+# Round-3 config verbatim for the direct A/B (steps-per-call 60 -> k=6).
+CKPT2="$OUT/ckpt65536b"
+rm -rf "$CKPT2"
+stage product-run-60 3600 python -m akka_game_of_life_tpu run \
   --height 65536 --width 65536 --max-epochs 240 --steps-per-call 60 \
   --pattern gosper-glider-gun --probe-window 2:11,2:38 \
   --render-every 60 --metrics-every 60 \
-  --checkpoint-dir "$CKPT" --checkpoint-every 120
-
-# The session's own probe stage already proved the tunnel alive, so cap the
-# bench's retry window well under the stage budget (the 1500s default is for
-# the driver's standalone end-of-round run, where nothing probed first).
-stage bench-full 2400 python bench.py --probe-retry-window 300
+  --checkpoint-dir "$CKPT2" --checkpoint-every 120
 
 echo "session done $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
-grep -h '"value"' "$OUT"/bench-*.log 2>/dev/null | tail -20
+grep -h '"value"' "$OUT"/bench-*.log 2>/dev/null | tail -24
