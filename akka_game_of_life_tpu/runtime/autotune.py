@@ -49,13 +49,48 @@ def sweep(
     from akka_game_of_life_tpu.ops.rules import resolve_rule
 
     rule = resolve_rule(rule)
-    # Generate the packed words directly: uniform random uint32s ARE a
-    # density-1/2 random board, and 0.25 B/cell scratch (512 MiB at 65536²)
-    # instead of the tens of GiB a float sample + pack would cost.
     rng = np.random.default_rng(0)
-    words = jax.device_put(
-        rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32)
-    )
+    if rule.is_binary:
+        # Generate the packed words directly: uniform random uint32s ARE a
+        # density-1/2 random board, and 0.25 B/cell scratch (512 MiB at
+        # 65536²) instead of the tens of GiB a float sample + pack would
+        # cost.
+        words = jax.device_put(
+            rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32)
+        )
+
+        def make_fn(b, k, vmem):
+            return packed_multi_step_fn(
+                rule,
+                steps_per_call,
+                block_rows=b,
+                steps_per_sweep=k,
+                interpret=interpret,
+                vmem_limit_bytes=vmem,
+            )
+
+        fetch_row = lambda out: np.asarray(out[0])
+    else:
+        # Multi-state plane stack (Generations / wireworld): tune the plane
+        # sweep (ops/pallas_gen.py) — the on-chip (b, k) data behind the
+        # KERNELS.md pallas-vs-plane-scan decision (VERDICT.md round-3
+        # weak #5).
+        from akka_game_of_life_tpu.ops import bitpack_gen, pallas_gen
+
+        board = rng.integers(0, rule.states, size=(size, size), dtype=np.uint8)
+        words = jax.device_put(bitpack_gen.pack_gen_np(board, rule.states))
+
+        def make_fn(b, k, vmem):
+            return pallas_gen.gen_pallas_multi_step_fn(
+                rule,
+                steps_per_call,
+                block_rows=b,
+                steps_per_sweep=k,
+                interpret=interpret,
+                vmem_limit_bytes=vmem,
+            )
+
+        fetch_row = lambda out: np.asarray(out[0][0])
     results: List[dict] = []
     for b in blocks:
         for k in sweeps:
@@ -63,23 +98,16 @@ def sweep(
             if not feasible(size, steps_per_call, b, k):
                 continue  # silently skip: not a failure, just not a point
             try:
-                fn = packed_multi_step_fn(
-                    rule,
-                    steps_per_call,
-                    block_rows=b,
-                    steps_per_sweep=k,
-                    interpret=interpret,
-                    vmem_limit_bytes=(
-                        vmem_limit_mb * 2**20 if vmem_limit_mb else None
-                    ),
+                fn = make_fn(
+                    b, k, vmem_limit_mb * 2**20 if vmem_limit_mb else None
                 )
                 out = fn(words)  # compile + warm
-                np.asarray(out[0])  # force completion (host fetch of a row)
+                fetch_row(out)  # force completion (host fetch of a row)
                 t0 = time.perf_counter()
                 cur = out
                 for _ in range(timed_calls):
                     cur = fn(cur)
-                np.asarray(cur[0])
+                fetch_row(cur)
                 dt = time.perf_counter() - t0
                 cells = size * size * steps_per_call * timed_calls
                 point.update(

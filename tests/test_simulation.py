@@ -604,6 +604,31 @@ def test_cli_tune_interpret_smoke(capsys):
     assert any(l.startswith("best: bench.py --block-rows") for l in out)
 
 
+def test_cli_tune_gen_rule_interpret_smoke(capsys):
+    """The autotuner also sweeps the multi-state plane sweep (the on-chip
+    data source for the gen-pallas-vs-plane-scan decision)."""
+    import json
+
+    from akka_game_of_life_tpu.cli import main
+
+    rc = main(
+        [
+            "tune", "--platform", "cpu", "--size", "64",
+            "--steps-per-call", "4", "--blocks", "8,16",
+            "--sweeps", "2", "--timed-calls", "1", "--interpret",
+            "--rule", "brians-brain",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    points = [json.loads(l) for l in out if l.startswith("{")]
+    assert {(p["block_rows"], p["steps_per_sweep"]) for p in points} == {
+        (8, 2),
+        (16, 2),
+    }
+    assert all("cells_per_sec" in p for p in points)
+
+
 def test_tune_feasibility_guards():
     from akka_game_of_life_tpu.runtime.autotune import feasible
 

@@ -65,6 +65,10 @@ stage bench-sharded 1200 python bench_suite.py --config 5
 stage tune-65536 1800 python -m akka_game_of_life_tpu tune --size 65536
 stage tune-8192 1200 python -m akka_game_of_life_tpu tune --size 8192 \
   --blocks 32,64,128,192,256,512 --sweeps 4,8,16
+# The gen plane sweep's (b, k) space at 8192^2 — the data behind the
+# pallas-vs-plane-scan decision in KERNELS.md (VERDICT #7).
+stage tune-gen-8192 1200 python -m akka_game_of_life_tpu tune --size 8192 \
+  --rule brians-brain --steps-per-call 32 --blocks 32,64,128,256 --sweeps 4,8,16
 
 # Product selftest on the real chip: kernel=auto resolves to pallas, so
 # gun phase / oracle / checkpoint / chaos all exercise the Mosaic kernel.
