@@ -496,7 +496,7 @@ def _other_commands(args) -> int:
         )
         for p in results:
             print(json.dumps(p), flush=True)
-        flags = best_flags(results)
+        flags = best_flags(results, rule=args.rule)
         if flags is None:
             print("no feasible point succeeded", file=sys.stderr)
             return 1

@@ -77,8 +77,19 @@ def sweep(
         # weak #5).
         from akka_game_of_life_tpu.ops import bitpack_gen, pallas_gen
 
-        board = rng.integers(0, rule.states, size=(size, size), dtype=np.uint8)
-        words = jax.device_put(bitpack_gen.pack_gen_np(board, rule.states))
+        # Pack row chunks as they are sampled so host scratch stays one
+        # chunk + the plane stack (a full 65536² uint8 board would be ~4 GiB
+        # before packing even starts — the blowup the binary branch's
+        # direct-word sampling avoids).
+        chunk = max(1, min(size, 2**27 // size))
+        parts = []
+        for r0 in range(0, size, chunk):
+            rows = rng.integers(
+                0, rule.states, size=(min(chunk, size - r0), size), dtype=np.uint8
+            )
+            parts.append(bitpack_gen.pack_gen_np(rows, rule.states))
+        words = jax.device_put(np.concatenate(parts, axis=1))
+        del parts
 
         def make_fn(b, k, vmem):
             return pallas_gen.gen_pallas_multi_step_fn(
@@ -121,27 +132,40 @@ def sweep(
     return results
 
 
-def best_flags(results: List[dict]) -> Optional[str]:
-    """The winning point as ready-to-paste flags.
+def best_flags(results: List[dict], rule="conway") -> Optional[str]:
+    """The winning point as ready-to-paste flags — only flags that actually
+    drive the tuned kernel.
 
-    bench.py can pin both knobs; the product runtime exposes block_rows and
-    auto-picks the sweep depth with a cap of DEFAULT_STEPS_PER_SWEEP, so a
-    deeper winning k is flagged as bench-only rather than silently
-    misreported as reproducible through `run`."""
+    Binary rules: bench.py pins both knobs (it benchmarks the binary
+    Conway sweep) and `run --kernel pallas` honors block_rows.  Multi-state
+    plane rules: bench.py's headline path never runs the plane sweep, so
+    the flags point at `run --kernel pallas` (the gen-pallas stepper) and
+    name bench_suite's gen-pallas line as the benchmark consumer.  Either
+    way the product runtime auto-picks the sweep depth with a cap of
+    DEFAULT_STEPS_PER_SWEEP, so a deeper winning k is flagged as
+    tune/bench-only rather than silently misreported as reproducible."""
     from akka_game_of_life_tpu.ops.pallas_stencil import DEFAULT_STEPS_PER_SWEEP
+    from akka_game_of_life_tpu.ops.rules import resolve_rule
 
+    rule = resolve_rule(rule)
     for p in results:
         if "cells_per_sec" not in p:
             continue
         b, k = p["block_rows"], p["steps_per_sweep"]
-        flags = (
-            f"bench.py --block-rows {b} --steps-per-sweep {k}; "
-            f"run --pallas-block-rows {b}"
-        )
+        if rule.is_binary:
+            flags = (
+                f"bench.py --block-rows {b} --steps-per-sweep {k}; "
+                f"run --pallas-block-rows {b}"
+            )
+        else:
+            flags = (
+                f"run --kernel pallas --pallas-block-rows {b} "
+                f"(benchmark line: bench_suite.bench_pallas_gen)"
+            )
         if k > DEFAULT_STEPS_PER_SWEEP:
             flags += (
                 f" (run auto-caps steps_per_sweep at "
-                f"{DEFAULT_STEPS_PER_SWEEP}, so k={k} is bench-only)"
+                f"{DEFAULT_STEPS_PER_SWEEP}, so k={k} is tune-only)"
             )
         return flags
     return None

@@ -97,6 +97,20 @@ def test_widek_jax_engine_matches_dense():
     assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 24))
 
 
+@pytest.mark.parametrize("rule", ["brians-brain", "wireworld"])
+def test_widek_jax_engine_plane_rules_match_dense(rule):
+    """Multi-state chunks (k>=2) step as bit planes in the jax engine
+    (pack_gen -> step_gen scan -> unpack_gen around the interior slice);
+    trajectory identical to the dense oracle, junk-column padding included
+    (the padded slab is 30 + 2*4 = 38 wide -> col_pad = (-38) % 32 = 26)."""
+    cfg = SimulationConfig(
+        height=32, width=30, rule=rule, seed=17, max_epochs=24, exchange_width=4
+    )
+    with cluster(cfg, 2, engine="jax") as h:
+        final = h.run_to_completion()
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), rule, 24))
+
+
 def test_widek_paced_and_observed():
     """Paced ticks with k=3: tiles burst every k ticks; render/metrics land
     on chunk boundaries."""
