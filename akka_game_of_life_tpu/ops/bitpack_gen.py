@@ -142,9 +142,9 @@ def _transition(
     dead_c: jax.Array,
     eq,
     rule,
-) -> jax.Array:
-    """Next-state planes from center-row plane slices plus count predicates
-    (shared by the toroidal and padded-rows steppers)."""
+) -> List[jax.Array]:
+    """Next-state planes (as a list) from center-row plane slices plus
+    count predicates (shared by the toroidal and padded-rows steppers)."""
     birth = jnp.uint32(0)
     for n in rule.birth:
         birth = birth | eq(n)  # dead center: count has no self term
@@ -157,40 +157,41 @@ def _transition(
     inc = _increment(ps_center)
     wrap = _eq_const(ps_center, rule.states - 1)
     advance = ~dead_c & ~to_one & ~wrap
-    return jnp.stack(
-        [
-            (to_one if k == 0 else jnp.uint32(0)) | (advance & inc[k])
-            for k in range(len(ps_center))
-        ]
-    )
+    return [
+        (to_one if k == 0 else jnp.uint32(0)) | (advance & inc[k])
+        for k in range(len(ps_center))
+    ]
 
 
-def _transition_wire(ps_center: List[jax.Array], eq, rule) -> jax.Array:
-    """Next-state WireWorld planes from center-row plane slices plus count
-    predicates (see the module docstring's derivation).  Far cheaper than
-    the Generations transition: two plane expressions on top of the shared
-    head count."""
+def _transition_wire(ps_center: List[jax.Array], eq, rule) -> List[jax.Array]:
+    """Next-state WireWorld planes (as a list) from center-row plane slices
+    plus count predicates (see the module docstring's derivation).  Far
+    cheaper than the Generations transition: two plane expressions on top
+    of the shared head count."""
     p0, p1 = ps_center
     excite = jnp.uint32(0)
     for n in rule.birth:  # {1, 2}: conductor center never self-counts
         excite = excite | eq(n)
-    return jnp.stack([p1, (p0 ^ p1) | (p0 & p1 & ~excite)])
+    return [p1, (p0 ^ p1) | (p0 & p1 & ~excite)]
 
 
-def step_gen_padded_rows(padded: jax.Array, rule) -> jax.Array:
-    """One plane step (Generations or WireWorld) on a row-padded slab:
-    (m, h+2, words) with one halo row top and bottom → (m, h, words).  Row
-    triple sums of the counted plane (state==1: alive / electron heads) are
-    computed once per slab row and shared across the three output rows each
-    feeds — the multi-state twin of
-    :func:`akka_game_of_life_tpu.ops.bitpack.step_padded_rows`, used by the
-    Pallas temporal-blocking kernel."""
+def step_gen_padded_rows_planes(
+    ps: List[jax.Array], rule
+) -> List[jax.Array]:
+    """One plane step (Generations or WireWorld) on ``m`` separate
+    row-padded 2-D slabs: each (h+2, words) with one halo row top and
+    bottom → m × (h, w).  Row triple sums of the counted plane (state==1:
+    alive / electron heads) are computed once per slab row and shared
+    across the three output rows each feeds — the multi-state twin of
+    :func:`akka_game_of_life_tpu.ops.bitpack.step_padded_rows`.  The
+    Pallas plane sweep feeds each plane as its own 2-D operand (clean 2-D
+    VMEM blocks, no stacked leading dim), so the list form is the kernel
+    primitive and the stacked form below wraps it."""
     rule = resolve_rule(rule)
     _require_plane_support(rule)
     m = n_planes(rule.states)
-    if padded.shape[0] != m:
+    if len(ps) != m:
         raise ValueError(f"expected {m} planes for {rule.states} states")
-    ps = [padded[k] for k in range(m)]
     alive = _eq_const(ps, 1)
     s, c = _row_triple_sum(alive)
     eq = count_eq_fn(
@@ -201,6 +202,18 @@ def step_gen_padded_rows(padded: jax.Array, rule) -> jax.Array:
         return _transition_wire(center, eq, rule)
     dead = _eq_const(ps, 0)
     return _transition(center, alive[1:-1], dead[1:-1], eq, rule)
+
+
+def step_gen_padded_rows(padded: jax.Array, rule) -> jax.Array:
+    """Stacked-form twin of :func:`step_gen_padded_rows_planes`:
+    (m, h+2, words) → (m, h, words)."""
+    rule = resolve_rule(rule)
+    m = n_planes(rule.states)
+    if padded.shape[0] != m:
+        raise ValueError(f"expected {m} planes for {rule.states} states")
+    return jnp.stack(
+        step_gen_padded_rows_planes([padded[k] for k in range(m)], rule)
+    )
 
 
 def step_gen(planes: jax.Array, rule) -> jax.Array:
@@ -227,9 +240,9 @@ def step_gen(planes: jax.Array, rule) -> jax.Array:
         )
     )
     if rule.kind == "wireworld":
-        return _transition_wire(ps, eq, rule)
+        return jnp.stack(_transition_wire(ps, eq, rule))
     dead = _eq_const(ps, 0)
-    return _transition(ps, alive, dead, eq, rule)
+    return jnp.stack(_transition(ps, alive, dead, eq, rule))
 
 
 @functools.lru_cache(maxsize=None)

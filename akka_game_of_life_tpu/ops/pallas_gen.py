@@ -1,17 +1,26 @@
-"""Pallas TPU kernel for bit-plane Generations CA — the multi-state twin of
-:mod:`akka_game_of_life_tpu.ops.pallas_stencil`, built on the same shared
-temporally-blocked sweep (:func:`pallas_stencil.temporal_sweep_fn`) with the
-plane stack's leading ``m`` axis carried whole in every block.
+"""Pallas TPU kernel for bit-plane CA (Generations / WireWorld) — the
+multi-state twin of :mod:`akka_game_of_life_tpu.ops.pallas_stencil`, built
+on the shared temporally-blocked sweep with each plane fed as its OWN 2-D
+operand (:func:`pallas_stencil.temporal_sweep_planes_fn`).
 
 Each grid step loads ``block_rows + 2k`` packed rows of every plane into
-VMEM, advances the central ``block_rows`` by ``k`` generations with
-:func:`bitpack_gen.step_gen_padded_rows` (shared-row alive sums,
-ripple-carry refractory decay), and writes back — HBM sees one read and one
-write of the (m, H, W/32) plane stack per sweep.
+VMEM as plain 2-D blocks, advances the central ``block_rows`` by ``k``
+generations with :func:`bitpack_gen.step_gen_padded_rows_planes`
+(shared-row alive sums; ripple-carry refractory decay or the wireworld
+plane transition), and writes back — HBM sees one read and one write of
+each (H, W/32) plane per sweep.
 
-Reference capability note: this is the Generations-family end point of
+An earlier revision carried the planes as one stacked (m, H, W/32) array
+through the single-array sweep's ``n_prefix=1`` path; on hardware that
+measured *slower* than the XLA plane scan (2.81 vs 3.19×10¹⁰ at 8192²,
+`artifacts/tpu_session_r3b/bench-full.log`) while the binary kernel's 2-D
+blocks ran 1.82×10¹² — hence the per-plane operand layout.  The public
+interface stays stacked: (m, H, W/32) in, (m, H, W/32) out, with the
+tuple↔stack conversion paid once per jitted call, not per sweep.
+
+Reference capability note: this is the multi-state-family end point of
 collapsing the reference's per-cell actor protocol
-(``CellActor.scala:63-89``) into on-chip arithmetic — multi-state decay
+(``CellActor.scala:63-89``) into on-chip arithmetic — refractory decay
 included, which the reference's single hard-coded rule
 (``NextStateCellGathererActor.scala:44``) never had.
 """
@@ -24,11 +33,14 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from akka_game_of_life_tpu.ops.bitpack_gen import n_planes, step_gen_padded_rows
+from akka_game_of_life_tpu.ops.bitpack_gen import (
+    n_planes,
+    step_gen_padded_rows_planes,
+)
 from akka_game_of_life_tpu.ops.pallas_stencil import (
     DEFAULT_STEPS_PER_SWEEP,
     auto_steps_per_sweep,
-    temporal_sweep_fn,
+    temporal_sweep_planes_fn,
 )
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 
@@ -42,26 +54,18 @@ def gen_sweep_fn(
     steps_per_sweep: int = DEFAULT_STEPS_PER_SWEEP,
     interpret: bool = False,
     vmem_limit_bytes: Optional[int] = None,
-) -> Callable[[jax.Array], jax.Array]:
-    """One Pallas sweep advancing (m, H, W/32) packed planes by
-    ``steps_per_sweep`` generations."""
+) -> Callable[[tuple], tuple]:
+    """One Pallas sweep advancing a tuple of m (H, W/32) packed planes by
+    ``steps_per_sweep`` generations (each plane its own 2-D operand)."""
     rule = resolve_rule(rule)
-    m = n_planes(rule.states)
-    inner = temporal_sweep_fn(
-        lambda ext: step_gen_padded_rows(ext, rule),
-        n_prefix=1,
+    return temporal_sweep_planes_fn(
+        lambda exts: step_gen_padded_rows_planes(exts, rule),
+        n_planes=n_planes(rule.states),
         block_rows=block_rows,
         steps_per_sweep=steps_per_sweep,
         interpret=interpret,
         vmem_limit_bytes=vmem_limit_bytes,
     )
-
-    def sweep(planes: jax.Array) -> jax.Array:
-        if planes.shape[0] != m:
-            raise ValueError(f"expected {m} planes for {rule.states} states")
-        return inner(planes)
-
-    return sweep
 
 
 @functools.lru_cache(maxsize=None)
@@ -74,9 +78,11 @@ def gen_pallas_multi_step_fn(
     interpret: bool = False,
     vmem_limit_bytes: Optional[int] = None,
 ) -> Callable[[jax.Array], jax.Array]:
-    """Jitted n-step Generations advance from temporally-blocked sweeps
-    (defaulting ``steps_per_sweep`` like the binary kernel)."""
+    """Jitted n-step plane advance from temporally-blocked sweeps
+    (defaulting ``steps_per_sweep`` like the binary kernel).  Stacked
+    (m, H, W/32) in and out — the tuple form lives inside the jit."""
     rule = resolve_rule(rule_key)
+    m = n_planes(rule.states)
     if steps_per_sweep is None:
         steps_per_sweep = auto_steps_per_sweep(n_steps, block_rows)
     if n_steps % steps_per_sweep:
@@ -93,10 +99,18 @@ def gen_pallas_multi_step_fn(
 
     @jax.jit
     def run(planes: jax.Array) -> jax.Array:
-        def body(s, _):
-            return sweep(s), None
+        if planes.shape[0] != m:
+            raise ValueError(f"expected {m} planes for {rule.states} states")
 
-        out, _ = jax.lax.scan(body, planes, None, length=n_steps // steps_per_sweep)
-        return out
+        def body(ps, _):
+            return sweep(ps), None
+
+        out, _ = jax.lax.scan(
+            body,
+            tuple(planes[k] for k in range(m)),
+            None,
+            length=n_steps // steps_per_sweep,
+        )
+        return jnp.stack(out)
 
     return run

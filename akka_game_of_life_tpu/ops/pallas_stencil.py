@@ -110,25 +110,34 @@ def auto_steps_per_sweep(
     return max(candidates)
 
 
-def temporal_sweep_fn(
-    step_padded_rows_fn: Callable[[jax.Array], jax.Array],
+def temporal_sweep_planes_fn(
+    step_planes_fn: Callable[[list], list],
     *,
-    n_prefix: int,
+    n_planes: int,
     block_rows: int,
     steps_per_sweep: int,
     interpret: bool,
     vmem_limit_bytes: Optional[int] = None,
-) -> Callable[[jax.Array], jax.Array]:
-    """The shared temporally-blocked Pallas sweep over a row-tiled array
-    whose LAST TWO axes are (rows, packed words), with ``n_prefix`` leading
-    axes carried whole in every block (0 for the binary board, 1 for the
-    Generations plane stack).
+) -> Callable[[tuple], tuple]:
+    """THE temporally-blocked Pallas sweep: ``n_planes`` separate 2-D
+    arrays (each (rows, packed words)) advancing in lockstep.  The binary
+    board is the 1-plane case (:func:`packed_sweep_fn`); Generations /
+    WireWorld plane stacks pass one operand per plane.
 
     Mosaic requires sublane-dim block sizes divisible by 8, so the halo
     blocks are ``hb = round_up(k, 8)`` rows; the kernel statically slices
-    the ``k`` rows actually adjacent to the center block (the last k of the
-    north block, the first k of the south block).  The torus wraps through
-    the halo BlockSpec ``index_map`` modulo.
+    the ``k`` rows actually adjacent to the center block (the last k of
+    the north block, the first k of the south block).  The torus wraps
+    through the halo BlockSpec ``index_map`` modulo.
+
+    Why separate 2-D operands and not one (m, rows, words) stack with a
+    carried leading axis?  That shape hands Mosaic 3-D VMEM blocks with a
+    tiny leading dim, and on hardware the stacked Generations sweep
+    measured *slower* than the XLA plane scan (2.81 vs 3.19×10¹⁰ at 8192²
+    — VERDICT.md round-3 weak #5) while the binary kernel's clean 2-D
+    blocks ran at 1.82×10¹².  Per-plane operands give every block the
+    same 2-D (rows, words) tiling as the binary kernel; the plane-wise
+    compute inside the kernel is unchanged.
 
     ``vmem_limit_bytes`` raises Mosaic's scoped-VMEM budget past its 16 MB
     default — required for large blocks (e.g. block_rows=256 at 65536²
@@ -137,80 +146,81 @@ def temporal_sweep_fn(
     b, k = block_rows, steps_per_sweep
     if k < 1:
         raise ValueError(f"steps_per_sweep={k} must be >= 1")
-    hb = _round_up8(k)  # Mosaic sublane alignment for the halo blocks
+    hb = _round_up8(k)
     if b % hb:
         raise ValueError(
             f"block_rows={b} must be a multiple of {hb} "
             f"(steps_per_sweep={k} rounded up to the 8-row sublane tile)"
         )
-    row_ax = n_prefix
-    pre = (slice(None),) * n_prefix
+    m = n_planes
 
-    def kernel(north_ref, center_ref, south_ref, out_ref):
-        ext = jnp.concatenate(
-            [
-                north_ref[pre + (slice(hb - k, None),)],
-                center_ref[...],
-                south_ref[pre + (slice(None, k),)],
-            ],
-            axis=row_ax,
-        )  # (..., B + 2k, W)
+    def kernel(*refs):
+        ins, outs = refs[: 3 * m], refs[3 * m :]
+        exts = [
+            jnp.concatenate(
+                [
+                    ins[3 * j][hb - k :],
+                    ins[3 * j + 1][...],
+                    ins[3 * j + 2][:k],
+                ],
+                axis=0,
+            )
+            for j in range(m)
+        ]
         for _ in range(k):
-            ext = step_padded_rows_fn(ext)
-        out_ref[...] = ext
+            exts = step_planes_fn(exts)
+        for j in range(m):
+            outs[j][...] = exts[j]
 
-    def sweep(x: jax.Array) -> jax.Array:
-        prefix = x.shape[:n_prefix]
-        h, words = x.shape[row_ax], x.shape[row_ax + 1]
+    def sweep(planes: tuple) -> tuple:
+        if len(planes) != m:
+            raise ValueError(f"expected {m} planes, got {len(planes)}")
+        h, words = planes[0].shape
         if h % b:
             raise ValueError(f"grid height {h} not a multiple of block_rows={b}")
-        # h % b == 0 and b % hb == 0 together imply h % hb == 0, so the
-        # hb-row halo views below always tile the array exactly.
         n_row_blocks = h // b
-        halo_blocks = h // hb  # the same array viewed in (hb, words) blocks
-        zeros = (0,) * n_prefix
+        halo_blocks = h // hb
+
+        def specs():
+            # One (north, center, south) triple per plane — identical
+            # index maps to the single-array sweep, all 2-D blocks.
+            return [
+                pl.BlockSpec(
+                    (hb, words),
+                    lambda i: ((i * (b // hb) - 1) % halo_blocks, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec((b, words), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec(
+                    (hb, words),
+                    lambda i: (((i + 1) * (b // hb)) % halo_blocks, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+            ]
 
         grid_spec = pl.GridSpec(
             grid=(n_row_blocks,),
-            in_specs=[
-                # North halo: the hb-row block ending exactly where the center
-                # block starts (its last k rows are the true halo).
-                pl.BlockSpec(
-                    prefix + (hb, words),
-                    lambda i: zeros + ((i * (b // hb) - 1) % halo_blocks, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec(
-                    prefix + (b, words),
-                    lambda i: zeros + (i, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-                # South halo: the hb-row block starting just below the center
-                # block (its first k rows are the true halo).
-                pl.BlockSpec(
-                    prefix + (hb, words),
-                    lambda i: zeros + (((i + 1) * (b // hb)) % halo_blocks, 0),
-                    memory_space=pltpu.VMEM,
-                ),
+            in_specs=[s for _ in range(m) for s in specs()],
+            out_specs=[
+                pl.BlockSpec((b, words), lambda i: (i, 0), memory_space=pltpu.VMEM)
+                for _ in range(m)
             ],
-            out_specs=pl.BlockSpec(
-                prefix + (b, words),
-                lambda i: zeros + (i, 0),
-                memory_space=pltpu.VMEM,
-            ),
         )
         compiler_params = None
         if vmem_limit_bytes is not None and not interpret:
             compiler_params = pltpu.CompilerParams(
                 vmem_limit_bytes=vmem_limit_bytes
             )
-        return pl.pallas_call(
+        out = pl.pallas_call(
             kernel,
-            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            out_shape=[
+                jax.ShapeDtypeStruct((h, words), p.dtype) for p in planes
+            ],
             grid_spec=grid_spec,
             interpret=interpret,
             compiler_params=compiler_params,
-        )(x, x, x)
+        )(*[x for p in planes for x in (p, p, p)])
+        return tuple(out)
 
     return sweep
 
@@ -231,14 +241,19 @@ def packed_sweep_fn(
     """
     rule = resolve_rule(rule)
     require_packed_support(rule)
-    return temporal_sweep_fn(
-        lambda ext: step_padded_rows(ext, rule),
-        n_prefix=0,
+    inner = temporal_sweep_planes_fn(
+        lambda exts: [step_padded_rows(exts[0], rule)],
+        n_planes=1,
         block_rows=block_rows,
         steps_per_sweep=steps_per_sweep,
         interpret=interpret,
         vmem_limit_bytes=vmem_limit_bytes,
     )
+
+    def sweep(x: jax.Array) -> jax.Array:
+        return inner((x,))[0]
+
+    return sweep
 
 
 @functools.lru_cache(maxsize=None)

@@ -153,7 +153,9 @@ def test_packed_and_dense_render_identically(tmp_path):
     packed.advance(32)
     # Identical frames and populations; only the wall-clock rates may differ.
     detime = lambda s: re.sub(
-        r"[\d.]+e[+-]\d+ cell-updates/s \([\d.]+ ms/epoch\)", "<rate>", s
+        r"[\d.]+e[+-]\d+ cell-updates/s \([\d.]+ ms/epoch\)( \(obs [\d.]+ ms\))?",
+        "<rate>",
+        s,
     )
     assert detime(out_d.getvalue()) == detime(out_p.getvalue())
     assert "pop=" in out_d.getvalue()

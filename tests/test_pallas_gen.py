@@ -30,7 +30,9 @@ def test_padded_rows_matches_toroidal_interior():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
-@pytest.mark.parametrize("rule", ["brians-brain", "star-wars"])
+# brians-brain/star-wars are m=2; B2/S/7 (7 states) exercises m=3 planes
+# through the per-plane-operand sweep.
+@pytest.mark.parametrize("rule", ["brians-brain", "star-wars", "B2/S/7"])
 @pytest.mark.parametrize("block_rows,steps_per_sweep", [(16, 4), (32, 8), (8, 1)])
 def test_pallas_gen_matches_bitpack_gen(rule, block_rows, steps_per_sweep):
     planes = _random_planes(rule, 64, 2, seed=7)
