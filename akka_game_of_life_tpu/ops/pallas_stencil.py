@@ -175,6 +175,14 @@ def temporal_sweep_planes_fn(
     def sweep(planes: tuple) -> tuple:
         if len(planes) != m:
             raise ValueError(f"expected {m} planes, got {len(planes)}")
+        if any(
+            p.shape != planes[0].shape or p.dtype != planes[0].dtype
+            for p in planes[1:]
+        ):
+            raise ValueError(
+                f"planes must share shape/dtype, got "
+                f"{[(p.shape, str(p.dtype)) for p in planes]}"
+            )
         h, words = planes[0].shape
         if h % b:
             raise ValueError(f"grid height {h} not a multiple of block_rows={b}")
@@ -237,7 +245,7 @@ def packed_sweep_fn(
     ``steps_per_sweep`` generations.
 
     Requires ``H % block_rows == 0`` and sublane-aligned halos (see
-    :func:`temporal_sweep_fn`).
+    :func:`temporal_sweep_planes_fn` — this is its 1-plane case).
     """
     rule = resolve_rule(rule)
     require_packed_support(rule)

@@ -56,5 +56,12 @@ def test_pallas_gen_rejects_bad_configs():
     sweep = pallas_gen.gen_sweep_fn(
         "brians-brain", block_rows=8, steps_per_sweep=2, interpret=True
     )
+    # The sweep's contract is a tuple of 2-D planes.
+    bad = _random_planes("brians-brain", 12, 1)
     with pytest.raises(ValueError, match="block_rows"):
-        sweep(_random_planes("brians-brain", 12, 1))
+        sweep(tuple(bad[k] for k in range(bad.shape[0])))
+    ok = _random_planes("brians-brain", 16, 1)
+    with pytest.raises(ValueError, match="planes"):
+        sweep((ok[0],))  # wrong plane count
+    with pytest.raises(ValueError, match="share shape"):
+        sweep((ok[0], ok[1][:8]))  # mismatched plane shapes

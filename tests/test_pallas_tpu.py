@@ -46,7 +46,8 @@ for rule in ("conway", "highlife"):
     )
     np.testing.assert_array_equal(got, oracle)
 
-# Generations bit planes through the Mosaic compiler too.
+# Generations bit planes through the Mosaic compiler too (per-plane 2-D
+# operands — the round-4 layout).
 from akka_game_of_life_tpu.ops import bitpack_gen, pallas_gen
 
 board = rng.integers(0, 3, size=(512, 4096), dtype=np.uint8)
@@ -60,6 +61,39 @@ got_g = np.asarray(
     )(planes)
 )
 np.testing.assert_array_equal(got_g, oracle_g)
+
+# WireWorld's 2-plane transition: XLA plane scan vs the dense oracle vs
+# the Mosaic plane sweep, all on the chip.
+from akka_game_of_life_tpu.ops.stencil import multi_step
+
+ww = rng.choice(np.arange(4, dtype=np.uint8), size=(512, 4096),
+                p=[0.4, 0.05, 0.05, 0.5])
+ww_planes = bitpack_gen.pack_gen(jnp.asarray(ww), 4)
+ww_dense = np.asarray(multi_step(jnp.asarray(ww), "wireworld", 16))
+ww_scan = np.asarray(bitpack_gen.unpack_gen(
+    bitpack_gen.gen_multi_step_fn(resolve_rule("wireworld"), 16)(ww_planes)
+))
+np.testing.assert_array_equal(ww_scan, ww_dense)
+ww_sweep = np.asarray(bitpack_gen.unpack_gen(
+    pallas_gen.gen_pallas_multi_step_fn(
+        resolve_rule("wireworld"), 16, block_rows=64, steps_per_sweep=4
+    )(ww_planes)
+))
+np.testing.assert_array_equal(ww_sweep, ww_dense)
+
+# Radius-5 LtL shift-add window sums vs the numpy integral-image oracle on
+# the chip — the formulation that replaced the 128-lane-padded conv (the
+# round-3 8192^2 OOM); exactness of the bf16 counts is the point.
+from akka_game_of_life_tpu.ops import ltl
+from akka_game_of_life_tpu.ops.rules import resolve_rule as _rrl
+
+bugs = _rrl("bugs")
+lb = (rng.random((1024, 1024)) < 0.4).astype(np.uint8)
+got_l = np.asarray(ltl.ltl_multi_step_fn(bugs, 4)(jnp.asarray(lb)))
+want_l = lb
+for _ in range(4):
+    want_l = ltl.step_ltl_np(want_l, bugs)
+np.testing.assert_array_equal(got_l, want_l)
 print("PALLAS-TPU-OK", backend)
 """
 
