@@ -134,6 +134,7 @@ class BoardObserver:
         self._total_epochs = 0
         self._total_seconds = 0.0
         self._total_cells = 0
+        self._total_obs_seconds = 0.0
 
     # -- complete-board path (standalone runner) -----------------------------
 
@@ -175,6 +176,7 @@ class BoardObserver:
             self._total_epochs += m.epochs
             self._total_seconds += m.seconds
             self._total_cells += m.cells
+            self._total_obs_seconds += m.obs_seconds
             if self.metrics_every and epoch % self.metrics_every == 0:
                 # obs = the observation's own share of the interval (device
                 # obs dispatch + host fetches): ms/epoch minus obs/epochs is
@@ -395,7 +397,7 @@ class BoardObserver:
         intervals were observed."""
         if not self.history:
             return None
-        return {
+        out = {
             "epochs_observed": self._total_epochs,
             "seconds": round(self._total_seconds, 3),
             "cell_updates_per_sec": (
@@ -405,6 +407,15 @@ class BoardObserver:
             ),
             "final_population": self.history[-1].population,
         }
+        if self._total_obs_seconds > 0:
+            # The observation share of the whole run (the breakdown behind
+            # any product-vs-bench throughput gap), and the rate the
+            # stepper alone sustained outside observation windows.
+            out["obs_seconds"] = round(self._total_obs_seconds, 3)
+            compute = self._total_seconds - self._total_obs_seconds
+            if compute > 0:
+                out["stepper_cell_updates_per_sec"] = self._total_cells / compute
+        return out
 
     def close(self) -> None:
         if self._own_file is not None:

@@ -519,6 +519,9 @@ def main() -> None:
         bench_cluster_exchange(s(4096))
     if 7 in args.config:
         bench_ltl(s(8192), "bugs", "ltl-8192")
+        # The von Neumann diamond (cumsum-difference path) at the same
+        # radius — the second of the two shift-add count formulations.
+        bench_ltl(s(8192), "R5,B15-22,S15-25,NN", "ltl-8192")
     if 8 in args.config:
         # WireWorld: dense baseline vs the 2-bit-plane SWAR kernel
         # (VERDICT.md round-3 weak #6: the family no longer pays the ~4×
