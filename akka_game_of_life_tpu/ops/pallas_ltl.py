@@ -1,0 +1,184 @@
+"""Pallas TPU kernel for Larger-than-Life: VMEM-blocked shift-add counts.
+
+The XLA LtL path (:mod:`akka_game_of_life_tpu.ops.ltl`) materializes its
+separable count passes in HBM between fusions — the same scheduling toll
+the binary SWAR kernel paid before its Mosaic sweep (BASELINE.md: 2.05×10¹¹
+→ 1.82×10¹² at 65536²).  Here one grid step loads a ``block_rows + 2R``
+row slab into VMEM, wraps the columns in-register, runs the column then
+row slice-sum passes entirely in VMEM, applies the rule, and writes the
+central ``block_rows`` back — HBM sees one uint8 read and one write of the
+board per step.  At ~2(2R+1) bf16 adds/cell the kernel is compute-bound,
+so no temporal blocking (extra halo recompute would cost more than the
+HBM traffic it saves — the measured k=16 lesson from the binary sweep).
+
+The birth/survive sets are applied as range compares, not a table gather:
+LtL rules are written as count *ranges* (``R5,B15-22,S15-25``), and an
+arbitrary set decomposes into a handful of contiguous runs — each run is
+two compares, which Mosaic vectorizes trivially where a gather would not
+lower.  Counts stay exact in bf16 to 256 and f32 beyond, same dtype rule
+as the XLA path.
+
+Torus wraps: rows through the halo BlockSpec ``index_map`` modulo (as in
+:mod:`akka_game_of_life_tpu.ops.pallas_stencil`), columns by an
+in-kernel concat of the east/west edges (a (rows, R) VMEM copy).
+
+Box neighborhoods only: the diamond's per-row widths defeat the separable
+two-pass form; it stays on the XLA cumsum-difference path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from akka_game_of_life_tpu.ops.ltl import _count_dtype
+from akka_game_of_life_tpu.ops.pallas_stencil import _round_up8
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+DEFAULT_BLOCK_ROWS = 128
+
+
+def _ranges(counts) -> List[Tuple[int, int]]:
+    """A sorted count set as inclusive (lo, hi) runs: {3,4,5,9} →
+    [(3,5), (9,9)]."""
+    runs: List[Tuple[int, int]] = []
+    for n in sorted(counts):
+        if runs and n == runs[-1][1] + 1:
+            runs[-1] = (runs[-1][0], n)
+        else:
+            runs.append((n, n))
+    return runs
+
+
+def _in_ranges(c: jax.Array, runs: List[Tuple[int, int]]) -> jax.Array:
+    hit = None
+    for lo, hi in runs:
+        t = (c >= lo) & (c <= hi)
+        hit = t if hit is None else hit | t
+    return hit if hit is not None else jnp.zeros(c.shape, jnp.bool_)
+
+
+def ltl_sweep_fn(
+    rule,
+    *,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    interpret: bool = False,
+    vmem_limit_bytes: Optional[int] = None,
+) -> Callable[[jax.Array], jax.Array]:
+    """One Pallas step advancing a (H, W) uint8 LtL torus by one
+    generation.  Requires ``H % block_rows == 0`` and a box neighborhood."""
+    rule = resolve_rule(rule)
+    if rule.kind != "ltl" or rule.neighborhood != "box":
+        raise ValueError(
+            f"pallas LtL kernel supports kind='ltl' box neighborhoods, got {rule}"
+        )
+    r = rule.radius
+    d = 2 * r + 1
+    b = block_rows
+    hb = _round_up8(r)  # sublane-aligned halo blocks; last/first r rows used
+    if b % hb:
+        raise ValueError(
+            f"block_rows={b} must be a multiple of {hb} (radius {r} rounded "
+            f"up to the 8-row sublane tile)"
+        )
+    dtype = _count_dtype(rule)
+    birth_runs = _ranges(rule.birth)
+    survive_runs = _ranges(rule.survive)
+
+    def kernel(north_ref, center_ref, south_ref, out_ref):
+        ext = jnp.concatenate(
+            [north_ref[hb - r :], center_ref[...], south_ref[:r]], axis=0
+        )  # (b + 2r, W)
+        # Column torus wrap in-register.
+        ext = jnp.concatenate([ext[:, -r:], ext, ext[:, :r]], axis=1)
+        alive = (ext == 1).astype(dtype)  # (b+2r, W+2r)
+        h_out, w_out = b, ext.shape[1] - 2 * r
+        col = alive[0:h_out]
+        for dy in range(1, d):
+            col = col + alive[dy : dy + h_out]  # (b, W+2r)
+        counts = col[:, 0:w_out]
+        for dx in range(1, d):
+            counts = counts + col[:, dx : dx + w_out]  # (b, W)
+        center = ext[r : r + h_out, r : r + w_out]
+        alive_c = center == 1
+        neighbors = counts - alive_c.astype(dtype)
+        next_alive = jnp.where(
+            alive_c,
+            _in_ranges(neighbors, survive_runs),
+            _in_ranges(neighbors, birth_runs),
+        )
+        out_ref[...] = next_alive.astype(ext.dtype)
+
+    def sweep(x: jax.Array) -> jax.Array:
+        h, w = x.shape
+        if h % b:
+            raise ValueError(f"grid height {h} not a multiple of block_rows={b}")
+        halo_blocks = h // hb
+
+        grid_spec = pl.GridSpec(
+            grid=(h // b,),
+            in_specs=[
+                pl.BlockSpec(
+                    (hb, w),
+                    lambda i: ((i * (b // hb) - 1) % halo_blocks, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec((b, w), lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec(
+                    (hb, w),
+                    lambda i: (((i + 1) * (b // hb)) % halo_blocks, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (b, w), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+        )
+        compiler_params = None
+        if vmem_limit_bytes is not None and not interpret:
+            compiler_params = pltpu.CompilerParams(
+                vmem_limit_bytes=vmem_limit_bytes
+            )
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            grid_spec=grid_spec,
+            interpret=interpret,
+            compiler_params=compiler_params,
+        )(x, x, x)
+
+    return sweep
+
+
+@functools.lru_cache(maxsize=None)
+def ltl_pallas_multi_step_fn(
+    rule_key,
+    n_steps: int,
+    *,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    interpret: bool = False,
+    vmem_limit_bytes: Optional[int] = None,
+) -> Callable[[jax.Array], jax.Array]:
+    """Jitted n-step LtL advance from single-generation Pallas sweeps."""
+    rule = resolve_rule(rule_key)
+    sweep = ltl_sweep_fn(
+        rule,
+        block_rows=block_rows,
+        interpret=interpret,
+        vmem_limit_bytes=vmem_limit_bytes,
+    )
+
+    @jax.jit
+    def run(x: jax.Array) -> jax.Array:
+        def body(s, _):
+            return sweep(s), None
+
+        out, _ = jax.lax.scan(body, x, None, length=n_steps)
+        return out
+
+    return run

@@ -1,0 +1,76 @@
+"""Interpret-mode tests for the Pallas LtL kernel: VMEM-blocked shift-add
+counts + range-compare rule must match the XLA toroidal step (itself pinned
+to the numpy integral-image oracle in test_ltl.py) across radii, block
+splits, and rule ranges."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from akka_game_of_life_tpu.ops import ltl, pallas_ltl
+from akka_game_of_life_tpu.ops.rules import Rule, parse_rule, resolve_rule
+
+
+def _soup(h, w, seed=0, density=0.4):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < density).astype(np.uint8)
+
+
+@pytest.mark.parametrize("radius", [1, 2, 5])
+@pytest.mark.parametrize("block_rows", [8, 16])
+def test_pallas_ltl_matches_xla(radius, block_rows):
+    max_n = (2 * radius + 1) ** 2 - 1
+    lo = radius * (radius + 1)  # mid-scale thresholds that keep soups alive
+    rule = Rule(
+        frozenset(n for n in range(lo, lo + 8) if n <= max_n),
+        frozenset(n for n in range(max(0, lo - 2), lo + 11) if n <= max_n),
+        radius=radius,
+        kind="ltl",
+    )
+    board = _soup(32, 64, seed=radius)
+    n_steps = 3
+    want = np.asarray(ltl.ltl_multi_step_fn(rule, n_steps)(jnp.asarray(board)))
+    got = np.asarray(
+        pallas_ltl.ltl_pallas_multi_step_fn(
+            rule, n_steps, block_rows=block_rows, interpret=True
+        )(jnp.asarray(board))
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_ltl_bugs_rule():
+    rule = resolve_rule("bugs")
+    board = _soup(24, 48, seed=9, density=0.35)
+    want = np.asarray(ltl.ltl_multi_step_fn(rule, 2)(jnp.asarray(board)))
+    got = np.asarray(
+        pallas_ltl.ltl_pallas_multi_step_fn(
+            rule, 2, block_rows=8, interpret=True
+        )(jnp.asarray(board))
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_ltl_sparse_count_set_decomposes_to_runs():
+    # Non-contiguous B/S sets exercise multi-run range compares.
+    assert pallas_ltl._ranges({3, 4, 5, 9, 11, 12}) == [(3, 5), (9, 9), (11, 12)]
+    rule = Rule(
+        frozenset({3, 4, 5, 9}), frozenset({2, 3, 8}), radius=2, kind="ltl"
+    )
+    board = _soup(16, 32, seed=4)
+    want = np.asarray(ltl.ltl_multi_step_fn(rule, 2)(jnp.asarray(board)))
+    got = np.asarray(
+        pallas_ltl.ltl_pallas_multi_step_fn(
+            rule, 2, block_rows=8, interpret=True
+        )(jnp.asarray(board))
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_ltl_rejects_diamond_and_misaligned():
+    diamond = parse_rule("R3,B7-10,S6-12,NN")
+    with pytest.raises(ValueError, match="box"):
+        pallas_ltl.ltl_sweep_fn(diamond)
+    sweep = pallas_ltl.ltl_sweep_fn(resolve_rule("bugs"), block_rows=8, interpret=True)
+    with pytest.raises(ValueError, match="block_rows"):
+        sweep(jnp.zeros((12, 32), jnp.uint8))
