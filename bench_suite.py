@@ -575,6 +575,7 @@ def main() -> None:
         # dense toll).
         bench_dense(s(8192), "wireworld", "wireworld-8192", steps=16, density=0.5)
         bench_packed_gen(s(8192), "wireworld", "wireworld-8192")
+        bench_pallas_gen(s(8192), "wireworld", "wireworld-8192")
 
 
 if __name__ == "__main__":
