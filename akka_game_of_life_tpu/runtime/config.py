@@ -118,16 +118,18 @@ class SimulationConfig:
     # TPU execution.
     backend: str = "tpu"  # "tpu" (stencil) | "actor" / "actor-native" (per-cell parity)
     # Stencil kernel on the tpu backend:
-    #   dense   — uint8 roll-sum (any rule, incl. multi-state Generations)
-    #   bitpack — 32 cells/uint32 SWAR (binary rules, width % 32 == 0)
-    #   pallas  — temporally-blocked Mosaic kernel (fastest on real TPU
-    #             hardware, interpret-mode elsewhere); binary rules shard
-    #             over the mesh via parallel/pallas_halo.py, Generations
-    #             pallas is single-device
-    #   auto    — pallas on a real TPU for binary rules, single-device or
-    #             meshed (size-adaptive block rows, bitpack fallback if
-    #             Mosaic fails), else bitpack when the rule/shape allow it,
-    #             else dense
+    #   dense   — uint8 roll-sum (any rule, incl. multi-state and LtL)
+    #   bitpack — 32 cells/uint32 SWAR (binary totalistic rules) or m bit
+    #             planes (Generations/wireworld); width % 32 == 0
+    #   pallas  — VMEM-blocked Mosaic kernels (fastest on real TPU
+    #             hardware, interpret-mode elsewhere): binary totalistic
+    #             shards over the mesh via parallel/pallas_halo.py;
+    #             Generations/wireworld plane sweeps and box-LtL slabs
+    #             are single-device opt-ins
+    #   auto    — pallas on a real TPU for binary totalistic rules,
+    #             single-device or meshed (size-adaptive block rows,
+    #             bitpack fallback if Mosaic fails), else bitpack/planes
+    #             when the rule/shape allow it, else dense
     kernel: str = "auto"
     pallas_block_rows: int = 64  # VMEM row-block for kernel="pallas"
     # Mosaic scoped-VMEM budget override in MB (0 = compiler default, 16 MB).

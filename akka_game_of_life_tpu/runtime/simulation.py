@@ -229,14 +229,16 @@ class Simulation:
 
         n_dev = len(jax.devices())
         self._n_dev = n_dev
-        # Binary-rule pallas shards via the Mosaic sweep inside shard_map
-        # (parallel/pallas_halo.py); the Generations pallas sweep has no
-        # sharded form yet, so explicit gen pallas pins to one device — an
-        # explicit mesh_shape then errors in _resolve_kernel rather than
-        # silently ignoring either request.
-        gen_pallas = config.kernel == "pallas" and not self.rule.is_binary
+        # Binary-totalistic pallas shards via the Mosaic sweep inside
+        # shard_map (parallel/pallas_halo.py); the Generations and LtL
+        # pallas kernels have no sharded form yet, so explicit pallas for
+        # those pins to one device — an explicit mesh_shape then errors in
+        # _resolve_kernel rather than silently ignoring either request.
+        unsharded_pallas = config.kernel == "pallas" and (
+            not self.rule.is_binary or self.rule.kind == "ltl"
+        )
         self._use_mesh = config.mesh_shape is not None or (
-            n_dev > 1 and not gen_pallas
+            n_dev > 1 and not unsharded_pallas
         )
         self._kernel_auto = config.kernel == "auto"
         self.kernel = self._resolve_kernel()
@@ -247,7 +249,11 @@ class Simulation:
             if self._kernel_auto and self.kernel == "pallas"
             else config.pallas_block_rows
         )
-        self._packed = self.kernel in ("bitpack", "pallas")
+        # LtL's pallas kernel is dense-layout (uint8 board in, uint8 out);
+        # every other bitpack/pallas kernel is packed words/planes.
+        self._packed = (
+            self.kernel in ("bitpack", "pallas") and self.rule.kind != "ltl"
+        )
         # Multi-state Generations rules on the packed kernel use bit planes
         # (ops/bitpack_gen.py): m = ceil(log2(states)) packed planes.
         self._gen = self._packed and not self.rule.is_binary
@@ -344,12 +350,39 @@ class Simulation:
                 return "bitpack"
             # Generations rules: bit planes (0.25·m B/cell vs 1 B/cell dense).
             return "bitpack" if self.rule.states <= 256 else "dense"
-        if kernel in ("bitpack", "pallas"):
-            if self.rule.kind == "ltl":
+        if kernel == "bitpack" and self.rule.kind == "ltl":
+            raise ValueError(
+                f"kernel=bitpack supports totalistic and wireworld rules "
+                f"only; {self.rule} runs on kernel=dense (or kernel=pallas "
+                f"for box neighborhoods)"
+            )
+        if kernel == "pallas" and self.rule.kind == "ltl":
+            # The dense-layout VMEM-blocked LtL kernel (ops/pallas_ltl.py):
+            # explicit opt-in, single device, box neighborhoods.  All of
+            # the kernel's own preconditions are checked HERE so a bad
+            # config fails at __init__, never mid-advance.
+            from akka_game_of_life_tpu.ops.pallas_stencil import _round_up8
+
+            if self.rule.neighborhood != "box":
                 raise ValueError(
-                    f"kernel={kernel} supports totalistic and wireworld "
-                    f"rules only; {self.rule} runs on kernel=dense"
+                    "kernel=pallas for ltl supports box neighborhoods only "
+                    "(the diamond runs the cumsum path on kernel=dense)"
                 )
+            if self._use_mesh:
+                raise ValueError(
+                    "kernel=pallas for ltl is single-device (no sharded "
+                    "form); use kernel=dense on a mesh"
+                )
+            hb = _round_up8(self.rule.radius)
+            if cfg.pallas_block_rows % hb:
+                raise ValueError(
+                    f"kernel=pallas for ltl radius {self.rule.radius} "
+                    f"requires pallas_block_rows % {hb} == 0, got "
+                    f"{cfg.pallas_block_rows}"
+                )
+            self._require_block_rows_divides()
+            return kernel
+        if kernel in ("bitpack", "pallas"):
             if not self.rule.is_binary and self.rule.states > 256:
                 raise ValueError(
                     f"kernel={kernel} supports at most 256 states, rule "
@@ -377,21 +410,21 @@ class Simulation:
                         # both forms are infeasible, the error talks about
                         # the single-device constraint, not an implicit
                         # mesh the user never configured.
-                        if cfg.height % cfg.pallas_block_rows:
-                            raise ValueError(
-                                f"kernel=pallas requires height % "
-                                f"pallas_block_rows ({cfg.pallas_block_rows}) "
-                                f"== 0, got {cfg.height}"
-                            )
+                        self._require_block_rows_divides()
                         self._use_mesh = False
                     else:
                         raise ValueError(err)
-            elif cfg.height % cfg.pallas_block_rows:
-                raise ValueError(
-                    f"kernel=pallas requires height % pallas_block_rows "
-                    f"({cfg.pallas_block_rows}) == 0, got {cfg.height}"
-                )
+            else:
+                self._require_block_rows_divides()
         return kernel
+
+    def _require_block_rows_divides(self) -> None:
+        cfg = self.config
+        if cfg.height % cfg.pallas_block_rows:
+            raise ValueError(
+                f"kernel=pallas requires height % pallas_block_rows "
+                f"({cfg.pallas_block_rows}) == 0, got {cfg.height}"
+            )
 
     def _meshed_pallas_error(self, block_rows: int) -> Optional[str]:
         """Config-time feasibility of the sharded pallas path, or why not.
@@ -660,6 +693,18 @@ class Simulation:
             elif self.mesh is not None:
                 self._steppers[k] = sharded_step_fn(
                     self.mesh, self.rule, steps_per_call=k, halo_width=self._halo_for(k)
+                )
+            elif self.kernel == "pallas":
+                # Only the LtL pallas kernel reaches here (dense layout,
+                # single device — _resolve_kernel enforced box + no mesh).
+                from akka_game_of_life_tpu.ops import pallas_ltl
+
+                self._steppers[k] = pallas_ltl.ltl_pallas_multi_step_fn(
+                    self.rule,
+                    k,
+                    block_rows=self.config.pallas_block_rows,
+                    vmem_limit_bytes=self.config.pallas_vmem_limit_bytes,
+                    interpret=jax.default_backend() != "tpu",
                 )
             else:
                 self._steppers[k] = get_model(self.rule).run(k)

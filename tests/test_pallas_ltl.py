@@ -67,6 +67,44 @@ def test_pallas_ltl_sparse_count_set_decomposes_to_runs():
     np.testing.assert_array_equal(got, want)
 
 
+def test_simulation_ltl_pallas_opt_in_matches_dense():
+    """run --kernel pallas for a box LtL rule drives the VMEM-blocked
+    kernel through the product Simulation (dense board layout: observers
+    and checkpoints unchanged) and must match the dense-kernel run."""
+    import io
+
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.runtime.render import BoardObserver
+    from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+    mk = lambda kernel: Simulation(
+        SimulationConfig(
+            height=32, width=48, rule="bugs", seed=5, steps_per_call=4,
+            kernel=kernel, pallas_block_rows=8,
+        ),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    sim_p, sim_d = mk("pallas"), mk("dense")
+    assert sim_p.kernel == "pallas"
+    sim_p.advance(8)
+    sim_d.advance(8)
+    np.testing.assert_array_equal(sim_p.board_host(), sim_d.board_host())
+
+    with pytest.raises(ValueError, match="box"):
+        Simulation(
+            SimulationConfig(
+                height=32, width=32, rule="R3,B7-10,S6-12,NN", kernel="pallas",
+                pallas_block_rows=8,
+            ),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+    with pytest.raises(ValueError, match="bitpack"):
+        Simulation(
+            SimulationConfig(height=32, width=32, rule="bugs", kernel="bitpack"),
+            observer=BoardObserver(out=io.StringIO()),
+        )
+
+
 def test_pallas_ltl_rejects_diamond_and_misaligned():
     diamond = parse_rule("R3,B7-10,S6-12,NN")
     with pytest.raises(ValueError, match="box"):
