@@ -50,6 +50,56 @@ def sweep(
 
     rule = resolve_rule(rule)
     rng = np.random.default_rng(0)
+    if rule.kind == "ltl":
+        # Dense-layout VMEM kernel (ops/pallas_ltl.py): single-generation
+        # sweeps, so only block_rows varies — each (b, k) point runs k=1
+        # once per block and the grid's `sweeps` axis collapses.  (LtL
+        # rules ARE binary; without this branch they would fall into the
+        # packed branch and fail require_packed_support on every point.)
+        from akka_game_of_life_tpu.ops import pallas_ltl
+        from akka_game_of_life_tpu.ops.pallas_stencil import _round_up8
+
+        if rule.neighborhood != "box":
+            raise ValueError(
+                "tune supports box-neighborhood ltl rules only (the diamond "
+                "has no pallas kernel)"
+            )
+        board = jax.device_put(
+            (rng.random((size, size)) < 0.4).astype(np.uint8)
+        )
+        hb = _round_up8(rule.radius)
+        results: List[dict] = []
+        for b in blocks:
+            point = {"block_rows": int(b), "steps_per_sweep": 1}
+            if not feasible(size, steps_per_call, b, 1) or b % hb:
+                continue
+            try:
+                fn = pallas_ltl.ltl_pallas_multi_step_fn(
+                    rule,
+                    steps_per_call,
+                    block_rows=b,
+                    interpret=interpret,
+                    vmem_limit_bytes=(
+                        vmem_limit_mb * 2**20 if vmem_limit_mb else None
+                    ),
+                )
+                out = fn(board)
+                np.asarray(out[0])
+                t0 = time.perf_counter()
+                cur = out
+                for _ in range(timed_calls):
+                    cur = fn(cur)
+                np.asarray(cur[0])
+                dt = time.perf_counter() - t0
+                point.update(
+                    seconds=round(dt, 4),
+                    cells_per_sec=size * size * steps_per_call * timed_calls / dt,
+                )
+            except Exception as e:
+                point["error"] = f"{type(e).__name__}: {e}"
+            results.append(point)
+        results.sort(key=lambda p: p.get("cells_per_sec", -1.0), reverse=True)
+        return results
     if rule.is_binary:
         # Generate the packed words directly: uniform random uint32s ARE a
         # density-1/2 random board, and 0.25 B/cell scratch (512 MiB at
@@ -152,7 +202,12 @@ def best_flags(results: List[dict], rule="conway") -> Optional[str]:
         if "cells_per_sec" not in p:
             continue
         b, k = p["block_rows"], p["steps_per_sweep"]
-        if rule.is_binary:
+        if rule.kind == "ltl":
+            flags = (
+                f"run --kernel pallas --pallas-block-rows {b} "
+                f"(benchmark line: bench_suite.bench_pallas_ltl)"
+            )
+        elif rule.is_binary:
             flags = (
                 f"bench.py --block-rows {b} --steps-per-sweep {k}; "
                 f"run --pallas-block-rows {b}"

@@ -69,6 +69,9 @@ stage tune-8192 1200 python -m akka_game_of_life_tpu tune --size 8192 \
 # pallas-vs-plane-scan decision in KERNELS.md (VERDICT #7).
 stage tune-gen-8192 1200 python -m akka_game_of_life_tpu tune --size 8192 \
   --rule brians-brain --steps-per-call 32 --blocks 32,64,128,256 --sweeps 4,8,16
+# The LtL VMEM kernel's block space (k collapses to 1; radius-5 Bugs).
+stage tune-ltl-8192 1200 python -m akka_game_of_life_tpu tune --size 8192 \
+  --rule bugs --steps-per-call 16 --blocks 64,128,256,512 --sweeps 1
 
 # Product selftest on the real chip: kernel=auto resolves to pallas, so
 # gun phase / oracle / checkpoint / chaos all exercise the Mosaic kernel.
