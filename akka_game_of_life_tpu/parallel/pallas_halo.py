@@ -94,6 +94,72 @@ def plan_exchange(
     return k, g
 
 
+def _wire_sharded_sweep(
+    mesh: Mesh,
+    spec,
+    *,
+    steps_per_call: int,
+    block_rows: int,
+    steps_per_sweep: Optional[int],
+    make_sweep: Callable[[int], Callable],
+    make_check: Callable[[int], Callable],
+    to_carry=None,
+    from_carry=None,
+) -> Callable[[jax.Array], jax.Array]:
+    """The shared body of the sharded Mosaic steppers: plan the exchange,
+    size halos, wrap g back-to-back k-generation sweeps as the local
+    advance, and wire it through the two-phase exchange loop.
+
+    ``make_sweep(k)`` builds the Mosaic sweep at the planned depth;
+    ``make_check(hw)`` builds the per-tile validator given the word halo.
+    ``to_carry``/``from_carry`` adapt the padded tile to the sweep's carry
+    type (the plane sweep takes a tuple of 2-D planes; identity for the
+    binary board).
+
+    check_vma=False everywhere: the vma tracker can't yet see through
+    pallas_call's interpret-mode discharge (shift-by-literal mixes
+    varying/unvarying operands and errors with "Primitive shift_left
+    requires varying manual axes to match"); JAX's own error text
+    prescribes this workaround.  Correctness does not lean on the checker
+    — every mesh shape is oracle-tested against the dense single-device
+    step (test_pallas_halo).
+    """
+    k, g = plan_exchange(steps_per_call, block_rows, steps_per_sweep)
+    steps_per_exchange = k * g
+    p = block_rows // 2
+    hw = word_halo_width(steps_per_exchange) if mesh.shape[COL_AXIS] > 1 else 0
+    sweep = make_sweep(k)
+
+    def advance(padded: jax.Array) -> jax.Array:
+        # g back-to-back Mosaic sweeps of k generations each.  The padded
+        # tile is h_loc + 2p = h_loc + block_rows rows — a whole number of
+        # VMEM row blocks, which the torus sweep's BlockSpec grid tiles
+        # exactly.
+        carry = padded if to_carry is None else to_carry(padded)
+        out, _ = jax.lax.scan(lambda s, _: (sweep(s), None), carry, None, length=g)
+        return out if from_carry is None else from_carry(out)
+
+    jitted = _sharded_exchange_fn(
+        mesh,
+        spec,
+        None,
+        steps_per_call=steps_per_call,
+        halo_rows=p,
+        check_tile=make_check(hw),
+        steps_per_exchange=steps_per_exchange,
+        local_advance=advance,
+        halo_words=hw,
+        check_vma=False,
+    )
+
+    def fn(board: jax.Array) -> jax.Array:
+        return jitted(board)
+
+    fn.steps_per_exchange = steps_per_exchange
+    fn.steps_per_sweep = k
+    return fn
+
+
 def sharded_pallas_step_fn(
     mesh: Mesh,
     rule,
@@ -113,62 +179,110 @@ def sharded_pallas_step_fn(
     """
     rule = resolve_rule(rule)
     require_packed_support(rule)
-    k, g = plan_exchange(steps_per_call, block_rows, steps_per_sweep)
-    steps_per_exchange = k * g
-    p = block_rows // 2
-    cols = mesh.shape[COL_AXIS]
-    hw = word_halo_width(steps_per_exchange) if cols > 1 else 0
-    sweep = packed_sweep_fn(
-        rule,
-        block_rows=block_rows,
-        steps_per_sweep=k,
-        interpret=interpret,
-        vmem_limit_bytes=vmem_limit_bytes,
-    )
 
-    def check(tile: jax.Array) -> None:
-        h_loc, w_loc = tile.shape
-        if h_loc % block_rows:
-            raise ValueError(
-                f"per-shard tile height {h_loc} not a multiple of "
-                f"block_rows={block_rows}"
-            )
-        if hw and w_loc < hw:
-            raise ValueError(
-                f"per-shard tile has {w_loc} words < word halo {hw}; "
-                f"use fewer column shards or fewer steps per exchange"
-            )
+    def make_check(hw: int):
+        def check(tile: jax.Array) -> None:
+            h_loc, w_loc = tile.shape
+            if h_loc % block_rows:
+                raise ValueError(
+                    f"per-shard tile height {h_loc} not a multiple of "
+                    f"block_rows={block_rows}"
+                )
+            if hw and w_loc < hw:
+                raise ValueError(
+                    f"per-shard tile has {w_loc} words < word halo {hw}; "
+                    f"use fewer column shards or fewer steps per exchange"
+                )
 
-    def advance(padded: jax.Array) -> jax.Array:
-        # g back-to-back Mosaic sweeps of k generations each.  The padded
-        # tile is h_loc + 2p = h_loc + block_rows rows — a whole number of
-        # VMEM row blocks, which the torus sweep's BlockSpec grid tiles
-        # exactly.
-        out, _ = jax.lax.scan(lambda s, _: (sweep(s), None), padded, None, length=g)
-        return out
+        return check
 
-    # check_vma=False: the vma tracker can't yet see through pallas_call's
-    # interpret-mode discharge (shift-by-literal mixes varying/unvarying
-    # operands and errors with "Primitive shift_left requires varying manual
-    # axes to match"); JAX's own error text prescribes this workaround.
-    # Correctness does not lean on the checker — every mesh shape is
-    # oracle-tested against the dense single-device step (test_pallas_halo).
-    jitted = _sharded_exchange_fn(
+    return _wire_sharded_sweep(
         mesh,
         GRID_SPEC,
-        None,
         steps_per_call=steps_per_call,
-        halo_rows=p,
-        check_tile=check,
-        steps_per_exchange=steps_per_exchange,
-        local_advance=advance,
-        halo_words=hw,
-        check_vma=False,
+        block_rows=block_rows,
+        steps_per_sweep=steps_per_sweep,
+        make_sweep=lambda k: packed_sweep_fn(
+            rule,
+            block_rows=block_rows,
+            steps_per_sweep=k,
+            interpret=interpret,
+            vmem_limit_bytes=vmem_limit_bytes,
+        ),
+        make_check=make_check,
     )
 
-    def fn(board: jax.Array) -> jax.Array:
-        return jitted(board)
 
-    fn.steps_per_exchange = steps_per_exchange
-    fn.steps_per_sweep = k
-    return fn
+def sharded_gen_pallas_step_fn(
+    mesh: Mesh,
+    rule,
+    *,
+    steps_per_call: int = 1,
+    block_rows: Optional[int] = None,
+    steps_per_sweep: Optional[int] = None,
+    vmem_limit_bytes: Optional[int] = None,
+    interpret: bool = False,
+) -> Callable[[jax.Array], jax.Array]:
+    """The sharded Mosaic sweep for bit-plane rules (Generations /
+    WireWorld): a (m, H, W/32) plane stack under ``GEN_SPEC`` (plane dim
+    replicated, rows × word-cols tiled), local compute = the per-plane-
+    operand Pallas sweep (:func:`..ops.pallas_gen.gen_sweep_fn`).
+
+    Same exchange plan and garbage-front economics as the binary
+    :func:`sharded_pallas_step_fn` — the plane transition is cell-local
+    (radius 1), so the alive plane's 1-cell/step validity front bounds
+    every plane; per-shard plane tiles must be a whole number of
+    ``block_rows`` tall."""
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.ops import pallas_gen
+    from akka_game_of_life_tpu.ops.bitpack_gen import (
+        _require_plane_support,
+        n_planes,
+    )
+    from akka_game_of_life_tpu.parallel.mesh import GEN_SPEC
+
+    rule = resolve_rule(rule)
+    _require_plane_support(rule)
+    m = n_planes(rule.states)
+    if block_rows is None:
+        block_rows = pallas_gen.DEFAULT_BLOCK_ROWS
+
+    def make_check(hw: int):
+        def check(tile: jax.Array) -> None:
+            if tile.shape[0] != m:
+                raise ValueError(
+                    f"expected {m} planes for {rule.states} states"
+                )
+            _, h_loc, w_loc = tile.shape
+            if h_loc % block_rows:
+                raise ValueError(
+                    f"per-shard plane tile height {h_loc} not a multiple of "
+                    f"block_rows={block_rows}"
+                )
+            if hw and w_loc < hw:
+                raise ValueError(
+                    f"per-shard plane tile has {w_loc} words < word halo "
+                    f"{hw}; use fewer column shards or fewer steps per "
+                    f"exchange"
+                )
+
+        return check
+
+    return _wire_sharded_sweep(
+        mesh,
+        GEN_SPEC,
+        steps_per_call=steps_per_call,
+        block_rows=block_rows,
+        steps_per_sweep=steps_per_sweep,
+        make_sweep=lambda k: pallas_gen.gen_sweep_fn(
+            rule,
+            block_rows=block_rows,
+            steps_per_sweep=k,
+            interpret=interpret,
+            vmem_limit_bytes=vmem_limit_bytes,
+        ),
+        make_check=make_check,
+        to_carry=lambda padded: tuple(padded[j] for j in range(m)),
+        from_carry=lambda out: jnp.stack(out),
+    )

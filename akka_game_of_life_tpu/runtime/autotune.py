@@ -64,9 +64,15 @@ def sweep(
                 "tune supports box-neighborhood ltl rules only (the diamond "
                 "has no pallas kernel)"
             )
-        board = jax.device_put(
-            (rng.random((size, size)) < 0.4).astype(np.uint8)
-        )
+        # Sample into a preallocated uint8 board in row chunks: a one-shot
+        # rng.random((size, size)) would be 32 GiB of float64 at 65536².
+        board_np = np.empty((size, size), np.uint8)
+        chunk = max(1, min(size, 2**24 // size))
+        for r0 in range(0, size, chunk):
+            rows = min(chunk, size - r0)
+            board_np[r0 : r0 + rows] = rng.random((rows, size)) < 0.4
+        board = jax.device_put(board_np)
+        del board_np
         hb = _round_up8(rule.radius)
         results: List[dict] = []
         for b in blocks:

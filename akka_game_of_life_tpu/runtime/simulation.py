@@ -229,13 +229,13 @@ class Simulation:
 
         n_dev = len(jax.devices())
         self._n_dev = n_dev
-        # Binary-totalistic pallas shards via the Mosaic sweep inside
-        # shard_map (parallel/pallas_halo.py); the Generations and LtL
-        # pallas kernels have no sharded form yet, so explicit pallas for
-        # those pins to one device — an explicit mesh_shape then errors in
-        # _resolve_kernel rather than silently ignoring either request.
-        unsharded_pallas = config.kernel == "pallas" and (
-            not self.rule.is_binary or self.rule.kind == "ltl"
+        # Binary-totalistic AND plane-rule pallas shard via the Mosaic
+        # sweeps inside shard_map (parallel/pallas_halo.py); the LtL pallas
+        # kernel has no sharded form, so explicit pallas for it pins to one
+        # device — an explicit mesh_shape then errors in _resolve_kernel
+        # rather than silently ignoring either request.
+        unsharded_pallas = (
+            config.kernel == "pallas" and self.rule.kind == "ltl"
         )
         self._use_mesh = config.mesh_shape is not None or (
             n_dev > 1 and not unsharded_pallas
@@ -394,12 +394,6 @@ class Simulation:
                 )
         if kernel == "pallas":
             if self._use_mesh:
-                if not self.rule.is_binary:
-                    raise ValueError(
-                        "kernel=pallas on a mesh supports binary rules only "
-                        "(the sharded Mosaic sweep, parallel/pallas_halo.py); "
-                        "use kernel=bitpack for sharded Generations runs"
-                    )
                 err = self._meshed_pallas_error(cfg.pallas_block_rows)
                 if err is not None:
                     if cfg.mesh_shape is None:
@@ -633,6 +627,19 @@ class Simulation:
                         self._steppers[k] = bitpack_gen.gen_multi_step_fn(
                             self.rule, k
                         )
+                elif self.kernel == "pallas":
+                    from akka_game_of_life_tpu.parallel.pallas_halo import (
+                        sharded_gen_pallas_step_fn,
+                    )
+
+                    self._steppers[k] = sharded_gen_pallas_step_fn(
+                        self.mesh,
+                        self.rule,
+                        steps_per_call=k,
+                        block_rows=self.config.pallas_block_rows,
+                        vmem_limit_bytes=self.config.pallas_vmem_limit_bytes,
+                        interpret=jax.default_backend() != "tpu",
+                    )
                 else:
                     from akka_game_of_life_tpu.parallel.packed_halo2d import (
                         sharded_gen_step_fn,

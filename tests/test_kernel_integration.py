@@ -72,18 +72,32 @@ def test_auto_falls_back_to_dense_for_odd_width():
 def test_explicit_kernel_rejections():
     with pytest.raises(ValueError, match="width"):
         Simulation(_cfg("bitpack", width=60), observer=BoardObserver(out=io.StringIO()))
-    # pallas + multi-state is supported (the bit-plane Generations kernel)
-    # but has no sharded form: it pins to one device even with 8 visible,
-    # and an explicit mesh_shape errors instead of being ignored.
+    # pallas + multi-state shards via the plane Mosaic sweep; an implicit
+    # mesh the block rows can't tile falls back to one device (same rule
+    # as the binary path), and an INFEASIBLE explicit mesh still errors.
     sim = Simulation(
         _cfg("pallas", rule="brians-brain"), observer=BoardObserver(out=io.StringIO())
     )
     assert sim.kernel == "pallas" and sim._gen and sim.mesh is None
-    with pytest.raises(ValueError, match="binary rules only"):
+    with pytest.raises(ValueError, match="per-shard height"):
         Simulation(
             _cfg("pallas", rule="brians-brain", mesh_shape=(2, 1)),
             observer=BoardObserver(out=io.StringIO()),
         )
+    # A feasible explicit mesh runs the sharded plane sweep ≡ dense.
+    meshed = Simulation(
+        _cfg(
+            "pallas", rule="brians-brain", mesh_shape=(8, 1), pallas_block_rows=8
+        ),
+        observer=BoardObserver(out=io.StringIO()),
+    )
+    assert meshed.kernel == "pallas" and meshed._gen and meshed.mesh is not None
+    dense = Simulation(
+        _cfg("dense", rule="brians-brain"), observer=BoardObserver(out=io.StringIO())
+    )
+    meshed.advance(16)
+    dense.advance(16)
+    np.testing.assert_array_equal(meshed.board_host(), dense.board_host())
 
 
 def test_gen_planes_sim_matches_dense_sim(tmp_path):

@@ -629,6 +629,33 @@ def test_cli_tune_gen_rule_interpret_smoke(capsys):
     assert all("cells_per_sec" in p for p in points)
 
 
+def test_cli_tune_ltl_rule_interpret_smoke(capsys):
+    """The LtL branch of the autotuner: block-only sweep (k collapses to
+    1), radius alignment gate, and the ltl best-flags string."""
+    import json
+
+    from akka_game_of_life_tpu.cli import main
+
+    rc = main(
+        [
+            "tune", "--platform", "cpu", "--size", "64",
+            "--steps-per-call", "2", "--blocks", "8,16,12",
+            "--sweeps", "1", "--timed-calls", "1", "--interpret",
+            "--rule", "bugs",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    points = [json.loads(l) for l in out if l.startswith("{")]
+    # 12 is not an 8-multiple; feasible blocks sweep at k=1 only.
+    assert {(p["block_rows"], p["steps_per_sweep"]) for p in points} == {
+        (8, 1),
+        (16, 1),
+    }
+    assert all("cells_per_sec" in p for p in points)
+    assert any("bench_suite.bench_pallas_ltl" in l for l in out)
+
+
 def test_tune_feasibility_guards():
     from akka_game_of_life_tpu.runtime.autotune import feasible
 
