@@ -147,10 +147,11 @@ def _check_sharded(kernel: str) -> str:
 
 
 def _check_families(kernel: str) -> str:
-    """The non-Conway rule families on this machine's dense path: the
-    wireworld clock must hold its period-10 phase, and a radius-1 LtL
-    Conway must be bit-identical to the classic kernel (the conv-vs-VPU
-    cross-unit anchor)."""
+    """The non-Conway rule families: the wireworld clock must hold its
+    period-10 phase on whatever kernel this machine resolves (the packed
+    2-bit-plane path on 32-aligned widths), and a radius-1 LtL Conway must
+    be bit-identical to the classic kernel (the shift-add-vs-SWAR
+    cross-formulation anchor)."""
     import jax.numpy as jnp
 
     from akka_game_of_life_tpu.models import get_model
@@ -159,6 +160,7 @@ def _check_families(kernel: str) -> str:
 
     ww = _sim(rule="wireworld", pattern="wireworld-clock", pattern_offset=(8, 8),
               height=64, width=64, steps_per_call=5)
+    ww_kernel = ww.kernel
     start = ww.board_window(8, 12, 8, 13)
     assert start.sum() > 0
     ww.advance(10)
@@ -170,9 +172,9 @@ def _check_families(kernel: str) -> str:
     board = pattern_board("acorn", (128, 128), (60, 60))
     classic = _dense(board, 32)
     as_ltl = Rule(frozenset({3}), frozenset({2, 3}), kind="ltl")
-    via_conv = np.asarray(get_model(as_ltl).run(32)(jnp.asarray(board)))
-    assert np.array_equal(via_conv, classic), "conv path diverged from classic"
-    return "dense"
+    via_ltl = np.asarray(get_model(as_ltl).run(32)(jnp.asarray(board)))
+    assert np.array_equal(via_ltl, classic), "ltl path diverged from classic"
+    return f"wireworld={ww_kernel}, ltl=dense"
 
 
 class _Skip(Exception):

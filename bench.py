@@ -271,11 +271,25 @@ def main() -> None:
                     ),
                 ),
             ),
-            ("ltl-8192", lambda: bench_suite.bench_ltl(8192, "bugs", "ltl-8192")),
+            (
+                "ltl-8192",
+                lambda: (
+                    bench_suite.bench_ltl(8192, "bugs", "ltl-8192"),
+                    bench_suite.bench_ltl(
+                        8192, "R5,B15-22,S15-25,NN", "ltl-8192"
+                    ),
+                    bench_suite.bench_pallas_ltl(8192, "bugs", "ltl-8192"),
+                ),
+            ),
             (
                 "wireworld-8192",
-                lambda: bench_suite.bench_packed_gen(
-                    8192, "wireworld", "wireworld-8192"
+                lambda: (
+                    bench_suite.bench_packed_gen(
+                        8192, "wireworld", "wireworld-8192"
+                    ),
+                    bench_suite.bench_pallas_gen(
+                        8192, "wireworld", "wireworld-8192"
+                    ),
                 ),
             ),
         ]
