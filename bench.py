@@ -54,6 +54,7 @@ def probe_device(
     attempts: int,
     platform: str | None = None,
     window_s: float = 0.0,
+    on_first_failure=None,
 ) -> str | None:
     """Return None if a small matmul completes on the default platform,
     else a short machine-readable failure reason.
@@ -115,6 +116,8 @@ def probe_device(
         except subprocess.TimeoutExpired:
             reason = f"probe-timeout: device touch exceeded {timeout_try:.0f}s (tunnel hung?)"
             transient = True
+            if attempt == 1 and on_first_failure is not None:
+                on_first_failure(reason)
             continue
         if proc.returncode == 0:
             return None
@@ -135,6 +138,8 @@ def probe_device(
                 "SyntaxError",  # broken probe code
             )
         )
+        if attempt == 1 and transient and on_first_failure is not None:
+            on_first_failure(reason)
     elapsed = time.monotonic() - (deadline - window_s)
     return f"{reason} (after {attempt} attempts over {elapsed:.0f}s)"
 
@@ -197,11 +202,38 @@ def main() -> None:
         )
 
     if args.probe_timeout > 0:
+
+        def provisional(reason: str) -> None:
+            # A harness with its own (shorter) timeout may kill bench.py
+            # mid-retry-window; flush a structured record NOW so the
+            # artifact can never end up empty (the round-1 failure mode).
+            # A later success line supersedes it — consumers read the last
+            # line — and the flag marks it non-final either way.
+            print(
+                json.dumps(
+                    {
+                        "metric": _label(args.kernel),
+                        "value": None,
+                        "unit": "cell-updates/sec",
+                        "vs_baseline": None,
+                        "provisional": True,
+                        "error": reason,
+                        "note": (
+                            "first device probe failed; still retrying "
+                            "within --probe-retry-window — a later line "
+                            "supersedes this one"
+                        ),
+                    }
+                ),
+                flush=True,
+            )
+
         failure = probe_device(
             args.probe_timeout,
             max(1, args.probe_attempts),
             args.platform,
             window_s=max(0.0, args.probe_retry_window),
+            on_first_failure=provisional,
         )
         if failure is not None:
             # Structured, parseable record of the failure — never a hang or a
