@@ -60,6 +60,11 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int32, ctypes.c_int32,
         ctypes.c_uint32, ctypes.c_uint32, u8p,
     ]
+    lib.swar_wire_chunk.argtypes = [
+        u8p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_uint32, u8p,
+    ]
     return lib
 
 

@@ -141,8 +141,10 @@ def swar_chunk_native(
     bit-packed kernel, and the machine-code replacement for the numpy
     engine's roll-sum stepping on binary rules."""
     rule = resolve_rule(rule)
-    if not rule.is_binary:
-        raise ValueError("native SWAR kernel supports binary rules only")
+    if not (rule.is_binary and rule.is_totalistic):
+        raise ValueError(
+            "native SWAR kernel supports binary totalistic rules only"
+        )
     if steps > halo:
         raise ValueError(f"steps={steps} > halo={halo}")
     lib = load()
@@ -156,5 +158,30 @@ def swar_chunk_native(
     lib.swar_chunk(
         _as_u8p(padded), ph, pw, steps, halo,
         rule.birth_mask, rule.survive_mask, _as_u8p(out),
+    )
+    return out
+
+
+def swar_wire_chunk_native(
+    padded: np.ndarray, steps: int, halo: int, rule
+) -> np.ndarray:
+    """WireWorld twin of :func:`swar_chunk_native`: the 4-state CA as two
+    uint64 bit planes through the same carry-save head-count adders
+    (native/swar_kernel.cpp ``swar_wire_chunk``)."""
+    rule = resolve_rule(rule)
+    if rule.kind != "wireworld":
+        raise ValueError(f"expected a wireworld rule, got {rule}")
+    if steps > halo:
+        raise ValueError(f"steps={steps} > halo={halo}")
+    lib = load()
+    if lib is None:
+        from akka_game_of_life_tpu.native import load_error
+
+        raise RuntimeError(f"native engine unavailable: {load_error()}")
+    padded = np.ascontiguousarray(padded, dtype=np.uint8)
+    ph, pw = padded.shape
+    out = np.empty((ph - 2 * halo, pw - 2 * halo), dtype=np.uint8)
+    lib.swar_wire_chunk(
+        _as_u8p(padded), ph, pw, steps, halo, rule.birth_mask, _as_u8p(out)
     )
     return out

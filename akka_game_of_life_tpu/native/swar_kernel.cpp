@@ -53,6 +53,24 @@ inline void row_triple(const uint64_t* x, uint64_t* s, uint64_t* c, int words) {
   }
 }
 
+// Assemble count = (sN+sC+sS) + 2*(cN+cC+cS) — the 9-cell Moore sum as
+// bit planes (b3, b2, b1, b0) — shared by both chunk kernels' combine
+// loops (the C++ twin of ops/bitpack.py _count_bits).
+inline void nine_sum(uint64_t sN, uint64_t sC, uint64_t sS, uint64_t cN,
+                     uint64_t cC, uint64_t cS, uint64_t& b3, uint64_t& b2,
+                     uint64_t& b1, uint64_t& b0) {
+  uint64_t sNC = sN ^ sC;
+  b0 = sNC ^ sS;
+  uint64_t p1 = (sN & sC) | (sS & sNC);
+  uint64_t cNC = cN ^ cC;
+  uint64_t q0 = cNC ^ cS;
+  uint64_t q1 = (cN & cC) | (cS & cNC);
+  b1 = p1 ^ q0;
+  uint64_t r2 = p1 & q0;
+  b2 = q1 ^ r2;
+  b3 = q1 & r2;
+}
+
 // Row-band parallelism: both per-step phases (triple sums; combine) are
 // row-local over read-only inputs, so bands need no locks — only the join
 // between phases (phase B reads neighbor rows' phase-A output).  Threads
@@ -177,17 +195,8 @@ extern "C" void swar_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
       const uint64_t* x = cur.row(r);
       uint64_t* o = next.row(r);
       for (int i = 0; i < words; ++i) {
-        // count = (sN+sC+sS) + 2*(cN+cC+cS), range 0..9, as bit planes.
-        uint64_t sNC = sN[i] ^ sC[i];
-        uint64_t b0 = sNC ^ sS[i];
-        uint64_t p1 = (sN[i] & sC[i]) | (sS[i] & sNC);
-        uint64_t cNC = cN[i] ^ cC[i];
-        uint64_t q0 = cNC ^ cS[i];
-        uint64_t q1 = (cN[i] & cC[i]) | (cS[i] & cNC);
-        uint64_t b1 = p1 ^ q0;
-        uint64_t r2 = p1 & q0;
-        uint64_t b2 = q1 ^ r2;
-        uint64_t b3 = q1 & r2;
+        uint64_t b3, b2, b1, b0;
+        nine_sum(sN[i], sC[i], sS[i], cN[i], cC[i], cS[i], b3, b2, b1, b0);
         uint64_t always = 0, birth = 0, survive = 0;
         for (const Need& nd : needs) {
           // Predicate plane: count == nd.n.
@@ -223,6 +232,103 @@ extern "C" void swar_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
     for (int x = 0; x < w; ++x) {
       int col = x + halo;
       dst[x] = (src[col >> 6] >> (col & 63)) & 1;
+    }
+  }
+}
+
+// WireWorld chunk: the 4-state digital-logic CA as TWO bit planes with the
+// state's binary encoding (empty=00, head=01, tail=10, conductor=11), the
+// same layout as the TPU plane kernel (ops/bitpack_gen.py).  Heads
+// (p0 & ~p1) feed the shared carry-save adders; the transition collapses to
+//
+//   next_p0 = p1                                  // tail|conductor gain p0
+//   next_p1 = (p0 ^ p1) | (p0 & p1 & ~excite)     // head|tail | calm conductor
+//
+// where `excite` is the head-count-in-birth predicate with NO +1 shift (a
+// conductor center is never a head, so it cannot self-count).  Everything
+// beyond the slab is empty (00) — the same peeling contract as swar_chunk.
+extern "C" void swar_wire_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
+                                int32_t steps, int32_t halo,
+                                uint32_t birth_mask, uint8_t* out) {
+  const int words = (pw + 63) / 64;
+  Planes p0(ph, words), p1(ph, words), n0(ph, words), n1(ph, words);
+  Planes H(ph, words), S(ph, words), C(ph, words);
+
+  for (int r = 0; r < ph; ++r) {
+    const uint8_t* src = padded + (size_t)r * pw;
+    uint64_t* d0 = p0.row(r);
+    uint64_t* d1 = p1.row(r);
+    for (int x = 0; x < pw; ++x) {
+      uint8_t v = src[x];
+      if (v & 1) d0[x >> 6] |= (uint64_t)1 << (x & 63);
+      if (v & 2) d1[x >> 6] |= (uint64_t)1 << (x & 63);
+    }
+  }
+
+  // Counts the birth mask actually tests ({1, 2} for standard wireworld).
+  std::vector<int> excite_counts;
+  for (int n = 0; n <= 9; ++n)
+    if ((birth_mask >> n) & 1) excite_counts.push_back(n);
+
+  std::vector<uint64_t> zero(words + 2, 0);
+  struct ActiveGuard {
+    ActiveGuard() { g_active_chunks.fetch_add(1, std::memory_order_relaxed); }
+    ~ActiveGuard() { g_active_chunks.fetch_sub(1, std::memory_order_relaxed); }
+  } guard;
+  const int threads = thread_count(ph, words);
+  for (int step = 0; step < steps; ++step) {
+    parallel_rows(ph, threads, [&](int r0, int r1) {
+      for (int r = r0; r < r1; ++r) {
+        const uint64_t* a = p0.row(r);
+        const uint64_t* b = p1.row(r);
+        uint64_t* hrow = H.row(r);
+        for (int i = 0; i < words; ++i) hrow[i] = a[i] & ~b[i];  // heads
+        row_triple(hrow, S.row(r), C.row(r), words);
+      }
+    });
+    parallel_rows(ph, threads, [&](int band0, int band1) {
+      for (int r = band0; r < band1; ++r) {
+        const uint64_t* sN = r > 0 ? S.row(r - 1) : zero.data() + 1;
+        const uint64_t* cN = r > 0 ? C.row(r - 1) : zero.data() + 1;
+        const uint64_t* sS = r < ph - 1 ? S.row(r + 1) : zero.data() + 1;
+        const uint64_t* cS = r < ph - 1 ? C.row(r + 1) : zero.data() + 1;
+        const uint64_t* sC = S.row(r);
+        const uint64_t* cC = C.row(r);
+        const uint64_t* a = p0.row(r);
+        const uint64_t* b = p1.row(r);
+        uint64_t* o0 = n0.row(r);
+        uint64_t* o1 = n1.row(r);
+        for (int i = 0; i < words; ++i) {
+          uint64_t b3, b2, b1, b0;
+          nine_sum(sN[i], sC[i], sS[i], cN[i], cC[i], cS[i], b3, b2, b1, b0);
+          uint64_t excite = 0;
+          for (int n : excite_counts)
+            excite |= (n & 8 ? b3 : ~b3) & (n & 4 ? b2 : ~b2) &
+                      (n & 2 ? b1 : ~b1) & (n & 1 ? b0 : ~b0);
+          o0[i] = b[i];
+          o1[i] = (a[i] ^ b[i]) | (a[i] & b[i] & ~excite);
+        }
+        // Out-of-slab columns stay empty (00) through later steps.
+        if (pw & 63) {
+          uint64_t m = ((uint64_t)1 << (pw & 63)) - 1;
+          o0[words - 1] &= m;
+          o1[words - 1] &= m;
+        }
+      }
+    });
+    std::swap(p0.data, n0.data);
+    std::swap(p1.data, n1.data);
+  }
+
+  const int h = ph - 2 * halo, w = pw - 2 * halo;
+  for (int r = 0; r < h; ++r) {
+    const uint64_t* s0 = p0.row(r + halo);
+    const uint64_t* s1 = p1.row(r + halo);
+    uint8_t* dst = out + (size_t)r * w;
+    for (int x = 0; x < w; ++x) {
+      int col = x + halo;
+      dst[x] = (uint8_t)(((s0[col >> 6] >> (col & 63)) & 1) |
+                         (((s1[col >> 6] >> (col & 63)) & 1) << 1));
     }
   }
 }

@@ -13,8 +13,8 @@ advanced by a stencil engine:
   :mod:`akka_game_of_life_tpu.parallel` — ICI inside, control plane outside);
 - ``engine="swar"``: C++ 64-cells-per-uint64 SWAR chunks
   (``native/swar_kernel.cpp``) — host machine code for binary radius-1
-  totalistic rules, falling back to the numpy chunk for everything else
-  (Generations, wireworld);
+  totalistic rules AND wireworld (its 2-bit-plane twin,
+  ``swar_wire_chunk``), falling back to the numpy chunk for Generations;
 - ``engine="actor"`` / ``"actor-native"``: the per-cell actor engine
   (:mod:`akka_game_of_life_tpu.runtime.actor_engine` and its C++ twin) —
   the reference's own architecture, swappable at role config (BASELINE
@@ -684,17 +684,27 @@ class BackendWorker:
                 if self.engine == "jax":
                     self._step_chunk = _jax_engine(rule, pallas=self.pallas)
                 elif self.engine == "swar":
-                    from akka_game_of_life_tpu.native.engine import swar_chunk_native
+                    from akka_game_of_life_tpu.native.engine import (
+                        swar_chunk_native,
+                        swar_wire_chunk_native,
+                    )
 
-                    if rule.is_binary:
+                    if rule.is_binary and rule.is_totalistic:
                         self._step_chunk = (
                             lambda padded, steps, halo: swar_chunk_native(
                                 padded, steps, halo, rule
                             )
                         )
+                    elif rule.kind == "wireworld":
+                        # The 2-bit-plane C++ twin (swar_wire_chunk).
+                        self._step_chunk = (
+                            lambda padded, steps, halo: swar_wire_chunk_native(
+                                padded, steps, halo, rule
+                            )
+                        )
                     else:
-                        # The C++ SWAR kernel is binary-only; Generations
-                        # rules fall back to the numpy chunk on this engine.
+                        # Generations rules fall back to the numpy chunk on
+                        # this engine.
                         self._step_chunk = (
                             lambda padded, steps, halo: _np_chunk(
                                 padded, steps, halo, rule

@@ -75,3 +75,41 @@ def test_swar_cluster_engine_generations_fallback():
     assert np.array_equal(
         final, dense_oracle(initial_board(cfg), "brians-brain", 12)
     )
+
+
+@pytest.mark.parametrize("shape,steps,halo", [
+    ((34, 34), 1, 1),
+    ((40, 70), 4, 4),     # width straddles a uint64 word boundary
+    ((24, 129), 3, 8),    # partial chunk, 3-word rows
+])
+def test_swar_wire_chunk_matches_numpy(shape, steps, halo):
+    from akka_game_of_life_tpu.native.engine import swar_wire_chunk_native
+
+    rng = np.random.default_rng(zlib.crc32(repr(("ww", shape)).encode()))
+    padded = rng.choice(
+        np.arange(4, dtype=np.uint8), size=shape, p=[0.4, 0.05, 0.05, 0.5]
+    )
+    want = _np_chunk(padded, steps, halo, resolve_rule("wireworld"))
+    got = swar_wire_chunk_native(padded, steps, halo, "wireworld")
+    assert np.array_equal(got, want), (shape, steps, halo)
+
+
+def test_swar_wire_chunk_rejects_non_wireworld():
+    from akka_game_of_life_tpu.native.engine import swar_wire_chunk_native
+
+    with pytest.raises(ValueError, match="wireworld"):
+        swar_wire_chunk_native(np.zeros((10, 10), np.uint8), 1, 1, "conway")
+
+
+def test_swar_cluster_engine_wireworld_matches_dense():
+    """WireWorld through the C++ plane chunk as a cluster worker engine."""
+    cfg = SimulationConfig(
+        height=24, width=24, seed=5, rule="wireworld",
+        pattern="wireworld-clock", pattern_offset=(7, 7), max_epochs=20,
+        exchange_width=4,
+    )
+    with cluster(cfg, 2, engine="swar") as h:
+        final = h.run_to_completion()
+    assert np.array_equal(
+        final, dense_oracle(initial_board(cfg), "wireworld", 20)
+    )
