@@ -65,6 +65,11 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int32, ctypes.c_int32,
         ctypes.c_uint32, u8p,
     ]
+    lib.swar_gen_chunk.argtypes = [
+        u8p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int32, u8p,
+    ]
     return lib
 
 

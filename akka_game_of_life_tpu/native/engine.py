@@ -132,6 +132,25 @@ class NativeActorTileEngine:
         return out
 
 
+
+def _native_chunk(padded, steps, halo, call):
+    """Shared body of the chunk wrappers: steps/halo contract, library
+    load, contiguity, and interior-output allocation; ``call(lib, padded,
+    ph, pw, out)`` invokes the kernel."""
+    if steps > halo:
+        raise ValueError(f"steps={steps} > halo={halo}")
+    lib = load()
+    if lib is None:
+        from akka_game_of_life_tpu.native import load_error
+
+        raise RuntimeError(f"native engine unavailable: {load_error()}")
+    padded = np.ascontiguousarray(padded, dtype=np.uint8)
+    ph, pw = padded.shape
+    out = np.empty((ph - 2 * halo, pw - 2 * halo), dtype=np.uint8)
+    call(lib, padded, ph, pw, out)
+    return out
+
+
 def swar_chunk_native(
     padded: np.ndarray, steps: int, halo: int, rule
 ) -> np.ndarray:
@@ -145,21 +164,13 @@ def swar_chunk_native(
         raise ValueError(
             "native SWAR kernel supports binary totalistic rules only"
         )
-    if steps > halo:
-        raise ValueError(f"steps={steps} > halo={halo}")
-    lib = load()
-    if lib is None:
-        from akka_game_of_life_tpu.native import load_error
-
-        raise RuntimeError(f"native engine unavailable: {load_error()}")
-    padded = np.ascontiguousarray(padded, dtype=np.uint8)
-    ph, pw = padded.shape
-    out = np.empty((ph - 2 * halo, pw - 2 * halo), dtype=np.uint8)
-    lib.swar_chunk(
-        _as_u8p(padded), ph, pw, steps, halo,
-        rule.birth_mask, rule.survive_mask, _as_u8p(out),
+    return _native_chunk(
+        padded, steps, halo,
+        lambda lib, p, ph, pw, out: lib.swar_chunk(
+            _as_u8p(p), ph, pw, steps, halo,
+            rule.birth_mask, rule.survive_mask, _as_u8p(out),
+        ),
     )
-    return out
 
 
 def swar_wire_chunk_native(
@@ -171,17 +182,31 @@ def swar_wire_chunk_native(
     rule = resolve_rule(rule)
     if rule.kind != "wireworld":
         raise ValueError(f"expected a wireworld rule, got {rule}")
-    if steps > halo:
-        raise ValueError(f"steps={steps} > halo={halo}")
-    lib = load()
-    if lib is None:
-        from akka_game_of_life_tpu.native import load_error
-
-        raise RuntimeError(f"native engine unavailable: {load_error()}")
-    padded = np.ascontiguousarray(padded, dtype=np.uint8)
-    ph, pw = padded.shape
-    out = np.empty((ph - 2 * halo, pw - 2 * halo), dtype=np.uint8)
-    lib.swar_wire_chunk(
-        _as_u8p(padded), ph, pw, steps, halo, rule.birth_mask, _as_u8p(out)
+    return _native_chunk(
+        padded, steps, halo,
+        lambda lib, p, ph, pw, out: lib.swar_wire_chunk(
+            _as_u8p(p), ph, pw, steps, halo, rule.birth_mask, _as_u8p(out)
+        ),
     )
-    return out
+
+
+def swar_gen_chunk_native(
+    padded: np.ndarray, steps: int, halo: int, rule
+) -> np.ndarray:
+    """Generations twin of :func:`swar_chunk_native`: m bit planes with
+    ripple-carry refractory decay (native/swar_kernel.cpp
+    ``swar_gen_chunk``)."""
+    rule = resolve_rule(rule)
+    # Rule() caps states at 255, so totalistic + multi-state is the whole
+    # gate.
+    if not (rule.is_totalistic and not rule.is_binary):
+        raise ValueError(
+            f"expected a multi-state Generations rule, got {rule}"
+        )
+    return _native_chunk(
+        padded, steps, halo,
+        lambda lib, p, ph, pw, out: lib.swar_gen_chunk(
+            _as_u8p(p), ph, pw, steps, halo,
+            rule.birth_mask, rule.survive_mask, rule.states, _as_u8p(out),
+        ),
+    )

@@ -124,6 +124,45 @@ inline void parallel_rows(int rows, int threads, const Fn& fn) {
   for (auto& th : pool) th.join();
 }
 
+
+// Which counts the rule actually tests, mirroring ops/bitpack.py: birth
+// tests count n directly; survive tests count n+1 (the live center is
+// inside the 9-sum); a count in BOTH sets needs no center masking.
+struct Need {
+  int n;
+  enum { ALWAYS, BIRTH, SURVIVE } kind;
+};
+
+struct NeedSet {
+  std::vector<Need> needs;
+  bool any_birth = false, any_survive = false;
+};
+
+inline NeedSet build_needs(uint32_t birth_mask, uint32_t survive_mask) {
+  NeedSet ns;
+  for (int n = 0; n <= 9; ++n) {
+    bool b = (birth_mask >> n) & 1;
+    bool s = n > 0 && ((survive_mask >> (n - 1)) & 1);
+    if (b && s)
+      ns.needs.push_back({n, Need::ALWAYS});
+    else if (b) {
+      ns.needs.push_back({n, Need::BIRTH});
+      ns.any_birth = true;
+    } else if (s) {
+      ns.needs.push_back({n, Need::SURVIVE});
+      ns.any_survive = true;
+    }
+  }
+  return ns;
+}
+
+// RAII counter of concurrent chunk callers (thread_count divides the core
+// budget by it).
+struct ActiveGuard {
+  ActiveGuard() { g_active_chunks.fetch_add(1, std::memory_order_relaxed); }
+  ~ActiveGuard() { g_active_chunks.fetch_sub(1, std::memory_order_relaxed); }
+};
+
 }  // namespace
 
 extern "C" void swar_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
@@ -152,32 +191,12 @@ extern "C" void swar_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
   // state (count n = n neighbors when dead, n-1 when alive), so those
   // predicate planes skip the x masking entirely — for Conway the combine
   // collapses to eq3 | (x & eq4), mirroring ops/bitpack.py _combine_rows.
-  struct Need {
-    int n;
-    enum { ALWAYS, BIRTH, SURVIVE } kind;
-  };
-  std::vector<Need> needs;
-  bool any_birth = false, any_survive = false;
-  for (int n = 0; n <= 9; ++n) {
-    bool b = (birth_mask >> n) & 1;
-    // Count includes the live center: survive threshold n matches count n+1.
-    bool s = n > 0 && ((survive_mask >> (n - 1)) & 1);
-    if (b && s)
-      needs.push_back({n, Need::ALWAYS});
-    else if (b) {
-      needs.push_back({n, Need::BIRTH});
-      any_birth = true;
-    } else if (s) {
-      needs.push_back({n, Need::SURVIVE});
-      any_survive = true;
-    }
-  }
+  const NeedSet ns = build_needs(birth_mask, survive_mask);
+  const std::vector<Need>& needs = ns.needs;
+  const bool any_birth = ns.any_birth, any_survive = ns.any_survive;
 
   std::vector<uint64_t> zero(words + 2, 0);
-  struct ActiveGuard {
-    ActiveGuard() { g_active_chunks.fetch_add(1, std::memory_order_relaxed); }
-    ~ActiveGuard() { g_active_chunks.fetch_sub(1, std::memory_order_relaxed); }
-  } guard;
+  ActiveGuard guard;
   const int threads = thread_count(ph, words);
   for (int step = 0; step < steps; ++step) {
     parallel_rows(ph, threads, [&](int r0, int r1) {
@@ -271,10 +290,7 @@ extern "C" void swar_wire_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
     if ((birth_mask >> n) & 1) excite_counts.push_back(n);
 
   std::vector<uint64_t> zero(words + 2, 0);
-  struct ActiveGuard {
-    ActiveGuard() { g_active_chunks.fetch_add(1, std::memory_order_relaxed); }
-    ~ActiveGuard() { g_active_chunks.fetch_sub(1, std::memory_order_relaxed); }
-  } guard;
+  ActiveGuard guard;
   const int threads = thread_count(ph, words);
   for (int step = 0; step < steps; ++step) {
     parallel_rows(ph, threads, [&](int r0, int r1) {
@@ -329,6 +345,128 @@ extern "C" void swar_wire_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
       int col = x + halo;
       dst[x] = (uint8_t)(((s0[col >> 6] >> (col & 63)) & 1) |
                          (((s1[col >> 6] >> (col & 63)) & 1) << 1));
+    }
+  }
+}
+
+// Generations chunk: m = ceil(log2(states)) bit planes, decay semantics as
+// in ops/bitpack_gen.py — dead -> 1 on birth-hit; alive -> 1 on
+// survive-hit else state+1; refractory -> state+1 wrapping S-1 -> 0.  The
+// counted plane is state==1; survive thresholds shift by +1 (the live
+// center is inside the 9-sum).  Beyond-slab cells stay dead (00..0), the
+// same peeling contract as the other chunks.
+extern "C" void swar_gen_chunk(const uint8_t* padded, int32_t ph, int32_t pw,
+                               int32_t steps, int32_t halo,
+                               uint32_t birth_mask, uint32_t survive_mask,
+                               int32_t states, uint8_t* out) {
+  const int words = (pw + 63) / 64;
+  int m = 1;
+  while ((1 << m) < states) ++m;
+  std::vector<Planes> cur, nxt;
+  for (int k = 0; k < m; ++k) {
+    cur.emplace_back(ph, words);
+    nxt.emplace_back(ph, words);
+  }
+  Planes A(ph, words), S(ph, words), C(ph, words);
+
+  for (int r = 0; r < ph; ++r) {
+    const uint8_t* src = padded + (size_t)r * pw;
+    for (int k = 0; k < m; ++k) {
+      uint64_t* dst = cur[k].row(r);
+      for (int x = 0; x < pw; ++x)
+        if ((src[x] >> k) & 1) dst[x >> 6] |= (uint64_t)1 << (x & 63);
+    }
+  }
+
+  const NeedSet ns = build_needs(birth_mask, survive_mask);
+  const std::vector<Need>& needs = ns.needs;
+  const bool any_birth = ns.any_birth, any_survive = ns.any_survive;
+  const uint32_t last = (uint32_t)states - 1;  // the wrapping state
+
+  std::vector<uint64_t> zero(words + 2, 0);
+  ActiveGuard guard;
+  const int threads = thread_count(ph, words);
+  for (int step = 0; step < steps; ++step) {
+    parallel_rows(ph, threads, [&](int r0, int r1) {
+      for (int r = r0; r < r1; ++r) {
+        uint64_t* arow = A.row(r);
+        // alive = state == 1 = p0 & ~p1 & ... & ~p_{m-1}
+        const uint64_t* q0 = cur[0].row(r);
+        for (int i = 0; i < words; ++i) arow[i] = q0[i];
+        for (int k = 1; k < m; ++k) {
+          const uint64_t* qk = cur[k].row(r);
+          for (int i = 0; i < words; ++i) arow[i] &= ~qk[i];
+        }
+        row_triple(arow, S.row(r), C.row(r), words);
+      }
+    });
+    parallel_rows(ph, threads, [&](int band0, int band1) {
+      for (int r = band0; r < band1; ++r) {
+        const uint64_t* sN = r > 0 ? S.row(r - 1) : zero.data() + 1;
+        const uint64_t* cN = r > 0 ? C.row(r - 1) : zero.data() + 1;
+        const uint64_t* sS = r < ph - 1 ? S.row(r + 1) : zero.data() + 1;
+        const uint64_t* cS = r < ph - 1 ? C.row(r + 1) : zero.data() + 1;
+        const uint64_t* sC = S.row(r);
+        const uint64_t* cC = C.row(r);
+        const uint64_t* alive = A.row(r);
+        for (int i = 0; i < words; ++i) {
+          uint64_t b3, b2, b1, b0;
+          nine_sum(sN[i], sC[i], sS[i], cN[i], cC[i], cS[i], b3, b2, b1, b0);
+          uint64_t always = 0, birth = 0, survive = 0;
+          for (const Need& nd : needs) {
+            uint64_t t = (nd.n & 8 ? b3 : ~b3) & (nd.n & 4 ? b2 : ~b2) &
+                         (nd.n & 2 ? b1 : ~b1) & (nd.n & 1 ? b0 : ~b0);
+            if (nd.kind == Need::ALWAYS)
+              always |= t;
+            else if (nd.kind == Need::BIRTH)
+              birth |= t;
+            else
+              survive |= t;
+          }
+          uint64_t dead = ~(uint64_t)0, wrap = ~(uint64_t)0;
+          uint64_t p[8], inc[8];
+          for (int k = 0; k < m; ++k) {
+            p[k] = cur[k].row(r)[i];
+            dead &= ~p[k];
+            wrap &= ((last >> k) & 1) ? p[k] : ~p[k];
+          }
+          // state+1 over the planes (ripple carry; the wrap mask zeroes
+          // the only state that can overflow).
+          uint64_t carry = 0;
+          for (int k = 0; k < m; ++k) {
+            inc[k] = k == 0 ? ~p[0] : p[k] ^ carry;
+            carry = k == 0 ? p[0] : (p[k] & carry);
+          }
+          uint64_t to_one = always;
+          if (any_birth) to_one |= dead & birth;
+          if (any_survive) to_one |= alive[i] & survive;
+          // ALWAYS counts still require a live-or-dead center (refractory
+          // cells neither survive nor give birth).
+          to_one &= dead | alive[i];
+          uint64_t advance = ~dead & ~to_one & ~wrap;
+          for (int k = 0; k < m; ++k)
+            nxt[k].row(r)[i] = (k == 0 ? to_one : 0) | (advance & inc[k]);
+        }
+        // Out-of-slab columns stay dead through later steps.
+        if (pw & 63) {
+          uint64_t mask = ((uint64_t)1 << (pw & 63)) - 1;
+          for (int k = 0; k < m; ++k) nxt[k].row(r)[words - 1] &= mask;
+        }
+      }
+    });
+    for (int k = 0; k < m; ++k) std::swap(cur[k].data, nxt[k].data);
+  }
+
+  const int h = ph - 2 * halo, w = pw - 2 * halo;
+  for (int r = 0; r < h; ++r) {
+    uint8_t* dst = out + (size_t)r * w;
+    for (int x = 0; x < w; ++x) dst[x] = 0;
+    for (int k = 0; k < m; ++k) {
+      const uint64_t* src = cur[k].row(r + halo);
+      for (int x = 0; x < w; ++x) {
+        int col = x + halo;
+        dst[x] |= (uint8_t)(((src[col >> 6] >> (col & 63)) & 1) << k);
+      }
     }
   }
 }

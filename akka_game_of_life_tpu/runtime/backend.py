@@ -12,9 +12,10 @@ advanced by a stencil engine:
   TPU path; within a multi-device worker the tile itself is mesh-sharded by
   :mod:`akka_game_of_life_tpu.parallel` — ICI inside, control plane outside);
 - ``engine="swar"``: C++ 64-cells-per-uint64 SWAR chunks
-  (``native/swar_kernel.cpp``) — host machine code for binary radius-1
-  totalistic rules AND wireworld (its 2-bit-plane twin,
-  ``swar_wire_chunk``), falling back to the numpy chunk for Generations;
+  (``native/swar_kernel.cpp``) — host machine code for every radius-1
+  family: binary totalistic (``swar_chunk``), wireworld (2-bit-plane
+  ``swar_wire_chunk``), and Generations (m-plane ripple-carry
+  ``swar_gen_chunk``); only radius-R LtL falls back to the numpy chunk;
 - ``engine="actor"`` / ``"actor-native"``: the per-cell actor engine
   (:mod:`akka_game_of_life_tpu.runtime.actor_engine` and its C++ twin) —
   the reference's own architecture, swappable at role config (BASELINE
@@ -686,6 +687,7 @@ class BackendWorker:
                 elif self.engine == "swar":
                     from akka_game_of_life_tpu.native.engine import (
                         swar_chunk_native,
+                        swar_gen_chunk_native,
                         swar_wire_chunk_native,
                     )
 
@@ -702,9 +704,15 @@ class BackendWorker:
                                 padded, steps, halo, rule
                             )
                         )
+                    elif rule.is_totalistic:
+                        # Generations: the m-plane C++ twin (swar_gen_chunk;
+                        # Rule() caps states at 255, so no extra gate).
+                        self._step_chunk = (
+                            lambda padded, steps, halo: swar_gen_chunk_native(
+                                padded, steps, halo, rule
+                            )
+                        )
                     else:
-                        # Generations rules fall back to the numpy chunk on
-                        # this engine.
                         self._step_chunk = (
                             lambda padded, steps, halo: _np_chunk(
                                 padded, steps, halo, rule

@@ -64,8 +64,8 @@ def test_swar_cluster_engine_matches_dense():
     assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 24))
 
 
-def test_swar_cluster_engine_generations_fallback():
-    """Multi-state rules on the swar engine fall back to the numpy chunk."""
+def test_swar_cluster_engine_generations_native():
+    """Multi-state rules on the swar engine run the native m-plane chunk."""
     cfg = SimulationConfig(
         height=24, width=24, seed=9, rule="brians-brain", max_epochs=12,
         exchange_width=3,
@@ -113,3 +113,30 @@ def test_swar_cluster_engine_wireworld_matches_dense():
     assert np.array_equal(
         final, dense_oracle(initial_board(cfg), "wireworld", 20)
     )
+
+
+@pytest.mark.parametrize("rule", ["brians-brain", "star-wars", "B2/S/7", "B3/S23/5"])
+@pytest.mark.parametrize("shape,steps,halo", [
+    ((40, 70), 4, 4),     # width straddles a uint64 word boundary
+    ((24, 129), 3, 8),    # partial chunk, 3-word rows
+])
+def test_swar_gen_chunk_matches_numpy(rule, shape, steps, halo):
+    from akka_game_of_life_tpu.native.engine import swar_gen_chunk_native
+    from akka_game_of_life_tpu.ops.rules import parse_rule
+
+    r = resolve_rule(rule) if not rule.startswith("B") else parse_rule(rule)
+    rng = np.random.default_rng(zlib.crc32(repr((rule, shape)).encode()))
+    padded = rng.integers(0, r.states, size=shape, dtype=np.uint8)
+    want = _np_chunk(padded, steps, halo, r)
+    got = swar_gen_chunk_native(padded, steps, halo, r)
+    assert np.array_equal(got, want), (rule, shape, steps, halo)
+
+
+def test_swar_gen_chunk_rejects_binary_and_wireworld():
+    from akka_game_of_life_tpu.native.engine import swar_gen_chunk_native
+
+    z = np.zeros((10, 10), np.uint8)
+    with pytest.raises(ValueError, match="Generations"):
+        swar_gen_chunk_native(z, 1, 1, "conway")
+    with pytest.raises(ValueError, match="Generations"):
+        swar_gen_chunk_native(z, 1, 1, "wireworld")
