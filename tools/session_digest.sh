@@ -1,0 +1,39 @@
+#!/bin/bash
+# Digest a tpu_session output directory into the handful of numbers the
+# round's docs need (BASELINE.md round-4 section, KERNELS.md measured
+# table, MEASURED_BLOCK_ROWS_CAPS).  Usage:
+#
+#   bash tools/session_digest.sh /tmp/tpu_session_r4
+set -u
+D="${1:?usage: session_digest.sh <session-dir>}"
+
+section() { echo; echo "== $1"; }
+
+section "stage results"
+grep "rc=" "$D/session.log" 2>/dev/null
+
+section "tpu-tests tail"
+tail -3 "$D/tpu-tests.log" 2>/dev/null
+
+section "bench-full: every value line"
+grep '"value"' "$D/bench-full.log" 2>/dev/null
+
+section "bench-sharded (dus-carry A/B vs round-3's 1.32e12)"
+grep '"value"' "$D/bench-sharded.log" 2>/dev/null
+
+section "tune winners"
+for f in "$D"/tune-*.log; do
+  [ -f "$f" ] || continue
+  echo "-- $(basename "$f")"
+  grep '^best:' "$f" 2>/dev/null
+  grep '"cells_per_sec"' "$f" 2>/dev/null | head -3
+done
+
+section "selftest"
+grep '"check"' "$D/selftest.log" 2>/dev/null
+
+section "product-run (k=8-aligned): metrics w/ obs breakdown + summary"
+grep -E "ms/epoch|run summary|window" "$D/product-run.log" 2>/dev/null | tail -40
+
+section "product-run-60 (round-3 config verbatim)"
+grep -E "ms/epoch|run summary|window" "$D/product-run-60.log" 2>/dev/null | tail -12
