@@ -62,16 +62,23 @@ stage bench-full 2400 python bench.py --probe-retry-window 300
 
 stage bench-sharded 1200 python bench_suite.py --config 5
 
+# Mid-scale points are dispatch-dominated through the tunnel unless each
+# timed call amortizes it (r3b: the XLA bitpack line measured 3.5x SLOWER
+# at 8192^2 than at 65536^2 purely from per-call overhead at ~3 ms of
+# compute/call) — hence deep steps-per-call and extra timed calls below.
 stage tune-65536 1800 python -m akka_game_of_life_tpu tune --size 65536
-stage tune-8192 1200 python -m akka_game_of_life_tpu tune --size 8192 \
-  --blocks 32,64,128,192,256,512 --sweeps 4,8,16
+stage tune-8192 1500 python -m akka_game_of_life_tpu tune --size 8192 \
+  --steps-per-call 1024 --timed-calls 4 --blocks 32,64,128,192,256,512 \
+  --sweeps 4,8,16
 # The gen plane sweep's (b, k) space at 8192^2 — the data behind the
 # pallas-vs-plane-scan decision in KERNELS.md (VERDICT #7).
-stage tune-gen-8192 1200 python -m akka_game_of_life_tpu tune --size 8192 \
-  --rule brians-brain --steps-per-call 32 --blocks 32,64,128,256 --sweeps 4,8,16
+stage tune-gen-8192 1500 python -m akka_game_of_life_tpu tune --size 8192 \
+  --rule brians-brain --steps-per-call 128 --timed-calls 4 \
+  --blocks 32,64,128,256 --sweeps 4,8,16
 # The LtL VMEM kernel's block space (k collapses to 1; radius-5 Bugs).
 stage tune-ltl-8192 1200 python -m akka_game_of_life_tpu tune --size 8192 \
-  --rule bugs --steps-per-call 16 --blocks 64,128,256,512 --sweeps 1
+  --rule bugs --steps-per-call 64 --timed-calls 2 --blocks 64,128,256,512 \
+  --sweeps 1
 
 # Product selftest on the real chip: kernel=auto resolves to pallas, so
 # gun phase / oracle / checkpoint / chaos all exercise the Mosaic kernel.
