@@ -101,6 +101,17 @@ stage product-run 3600 python -m akka_game_of_life_tpu run \
   --render-every 960 --metrics-every 64 \
   --checkpoint-dir "$CKPT" --checkpoint-every 960
 
+# Same config with observation every 4 chunks: chunks between cadence
+# points dispatch back-to-back without a sync, so this bounds how much of
+# the per-chunk cost is the tunnel round-trip vs the stepper itself.
+CKPT3="$OUT/ckpt65536c"
+rm -rf "$CKPT3"
+stage product-run-sparse-obs 3600 python -m akka_game_of_life_tpu run \
+  --height 65536 --width 65536 --max-epochs 1920 --steps-per-call 64 \
+  --pattern gosper-glider-gun --probe-window 2:11,2:38 \
+  --render-every 960 --metrics-every 256 \
+  --checkpoint-dir "$CKPT3" --checkpoint-every 960
+
 # Round-3 config verbatim for the direct A/B (steps-per-call 60 -> k=6).
 CKPT2="$OUT/ckpt65536b"
 rm -rf "$CKPT2"
