@@ -19,7 +19,12 @@ from akka_game_of_life_tpu.parallel.packed_halo import (  # noqa: F401
 )
 from akka_game_of_life_tpu.parallel.packed_halo2d import (  # noqa: F401
     shard_packed2d,
+    sharded_gen_step_fn,
     sharded_packed2d_step_fn,
     word_halo_width,
+)
+from akka_game_of_life_tpu.parallel.pallas_halo import (  # noqa: F401
+    sharded_gen_pallas_step_fn,
+    sharded_pallas_step_fn,
 )
 from akka_game_of_life_tpu.parallel import distributed  # noqa: F401
