@@ -144,6 +144,57 @@ def probe_device(
     return f"{reason} (after {attempt} attempts over {elapsed:.0f}s)"
 
 
+def _freshest_archived_headline() -> dict | None:
+    """The newest 65536² torus headline line with a real value from the
+    in-repo hardware archives (``artifacts/`` session logs), tagged with where it
+    came from.  Used ONLY to enrich a probe-failure record: when the tunnel
+    is wedged at driver bench time (the round-3 failure mode — BASELINE.md
+    documents 10-hour wedges), the official artifact still points at the
+    freshest number this code actually measured on the chip, machine-
+    readably, while ``value`` stays honestly null."""
+    import pathlib
+
+    try:
+        root = pathlib.Path(__file__).resolve().parent / "artifacts"
+        best: tuple[float, dict, str] | None = None
+        for log in root.glob("*/*.log"):
+            try:
+                mtime = log.stat().st_mtime
+                if best is not None and mtime <= best[0]:
+                    continue
+                text = log.read_text(errors="replace")
+            except OSError:
+                continue
+            for line in text.splitlines():
+                if '"value"' not in line or "65536x65536 torus" not in line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("value") and rec.get("metric") and "config" not in rec:
+                    best = (mtime, rec, str(log.relative_to(root.parent)))
+        if best is None:
+            return None
+        mtime, rec, src = best
+        return {
+            "metric": rec["metric"],
+            "value": rec["value"],
+            "unit": rec.get("unit"),
+            "vs_baseline": rec.get("vs_baseline"),
+            "source": src,
+            # File mtime, not the measurement instant (a re-clone would reset
+            # it); the session log named in "source" carries the real
+            # timestamps.
+            "source_mtime": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime)
+            ),
+        }
+    except Exception:  # noqa: BLE001 — enrichment must never break the
+        # structured failure record it decorates (the record IS the artifact).
+        return None
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--size", type=int, default=65536)
@@ -246,6 +297,12 @@ def main() -> None:
                         "unit": "cell-updates/sec",
                         "vs_baseline": None,
                         "error": failure,
+                        # The freshest number this code measured on the real
+                        # chip, from the in-repo session archives — so an
+                        # outage at bench time cannot erase the hardware
+                        # record from the official artifact.  value above
+                        # stays null: this run measured nothing.
+                        "last_measured": _freshest_archived_headline(),
                         # When an outage or probe failure eats the artifact
                         # run, the repo's hardware record still exists —
                         # point the reader at the living documents rather

@@ -1,0 +1,16 @@
+"""The canonical device-aliveness probe: one tiny matmul with a host
+fetch (block_until_ready does not block on the axon platform), reporting
+backend + device count.  Shared by tools/tpu_session.sh and
+tools/tpu_opportunist.sh so the probe cannot drift between scripts
+(bench.py keeps its own inline copy because it must ship self-contained
+for the driver).  Exit 0 = alive.  Callers MUST wrap in a hard timeout
+(`timeout -k 30 120 python tools/probe.py`): a wedged tunnel hangs here
+forever by design — that hang, killed by the caller, IS the signal.
+"""
+
+import jax
+import jax.numpy as jnp
+
+x = jnp.ones((256, 256), jnp.float32)
+assert float((x @ x)[0, 0]) == 256.0
+print("probe-ok", jax.default_backend(), jax.device_count(), flush=True)

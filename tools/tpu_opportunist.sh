@@ -34,13 +34,10 @@ MAX_TRIES=3
 
 log() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$OUT/session.log"; }
 
+# -k 30: SIGTERM at the deadline, SIGKILL 30s later — a child wedged in
+# uninterruptible tunnel I/O must not hang the loop (the whole point).
 probe_ok() {
-  timeout 120 python -c "
-import jax, jax.numpy as jnp
-x = jnp.ones((256,256), jnp.float32)
-assert float((x@x)[0,0]) == 256.0
-print('probe-ok')
-" >> "$OUT/probe.log" 2>&1
+  timeout -k 30 120 python tools/probe.py >> "$OUT/probe.log" 2>&1
 }
 
 # stage <name> <timeout_s> <cmd...>
@@ -49,7 +46,7 @@ print('probe-ok')
 run_stage() {
   local name="$1" t="$2"; shift 2
   log "stage $name start (timeout ${t}s)"
-  timeout "$t" "$@" >> "$OUT/$name.log" 2>&1
+  timeout -k 30 "$t" "$@" >> "$OUT/$name.log" 2>&1
   local rc=$?
   log "stage $name rc=$rc"
   if [ "$rc" -eq 0 ]; then

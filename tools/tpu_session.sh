@@ -40,18 +40,14 @@ mkdir -p "$OUT"
 stage() {  # stage <name> <timeout_s> <cmd...>
   local name="$1" t="$2"; shift 2
   echo "== $name (timeout ${t}s) $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
-  timeout "$t" "$@" > "$OUT/$name.log" 2>&1
+  timeout -k 30 "$t" "$@" > "$OUT/$name.log" 2>&1
   local rc=$?
   echo "== $name rc=$rc $(date -u +%H:%M:%S)" | tee -a "$OUT/session.log"
   return $rc
 }
 
-stage probe 180 python -c "
-import jax, jax.numpy as jnp
-x = jnp.ones((256,256), jnp.float32)
-assert float((x@x)[0,0]) == 256.0
-print('probe-ok', jax.default_backend(), jax.device_count())
-" || { echo 'tunnel wedged — aborting' | tee -a "$OUT/session.log"; exit 1; }
+stage probe 180 python tools/probe.py \
+  || { echo 'tunnel wedged — aborting' | tee -a "$OUT/session.log"; exit 1; }
 
 stage tpu-tests 1800 env GOL_TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.py -v
 
