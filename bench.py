@@ -156,11 +156,15 @@ def _freshest_archived_headline() -> dict | None:
 
     try:
         root = pathlib.Path(__file__).resolve().parent / "artifacts"
-        best: tuple[float, dict, str] | None = None
-        for log in root.glob("*/*.log"):
+        # Key = (mtime, path): after a fresh clone every log shares the
+        # checkout mtime, so the path (session dirs sort r3 < r4 < ...)
+        # breaks ties deterministically toward the newest session.
+        best: tuple[tuple[float, str], dict, str] | None = None
+        for log in sorted(root.glob("*/*.log")):
             try:
                 mtime = log.stat().st_mtime
-                if best is not None and mtime <= best[0]:
+                src = str(log.relative_to(root.parent))
+                if best is not None and (mtime, src) <= best[0]:
                     continue
                 text = log.read_text(errors="replace")
             except OSError:
@@ -173,10 +177,10 @@ def _freshest_archived_headline() -> dict | None:
                 except ValueError:
                     continue
                 if rec.get("value") and rec.get("metric") and "config" not in rec:
-                    best = (mtime, rec, str(log.relative_to(root.parent)))
+                    best = ((mtime, src), rec, src)
         if best is None:
             return None
-        mtime, rec, src = best
+        (mtime, _), rec, src = best
         return {
             "metric": rec["metric"],
             "value": rec["value"],

@@ -1,0 +1,66 @@
+"""The official bench artifact's failure-record contract.
+
+``BENCH_r{N}.json`` is the driver-recorded scoreboard: round 1 lost its
+artifact to a hang, round 3 to a single-shot probe timeout during a
+tunnel outage (VERDICT.md round-3 weak #1).  These tests pin the two
+guarantees bench.py now makes: a probe failure still emits one parseable
+JSON record, and that record carries ``last_measured`` — the freshest
+real number from the in-repo hardware archives — so an outage at bench
+time cannot erase the hardware record from the official artifact.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+def test_freshest_archived_headline_finds_the_hardware_record():
+    rec = bench._freshest_archived_headline()
+    assert rec is not None, "artifacts/ session logs should contain a headline"
+    # The archived record is the round-3+ Pallas measurement class: north
+    # of 1e12 cell-updates/s/chip at 65536^2 (BASELINE.md sweep table).
+    assert rec["value"] > 1.0e12
+    assert "65536x65536 torus" in rec["metric"]
+    assert rec["source"].startswith("artifacts/")
+    assert (REPO / rec["source"]).is_file()
+
+
+def test_probe_failure_still_emits_structured_record_with_last_measured():
+    # A bogus platform is a deterministic probe failure: bench must exit
+    # nonzero yet print exactly one parseable JSON record (never a raw
+    # traceback — the round-1 artifact failure mode), enriched with the
+    # archived headline.
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "bench.py",
+            "--headline-only",
+            "--platform",
+            "bogus-backend",
+            "--probe-timeout",
+            "60",
+            "--probe-attempts",
+            "1",
+            "--probe-retry-window",
+            "0",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["value"] is None
+    assert "probe" in rec["error"]
+    last = rec["last_measured"]
+    assert last is not None and last["value"] > 1.0e12
+    assert (REPO / last["source"]).is_file()

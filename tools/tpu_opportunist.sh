@@ -51,7 +51,9 @@ run_stage() {
   log "stage $name rc=$rc"
   if [ "$rc" -eq 0 ]; then
     touch "$OUT/done/$name"
-  elif [ "$rc" -ne 124 ]; then
+  elif [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then
+    # 124 = timeout SIGTERM, 137 = timeout's -k SIGKILL after a SIGTERM-
+    # immune wedge: both are tunnel hangs, retried forever by design.
     # Non-timeout failure: could still be tunnel-wedge-at-init (which
     # fails fast on axon sometimes) — allow MAX_TRIES before giving up.
     local n=0
