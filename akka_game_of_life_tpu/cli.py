@@ -36,6 +36,12 @@ def _apply_platform(platform: Optional[str]) -> None:
         import jax
 
         jax.config.update("jax_platforms", platform)
+    # Every subcommand funnels through here before first backend init —
+    # the one spot to arm the persistent compile cache (tunnel compiles
+    # cost 20-40 s; re-runs of a seen program load from disk instead).
+    from akka_game_of_life_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
 
 
 def _add_platform(p: argparse.ArgumentParser) -> None:

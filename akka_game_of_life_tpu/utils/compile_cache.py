@@ -1,0 +1,55 @@
+"""Persistent XLA compilation cache for every entry point.
+
+First jit compile of the 65536² kernels costs 20-40 s through the axon
+tunnel — often the dominant cost of a short measurement window on this
+image, where the tunnel serves ~10-minute alive windows between
+multi-hour wedges (artifacts/tpu_session_r4/OUTAGE.md).  JAX's
+persistent cache turns every re-compile of an already-seen program into
+a disk load, across processes, so repeat runs (bench re-runs, tune
+sweeps revisiting a config, product restarts from checkpoints) skip the
+tunnel compile entirely.
+
+Enabled by every CLI subcommand and bench entry point; the reference has
+no analog (JVM actors have no compile step — parity-neutral, pure
+operational win).  Failure-proof by construction: a PJRT plugin without
+executable (de)serialization support degrades to JAX's own warning and
+a normal compile, and any error enabling the cache is swallowed — a
+broken cache must never break a run.
+
+``GOL_COMPILE_CACHE=0`` disables; ``GOL_COMPILE_CACHE_DIR`` overrides
+the default repo-local ``.jax_cache`` directory (git-ignored).
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache",
+)
+
+
+def enable_compile_cache() -> str | None:
+    """Turn on JAX's persistent compilation cache; returns the cache dir
+    actually enabled, or None if disabled/unavailable."""
+    if os.environ.get("GOL_COMPILE_CACHE", "1").strip().lower() in (
+        "0",
+        "false",
+        "off",
+        "no",
+    ):
+        return None
+    cache_dir = os.environ.get("GOL_COMPILE_CACHE_DIR", _DEFAULT_DIR)
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache every compile that costs >= 1 s: the tunnel compiles we
+        # care about cost tens of seconds; sub-second host compiles are
+        # not worth the disk churn.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        return cache_dir
+    except Exception:  # noqa: BLE001 — cache is an optimization, never a failure
+        return None

@@ -326,8 +326,12 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
+    # The CLI's funnel: pins --platform (with the GOL_PLATFORM fallback)
+    # and arms the persistent compile cache, so re-runs of an already-seen
+    # program skip the 20-40 s tunnel compile.
+    from akka_game_of_life_tpu.cli import _apply_platform
+
+    _apply_platform(args.platform)
 
     from akka_game_of_life_tpu.models import get_model
     from akka_game_of_life_tpu.ops import bitpack
