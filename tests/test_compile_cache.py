@@ -1,0 +1,60 @@
+"""The persistent-compile-cache switch (utils/compile_cache.py).
+
+The cache is armed by every entry point and must be failure-proof: a
+broken cache dir or a disable flag must never break a run.  These tests
+pin the env contract; the cache's actual hit behavior is JAX's own.
+"""
+
+import os
+
+from akka_game_of_life_tpu.utils.compile_cache import enable_compile_cache
+
+
+def _with_env(monkeypatch, **env):
+    for k, v in env.items():
+        if v is None:
+            monkeypatch.delenv(k, raising=False)
+        else:
+            monkeypatch.setenv(k, v)
+
+
+def test_disable_flag_spellings(monkeypatch, tmp_path):
+    for spelling in ("0", "false", "OFF", " no "):
+        _with_env(
+            monkeypatch,
+            GOL_COMPILE_CACHE=spelling,
+            GOL_COMPILE_CACHE_DIR=str(tmp_path / "never"),
+        )
+        assert enable_compile_cache() is None
+    assert not (tmp_path / "never").exists()
+
+
+def test_dir_override_created_and_configured(monkeypatch, tmp_path):
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    target = tmp_path / "cache"
+    _with_env(
+        monkeypatch, GOL_COMPILE_CACHE=None, GOL_COMPILE_CACHE_DIR=str(target)
+    )
+    try:
+        assert enable_compile_cache() == str(target)
+        assert target.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+    finally:
+        # The config is process-global; don't leave the suite writing its
+        # compiles into this test's tmp dir.
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_unwritable_dir_is_swallowed(monkeypatch, tmp_path):
+    # A path that cannot be created (parent is a file) must yield None,
+    # not an exception — the cache is an optimization, never a failure.
+    parent = tmp_path / "blocker"
+    parent.write_text("")
+    _with_env(
+        monkeypatch,
+        GOL_COMPILE_CACHE=None,
+        GOL_COMPILE_CACHE_DIR=str(parent / "sub"),
+    )
+    assert enable_compile_cache() is None
