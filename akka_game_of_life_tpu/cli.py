@@ -108,6 +108,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--render-max-cells", type=int)
     p.add_argument("--metrics-every", type=int)
+    p.add_argument(
+        "--obs-defer",
+        action="store_true",
+        default=None,
+        help="dispatch cadence observations on device and fetch them one "
+        "chunk later, under the next chunk's compute — removes the host "
+        "round-trip from the critical path (observer lines for a cadence "
+        "point appear one chunk late; values are identical)",
+    )
     p.add_argument("--log-file")
     p.add_argument("--inject-faults", action="store_true", default=None)
     p.add_argument(
@@ -168,6 +177,7 @@ def _overrides(args: argparse.Namespace) -> dict:
         "render_max_cells": args.render_max_cells,
         "probe_window": _parse_window(args.probe_window),
         "metrics_every": args.metrics_every,
+        "obs_defer": args.obs_defer,
         "log_file": args.log_file,
         "distributed": args.distributed,
         "coordinator_address": args.coordinator,

@@ -207,6 +207,14 @@ class SimulationConfig:
     probe_window: Optional[Tuple[int, int, int, int]] = None
     log_file: Optional[str] = None  # reference renders to info.log
     metrics_every: int = 0
+    # Deferred observation: cadence points dispatch their device-side
+    # observation (population / render sample / probe window) and return
+    # without any host fetch; the tiny results are fetched one chunk later,
+    # while the device is busy on the next stepper chunk — so the host
+    # round-trip (the dominant per-chunk cost over a slow device tunnel)
+    # leaves the critical path.  Observer lines for a cadence point are
+    # emitted one chunk late; values and totals are identical to sync mode.
+    obs_defer: bool = False
 
     fault_injection: FaultInjectionConfig = dataclasses.field(
         default_factory=FaultInjectionConfig

@@ -193,6 +193,11 @@ class Simulation:
         self.crash_log: list[int] = []  # epochs at which injected crashes hit
 
         self.epoch = 0
+        # obs_defer mode: observation records dispatched but not yet fetched
+        # (resolved one chunk later, overlapped with the next stepper chunk).
+        # Initialized before the actor-backend early return: advance()'s
+        # resolve hook runs on every backend (a no-op when nothing defers).
+        self._pending_obs: list = []
 
         self._actor_board = None
         self._actor_board_cls = None
@@ -731,37 +736,58 @@ class Simulation:
         # otherwise observe nothing — no metrics line, no run summary).
         self.observer.start_clock(self.epoch)
         next_tick = time.monotonic()
-        while self.epoch < target:
-            if cfg.tick_s > 0:
-                now = time.monotonic()
-                if now < next_tick:
-                    time.sleep(next_tick - now)
-                next_tick = max(next_tick + cfg.tick_s, now)
+        try:
+            while self.epoch < target:
+                if cfg.tick_s > 0:
+                    now = time.monotonic()
+                    if now < next_tick:
+                        time.sleep(next_tick - now)
+                    next_tick = max(next_tick + cfg.tick_s, now)
 
-            if self.injector is not None and (
-                self.injector.should_crash()
-                or self.injector.should_crash_at_epoch(self.epoch)
-            ):
-                self._crash_and_recover()
+                if self.injector is not None and (
+                    self.injector.should_crash()
+                    or self.injector.should_crash_at_epoch(self.epoch)
+                ):
+                    self._crash_and_recover()
 
-            chunk = min(cfg.steps_per_call, target - self.epoch)
-            prev = self.epoch
-            with profiling.annotate_epochs("advance_chunk", self.epoch):
-                new_board = self._stepper(chunk)(self.board)
-            with _shield_sigint():
-                # Atomic wrt ^C: an interrupt-checkpoint must never see a
-                # stepped board still labeled with the previous epoch.
-                self.board = new_board
-                self.epoch += chunk
+                chunk = min(cfg.steps_per_call, target - self.epoch)
+                prev = self.epoch
+                with profiling.annotate_epochs("advance_chunk", self.epoch):
+                    new_board = self._stepper(chunk)(self.board)
+                with _shield_sigint():
+                    # Atomic wrt ^C: an interrupt-checkpoint must never see a
+                    # stepped board still labeled with the previous epoch.
+                    self.board = new_board
+                    self.epoch += chunk
+                # Resolve deferred observations from EARLIER cadence points
+                # now, while the device is busy executing the chunk just
+                # dispatched above — the host fetch round-trip rides under
+                # device compute instead of serializing with it.
+                self._obs_resolve()
 
-            if _crosses(prev, self.epoch, cfg.render_every) or _crosses(
-                prev, self.epoch, cfg.metrics_every
-            ):
-                self._observe(render=_crosses(prev, self.epoch, cfg.render_every))
-            if self.store is not None and _crosses(
-                prev, self.epoch, cfg.checkpoint_every
-            ):
-                self.checkpoint()
+                if _crosses(prev, self.epoch, cfg.render_every) or _crosses(
+                    prev, self.epoch, cfg.metrics_every
+                ):
+                    self._observe(
+                        render=_crosses(prev, self.epoch, cfg.render_every)
+                    )
+                if self.store is not None and _crosses(
+                    prev, self.epoch, cfg.checkpoint_every
+                ):
+                    self.checkpoint()
+        except BaseException:
+            # Best-effort flush on the way out, suppressed: a fetch against
+            # a poisoned device (the likely state when a stepper chunk just
+            # raised) must not replace the real exception — nor swallow a
+            # KeyboardInterrupt heading for the interrupt-checkpoint path.
+            try:
+                self._obs_resolve()
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        # A cadence crossing on the final chunk has no next chunk to ride
+        # under; flush it now (errors here are real and propagate).
+        self._obs_resolve()
         return self.epoch
 
     # -- observation (device-side: nothing here is O(board) on host) ---------
@@ -819,9 +845,11 @@ class Simulation:
                         self.config.probe_window,
                     )
             return
-        cfg = self.config
-        from akka_game_of_life_tpu.runtime.render import sample_strides
-
+        if self.config.obs_defer:
+            # Dispatch-only: the tiny device results are fetched by
+            # _obs_resolve one chunk later, under the next chunk's compute.
+            self._pending_obs.append(self._obs_dispatch(render))
+            return
         # Sync the stepper chain before starting the observation clock: the
         # stepper dispatch is async (and on the axon platform
         # block_until_ready does not actually block), so without this the
@@ -834,7 +862,17 @@ class Simulation:
         # Single-element index, never ravel(): an eager ravel materializes a
         # full flattened copy of the shard before the scalar is taken.
         np.asarray(jax.device_get(probe[(0,) * probe.ndim]))
-        obs_t0 = time.perf_counter()
+        obs_t0 = time.perf_counter()  # BEFORE dispatch: obs ms = dispatch+fetch
+        self._obs_emit(self._obs_dispatch(render), obs_t0)
+
+    def _obs_dispatch(self, render: bool) -> dict:
+        """Dispatch the cadence observation on device and return a record of
+        un-fetched handles: population chunk-sums (always), the strided
+        render sample (at render cadence), and the exact-cell probe window.
+        Nothing here touches the host."""
+        cfg = self.config
+        from akka_game_of_life_tpu.runtime.render import sample_strides
+
         if self._gen:
             m = bitpack_gen.n_planes(self.rule.states)
 
@@ -860,11 +898,15 @@ class Simulation:
                 rows = jnp.pad(rows, (0, pad))
             return jnp.sum(rows.reshape(n_chunks, -1), axis=1)
 
-        chunk_pops = self._obs_fn("pop", pop_core)(self.board)
-        population = int(np.asarray(dist.fetch(chunk_pops), dtype=np.int64).sum())
-        view = None
-        sy, sx = sample_strides(cfg.shape, cfg.render_max_cells)
+        rec: dict = {
+            "epoch": self.epoch,
+            "pops": self._obs_fn("pop", pop_core)(self.board),
+            "view": None,
+            "strides": sample_strides(cfg.shape, cfg.render_max_cells),
+            "win": None,
+        }
         if render:
+            sy, sx = rec["strides"]
             if self._gen:
                 plane_sample = bitpack.sample_packed_core(sy, sx, cfg.width)
                 m = bitpack_gen.n_planes(self.rule.states)
@@ -879,22 +921,50 @@ class Simulation:
                 sample_core = bitpack.sample_packed_core(sy, sx, cfg.width)
             else:
                 sample_core = lambda b: b[::sy, ::sx]
-            view = dist.fetch(
-                self._obs_fn(f"sample_{sy}_{sx}", sample_core)(self.board)
+            rec["view"] = self._obs_fn(f"sample_{sy}_{sx}", sample_core)(
+                self.board
             )
-        win = self.board_window(*cfg.probe_window) if self._probe_due(render) else None
-        obs_seconds = time.perf_counter() - obs_t0
+        if self._probe_due(render):
+            rec["win"] = self._window_request(*cfg.probe_window)
+        return rec
+
+    def _obs_emit(self, rec: dict, t0: float) -> None:
+        """Fetch a dispatched observation record and emit observer lines.
+        ``t0`` is where the obs clock started: dispatch time in sync mode
+        (obs ms = dispatch + fetch), resolve time in deferred mode (obs ms =
+        the residual fetch cost left on the critical path)."""
+        cfg = self.config
+        population = int(
+            np.asarray(dist.fetch(rec["pops"]), dtype=np.int64).sum()
+        )
+        view = dist.fetch(rec["view"]) if rec["view"] is not None else None
+        win = None
+        if rec["win"] is not None:
+            handle, post = rec["win"]
+            win = post(dist.fetch(handle))
+        obs_seconds = time.perf_counter() - t0
         if jax.process_index() == 0:
             self.observer.observe_summary(
-                self.epoch,
+                rec["epoch"],
                 population,
                 cfg.shape,
                 view,
-                (sy, sx),
+                rec["strides"],
                 obs_seconds=obs_seconds,
             )
             if win is not None:
-                self.observer.observe_window(self.epoch, win, cfg.probe_window)
+                self.observer.observe_window(
+                    rec["epoch"], win, cfg.probe_window
+                )
+
+    def _obs_resolve(self) -> None:
+        """Emit every pending deferred observation, oldest first (no-op in
+        sync mode or when nothing is pending)."""
+        while self._pending_obs:
+            # Pop only after a successful emit: a failed fetch leaves the
+            # record queued for the caller's retry/flush policy.
+            self._obs_emit(self._pending_obs[0], time.perf_counter())
+            self._pending_obs.pop(0)
 
     # -- failure & recovery --------------------------------------------------
 
@@ -902,6 +972,11 @@ class Simulation:
         """An injected crash: in-memory state is lost; recover from the
         latest checkpoint and deterministically replay the missed epochs."""
         assert self.store is not None
+        # Flush deferred observations first: their device handles reference
+        # the pre-crash board, whose values (for their epochs) are exactly
+        # what deterministic replay reproduces — emit them in order before
+        # the epoch rewinds.
+        self._obs_resolve()
         # A save still in flight must land before the restore reads the
         # store — the crash loses device state, not the writer thread.
         self._ckpt_wait()
@@ -1069,10 +1144,19 @@ class Simulation:
             raise ValueError(f"bad col window [{x0}, {x1})")
         if self._actor_board is not None:
             return np.asarray(self.board[y0:y1, x0:x1])
-        # The slice cores take the offsets as TRACED scalars and cache by
-        # window SHAPE only — a probe that moves across the board (glider
-        # tracking) reuses one compiled executable instead of leaking a
-        # fresh jit per position.
+        handle, post = self._window_request(y0, y1, x0, x1)
+        return post(dist.fetch(handle))
+
+    def _window_request(self, y0: int, y1: int, x0: int, x1: int):
+        """Dispatch the probe-window slice on device; returns ``(handle,
+        post)`` where ``post(fetched)`` finishes the O(window) host work
+        (unpack + trim on packed layouts).  Split from ``board_window`` so
+        obs_defer can fetch the handle a chunk later.
+
+        The slice cores take the offsets as TRACED scalars and cache by
+        window SHAPE only — a probe that moves across the board (glider
+        tracking) reuses one compiled executable instead of leaking a
+        fresh jit per position."""
         if self._packed or self._gen:
             # Packed: slice whole uint32 word columns on device, unpack the
             # tiny host copy, trim to the exact cell window.
@@ -1089,23 +1173,21 @@ class Simulation:
                     b, (r0, c0), (rows, wws)
                 )
                 name = f"win_packed_{rows}x{wws}"
-            words = np.asarray(
-                dist.fetch(self._obs_fn(name, core)(self.board, y0, w0)),
-                dtype=np.uint32,
-            )
-            cells = (
-                bitpack_gen.unpack_gen_np(words)
-                if self._gen
-                else bitpack.unpack_np(words)
+            unpack = (
+                bitpack_gen.unpack_gen_np if self._gen else bitpack.unpack_np
             )
             off = x0 - w0 * bitpack.LANE_BITS
-            return cells[:, off : off + (x1 - x0)]
+
+            def post(fetched) -> np.ndarray:
+                cells = unpack(np.asarray(fetched, dtype=np.uint32))
+                return cells[:, off : off + (x1 - x0)]
+
+            return self._obs_fn(name, core)(self.board, y0, w0), post
         rows, cols = y1 - y0, x1 - x0
         core = lambda b, r0, c0: jax.lax.dynamic_slice(b, (r0, c0), (rows, cols))
-        return np.asarray(
-            dist.fetch(
-                self._obs_fn(f"win_dense_{rows}x{cols}", core)(self.board, y0, x0)
-            )
+        return (
+            self._obs_fn(f"win_dense_{rows}x{cols}", core)(self.board, y0, x0),
+            np.asarray,
         )
 
     def board_host(self) -> np.ndarray:

@@ -1,0 +1,102 @@
+"""Deferred observation (``obs_defer``) ≡ synchronous observation.
+
+obs_defer dispatches each cadence observation on device and fetches it
+one chunk later, under the next chunk's compute — removing the host
+round-trip from the product loop's critical path (the dominant per-chunk
+cost over the axon tunnel, VERDICT.md round-3 weak #3).  These tests pin
+the mode's contract: identical metrics values, window probes, and final
+boards; nothing dropped at run end or across an injected crash.
+"""
+
+import io
+import re
+
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.runtime.config import load_config
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+
+def _run(tmp_path, tag, *, obs_defer, kernel="bitpack", chaos=False):
+    out = io.StringIO()
+    overrides = {
+        "height": 64,
+        "width": 64,
+        "pattern": "gosper-glider-gun",
+        "kernel": kernel,
+        "steps_per_call": 10,
+        "max_epochs": 120,
+        "metrics_every": 20,
+        "render_every": 60,
+        "probe_window": (2, 11, 2, 38),
+        "obs_defer": obs_defer,
+    }
+    if chaos:
+        overrides.update(
+            {
+                "checkpoint_dir": str(tmp_path / f"ck-{tag}"),
+                "checkpoint_every": 20,
+                "fault_injection": {
+                    "enabled": True,
+                    "first_after_epochs": 30,
+                    "every_epochs": 40,
+                    "max_crashes": 2,
+                },
+            }
+        )
+    cfg = load_config(overrides=overrides)
+    observer = BoardObserver(
+        out=out,
+        render_every=cfg.render_every,
+        metrics_every=cfg.metrics_every,
+        render_max_cells=cfg.render_max_cells,
+    )
+    sim = Simulation(cfg, observer=observer)
+    sim.advance()
+    sim.close()
+    return sim, observer, out.getvalue()
+
+
+def _window_lines(text):
+    return [l for l in text.splitlines() if l.startswith("epoch ") and "window" in l]
+
+
+@pytest.mark.parametrize("chaos", [False, True])
+def test_defer_matches_sync(tmp_path, chaos):
+    sim_s, obs_s, text_s = _run(tmp_path, "sync", obs_defer=False, chaos=chaos)
+    sim_d, obs_d, text_d = _run(tmp_path, "defer", obs_defer=True, chaos=chaos)
+
+    # Same cadence points, same populations — the metrics history is the
+    # structured record (wall timings legitimately differ).
+    assert [(m.epoch, m.population) for m in obs_s.history] == [
+        (m.epoch, m.population) for m in obs_d.history
+    ]
+    assert obs_s.history, "cadence points must have been observed"
+    # Window probes: identical epochs, pops, and cell rows.
+    assert _window_lines(text_s) == _window_lines(text_d)
+    assert sim_d.epoch == sim_s.epoch == 120
+    np.testing.assert_array_equal(sim_s.board_host(), sim_d.board_host())
+    # Nothing left pending after advance() returns.
+    assert sim_d._pending_obs == []
+
+
+def test_defer_emits_final_cadence_point(tmp_path):
+    # A cadence crossing on the LAST chunk has no next chunk to ride under;
+    # the finally-flush must still emit it.
+    _, obs_d, text_d = _run(tmp_path, "final", obs_defer=True)
+    assert obs_d.history[-1].epoch == 120
+    assert any(l.startswith("epoch 120: window") for l in text_d.splitlines())
+
+
+def test_defer_dense_kernel_window_path(tmp_path):
+    # The dense window post-processing (plain np.asarray) differs from the
+    # packed unpack+trim path; pin both.
+    sim_s, obs_s, text_s = _run(tmp_path, "dsync", obs_defer=False, kernel="dense")
+    sim_d, obs_d, text_d = _run(tmp_path, "ddefer", obs_defer=True, kernel="dense")
+    assert _window_lines(text_s) == _window_lines(text_d)
+    assert [(m.epoch, m.population) for m in obs_s.history] == [
+        (m.epoch, m.population) for m in obs_d.history
+    ]
+    np.testing.assert_array_equal(sim_s.board_host(), sim_d.board_host())

@@ -71,7 +71,7 @@ run_stage() {
 next_stage() {  # prints the first not-done stage name, or nothing
   for s in headline bench-full bench-sharded tune-65536 tune-8192 \
            tune-gen-8192 tune-ltl-8192 selftest product-run \
-           product-run-sparse-obs product-run-60; do
+           product-run-defer-obs product-run-sparse-obs product-run-60; do
     [ -f "$OUT/done/$s" ] || { echo "$s"; return; }
   done
 }
@@ -113,6 +113,17 @@ dispatch() {
         --pattern gosper-glider-gun --probe-window 2:11,2:38 \
         --render-every 960 --metrics-every 64 \
         --checkpoint-dir "$OUT/ckpt65536" --checkpoint-every 960 ;;
+    product-run-defer-obs)
+      # The deferred-observation hypothesis on hardware: same config as
+      # product-run but cadence fetches resolve one chunk later, under the
+      # next chunk's compute — if the product-vs-bench gap is the per-chunk
+      # host round-trip, this run closes it.
+      rm -rf "$OUT/ckpt65536d"
+      run_stage product-run-defer-obs 3600 python -m akka_game_of_life_tpu run \
+        --height 65536 --width 65536 --max-epochs 1920 --steps-per-call 64 \
+        --pattern gosper-glider-gun --probe-window 2:11,2:38 \
+        --render-every 960 --metrics-every 64 --obs-defer \
+        --checkpoint-dir "$OUT/ckpt65536d" --checkpoint-every 960 ;;
     product-run-sparse-obs)
       rm -rf "$OUT/ckpt65536c"
       run_stage product-run-sparse-obs 3600 python -m akka_game_of_life_tpu run \
