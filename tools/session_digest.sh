@@ -15,6 +15,9 @@ grep "rc=" "$D/session.log" 2>/dev/null
 section "tpu-tests tail"
 tail -3 "$D/tpu-tests.log" 2>/dev/null
 
+section "headline (opportunist certified-style line)"
+grep '"value"' "$D/headline.log" 2>/dev/null
+
 section "bench-full: every value line"
 grep '"value"' "$D/bench-full.log" 2>/dev/null
 
@@ -34,6 +37,12 @@ grep '"check"' "$D/selftest.log" 2>/dev/null
 
 section "product-run (k=8-aligned): metrics w/ obs breakdown + summary"
 grep -E "ms/epoch|run summary|window" "$D/product-run.log" 2>/dev/null | tail -40
+
+section "product-run-defer-obs (round-trip off the critical path?)"
+grep -E "ms/epoch|run summary|window" "$D/product-run-defer-obs.log" 2>/dev/null | tail -12
+
+section "product-run-sparse-obs (cadence 256)"
+grep -E "ms/epoch|run summary|window" "$D/product-run-sparse-obs.log" 2>/dev/null | tail -12
 
 section "product-run-60 (round-3 config verbatim)"
 grep -E "ms/epoch|run summary|window" "$D/product-run-60.log" 2>/dev/null | tail -12
