@@ -1,0 +1,73 @@
+"""Queue logic of the opportunistic TPU runner (tools/tpu_opportunist.sh).
+
+The opportunist is the round's hardware-measurement spine: it must spend
+each tunnel alive window on the highest-priority pending stage, stamp
+completions durably, retry hang-like failures forever, and park a stage
+only after repeated deterministic failures.  Sourcing the script loads
+its functions without running the loop; these tests drive them with
+stub commands.
+"""
+
+import subprocess
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _bash(outdir: Path, body: str) -> str:
+    proc = subprocess.run(
+        [
+            "bash",
+            "-c",
+            f'source tools/tpu_opportunist.sh "{outdir}"\n{body}',
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout
+
+
+def test_priority_order_and_stamps(tmp_path):
+    out = _bash(tmp_path, "next_stage")
+    assert out.strip() == "headline"
+    # Stamping the head of the queue advances to the next priority.
+    (tmp_path / "done" / "headline").touch()
+    (tmp_path / "done" / "bench-full").touch()
+    out = _bash(tmp_path, "next_stage")
+    assert out.strip() == "bench-sharded"
+    # All stamped -> empty (loop would exit).
+    for s in (
+        "bench-sharded tune-65536 tune-8192 tune-gen-8192 tune-ltl-8192 "
+        "selftest product-run product-run-defer-obs product-run-sparse-obs "
+        "product-run-60".split()
+    ):
+        (tmp_path / "done" / s).touch()
+    assert _bash(tmp_path, "next_stage").strip() == ""
+
+
+def test_run_stage_success_stamps(tmp_path):
+    _bash(tmp_path, "run_stage ok 10 true")
+    assert (tmp_path / "done" / "ok").exists()
+
+
+def test_run_stage_timeout_retries_forever(tmp_path):
+    # rc=124 (hang killed by timeout) must neither stamp nor count toward
+    # the deterministic-failure cap.
+    _bash(tmp_path, "run_stage hang 1 sleep 5 || true")
+    assert not (tmp_path / "done" / "hang").exists()
+    assert not (tmp_path / "done" / "hang.fails").exists()
+
+
+def test_run_stage_deterministic_failure_parks_after_cap(tmp_path):
+    for i in range(3):
+        _bash(tmp_path, "run_stage bad 10 false || true")
+    assert (tmp_path / "done" / "bad.fails").read_text().strip() == "3"
+    # Parked (stamped) so the queue moves on; the log keeps the evidence.
+    assert (tmp_path / "done" / "bad").exists()
+    # Two failures are not enough to park.
+    for i in range(2):
+        _bash(tmp_path, "run_stage flaky 10 false || true")
+    assert not (tmp_path / "done" / "flaky").exists()

@@ -27,7 +27,9 @@
 #
 #   bash tools/tpu_opportunist.sh [outdir]
 set -u
-cd "$(dirname "$0")/.."
+# BASH_SOURCE, not $0: resolves to this file even when sourced (the unit
+# tests source the script to load its functions).
+cd "$(dirname "${BASH_SOURCE[0]}")/.."
 OUT="${1:-/tmp/tpu_opportunist}"
 mkdir -p "$OUT/done"
 MAX_TRIES=3
@@ -142,17 +144,25 @@ dispatch() {
   esac
 }
 
-log "opportunist start, queue: $(next_stage) ..."
-while :; do
-  s="$(next_stage)"
-  [ -n "$s" ] || { log "all stages done"; break; }
-  if probe_ok; then
-    log "probe ok -> running $s"
-    dispatch "$s"
-  else
-    log "probe failed (tunnel wedged); retrying in 180s (pending: $s)"
-    sleep 180
-  fi
-done
-log "opportunist done"
-grep -h '"value"' "$OUT"/bench*.log "$OUT"/headline.log 2>/dev/null | tail -24
+main() {
+  log "opportunist start, queue: $(next_stage) ..."
+  while :; do
+    s="$(next_stage)"
+    [ -n "$s" ] || { log "all stages done"; break; }
+    if probe_ok; then
+      log "probe ok -> running $s"
+      dispatch "$s"
+    else
+      log "probe failed (tunnel wedged); retrying in 180s (pending: $s)"
+      sleep 180
+    fi
+  done
+  log "opportunist done"
+  grep -h '"value"' "$OUT"/bench*.log "$OUT"/headline.log 2>/dev/null | tail -24
+}
+
+# Sourcing loads the functions without running the loop (how the queue
+# logic is unit-tested); executing runs the opportunist.
+if [ "${BASH_SOURCE[0]}" = "$0" ]; then
+  main
+fi
