@@ -16,6 +16,7 @@ Checks:
   chaos            injected crash mid-run leaves the trajectory bit-identical
   sharded          (multi-device only) meshed stepping ≡ single-device
   families         wireworld clock phase + LtL-R1 ≡ classic (cross-unit)
+  obs-defer        deferred observation ≡ synchronous on this hardware
 """
 
 from __future__ import annotations
@@ -177,6 +178,40 @@ def _check_families(kernel: str) -> str:
     return f"wireworld={ww_kernel}, ltl=dense"
 
 
+def _check_obs_defer(kernel: str) -> str:
+    """Deferred observation ≡ synchronous: same cadence epochs, the same
+    populations, the same probe-window cells, the same final board — run
+    on whatever kernel this machine resolves, so the mode's on-hardware
+    behavior (fetch-one-chunk-later over the real device link) is part of
+    the product's self-verification."""
+    outs = {}
+    for defer in (False, True):
+        out = io.StringIO()
+        sim = _sim(
+            observer_out=out,
+            pattern="gosper-glider-gun",
+            pattern_offset=(4, 4),
+            kernel=kernel,
+            metrics_every=12,
+            render_every=30,
+            probe_window=(4, 13, 4, 40),
+            obs_defer=defer,
+        )
+        sim.advance(60)
+        sim.close()
+        history = [(m.epoch, m.population) for m in sim.observer.history]
+        windows = [
+            l for l in out.getvalue().splitlines() if "window" in l
+        ]
+        outs[defer] = (history, windows, sim.board_host(), sim.kernel)
+    assert outs[False][0] == outs[True][0], "metrics history diverged"
+    assert outs[False][0], "no cadence points observed"
+    assert outs[False][1], "no probe windows observed"
+    assert outs[False][1] == outs[True][1], "probe windows diverged"
+    assert np.array_equal(outs[False][2], outs[True][2]), "final board diverged"
+    return outs[True][3]
+
+
 class _Skip(Exception):
     pass
 
@@ -188,6 +223,7 @@ CHECKS: List[tuple] = [
     ("chaos", _check_chaos),
     ("sharded", _check_sharded),
     ("families", _check_families),
+    ("obs-defer", _check_obs_defer),
 ]
 
 
