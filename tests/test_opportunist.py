@@ -15,6 +15,9 @@ REPO = Path(__file__).resolve().parents[1]
 
 
 def _bash(outdir: Path, body: str) -> str:
+    import os
+
+    env = dict(os.environ, GOL_OPPORTUNIST_ARCHIVE="0")
     proc = subprocess.run(
         [
             "bash",
@@ -25,6 +28,7 @@ def _bash(outdir: Path, body: str) -> str:
         text=True,
         timeout=120,
         cwd=REPO,
+        env=env,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     return proc.stdout

