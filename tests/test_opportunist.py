@@ -44,9 +44,9 @@ def test_priority_order_and_stamps(tmp_path):
     assert out.strip() == "bench-sharded"
     # All stamped -> empty (loop would exit).
     for s in (
-        "bench-sharded tune-65536 tune-8192 tune-gen-8192 tune-ltl-8192 "
-        "selftest product-run product-run-defer-obs product-run-sparse-obs "
-        "product-run-60".split()
+        "bench-sharded tpu-tests-auto tune-65536 tune-8192 tune-gen-8192 "
+        "tune-ltl-8192 selftest product-run product-run-defer-obs "
+        "product-run-sparse-obs product-run-60".split()
     ):
         (tmp_path / "done" / s).touch()
     assert _bash(tmp_path, "next_stage").strip() == ""

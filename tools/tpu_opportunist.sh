@@ -79,8 +79,8 @@ run_stage() {
 
 # The queue: "name timeout_s command...".  One line per stage.
 next_stage() {  # prints the first not-done stage name, or nothing
-  for s in headline bench-full bench-sharded tune-65536 tune-8192 \
-           tune-gen-8192 tune-ltl-8192 selftest product-run \
+  for s in headline bench-full bench-sharded tpu-tests-auto tune-65536 \
+           tune-8192 tune-gen-8192 tune-ltl-8192 selftest product-run \
            product-run-defer-obs product-run-sparse-obs product-run-60; do
     [ -f "$OUT/done/$s" ] || { echo "$s"; return; }
   done
@@ -99,6 +99,12 @@ dispatch() {
         --probe-timeout 60 --probe-attempts 1 --probe-retry-window 0 ;;
     bench-sharded)
       run_stage bench-sharded 1200 python bench_suite.py --config 5 ;;
+    tpu-tests-auto)
+      # The one GOL_TPU_TESTS test that skipped when the tunnel wedged
+      # mid-run in the 03:45 window (auto->pallas promotion, now covering
+      # the refactored product loop); the other two passed on-chip then.
+      run_stage tpu-tests-auto 900 env GOL_TPU_TESTS=1 \
+        python -m pytest tests/test_pallas_tpu.py -k auto_promotes -v ;;
     tune-65536)
       run_stage tune-65536 1500 python -m akka_game_of_life_tpu tune \
         --size 65536 ;;
@@ -153,6 +159,9 @@ dispatch() {
 }
 
 main() {
+  # Pidfile for clean restarts: `kill $(cat $OUT/pid)` — never pkill/ps
+  # pattern-matching, which can match the operator's own shell wrapper.
+  echo $$ > "$OUT/pid"
   log "opportunist start, queue: $(next_stage) ..."
   while :; do
     s="$(next_stage)"
