@@ -90,6 +90,48 @@ def test_defer_emits_final_cadence_point(tmp_path):
     assert any(l.startswith("epoch 120: window") for l in text_d.splitlines())
 
 
+def test_defer_across_checkpoint_resume(tmp_path):
+    # Deferred observation composes with resume: a run saved at epoch 60
+    # and resumed with --obs-defer lands on the same trajectory as an
+    # uninterrupted sync run.
+    ck = tmp_path / "ck-resume"
+    base = dict(
+        height=64,
+        width=64,
+        pattern="gosper-glider-gun",
+        kernel="bitpack",
+        steps_per_call=10,
+        metrics_every=20,
+        checkpoint_dir=str(ck),
+        checkpoint_every=20,
+    )
+    first = Simulation(
+        load_config(overrides=dict(base, max_epochs=60, obs_defer=True)),
+        observer=BoardObserver(out=io.StringIO(), metrics_every=20),
+    )
+    first.advance()
+    first.close()
+    resumed = Simulation(
+        load_config(overrides=dict(base, max_epochs=120, obs_defer=True)),
+        observer=BoardObserver(out=io.StringIO(), metrics_every=20),
+    )
+    assert resumed.epoch == 60
+    resumed.advance(60)
+    resumed.close()
+
+    oracle = Simulation(
+        load_config(
+            overrides=dict(
+                {k: v for k, v in base.items() if "checkpoint" not in k},
+                max_epochs=120,
+            )
+        ),
+        observer=BoardObserver(out=io.StringIO(), metrics_every=20),
+    )
+    oracle.advance()
+    np.testing.assert_array_equal(resumed.board_host(), oracle.board_host())
+
+
 def test_defer_dense_kernel_window_path(tmp_path):
     # The dense window post-processing (plain np.asarray) differs from the
     # packed unpack+trim path; pin both.
