@@ -18,6 +18,10 @@ broken cache must never break a run.
 
 ``GOL_COMPILE_CACHE=0`` disables; ``GOL_COMPILE_CACHE_DIR`` overrides
 the default repo-local ``.jax_cache`` directory (git-ignored).
+CPU-pinned runs (``--platform cpu`` / ``GOL_PLATFORM=cpu``, or any
+cpu-first platform list) skip the cache regardless: host compiles are
+fast, and XLA:CPU's AOT loader warns ("could lead to SIGILL") on every
+cache hit — the cache exists for slow *device* compiles.
 """
 
 from __future__ import annotations
@@ -44,6 +48,17 @@ def enable_compile_cache() -> str | None:
     try:
         import jax
 
+        # CPU-pinned runs skip the cache: host compiles are fast (the cache
+        # exists for 20-40 s device-tunnel compiles), and XLA:CPU's AOT
+        # loader warns about machine-feature fingerprints on every cache
+        # hit ("could lead to SIGILL") — noise and theoretical risk for no
+        # benefit.  Checked via the *configured* platform string only
+        # (first element of a priority list like "cpu,axon"): calling
+        # jax.default_backend() here would initialize the backend, which
+        # HANGS on a wedged device tunnel.
+        platforms = jax.config.jax_platforms or ""
+        if platforms.split(",")[0].strip() == "cpu":
+            return None
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # Cache every compile that costs >= 1 s: the tunnel compiles we
