@@ -1,10 +1,12 @@
 """Headline benchmark: Conway B3/S23 toroidal stencil throughput.
 
-Prints one JSON line per BASELINE.json config (actor 64², dense 8192²,
-HighLife/Day&Night, Brian's Brain, then the 65536² headline LAST so a
-one-line consumer reads the headline): {"metric", "value", "unit",
-"vs_baseline"} (+ "config" on the non-headline lines).  --headline-only
-restores the single-line behavior.
+Prints one JSON line per BASELINE.json config: {"metric", "value",
+"unit", "vs_baseline"} (+ "config" on the non-headline lines).  The
+65536² headline runs FIRST and its line is flushed immediately — a
+tunnel wedge mid-way through the aux configs must not cost the round
+its one scored number — and is printed again as the LAST line, so a
+one-line consumer reading either end gets the headline.
+--headline-only emits just the single headline line.
 
 Baseline (BASELINE.md): the north-star target is >=1e11 cell-updates/sec
 aggregate on a TPU v5e-8 at 65536^2, i.e. 1.25e10 per chip; vs_baseline is
@@ -246,6 +248,13 @@ def main() -> None:
         help="pin a jax platform (e.g. cpu) for smoke-testing; default is the "
         "image's pinned platform (the real chip)",
     )
+    parser.add_argument(
+        "--aux-timeout", type=float, default=1500.0,
+        help="seconds allowed for the aux-config subprocess (bench_suite); "
+        "a tunnel wedge mid-aux kills the child at this deadline so the "
+        "final headline line still prints (r3b measured the full aux set "
+        "at ~10 min on the chip)",
+    )
     args = parser.parse_args()
     if args.vmem_limit_mb < 0:
         parser.error(f"--vmem-limit-mb {args.vmem_limit_mb} must be >= 0")
@@ -337,75 +346,6 @@ def main() -> None:
     from akka_game_of_life_tpu.ops import bitpack
     from akka_game_of_life_tpu.ops.rules import CONWAY
 
-    if not args.headline_only:
-        # The other BASELINE.json configs, one JSON line each (VERDICT.md
-        # round-2 next #5); a failure in one config is recorded as a line,
-        # never a crash of the headline run.
-        import bench_suite
-
-        aux = [
-            ("conway-actor-64", lambda: bench_suite.bench_actor(64)),
-            (
-                "conway-8192",
-                lambda: bench_suite.bench_dense(8192, "conway", "conway-8192"),
-            ),
-            (
-                "lifelike-8192",
-                lambda: (
-                    bench_suite.bench_packed(8192, "highlife", "lifelike-8192"),
-                    bench_suite.bench_packed(8192, "day-and-night", "lifelike-8192"),
-                    bench_suite.bench_pallas(8192, "highlife", "lifelike-8192"),
-                ),
-            ),
-            (
-                "generations-8192",
-                lambda: (
-                    bench_suite.bench_packed_gen(
-                        8192, "brians-brain", "generations-8192"
-                    ),
-                    bench_suite.bench_pallas_gen(
-                        8192, "brians-brain", "generations-8192"
-                    ),
-                ),
-            ),
-            (
-                "ltl-8192",
-                lambda: (
-                    bench_suite.bench_ltl(8192, "bugs", "ltl-8192"),
-                    bench_suite.bench_ltl(
-                        8192, "R5,B15-22,S15-25,NN", "ltl-8192"
-                    ),
-                    bench_suite.bench_pallas_ltl(8192, "bugs", "ltl-8192"),
-                ),
-            ),
-            (
-                "wireworld-8192",
-                lambda: (
-                    # Dense baseline first: the >=4x-over-dense target
-                    # (VERDICT round-3 weak #6) needs both on one chip.
-                    bench_suite.bench_dense(
-                        8192, "wireworld", "wireworld-8192", steps=16
-                    ),
-                    bench_suite.bench_packed_gen(
-                        8192, "wireworld", "wireworld-8192"
-                    ),
-                    bench_suite.bench_pallas_gen(
-                        8192, "wireworld", "wireworld-8192"
-                    ),
-                ),
-            ),
-        ]
-        for name, fn in aux:
-            try:
-                fn()
-            except Exception as e:  # noqa: BLE001 — recorded, not raised
-                print(
-                    json.dumps(
-                        {"config": name, "error": f"{type(e).__name__}: {e}"}
-                    ),
-                    flush=True,
-                )
-
     n = args.size
     if args.kernel != "roll" and n % 32:
         # Packed kernels only; the dense roll path takes any size.
@@ -452,6 +392,12 @@ def main() -> None:
         assert pop > 0
         return n * n * args.steps_per_call * args.timed_calls / dt
 
+    # The headline runs FIRST and its line is flushed immediately: on this
+    # image the device tunnel can wedge mid-process (BASELINE.md), and a
+    # wedge during the aux configs must not cost the one number the round
+    # is scored on.  It is printed again as the final line after the aux
+    # configs (the "one-line consumer reads the headline last" contract) —
+    # an identical record, harmless to line-by-line readers.
     kernels = ["pallas", "bitpack"] if args.kernel == "auto" else [args.kernel]
     rate = None
     fallback_note = None
@@ -462,30 +408,71 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — fall back, record why
             fallback_note = f"{kernel} failed: {type(e).__name__}: {e}"
     if rate is None:
-        print(
-            json.dumps(
-                {
-                    "metric": _label(kernels[-1]),
-                    "value": None,
-                    "unit": "cell-updates/sec",
-                    "vs_baseline": None,
-                    "error": fallback_note,
-                }
-            )
-        )
-        sys.exit(1)
+        headline_line = {
+            "metric": _label(kernels[-1]),
+            "value": None,
+            "unit": "cell-updates/sec",
+            "vs_baseline": None,
+            "error": fallback_note,
+        }
+    else:
+        headline_line = {
+            # The benchmark computation is a plain single-device jit, so
+            # per-chip is literal regardless of how many chips the host has.
+            "metric": _label(kernel),
+            "value": rate,
+            "unit": "cell-updates/sec",
+            "vs_baseline": rate / PER_CHIP_TARGET,
+        }
+        if fallback_note is not None:
+            headline_line["note"] = fallback_note
+    print(json.dumps(headline_line), flush=True)
 
-    line = {
-        # The benchmark computation is a plain single-device jit, so
-        # per-chip is literal regardless of how many chips the host has.
-        "metric": _label(kernel),
-        "value": rate,
-        "unit": "cell-updates/sec",
-        "vs_baseline": rate / PER_CHIP_TARGET,
-    }
-    if fallback_note is not None:
-        line["note"] = fallback_note
-    print(json.dumps(line))
+    if not args.headline_only:
+        # The other BASELINE.json configs (VERDICT.md round-2 next #5), one
+        # JSON line each, via bench_suite in a KILLABLE SUBPROCESS sharing
+        # this stdout: the driver records the LAST stdout line as the scored
+        # number, so the aux phase must not be able to hang this process —
+        # a tunnel wedge mid-aux gets the child killed at the timeout and
+        # the final headline re-print still lands.  (Configs 5/6 — sharded
+        # mesh and TCP cluster — are separate artifacts, not aux lines.)
+        import os as _os
+        import pathlib
+
+        cmd = [
+            sys.executable,
+            str(pathlib.Path(__file__).resolve().parent / "bench_suite.py"),
+            "--config", "1", "2", "3", "4", "7", "8",
+        ]
+        if args.platform:
+            cmd += ["--platform", args.platform]
+        try:
+            proc = subprocess.run(
+                cmd, timeout=args.aux_timeout, env=dict(_os.environ)
+            )
+            if proc.returncode != 0:
+                print(
+                    json.dumps(
+                        {"config": "aux", "error": f"rc={proc.returncode}"}
+                    ),
+                    flush=True,
+                )
+        except subprocess.TimeoutExpired:
+            print(
+                json.dumps(
+                    {
+                        "config": "aux",
+                        "error": f"aux configs exceeded {args.aux_timeout:.0f}s "
+                        "(tunnel wedged mid-aux?); child killed",
+                    }
+                ),
+                flush=True,
+            )
+        # The final line repeats the headline (see the flush above).
+        print(json.dumps(headline_line), flush=True)
+
+    if rate is None:
+        sys.exit(1)
 
 
 if __name__ == "__main__":
