@@ -928,11 +928,16 @@ class Simulation:
             rec["win"] = self._window_request(*cfg.probe_window)
         return rec
 
-    def _obs_emit(self, rec: dict, t0: float) -> None:
+    def _obs_emit(self, rec: dict, t0: float, on_fetched=None) -> None:
         """Fetch a dispatched observation record and emit observer lines.
         ``t0`` is where the obs clock started: dispatch time in sync mode
         (obs ms = dispatch + fetch), resolve time in deferred mode (obs ms =
-        the residual fetch cost left on the critical path)."""
+        the residual fetch cost left on the critical path).  ``on_fetched``
+        fires once every device fetch has succeeded, before any observer
+        write — the deferred queue uses it to mark the record consumed
+        (a failed *write* must not leave the record queued: the metrics
+        line lands before the window line, so a requeue would duplicate
+        it on the next flush)."""
         cfg = self.config
         population = int(
             np.asarray(dist.fetch(rec["pops"]), dtype=np.int64).sum()
@@ -942,6 +947,8 @@ class Simulation:
         if rec["win"] is not None:
             handle, post = rec["win"]
             win = post(dist.fetch(handle))
+        if on_fetched is not None:
+            on_fetched()
         obs_seconds = time.perf_counter() - t0
         if jax.process_index() == 0:
             self.observer.observe_summary(
@@ -961,10 +968,16 @@ class Simulation:
         """Emit every pending deferred observation, oldest first (no-op in
         sync mode or when nothing is pending)."""
         while self._pending_obs:
-            # Pop only after a successful emit: a failed fetch leaves the
-            # record queued for the caller's retry/flush policy.
-            self._obs_emit(self._pending_obs[0], time.perf_counter())
-            self._pending_obs.pop(0)
+            # Pop once the fetches succeed (via on_fetched), not after the
+            # full emit: a failed device fetch leaves the record queued for
+            # the caller's retry/flush policy, but a failed observer WRITE
+            # consumes it — its metrics line may already be out, and a
+            # requeue would duplicate that line on the next flush.
+            self._obs_emit(
+                self._pending_obs[0],
+                time.perf_counter(),
+                on_fetched=lambda: self._pending_obs.pop(0),
+            )
 
     # -- failure & recovery --------------------------------------------------
 

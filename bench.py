@@ -155,18 +155,28 @@ def _freshest_archived_headline() -> dict | None:
     freshest number this code actually measured on the chip, machine-
     readably, while ``value`` stays honestly null."""
     import pathlib
+    import re
+
+    def natkey(s: str) -> list:
+        # Digit runs compare numerically: lexicographic order inverts at
+        # round 10 (tpu_session_r10 < tpu_session_r3 as strings), which
+        # would surface a stale round's number after a fresh clone
+        # flattens mtimes.  Tokens alternate text/digit starting with
+        # text, so ints and strs never meet at the same index.
+        return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", s)]
 
     try:
         root = pathlib.Path(__file__).resolve().parent / "artifacts"
-        # Key = (mtime, path): after a fresh clone every log shares the
-        # checkout mtime, so the path (session dirs sort r3 < r4 < ...)
-        # breaks ties deterministically toward the newest session.
-        best: tuple[tuple[float, str], dict, str] | None = None
+        # Key = (mtime, natural-sorted path): after a fresh clone every
+        # log shares the checkout mtime, so the path (session dirs sort
+        # r3 < r4 < ... < r10) breaks ties deterministically toward the
+        # newest session.
+        best: tuple[tuple[float, list], dict, str] | None = None
         for log in sorted(root.glob("*/*.log")):
             try:
                 mtime = log.stat().st_mtime
                 src = str(log.relative_to(root.parent))
-                if best is not None and (mtime, src) <= best[0]:
+                if best is not None and (mtime, natkey(src)) <= best[0]:
                     continue
                 text = log.read_text(errors="replace")
             except OSError:
@@ -179,7 +189,7 @@ def _freshest_archived_headline() -> dict | None:
                 except ValueError:
                     continue
                 if rec.get("value") and rec.get("metric") and "config" not in rec:
-                    best = ((mtime, src), rec, src)
+                    best = ((mtime, natkey(src)), rec, src)
         if best is None:
             return None
         (mtime, _), rec, src = best
@@ -468,8 +478,10 @@ def main() -> None:
                 ),
                 flush=True,
             )
-        # The final line repeats the headline (see the flush above).
-        print(json.dumps(headline_line), flush=True)
+        # The final line repeats the headline (see the flush above),
+        # tagged so aggregators that sum every "value" line can dedupe
+        # while line-at-either-end consumers still parse it unchanged.
+        print(json.dumps({**headline_line, "repeat": True}), flush=True)
 
     if rate is None:
         sys.exit(1)

@@ -31,6 +31,76 @@ def test_freshest_archived_headline_finds_the_hardware_record():
     assert (REPO / rec["source"]).is_file()
 
 
+def test_freshest_archived_headline_natural_sorts_sessions(tmp_path, monkeypatch):
+    # After a fresh clone every log shares the checkout mtime; the path
+    # tie-break must sort session rounds numerically (r3 < r10), not
+    # lexicographically (r10 < r3), or round 10+ would surface a stale
+    # round's number as last_measured (round-4 advisor finding).
+    line = (
+        '{"metric": "cell-updates/sec/chip, Conway B3/S23 65536x65536 torus '
+        '(pallas kernel, 1 chip)", "value": %s, "unit": "cell-updates/sec"}'
+    )
+    old = tmp_path / "artifacts" / "tpu_session_r3"
+    new = tmp_path / "artifacts" / "tpu_session_r10"
+    old.mkdir(parents=True)
+    new.mkdir(parents=True)
+    (old / "bench.log").write_text(line % "2.0e12")
+    (new / "bench.log").write_text(line % "3.0e12")
+    import os
+
+    for p in (old / "bench.log", new / "bench.log"):
+        os.utime(p, (1_700_000_000, 1_700_000_000))
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    rec = bench._freshest_archived_headline()
+    assert rec["value"] == 3.0e12
+    assert "r10" in rec["source"]
+
+
+def test_full_run_tags_repeated_headline_line(tmp_path):
+    # The headline prints first AND last in non---headline-only runs (a
+    # wedge mid-aux must not cost the scored line; the driver reads the
+    # last line).  The repeat must be tagged so aggregators that sum
+    # every "value" line — including last_measured's archive scan — can
+    # dedupe it (round-4 advisor finding).
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "bench.py",
+            "--platform",
+            "cpu",
+            "--kernel",
+            "bitpack",
+            "--size",
+            "1024",
+            "--steps-per-call",
+            "8",
+            "--timed-calls",
+            "1",
+            "--probe-timeout",
+            "60",
+            "--probe-attempts",
+            "1",
+            "--probe-retry-window",
+            "0",
+            "--aux-timeout",
+            "1",  # kill the aux child immediately; the repeat still lands
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    headlines = [l for l in lines if l.get("value") and "config" not in l]
+    assert len(headlines) == 2
+    first, last = headlines
+    assert "repeat" not in first
+    assert last.pop("repeat") is True
+    assert last == first
+    assert lines[-1]["value"] == first["value"]  # repeat is the final line
+
+
 def test_probe_failure_still_emits_structured_record_with_last_measured():
     # A bogus platform is a deterministic probe failure: bench must exit
     # nonzero yet print exactly one parseable JSON record (never a raw

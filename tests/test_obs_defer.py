@@ -132,6 +132,45 @@ def test_defer_across_checkpoint_resume(tmp_path):
     np.testing.assert_array_equal(resumed.board_host(), oracle.board_host())
 
 
+def test_defer_broken_window_write_consumes_record(tmp_path):
+    # An observe_window that raises (e.g. a broken output stream) must
+    # still consume the queued record: observe_summary already emitted
+    # its metrics line, so re-queueing would duplicate that line on the
+    # next flush (round-4 advisor finding).  A failed device FETCH, by
+    # contrast, happens before any write and may leave the record queued.
+    out = io.StringIO()
+    cfg = load_config(
+        overrides={
+            "height": 64,
+            "width": 64,
+            "pattern": "gosper-glider-gun",
+            "kernel": "bitpack",
+            "render_every": 60,
+            "probe_window": (2, 11, 2, 38),
+            "obs_defer": True,
+        }
+    )
+    observer = BoardObserver(
+        out=out, render_every=cfg.render_every, metrics_every=20
+    )
+    sim = Simulation(cfg, observer=observer)
+    # Epoch 0 is render cadence, so the record carries a probe window.
+    sim._pending_obs.append(sim._obs_dispatch(True))
+
+    def broken(*a, **k):
+        raise OSError("stream gone")
+
+    observer.observe_window = broken
+    with pytest.raises(OSError):
+        sim._obs_resolve()
+    assert sim._pending_obs == []  # consumed, not requeued
+    text = out.getvalue()
+    assert text.count("epoch 0:") == 1  # the summary frame went out once
+    sim._obs_resolve()  # nothing pending: flush is a no-op, no duplicate
+    assert out.getvalue() == text
+    sim.close()
+
+
 def test_defer_dense_kernel_window_path(tmp_path):
     # The dense window post-processing (plain np.asarray) differs from the
     # packed unpack+trim path; pin both.

@@ -2,16 +2,25 @@
 
 The opportunist is the round's hardware-measurement spine: it must spend
 each tunnel alive window on the highest-priority pending stage, stamp
-completions durably, retry hang-like failures forever, and park a stage
-only after repeated deterministic failures.  Sourcing the script loads
-its functions without running the loop; these tests drive them with
-stub commands.
+completions durably, retry hang-like failures forever, park a stage only
+after repeated deterministic failures — and un-park everything at the
+next alive window, so one wedge's fast-failing init can never
+permanently retire the headline (round-4 advisor finding, medium).
+Sourcing the script loads its functions without running the loop; these
+tests drive them with stub commands.
 """
 
 import subprocess
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
+
+ALL_STAGES = (
+    "prewarm headline bench-full bench-sharded tpu-tests-auto "
+    "product-run product-run-defer-obs tune-65536 tune-8192 "
+    "tune-gen-8192 tune-ltl-8192 selftest product-run-sparse-obs "
+    "product-run-60"
+).split()
 
 
 def _bash(outdir: Path, body: str) -> str:
@@ -36,18 +45,14 @@ def _bash(outdir: Path, body: str) -> str:
 
 def test_priority_order_and_stamps(tmp_path):
     out = _bash(tmp_path, "next_stage")
-    assert out.strip() == "headline"
+    assert out.strip() == "prewarm"
     # Stamping the head of the queue advances to the next priority.
+    (tmp_path / "done" / "prewarm").touch()
     (tmp_path / "done" / "headline").touch()
-    (tmp_path / "done" / "bench-full").touch()
     out = _bash(tmp_path, "next_stage")
-    assert out.strip() == "bench-sharded"
+    assert out.strip() == "bench-full"
     # All stamped -> empty (loop would exit).
-    for s in (
-        "bench-sharded tpu-tests-auto tune-65536 tune-8192 tune-gen-8192 "
-        "tune-ltl-8192 selftest product-run product-run-defer-obs "
-        "product-run-sparse-obs product-run-60".split()
-    ):
+    for s in ALL_STAGES:
         (tmp_path / "done" / s).touch()
     assert _bash(tmp_path, "next_stage").strip() == ""
 
@@ -69,9 +74,89 @@ def test_run_stage_deterministic_failure_parks_after_cap(tmp_path):
     for i in range(3):
         _bash(tmp_path, "run_stage bad 10 false || true")
     assert (tmp_path / "done" / "bad.fails").read_text().strip() == "3"
-    # Parked (stamped) so the queue moves on; the log keeps the evidence.
-    assert (tmp_path / "done" / "bad").exists()
+    # Parked with its own marker — NOT the done stamp — so a later alive
+    # window can clear it; the log keeps the evidence.
+    assert (tmp_path / "done" / "bad.parked").exists()
+    assert not (tmp_path / "done" / "bad").exists()
     # Two failures are not enough to park.
     for i in range(2):
         _bash(tmp_path, "run_stage flaky 10 false || true")
-    assert not (tmp_path / "done" / "flaky").exists()
+    assert not (tmp_path / "done" / "flaky.parked").exists()
+
+
+def test_next_stage_skips_parked(tmp_path):
+    (tmp_path / "done").mkdir()
+    (tmp_path / "done" / "prewarm").touch()
+    (tmp_path / "done" / "headline.parked").touch()
+    assert _bash(tmp_path, "next_stage").strip() == "bench-full"
+
+
+def test_new_window_unparks_everything(tmp_path):
+    # A parked stage (e.g. the headline after three wedge-at-init fast
+    # failures) must come back at the next alive window with a clean
+    # failure count.
+    for i in range(3):
+        _bash(tmp_path, "run_stage headline 10 false || true")
+    assert (tmp_path / "done" / "headline.parked").exists()
+    _bash(tmp_path, "new_window")
+    assert not (tmp_path / "done" / "headline.parked").exists()
+    assert not (tmp_path / "done" / "headline.fails").exists()
+    assert _bash(tmp_path, "next_stage").strip() == "prewarm"
+    # Real completions survive the window reset.
+    (tmp_path / "done" / "prewarm").touch()
+    _bash(tmp_path, "new_window")
+    assert (tmp_path / "done" / "prewarm").exists()
+
+
+def test_new_window_keeps_kill_counter(tmp_path):
+    # .kills must survive the window reset: cleared, an OOM-looping stage
+    # (rc=137 every few minutes) would reset its own cap at every flap
+    # and starve lower-priority stages forever.  Persisted, the stage
+    # parks at the cap and each later window grants exactly one retry.
+    for i in range(6):
+        _bash(tmp_path, 'run_stage oomy 10 sh -c "kill -9 \\$\\$" || true')
+    assert (tmp_path / "done" / "oomy.parked").exists()
+    _bash(tmp_path, "new_window")
+    assert not (tmp_path / "done" / "oomy.parked").exists()
+    assert (tmp_path / "done" / "oomy.kills").read_text().strip() == "6"
+    # The single granted retry re-parks immediately on another kill.
+    _bash(tmp_path, 'run_stage oomy 10 sh -c "kill -9 \\$\\$" || true')
+    assert (tmp_path / "done" / "oomy.parked").exists()
+
+
+def test_unpark_expired_ages_out_parked_markers(tmp_path):
+    # With a continuously-alive tunnel there is no probe fail->ok
+    # transition, so parked markers must also age out on a clock — or a
+    # parked headline would be skipped for the rest of the session.
+    (tmp_path / "done").mkdir()
+    (tmp_path / "done" / "headline.parked").write_text("5")  # long ago
+    import time
+
+    (tmp_path / "done" / "selftest.parked").write_text(str(int(time.time())))
+    (tmp_path / "done" / "junk.parked").write_text("not-a-number")
+    _bash(tmp_path, "unpark_expired")
+    assert not (tmp_path / "done" / "headline.parked").exists()
+    assert not (tmp_path / "done" / "junk.parked").exists()  # invalid = 0
+    assert (tmp_path / "done" / "selftest.parked").exists()  # still fresh
+
+
+def test_sigkill_counts_toward_separate_higher_cap(tmp_path):
+    # rc=137 is ambiguous (timeout -k kill of a SIGTERM-immune wedge vs
+    # the OOM killer); it must not park at the deterministic cap but also
+    # must not retry forever — 6 kills park the stage until next window.
+    for i in range(5):
+        _bash(tmp_path, 'run_stage oomy 10 sh -c "kill -9 \\$\\$" || true')
+    assert (tmp_path / "done" / "oomy.kills").read_text().strip() == "5"
+    assert not (tmp_path / "done" / "oomy.parked").exists()
+    _bash(tmp_path, 'run_stage oomy 10 sh -c "kill -9 \\$\\$" || true')
+    assert (tmp_path / "done" / "oomy.parked").exists()
+    assert not (tmp_path / "done" / "oomy").exists()
+    assert not (tmp_path / "done" / "oomy.fails").exists()
+
+
+def test_success_clears_failure_state(tmp_path):
+    _bash(tmp_path, "run_stage s 10 false || true")
+    _bash(tmp_path, "run_stage s 10 true")
+    assert (tmp_path / "done" / "s").exists()
+    assert not (tmp_path / "done" / "s.fails").exists()
+    assert not (tmp_path / "done" / "s.parked").exists()

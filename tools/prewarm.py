@@ -1,0 +1,84 @@
+"""Populate the persistent XLA compile cache with the exact headline
+program (compile + ONE call, nothing timed).
+
+The headline stage's dominant cost is the first 20-40 s tunnel compile
+(BASELINE.md); the timed calls themselves are sub-second.  Running the
+compile as its own cheap queue stage means a tunnel alive window too
+short to certify still banks the compile into the repo-local
+``.jax_cache`` (utils/compile_cache.py) — after which ANY later headline
+attempt, including the driver's end-of-round ``bench.py`` run, loads the
+executable from disk and finishes in seconds (VERDICT round-4 weak #6 /
+next #1).
+
+Keep the program construction in lockstep with ``bench.py``'s
+``_headline``: the cache key is the traced program, so any drift
+(steps_per_call, block_rows, dtype, board shape) silently makes this a
+no-op.  Both paths are compiled — pallas (the auto winner) and bitpack
+(its fallback) — so the fallback branch is also warm.
+
+Exit 0 = at least the pallas headline program is cached and produced a
+live board.  Callers wrap in a hard timeout (a wedged tunnel hangs).
+"""
+
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import jax.numpy as jnp
+import numpy as np
+
+from akka_game_of_life_tpu.cli import _apply_platform
+
+_apply_platform(None)  # pins the image's platform + arms the compile cache
+
+from akka_game_of_life_tpu.ops import bitpack, pallas_stencil  # noqa: E402
+from akka_game_of_life_tpu.ops.rules import CONWAY  # noqa: E402
+
+# bench.py defaults (--size / --steps-per-call / --block-rows); argv
+# overrides exist ONLY for CPU smoke tests — a non-default size compiles
+# a different program and warms nothing the headline uses.
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+STEPS_PER_CALL = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+BLOCK_ROWS = 128
+
+
+def _prewarm(kernel: str) -> None:
+    rng = np.random.default_rng(0)
+    board = jnp.asarray(
+        rng.integers(0, 2**32, size=(N, N // 32), dtype=np.uint32)
+    )
+    if kernel == "pallas":
+        run = pallas_stencil.packed_multi_step_fn(
+            CONWAY, STEPS_PER_CALL, block_rows=BLOCK_ROWS,
+            steps_per_sweep=None, vmem_limit_bytes=None,
+        )
+    else:
+        run = bitpack.packed_multi_step_fn(CONWAY, STEPS_PER_CALL)
+    t0 = time.perf_counter()
+    board = run(board)
+    pop = int(jnp.sum(jnp.bitwise_count(board)))  # the fetch forces execution
+    assert pop > 0, f"{kernel}: board died — prewarmed a broken program"
+    print(
+        f"prewarm {kernel}: compile+1 call in {time.perf_counter() - t0:.1f}s,"
+        f" pop={pop}",
+        flush=True,
+    )
+
+
+def main() -> int:
+    failures = []
+    for kernel in ("pallas", "bitpack"):
+        try:
+            _prewarm(kernel)
+        except Exception as e:  # noqa: BLE001 — warm the other path regardless
+            failures.append(kernel)
+            print(f"prewarm {kernel} FAILED: {type(e).__name__}: {e}", flush=True)
+    # bitpack is only the fallback; the stage succeeds iff the primary
+    # (pallas) program is banked.
+    return 1 if "pallas" in failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,16 +14,33 @@
 #     that has not yet succeeded, under its own timeout sized so that
 #     one ~10-minute alive window usually completes it;
 #   * stamps stages done on rc=0 (stamp files in $OUT/done/), retries
-#     wedge-like failures (timeout/hang) indefinitely, and gives up on
-#     a stage after $MAX_TRIES non-timeout failures so a deterministic
-#     error cannot loop forever;
+#     wedge-like failures (timeout/hang) indefinitely, and PARKS a
+#     stage (separate .parked marker, NOT the done stamp) after
+#     $MAX_TRIES non-timeout failures so a deterministic error cannot
+#     loop forever within a window;
+#   * clears parked markers and .fails counters at the start of every
+#     FRESH alive window (probe ok after >=1 failed probe), and ALSO
+#     ages parked markers out after $PARK_RETRY_S (a continuously-alive
+#     tunnel has no window boundary): a wedge-at-init that fails fast
+#     can park a stage — including the headline, the round's one scored
+#     number — and it must be retried, not skipped forever (round-4
+#     advisor finding, medium);
+#   * rc=137 (SIGKILL) gets its own higher cap $MAX_KILLS: it is
+#     ambiguous between timeout's -k kill of a SIGTERM-immune wedge
+#     (retry-forever territory) and the OOM killer (deterministic —
+#     plausible for the 65536^2 product runs); retrying it
+#     unconditionally would let one OOM-looping stage starve every
+#     lower-priority stage in each alive window (round-4 advisor);
 #   * re-probes between stages, so a wedge mid-window just parks the
 #     queue until the next window.
 #
-# Priority = VERDICT round-3 ranking: the driver-certifiable headline
-# first, then the per-family bench lines (ltl-8192 re-run, wireworld
-# 4x, generations A/B), the sharded A/B, the tune sweeps, selftest,
-# product runs last (longest, least per-minute value).
+# Priority = VERDICT round-4 ranking: compile-cache prewarm first (a
+# window too short to certify still banks the 20-40 s tunnel compile,
+# making the next headline attempt near-instant), then the driver-
+# certifiable headline, the per-family bench lines (ltl-8192, wireworld
+# 4x, generations A/B, pallas-ltl A/B — all in bench-full), the sharded
+# A/B, the skipped auto->pallas on-chip test, the obs-defer product A/B,
+# the tune sweeps, selftest, remaining product runs last.
 #
 #   bash tools/tpu_opportunist.sh [outdir]
 set -u
@@ -32,7 +49,9 @@ set -u
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 OUT="${1:-/tmp/tpu_opportunist}"
 mkdir -p "$OUT/done"
-MAX_TRIES=3
+MAX_TRIES=3     # non-timeout failures before parking (until next window)
+MAX_KILLS=6     # rc=137 SIGKILLs before parking (OOM-vs-wedge ambiguity)
+PARK_RETRY_S=1800  # time-based unpark when no window boundary occurs
 
 log() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$OUT/session.log"; }
 
@@ -40,6 +59,53 @@ log() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$OUT/session.log"; }
 # uninterruptible tunnel I/O must not hang the loop (the whole point).
 probe_ok() {
   timeout -k 30 120 python tools/probe.py >> "$OUT/probe.log" 2>&1
+}
+
+# Count a failure of $kind for $name and park the stage at $cap.  The
+# marker holds the park time so unpark_expired can age it out.
+count_and_park() {
+  local name="$1" kind="$2" cap="$3" n=0
+  [ -f "$OUT/done/$name.$kind" ] && n=$(cat "$OUT/done/$name.$kind")
+  n=$((n + 1)); echo "$n" > "$OUT/done/$name.$kind"
+  if [ "$n" -ge "$cap" ]; then
+    log "stage $name parked after $n $kind failures (unparked at next window or after ${PARK_RETRY_S}s)"
+    date +%s > "$OUT/done/$name.parked"
+  fi
+}
+
+# A fresh alive window: every parked stage gets another chance and the
+# deterministic-failure counters restart — only an error deterministic
+# WITHIN a window should park, never one wedge's fast-failing init.
+# .kills deliberately PERSISTS across windows: clearing it would let an
+# OOM-looping stage (rc=137 every ~4 min) reset its own cap at every
+# flap and retry unboundedly — persisted, it parks at MAX_KILLS and each
+# later window grants exactly ONE retry (unpark -> fail -> n>=cap ->
+# re-park), so a wedge-killed stage still comes back but an OOM looper
+# costs one slot per window, not the whole window.
+new_window() {
+  rm -f "$OUT"/done/*.parked "$OUT"/done/*.fails 2>/dev/null
+  return 0
+}
+
+# Age out parked markers: with a continuously-alive tunnel there is no
+# probe fail->ok transition to run new_window, and a non-empty queue
+# never reaches the all-parked fallback — without a time-based release a
+# parked headline (the round's one scored stage) could sit skipped for
+# hours behind 3600s product stages.  Invalid/empty marker content reads
+# as park-time 0, i.e. instantly expired.
+unpark_expired() {
+  local f t now
+  now=$(date +%s)
+  for f in "$OUT"/done/*.parked; do
+    [ -e "$f" ] || return 0
+    t=$(cat "$f" 2>/dev/null); t="${t:-0}"
+    case "$t" in *[!0-9]*) t=0 ;; esac
+    if [ $((now - t)) -ge "$PARK_RETRY_S" ]; then
+      log "unparking $(basename "$f" .parked) (parked ${PARK_RETRY_S}s+ ago)"
+      rm -f "$f"
+    fi
+  done
+  return 0
 }
 
 # stage <name> <timeout_s> <cmd...>
@@ -53,41 +119,51 @@ run_stage() {
   log "stage $name rc=$rc"
   if [ "$rc" -eq 0 ]; then
     touch "$OUT/done/$name"
+    rm -f "$OUT/done/$name.parked" "$OUT/done/$name.fails" \
+      "$OUT/done/$name.kills"
     # Auto-archive: bench.py's last_measured enrichment (and the judge)
     # read artifacts/ — a completed stage's evidence lands there
     # immediately, not at manual-harvest time.  (Unit tests set
     # GOL_OPPORTUNIST_ARCHIVE=0 so stub stages don't pollute artifacts/.)
     if [ "${GOL_OPPORTUNIST_ARCHIVE:-1}" != "0" ]; then
-      mkdir -p artifacts/tpu_session_r4 \
-        && cp "$OUT/$name.log" artifacts/tpu_session_r4/ 2>/dev/null
+      mkdir -p artifacts/tpu_session_r5 \
+        && cp "$OUT/$name.log" artifacts/tpu_session_r5/ 2>/dev/null
     fi
-  elif [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then
-    # 124 = timeout SIGTERM, 137 = timeout's -k SIGKILL after a SIGTERM-
-    # immune wedge: both are tunnel hangs, retried forever by design.
+  elif [ "$rc" -eq 124 ]; then
+    : # timeout SIGTERM = tunnel hang; retried forever by design.
+  elif [ "$rc" -eq 137 ]; then
+    count_and_park "$name" kills "$MAX_KILLS"
+  else
     # Non-timeout failure: could still be tunnel-wedge-at-init (which
-    # fails fast on axon sometimes) — allow MAX_TRIES before giving up.
-    local n=0
-    [ -f "$OUT/done/$name.fails" ] && n=$(cat "$OUT/done/$name.fails")
-    n=$((n + 1)); echo "$n" > "$OUT/done/$name.fails"
-    if [ "$n" -ge "$MAX_TRIES" ]; then
-      log "stage $name gave up after $n non-timeout failures"
-      touch "$OUT/done/$name"   # park it; the log carries the evidence
-    fi
+    # fails fast on axon sometimes) — allow MAX_TRIES before parking.
+    count_and_park "$name" fails "$MAX_TRIES"
   fi
   return $rc
 }
 
-# The queue: "name timeout_s command...".  One line per stage.
-next_stage() {  # prints the first not-done stage name, or nothing
-  for s in headline bench-full bench-sharded tpu-tests-auto tune-65536 \
-           tune-8192 tune-gen-8192 tune-ltl-8192 selftest product-run \
-           product-run-defer-obs product-run-sparse-obs product-run-60; do
-    [ -f "$OUT/done/$s" ] || { echo "$s"; return; }
+# The queue, in priority order.  One name per line in dispatch below.
+next_stage() {  # prints the first runnable (not done, not parked) stage
+  for s in prewarm headline bench-full bench-sharded tpu-tests-auto \
+           product-run product-run-defer-obs tune-65536 tune-8192 \
+           tune-gen-8192 tune-ltl-8192 selftest product-run-sparse-obs \
+           product-run-60; do
+    [ -f "$OUT/done/$s" ] && continue
+    [ -f "$OUT/done/$s.parked" ] && continue
+    echo "$s"; return
   done
 }
 
+any_parked() { ls "$OUT"/done/*.parked >/dev/null 2>&1; }
+
 dispatch() {
   case "$1" in
+    prewarm)
+      # Populate the persistent compile cache with the exact headline
+      # program (compile + one call, nothing timed): a window too short
+      # to certify still banks the dominant 20-40 s cost, so the NEXT
+      # headline attempt — ours or the driver's end-of-round bench —
+      # completes in seconds (VERDICT round-4 weak #6).
+      run_stage prewarm 600 python tools/prewarm.py ;;
     headline)
       # The certified-style headline alone: one compile + 2 timed calls,
       # well inside a short alive window.  Probe already ran, so skip
@@ -105,6 +181,24 @@ dispatch() {
       # the refactored product loop); the other two passed on-chip then.
       run_stage tpu-tests-auto 900 env GOL_TPU_TESTS=1 \
         python -m pytest tests/test_pallas_tpu.py -k auto_promotes -v ;;
+    product-run)
+      rm -rf "$OUT/ckpt65536"
+      run_stage product-run 3600 python -m akka_game_of_life_tpu run \
+        --height 65536 --width 65536 --max-epochs 1920 --steps-per-call 64 \
+        --pattern gosper-glider-gun --probe-window 2:11,2:38 \
+        --render-every 960 --metrics-every 64 \
+        --checkpoint-dir "$OUT/ckpt65536" --checkpoint-every 960 ;;
+    product-run-defer-obs)
+      # The deferred-observation hypothesis on hardware: same config as
+      # product-run but cadence fetches resolve one chunk later, under the
+      # next chunk's compute — if the product-vs-bench gap is the per-chunk
+      # host round-trip, this run closes it (VERDICT round-4 next #3).
+      rm -rf "$OUT/ckpt65536d"
+      run_stage product-run-defer-obs 3600 python -m akka_game_of_life_tpu run \
+        --height 65536 --width 65536 --max-epochs 1920 --steps-per-call 64 \
+        --pattern gosper-glider-gun --probe-window 2:11,2:38 \
+        --render-every 960 --metrics-every 64 --obs-defer \
+        --checkpoint-dir "$OUT/ckpt65536d" --checkpoint-every 960 ;;
     tune-65536)
       run_stage tune-65536 1500 python -m akka_game_of_life_tpu tune \
         --size 65536 ;;
@@ -122,24 +216,6 @@ dispatch() {
         --blocks 64,128,256,512 --sweeps 1 ;;
     selftest)
       run_stage selftest 900 python -m akka_game_of_life_tpu selftest ;;
-    product-run)
-      rm -rf "$OUT/ckpt65536"
-      run_stage product-run 3600 python -m akka_game_of_life_tpu run \
-        --height 65536 --width 65536 --max-epochs 1920 --steps-per-call 64 \
-        --pattern gosper-glider-gun --probe-window 2:11,2:38 \
-        --render-every 960 --metrics-every 64 \
-        --checkpoint-dir "$OUT/ckpt65536" --checkpoint-every 960 ;;
-    product-run-defer-obs)
-      # The deferred-observation hypothesis on hardware: same config as
-      # product-run but cadence fetches resolve one chunk later, under the
-      # next chunk's compute — if the product-vs-bench gap is the per-chunk
-      # host round-trip, this run closes it.
-      rm -rf "$OUT/ckpt65536d"
-      run_stage product-run-defer-obs 3600 python -m akka_game_of_life_tpu run \
-        --height 65536 --width 65536 --max-epochs 1920 --steps-per-call 64 \
-        --pattern gosper-glider-gun --probe-window 2:11,2:38 \
-        --render-every 960 --metrics-every 64 --obs-defer \
-        --checkpoint-dir "$OUT/ckpt65536d" --checkpoint-every 960 ;;
     product-run-sparse-obs)
       rm -rf "$OUT/ckpt65536c"
       run_stage product-run-sparse-obs 3600 python -m akka_game_of_life_tpu run \
@@ -163,13 +239,32 @@ main() {
   # pattern-matching, which can match the operator's own shell wrapper.
   echo $$ > "$OUT/pid"
   log "opportunist start, queue: $(next_stage) ..."
+  # fail, not ok: the first successful probe counts as a fresh window so
+  # parked markers left by a previous run (or a prior wedge) are cleared.
+  local prev_probe=fail
   while :; do
+    unpark_expired
     s="$(next_stage)"
-    [ -n "$s" ] || { log "all stages done"; break; }
+    if [ -z "$s" ]; then
+      if any_parked; then
+        # Everything runnable is done but parked stages remain; wait for
+        # unpark_expired to age them out (the loop keeps cycling).
+        log "only parked stages remain; waiting for time-based unpark"
+        sleep 180
+        continue
+      fi
+      log "all stages done"; break
+    fi
     if probe_ok; then
+      if [ "$prev_probe" != ok ]; then
+        new_window
+        s="$(next_stage)"
+      fi
+      prev_probe=ok
       log "probe ok -> running $s"
       dispatch "$s"
     else
+      prev_probe=fail
       log "probe failed (tunnel wedged); retrying in 180s (pending: $s)"
       sleep 180
     fi
