@@ -498,7 +498,11 @@ def _other_commands(args) -> int:
     if args.command == "tune":
         import json
 
-        from akka_game_of_life_tpu.runtime.autotune import best_flags, sweep
+        from akka_game_of_life_tpu.runtime.autotune import (
+            best_flags,
+            best_point,
+            sweep,
+        )
 
         results = sweep(
             args.size,
@@ -516,6 +520,22 @@ def _other_commands(args) -> int:
         if flags is None:
             print("no feasible point succeeded", file=sys.stderr)
             return 1
+        # Machine-readable summary line: what a harvest script (or the
+        # MEASURED_BLOCK_ROWS_CAPS table update) greps out of an archived
+        # tune log without re-parsing the per-point lines above.  best_point
+        # is the same selection best_flags rendered, so the two cannot
+        # drift apart.
+        best = best_point(results)
+        print(
+            json.dumps(
+                {
+                    "tune": {"size": args.size, "rule": args.rule},
+                    "best": best,
+                    "flags": flags,
+                }
+            ),
+            flush=True,
+        )
         print(f"best: {flags}")
         return 0
 

@@ -188,6 +188,16 @@ def sweep(
     return results
 
 
+def best_point(results: List[dict]) -> Optional[dict]:
+    """The single winning-point selection, shared by ``best_flags`` and the
+    CLI's machine-readable summary line so the two can never describe
+    different points."""
+    for p in results:
+        if "cells_per_sec" in p:
+            return p
+    return None
+
+
 def best_flags(results: List[dict], rule="conway") -> Optional[str]:
     """The winning point as ready-to-paste flags — only flags that actually
     drive the tuned kernel.
@@ -204,9 +214,8 @@ def best_flags(results: List[dict], rule="conway") -> Optional[str]:
     from akka_game_of_life_tpu.ops.rules import resolve_rule
 
     rule = resolve_rule(rule)
-    for p in results:
-        if "cells_per_sec" not in p:
-            continue
+    p = best_point(results)
+    if p is not None:
         b, k = p["block_rows"], p["steps_per_sweep"]
         if rule.kind == "ltl":
             flags = (

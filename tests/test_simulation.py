@@ -595,13 +595,20 @@ def test_cli_tune_interpret_smoke(capsys):
     )
     assert rc == 0
     out = capsys.readouterr().out.splitlines()
-    points = [json.loads(l) for l in out if l.startswith("{")]
+    recs = [json.loads(l) for l in out if l.startswith("{")]
+    points = [p for p in recs if "block_rows" in p]
     # size 128: blocks 8/16 divide, 24 doesn't; k=3 doesn't divide 4.
     combos = {(p["block_rows"], p["steps_per_sweep"]) for p in points}
     assert combos == {(8, 1), (8, 2), (16, 1), (16, 2)}
     rates = [p["cells_per_sec"] for p in points if "cells_per_sec" in p]
     assert rates == sorted(rates, reverse=True)
     assert any(l.startswith("best: bench.py --block-rows") for l in out)
+    # The machine-readable summary line a harvest script greps out of an
+    # archived tune log: the sweep identity, the winning point, the flags.
+    (summary,) = [r for r in recs if "tune" in r]
+    assert summary["tune"] == {"size": 128, "rule": "conway"}
+    assert summary["best"] == points[0]
+    assert "--block-rows" in summary["flags"]
 
 
 def test_cli_tune_gen_rule_interpret_smoke(capsys):
@@ -621,7 +628,8 @@ def test_cli_tune_gen_rule_interpret_smoke(capsys):
     )
     assert rc == 0
     out = capsys.readouterr().out.splitlines()
-    points = [json.loads(l) for l in out if l.startswith("{")]
+    recs = [json.loads(l) for l in out if l.startswith("{")]
+    points = [p for p in recs if "block_rows" in p]
     assert {(p["block_rows"], p["steps_per_sweep"]) for p in points} == {
         (8, 2),
         (16, 2),
@@ -646,7 +654,8 @@ def test_cli_tune_ltl_rule_interpret_smoke(capsys):
     )
     assert rc == 0
     out = capsys.readouterr().out.splitlines()
-    points = [json.loads(l) for l in out if l.startswith("{")]
+    recs = [json.loads(l) for l in out if l.startswith("{")]
+    points = [p for p in recs if "block_rows" in p]
     # 12 is not an 8-multiple; feasible blocks sweep at k=1 only.
     assert {(p["block_rows"], p["steps_per_sweep"]) for p in points} == {
         (8, 1),
