@@ -65,6 +65,10 @@ mkdir -p "$OUT/done"
 MAX_TRIES=3     # non-timeout failures before parking (until next window)
 MAX_KILLS=6     # rc=137 SIGKILLs before parking (OOM-vs-wedge ambiguity)
 PARK_RETRY_S=1800  # time-based unpark when no window boundary occurs
+# Loop sleeps, env-overridable so the unit tests can drive main() in
+# milliseconds-not-minutes; production never sets these.
+WEDGE_SLEEP_S="${GOL_OPPORTUNIST_WEDGE_SLEEP_S:-180}"
+PARKED_SLEEP_S="${GOL_OPPORTUNIST_PARKED_SLEEP_S:-180}"
 
 log() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$OUT/session.log"; }
 
@@ -263,7 +267,7 @@ main() {
         # Everything runnable is done but parked stages remain; wait for
         # unpark_expired to age them out (the loop keeps cycling).
         log "only parked stages remain; waiting for time-based unpark"
-        sleep 180
+        sleep "$PARKED_SLEEP_S"
         continue
       fi
       log "all stages done"; break
@@ -278,8 +282,8 @@ main() {
       dispatch "$s"
     else
       prev_probe=fail
-      log "probe failed (tunnel wedged); retrying in 180s (pending: $s)"
-      sleep 180
+      log "probe failed (tunnel wedged); retrying in ${WEDGE_SLEEP_S}s (pending: $s)"
+      sleep "$WEDGE_SLEEP_S"
     fi
   done
   log "opportunist done"
