@@ -29,6 +29,7 @@ for f in "$D"/tune-*.log; do
   [ -f "$f" ] || continue
   echo "-- $(basename "$f")"
   grep '^best:' "$f" 2>/dev/null
+  grep '"tune"' "$f" 2>/dev/null   # machine-readable summary line
   grep '"cells_per_sec"' "$f" 2>/dev/null | head -3
 done
 
