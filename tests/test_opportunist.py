@@ -19,7 +19,7 @@ ALL_STAGES = (
     "prewarm headline bench-full bench-sharded tpu-tests-auto "
     "product-run product-run-defer-obs tune-65536 tune-8192 "
     "tune-gen-8192 tune-ltl-8192 selftest product-run-sparse-obs "
-    "product-run-60"
+    "product-run-60 tune-65536-vmem"
 ).split()
 
 

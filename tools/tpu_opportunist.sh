@@ -163,7 +163,7 @@ next_stage() {  # prints the first runnable (not done, not parked) stage
   for s in prewarm headline bench-full bench-sharded tpu-tests-auto \
            product-run product-run-defer-obs tune-65536 tune-8192 \
            tune-gen-8192 tune-ltl-8192 selftest product-run-sparse-obs \
-           product-run-60; do
+           product-run-60 tune-65536-vmem; do
     [ -f "$OUT/done/$s" ] && continue
     [ -f "$OUT/done/$s.parked" ] && continue
     echo "$s"; return
@@ -219,6 +219,13 @@ dispatch() {
     tune-65536)
       run_stage tune-65536 1500 python -m akka_game_of_life_tpu tune \
         --size 65536 ;;
+    tune-65536-vmem)
+      # The unexplored corner of the round-3 sweep: b>=256 at 65536^2
+      # needs a raised Mosaic scoped-VMEM budget and was never timed —
+      # if a deeper block beats b=128, the headline flags change.
+      run_stage tune-65536-vmem 1500 python -m akka_game_of_life_tpu tune \
+        --size 65536 --blocks 256,512 --sweeps 8,16,32 \
+        --vmem-limit-mb 96 ;;
     tune-8192)
       run_stage tune-8192 1500 python -m akka_game_of_life_tpu tune \
         --size 8192 --steps-per-call 1024 --timed-calls 4 \
