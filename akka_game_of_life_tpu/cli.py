@@ -109,6 +109,24 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--render-max-cells", type=int)
     p.add_argument("--metrics-every", type=int)
     p.add_argument(
+        "--metrics-file",
+        help="dump Prometheus text exposition here at metrics cadence and "
+        "on exit (atomic write; scrape-safe)",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        help="serve live /metrics (Prometheus text) and /healthz on this "
+        "port for the run/frontend roles (0 = off)",
+    )
+    p.add_argument(
+        "--log-events",
+        metavar="PATH",
+        help="append structured JSONL lifecycle events (crashes, "
+        "recoveries, checkpoints, membership) here, with monotonic "
+        "timestamps and per-node labels",
+    )
+    p.add_argument(
         "--obs-defer",
         action="store_true",
         default=None,
@@ -177,6 +195,9 @@ def _overrides(args: argparse.Namespace) -> dict:
         "render_max_cells": args.render_max_cells,
         "probe_window": _parse_window(args.probe_window),
         "metrics_every": args.metrics_every,
+        "metrics_file": args.metrics_file,
+        "metrics_port": args.metrics_port,
+        "log_events": args.log_events,
         "obs_defer": args.obs_defer,
         "log_file": args.log_file,
         "distributed": args.distributed,
@@ -316,6 +337,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "ctypes)",
     )
     be_p.add_argument(
+        "--metrics-file",
+        help="dump this worker's Prometheus exposition here every few "
+        "seconds and on exit (the worker's peer/data-plane counters live "
+        "in this process, not the frontend's)",
+    )
+    be_p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="serve this worker's live /metrics + /healthz on this port "
+        "(0 = off)",
+    )
+    be_p.add_argument(
+        "--log-events",
+        metavar="PATH",
+        help="append worker-labeled JSONL lifecycle events here",
+    )
+    be_p.add_argument(
         "--pallas",
         choices=["auto", "off", "interpret"],
         default=None,
@@ -353,7 +392,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             cfg.max_epochs = 100
         sim = Simulation(cfg)
 
-        with _sigterm_as_interrupt():
+        with _sigterm_as_interrupt(), _metrics_endpoint(cfg, sim):
             try:
                 return _run_simulation(args, cfg, sim)
             except KeyboardInterrupt:
@@ -395,6 +434,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 return 130
 
     return _other_commands(args)
+
+
+@contextlib.contextmanager
+def _metrics_endpoint(cfg, sim):
+    """Live /metrics + /healthz for the standalone role while the run body
+    executes (the frontend role starts its own in Frontend.start)."""
+    import jax
+
+    if not cfg.metrics_port or jax.process_index() != 0:
+        yield
+        return
+    from akka_game_of_life_tpu.obs import MetricsServer
+
+    server = MetricsServer(
+        sim.metrics,
+        port=cfg.metrics_port,
+        health=lambda: {"ok": True, "epoch": sim.epoch},
+    )
+    print(f"metrics on :{server.port}/metrics (+/healthz)", flush=True)
+    try:
+        yield
+    finally:
+        server.close()
 
 
 def _run_simulation(args, cfg, sim) -> int:
@@ -596,6 +658,9 @@ def _other_commands(args) -> int:
                     name=args.name,
                     engine=args.engine,
                     pallas=args.pallas,
+                    metrics_file=args.metrics_file,
+                    metrics_port=args.metrics_port,
+                    log_events=args.log_events,
                 )
             except KeyboardInterrupt:
                 # run_backend handles interrupts inside its serve loop; this

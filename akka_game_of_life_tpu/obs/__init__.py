@@ -1,0 +1,43 @@
+"""Unified observability: metrics registry, structured events, exposition.
+
+The runtime's answer to "what is the steps/s right now, how many peer
+retries fired, how many chaos crashes were recovered" — without grepping
+stdout:
+
+- :class:`MetricsRegistry` — thread-safe counters/gauges/histograms,
+  rendered as Prometheus text exposition (``registry.render()`` /
+  ``registry.write(path)``);
+- :class:`EventLog` — structured JSONL lifecycle events with monotonic
+  timestamps and per-node labels (``--log-events``);
+- :class:`MetricsServer` — live ``/metrics`` + ``/healthz`` HTTP endpoint
+  (``--metrics-port``);
+- :mod:`.catalog` — every exported metric, declared once, pre-registered
+  into the default registry and lint-checked against the operations doc.
+
+Instrumented layers: the simulation hot loop, the cluster backend's peer
+data plane and retry machinery, the frontend's membership/redeploy paths,
+the chaos injector, and both checkpoint stores.
+"""
+
+from akka_game_of_life_tpu.obs.catalog import CATALOG, install
+from akka_game_of_life_tpu.obs.events import NULL_EVENTS, EventLog, read_events
+from akka_game_of_life_tpu.obs.httpd import MetricsServer
+from akka_game_of_life_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    MetricsRegistry,
+    escape_label_value,
+    get_registry,
+)
+
+__all__ = [
+    "CATALOG",
+    "DEFAULT_BUCKETS",
+    "EventLog",
+    "MetricsRegistry",
+    "MetricsServer",
+    "NULL_EVENTS",
+    "escape_label_value",
+    "get_registry",
+    "install",
+    "read_events",
+]

@@ -1,0 +1,100 @@
+"""The standard metric catalog — every metric the runtime exports, declared
+in one place.
+
+This is the single source of truth three consumers share:
+
+- :func:`install` pre-registers every family into a registry, so a scrape
+  (or a ``--metrics-file`` dump) shows the full metric surface even for
+  paths that never fired in this process — a standalone run still exposes
+  ``gol_peer_retries_total 0``;
+- ``docs/OPERATIONS.md`` documents the same names (the "Metrics & events"
+  table);
+- ``tools/check_metrics_doc.py`` (driven by a tier-1 test) asserts the two
+  cannot drift: every name here AND every ``gol_*`` literal in the source
+  must appear in the doc.
+
+Naming follows Prometheus conventions: ``_total`` counters, ``_seconds``
+histograms, bare gauges; everything is prefixed ``gol_``.
+"""
+
+from __future__ import annotations
+
+from akka_game_of_life_tpu.obs.metrics import DEFAULT_BUCKETS, MetricsRegistry
+
+# (name, kind, help, labelnames) — histograms all use DEFAULT_BUCKETS.
+CATALOG = (
+    # -- simulation hot path (L3) --------------------------------------------
+    ("gol_epochs_advanced_total", "counter",
+     "Generations advanced by the local simulation loop", ()),
+    ("gol_chunks_total", "counter",
+     "Stepper chunks dispatched (one device round-trip each)", ()),
+    ("gol_step_seconds", "histogram",
+     "Wall seconds per stepper chunk (dispatch to board swap)", ()),
+    ("gol_obs_seconds", "histogram",
+     "Wall seconds per cadence observation (device dispatch + host fetch)",
+     ()),
+    ("gol_epoch", "gauge", "Current simulation epoch", ()),
+    ("gol_population", "gauge", "Last observed live-cell population", ()),
+    ("gol_steps_per_second", "gauge",
+     "Epochs per wall second over the last observed interval", ()),
+    ("gol_halo_bytes_total", "counter",
+     "Halo bytes exchanged over the device mesh (analytic, per chunk)", ()),
+    # -- cluster data/control plane (L1/L2) ----------------------------------
+    ("gol_peer_sends_total", "counter",
+     "Peer data-plane messages sent (rings, pulls, hellos)", ()),
+    ("gol_peer_receives_total", "counter",
+     "PEER_RING messages received from peer workers", ()),
+    ("gol_peer_retries_total", "counter",
+     "Stale-halo re-pulls fired by the retry loop (one per stale tile "
+     "per round; rounds are gol_retry_wakeups_total)", ()),
+    ("gol_retry_wakeups_total", "counter",
+     "Retry-loop passes that found at least one stale tile", ()),
+    ("gol_peer_drops_total", "counter",
+     "Peer channels dropped (dead or stale-address peers)", ()),
+    ("gol_heartbeats_total", "counter", "Heartbeats sent to the frontend", ()),
+    ("gol_gather_failures_total", "counter",
+     "GATHER_FAILED escalations sent after the retry budget", ()),
+    ("gol_ring_bytes_total", "counter",
+     "Boundary-ring payload bytes pushed to remote peers", ()),
+    ("gol_members_alive", "gauge", "Cluster members currently alive", ()),
+    ("gol_members_joined_total", "counter", "Workers that ever joined", ()),
+    ("gol_members_lost_total", "counter",
+     "Workers lost (EOF, stale heartbeat, or GOODBYE)", ()),
+    ("gol_redeploys_total", "counter",
+     "Tile redeployments (crash recovery, stuck escalation, node loss)", ()),
+    # -- chaos / failure paths -----------------------------------------------
+    ("gol_chaos_crashes_total", "counter",
+     "Crashes fired by the chaos injector (any mode)", ()),
+    ("gol_chaos_recovered_total", "counter",
+     "Injected crashes recovered by checkpoint restore + replay "
+     "(standalone runtime; cluster recovery surfaces as "
+     "gol_redeploys_total)", ()),
+    ("gol_chaos_replay_epochs_total", "counter",
+     "Epochs recomputed during standalone crash-recovery replay", ()),
+    # -- checkpoint / durability ---------------------------------------------
+    ("gol_checkpoint_saves_total", "counter",
+     "Checkpoint saves made durable (full-board or finalized per-tile)", ()),
+    ("gol_checkpoint_restores_total", "counter",
+     "Checkpoint loads (resume, recovery, or inspection)", ()),
+    ("gol_checkpoint_seconds", "histogram",
+     "Checkpoint IO wall seconds", ("op",)),
+    # -- profiling spans -----------------------------------------------------
+    ("gol_span_seconds", "histogram",
+     "profiling.timed() span wall seconds", ("span",)),
+)
+
+
+def install(registry: MetricsRegistry) -> MetricsRegistry:
+    """Pre-register every cataloged family into ``registry`` (idempotent)."""
+    for name, kind, help, labelnames in CATALOG:
+        if kind == "counter":
+            registry.counter(name, help, labelnames)
+        elif kind == "gauge":
+            registry.gauge(name, help, labelnames)
+        else:
+            registry.histogram(name, help, labelnames, buckets=DEFAULT_BUCKETS)
+    return registry
+
+
+def names() -> tuple:
+    return tuple(n for n, _, _, _ in CATALOG)

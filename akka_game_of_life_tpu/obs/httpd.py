@@ -1,0 +1,95 @@
+"""Live exposition endpoint: ``/metrics`` + ``/healthz`` over plain HTTP.
+
+``--metrics-port N`` on the ``run`` and ``frontend`` roles starts this
+server; ``curl localhost:N/metrics`` scrapes the registry in Prometheus
+text format, ``curl localhost:N/healthz`` answers a one-line JSON health
+document (HTTP 200 while the role considers itself healthy, 503 once it
+does not — the shape load balancers and k8s probes expect).
+
+Stdlib-only (``http.server``), threaded, daemonized: a scrape can never
+block the simulation loop, and an abandoned server cannot hold the process
+open.  Port 0 binds an ephemeral port (tests); the bound port is on
+``server.port``.
+
+The default bind is ``0.0.0.0`` — deliberate: probes and scrapers reach a
+containerized role over the pod/VM network, not loopback (the exporter
+convention).  The endpoint is unauthenticated and ``/healthz`` includes
+internal error strings, so on shared hosts either firewall the port or
+pass ``host="127.0.0.1"`` when constructing :class:`MetricsServer`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Serve one registry's exposition until :meth:`close`."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        health: Optional[Callable[[], dict]] = None,
+    ) -> None:
+        self.registry = registry
+        # Health contract: return a JSON-serializable dict; "ok" (default
+        # True) picks the status code.  Exceptions read as unhealthy.
+        self._health = health or (lambda: {"ok": True})
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = outer.registry.render().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/healthz":
+                    try:
+                        doc = dict(outer._health())
+                    except Exception as e:  # noqa: BLE001 — report, not raise
+                        doc = {"ok": False, "error": repr(e)}
+                    body = (json.dumps(doc) + "\n").encode("utf-8")
+                    self.send_response(200 if doc.get("ok", True) else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):  # scrapes must not spam stdout
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            daemon=True,
+            name=f"metrics-http-{self.port}",
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,371 @@
+"""Thread-safe runtime metrics — counters, gauges, fixed-bucket histograms.
+
+The reference system's only runtime signal is its log stream; this registry
+is the first-class replacement: every hot and failure path (stepper chunks,
+peer retries, chaos crashes, checkpoint IO) records into named instruments,
+and the whole registry renders as Prometheus text exposition (format 0.0.4)
+— dumped to ``--metrics-file`` on exit and served live at ``/metrics`` by
+:mod:`akka_game_of_life_tpu.obs.httpd`.
+
+Design points:
+
+- One lock per registry, taken only for child-creation and rendering;
+  increments hit per-instrument locks (counters are on hot-ish paths — the
+  retry loop, per-chunk accounting — but never inside jitted code).
+- Instruments are created idempotently: ``registry.counter(name)`` returns
+  the existing counter if the name is known, so instrumentation sites never
+  need to coordinate registration order.
+- Labeled instruments follow the Prometheus child model:
+  ``c.labels(mode="tile").inc()``.  Unlabeled instruments expose a sample
+  even at zero; labeled ones expose HELP/TYPE headers until a child exists
+  (so the catalog is visible in every scrape either way).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, Mapping, Optional, Sequence, Tuple
+
+# Latency buckets shared by the step/obs/checkpoint histograms: half-decade
+# log spacing from 0.5 ms to 60 s — wide enough for a CPU-interpret chunk
+# and fine enough to separate a 2 ms from a 5 ms TPU chunk.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+_NAME_OK = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+def _check_name(name: str) -> str:
+    if not name or name[0].isdigit() or any(ch not in _NAME_OK for ch in name):
+        raise ValueError(f"invalid metric name {name!r}")
+    return name
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double-quote,
+    and newline (in that order, so the backslash pass cannot re-escape)."""
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def format_value(v: float) -> str:
+    """Render a sample value: integers without a trailing .0, infinities in
+    Prometheus spelling."""
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if isinstance(v, float) and v != int(v):
+        return repr(v)
+    return str(int(v))
+
+
+def _labels_suffix(labels: Mapping[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels.items()
+    )
+    return "{" + inner + "}"
+
+
+class _Child:
+    """One (labelset, value) series of an instrument."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _CounterChild(_Child):
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counters are monotonic; cannot inc by {amount}")
+        with self._lock:
+            self._value += amount
+
+
+class _GaugeChild(_Child):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...]) -> None:
+        self._lock = threading.Lock()
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +1 for the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            i = 0
+            for i, le in enumerate(self.buckets):  # noqa: B007
+                if value <= le:
+                    break
+            else:
+                i = len(self.buckets)
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def snapshot(self) -> dict:
+        """Cumulative bucket counts keyed by upper bound, plus sum/count."""
+        with self._lock:
+            counts = list(self.counts)
+            total, n = self.sum, self.count
+        out, cum = {}, 0
+        for le, c in zip(self.buckets, counts):
+            cum += c
+            out[le] = cum
+        out[math.inf] = cum + counts[-1]
+        return {"buckets": out, "sum": total, "count": n}
+
+
+class _Instrument:
+    """A named metric family: type, help text, label names, children."""
+
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        help: str,
+        labelnames: Tuple[str, ...],
+        buckets: Optional[Tuple[float, ...]] = None,
+    ) -> None:
+        self.name = _check_name(name)
+        self.kind = kind
+        self.help = help
+        self.labelnames = labelnames
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not labelnames:
+            self._children[()] = self._new_child()
+
+    def _new_child(self):
+        if self.kind == "histogram":
+            return _HistogramChild(self.buckets)
+        return _CounterChild() if self.kind == "counter" else _GaugeChild()
+
+    def labels(self, **labels: str):
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, got "
+                f"{tuple(labels)}"
+            )
+        key = tuple(str(labels[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._new_child()
+        return child
+
+    # Unlabeled convenience passthroughs -------------------------------------
+
+    def _default(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is labeled {self.labelnames}; use .labels(...)"
+            )
+        return self._children[()]
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def series(self) -> Iterable[Tuple[Dict[str, str], object]]:
+        with self._lock:
+            items = list(self._children.items())
+        for key, child in items:
+            yield dict(zip(self.labelnames, key)), child
+
+
+class MetricsRegistry:
+    """A process- or component-scoped set of named instruments.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create: re-asking for a
+    known name returns the existing instrument (mismatched type or labels
+    raises, so two call sites cannot silently split a metric)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    def _get_or_create(
+        self,
+        name: str,
+        kind: str,
+        help: str,
+        labelnames: Sequence[str],
+        buckets: Optional[Sequence[float]] = None,
+    ) -> _Instrument:
+        labelnames = tuple(labelnames)
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is not None:
+                if inst.kind != kind or inst.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name} already registered as {inst.kind}"
+                        f"{inst.labelnames}; asked for {kind}{labelnames}"
+                    )
+                return inst
+            if buckets is not None:
+                buckets = tuple(sorted(float(b) for b in buckets))
+                if not buckets:
+                    raise ValueError("histogram needs at least one bucket")
+            inst = _Instrument(name, kind, help, labelnames, buckets)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        return self._get_or_create(name, "counter", help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        return self._get_or_create(name, "gauge", help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        return self._get_or_create(name, "histogram", help, labelnames, buckets)
+
+    def names(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._instruments))
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        with self._lock:
+            return self._instruments.get(name)
+
+    def value(self, name: str, **labels: str) -> float:
+        """Read one series' current value (0.0 for a never-touched labelset
+        of a known instrument) — the test/assertion surface."""
+        inst = self.get(name)
+        if inst is None:
+            raise KeyError(name)
+        if labels or inst.labelnames:
+            return inst.labels(**labels).value
+        return inst.value
+
+    # -- exposition ----------------------------------------------------------
+
+    def render(self) -> str:
+        """The whole registry in Prometheus text exposition format 0.0.4,
+        families sorted by name, with HELP/TYPE headers for every family
+        (including labeled families that have no series yet)."""
+        lines = []
+        with self._lock:
+            families = [self._instruments[n] for n in sorted(self._instruments)]
+        for inst in families:
+            if inst.help:
+                lines.append(f"# HELP {inst.name} {_escape_help(inst.help)}")
+            lines.append(f"# TYPE {inst.name} {inst.kind}")
+            for labels, child in inst.series():
+                if inst.kind == "histogram":
+                    snap = child.snapshot()
+                    for le, cum in snap["buckets"].items():
+                        bl = dict(labels)
+                        bl["le"] = format_value(le)
+                        lines.append(
+                            f"{inst.name}_bucket{_labels_suffix(bl)} {cum}"
+                        )
+                    lines.append(
+                        f"{inst.name}_sum{_labels_suffix(labels)} "
+                        f"{repr(snap['sum']) if snap['sum'] else '0'}"
+                    )
+                    lines.append(
+                        f"{inst.name}_count{_labels_suffix(labels)} "
+                        f"{snap['count']}"
+                    )
+                else:
+                    lines.append(
+                        f"{inst.name}{_labels_suffix(labels)} "
+                        f"{format_value(child.value)}"
+                    )
+        return "\n".join(lines) + "\n"
+
+    def write(self, path: str) -> None:
+        """Dump the exposition atomically (tmp + rename): a scrape of the
+        file never sees a torn write, matching the checkpoint store's
+        durability idiom."""
+        import os
+        import tempfile
+
+        text = self.render()
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".metrics_")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+
+_GLOBAL_LOCK = threading.Lock()
+_GLOBAL: Optional[MetricsRegistry] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry (created on first use, with the
+    standard catalog installed so every exposition shows the full metric
+    surface — zeros included)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            from akka_game_of_life_tpu.obs.catalog import install
+
+            _GLOBAL = MetricsRegistry()
+            install(_GLOBAL)
+        return _GLOBAL

@@ -127,6 +127,30 @@ def sharded_step_fn(
     return jax.jit(stepped, in_shardings=sharding, out_shardings=sharding)
 
 
+def exchange_bytes(
+    mesh_shape, tile_shape, pad: int, itemsize: int = 1
+) -> int:
+    """Analytic bytes ONE width-``pad`` halo exchange moves across the whole
+    mesh — the data-movement cost model behind the ``gol_halo_bytes_total``
+    metric (Casper's observation: halo traffic, not flops, prices a
+    distributed stencil).
+
+    Mirrors :func:`exchange_halo`'s two phases per device: ``2·pad`` boundary
+    rows of the (h, w) tile along the row axis, then ``2·pad`` boundary
+    columns of the row-padded ``(h+2·pad, w)`` tile along the column axis
+    (corners ride with phase 2).  A 1-long mesh axis moves nothing — the
+    ppermute is self-to-self.  ``itemsize`` prices the element (1 for dense
+    uint8 boards, 4 for packed uint32 word columns)."""
+    mr, mc = mesh_shape
+    h, w = tile_shape
+    per_tile = 0
+    if mr > 1:
+        per_tile += 2 * pad * w
+    if mc > 1:
+        per_tile += 2 * pad * (h + 2 * pad)
+    return mr * mc * per_tile * itemsize
+
+
 def validate_tile_shape(
     mesh: Mesh, board_shape, halo_width: int, radius: int = 1
 ) -> None:

@@ -50,6 +50,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from akka_game_of_life_tpu.obs import get_registry
 from akka_game_of_life_tpu.ops.npkernel import step_padded_np
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 from akka_game_of_life_tpu.runtime import protocol as P
@@ -331,6 +332,7 @@ class BackendWorker:
         max_pull_retries: int = 10,
         peer_host: str = "0.0.0.0",
         crash_hook: Optional[Callable[[], None]] = None,
+        registry=None,
     ) -> None:
         if engine not in ("numpy", "jax", "swar", "actor", "actor-native"):
             raise ValueError(
@@ -356,6 +358,20 @@ class BackendWorker:
         # DoCrashMsg → throw (CellActor.scala:95-96): default is an abrupt
         # process death; in-thread harnesses override to simulate it.
         self.crash_hook = crash_hook or (lambda: os._exit(42))
+
+        # Worker-side observability: the peer data plane and the retry/
+        # escalation machinery are exactly the paths the reference's log
+        # stream never surfaced (how many rings flowed, how many pulls went
+        # stale); counters make them first-class.
+        reg = registry if registry is not None else get_registry()
+        self._m_sends = reg.counter("gol_peer_sends_total")
+        self._m_receives = reg.counter("gol_peer_receives_total")
+        self._m_retries = reg.counter("gol_peer_retries_total")
+        self._m_wakeups = reg.counter("gol_retry_wakeups_total")
+        self._m_drops = reg.counter("gol_peer_drops_total")
+        self._m_heartbeats = reg.counter("gol_heartbeats_total")
+        self._m_gather_failures = reg.counter("gol_gather_failures_total")
+        self._m_ring_bytes = reg.counter("gol_ring_bytes_total")
 
         self.tiles: Dict[TileId, _Tile] = {}
         self.rule: Optional[Rule] = None
@@ -496,6 +512,7 @@ class BackendWorker:
                 with self._peer_lock:
                     self._peers.setdefault(name, channel)
         elif kind == P.PEER_RING:
+            self._m_receives.inc()
             if self.store is not None:
                 # push_ring fires queued local pull callbacks (_apply_halo).
                 self.store.push_ring(
@@ -511,6 +528,7 @@ class BackendWorker:
             for e, ring in rings:
                 try:
                     channel.send(_ring_msg(tile, e, ring))
+                    self._m_sends.inc()
                 except OSError:
                     return
 
@@ -546,6 +564,7 @@ class BackendWorker:
             ch = self._peers.pop(owner, None)
         if ch is not None:
             ch.close()
+            self._m_drops.inc()
 
     def owners_by_name(self) -> Dict[str, Tuple[str, int]]:
         with self._lock:
@@ -557,6 +576,7 @@ class BackendWorker:
             return
         try:
             ch.send(msg)
+            self._m_sends.inc()
         except OSError:
             # Stale address or dead peer: drop; OWNERS rewiring + the retry
             # loop's PEER_PULLs recover.
@@ -569,6 +589,7 @@ class BackendWorker:
             time.sleep(interval)
             try:
                 self.channel.send({"type": P.HEARTBEAT})
+                self._m_heartbeats.inc()
             except OSError:
                 return
 
@@ -602,6 +623,10 @@ class BackendWorker:
                         failed.append((tid, t.epoch))
                     t.awaiting_since = now
                     stale.append((tid, t.epoch))
+            if stale:
+                # One wakeup that found work; one retry per stale tile.
+                self._m_wakeups.inc()
+                self._m_retries.inc(len(stale))
             for tid, epoch in stale:
                 self._ask_missing(tid, epoch)
             for tid, epoch in failed:
@@ -609,6 +634,7 @@ class BackendWorker:
                     self.channel.send(
                         {"type": P.GATHER_FAILED, "tile": list(tid), "epoch": epoch}
                     )
+                    self._m_gather_failures.inc()
                 except OSError:
                     pass
 
@@ -912,6 +938,17 @@ class BackendWorker:
                 else set()
             )
         msg = _ring_msg(tid, epoch, ring)
+        if remote_owners:
+            # Wire-cost accounting (the Casper data-movement signal at the
+            # cluster layer): payload array bytes per remote copy pushed.
+            payload = (
+                ring.top.nbytes
+                + ring.bottom.nbytes
+                + ring.left.nbytes
+                + ring.right.nbytes
+                + sum(np.asarray(c).nbytes for c in ring.corners.values())
+            )
+            self._m_ring_bytes.inc(payload * len(remote_owners))
         for owner in remote_owners:
             self._send_peer(owner, msg)
         # Control-plane progress ping (no arrays): feeds the frontend's
@@ -986,9 +1023,64 @@ def run_backend(
     name: Optional[str] = None,
     engine: str = "jax",
     pallas: Optional[str] = None,
+    metrics_file: Optional[str] = None,
+    metrics_port: int = 0,
+    log_events: Optional[str] = None,
 ) -> int:
-    worker = BackendWorker(host, port, name=name, engine=engine, pallas=pallas)
+    """CLI worker entry.  The worker's data-plane counters (peer sends/
+    receives/retries, heartbeats, ring bytes) live in THIS process's
+    registry — the frontend's /metrics is a different process — so the
+    backend role carries its own exposition: ``metrics_file`` is rewritten
+    every few seconds and on exit, ``metrics_port`` serves live
+    /metrics + /healthz, ``log_events`` appends worker-labeled JSONL."""
+    from akka_game_of_life_tpu.obs import (
+        NULL_EVENTS,
+        EventLog,
+        MetricsServer,
+        get_registry,
+    )
+
+    registry = get_registry()
+    worker = BackendWorker(
+        host, port, name=name, engine=engine, pallas=pallas, registry=registry
+    )
     worker.connect()
+    events = (
+        EventLog(log_events, node=worker.name or "backend")
+        if log_events
+        else NULL_EVENTS
+    )
+    events.emit("backend_joined", frontend=f"{host}:{port}", engine=engine)
+    server = None
+    if metrics_port:
+        server = MetricsServer(
+            registry,
+            port=metrics_port,
+            health=lambda: {
+                "ok": not worker._stop.is_set(),
+                "tiles": len(worker.tiles),
+                "target_epoch": worker.target,
+            },
+        )
+        print(f"metrics on :{server.port}/metrics (+/healthz)", flush=True)
+    if metrics_file:
+
+        def _dump_loop() -> None:
+            warned = False
+            while not worker._stop.wait(5.0):
+                try:
+                    registry.write(metrics_file)
+                except OSError as e:
+                    # Keep trying: a transient failure (ENOSPC blip, NFS
+                    # hiccup) must not freeze the exposition file for the
+                    # rest of a long soak.  Warn once, not every 5 s.
+                    if not warned:
+                        warned = True
+                        print(f"metrics-file write failed: {e}", flush=True)
+
+        threading.Thread(
+            target=_dump_loop, daemon=True, name="metrics-dump"
+        ).start()
     print(f"backend {worker.name} joined {host}:{port}", flush=True)
     try:
         return worker.run()
@@ -1002,3 +1094,13 @@ def run_backend(
         with mask_interrupts():
             worker.stop()
         return 130
+    finally:
+        if metrics_file:
+            try:
+                registry.write(metrics_file)
+            except OSError:
+                pass
+        if server is not None:
+            server.close()
+        events.emit("backend_stopped", reason=worker.stopped_reason)
+        events.close()

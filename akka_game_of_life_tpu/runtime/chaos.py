@@ -32,7 +32,11 @@ class CrashInjector:
     """
 
     def __init__(
-        self, config: FaultInjectionConfig, *, start_time: Optional[float] = None
+        self,
+        config: FaultInjectionConfig,
+        *,
+        start_time: Optional[float] = None,
+        registry=None,
     ) -> None:
         self.config = config
         self.crashes = 0
@@ -41,6 +45,13 @@ class CrashInjector:
         self._next_due: Optional[float] = (
             self._start + config.first_after_s if config.enabled else None
         )
+        # Fired crashes count at the source — both schedules, every consumer
+        # (standalone replay, cluster node/tile kill) share one counter.
+        if registry is None:
+            from akka_game_of_life_tpu.obs import get_registry
+
+            registry = get_registry()
+        self._crash_counter = registry.counter("gol_chaos_crashes_total")
 
     @property
     def exhausted(self) -> bool:
@@ -55,6 +66,7 @@ class CrashInjector:
         if now < self._next_due:
             return False
         self.crashes += 1
+        self._crash_counter.inc()
         self._next_due = now + self.config.every_s
         return True
 
@@ -72,4 +84,5 @@ class CrashInjector:
         if epoch < due:
             return False
         self.crashes += 1
+        self._crash_counter.inc()
         return True

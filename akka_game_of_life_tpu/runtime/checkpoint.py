@@ -14,11 +14,13 @@ checkpoints are np.packbits-packed for binary rules — 8 cells/byte).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
 import re
 import tempfile
+import time
 from pathlib import Path
 from typing import Optional, Tuple
 
@@ -59,7 +61,7 @@ def _existing_format(directory: str) -> Optional[str]:
     return None
 
 
-def make_store(directory: str, fmt: str = "npz", keep: int = 3):
+def make_store(directory: str, fmt: str = "npz", keep: int = 3, registry=None):
     """Checkpoint store factory: ``npz`` (host, synchronous, packed) or
     ``orbax`` (device-native, async, shard-parallel).
 
@@ -76,10 +78,46 @@ def make_store(directory: str, fmt: str = "npz", keep: int = 3):
             f"checkpoints; refusing to start a {fmt}-format store there"
         )
     if fmt == "npz":
-        return CheckpointStore(directory, keep=keep)
+        return CheckpointStore(directory, keep=keep, registry=registry)
     from akka_game_of_life_tpu.runtime.orbax_store import OrbaxCheckpointStore
 
-    return OrbaxCheckpointStore(directory, keep=keep)
+    return OrbaxCheckpointStore(directory, keep=keep, registry=registry)
+
+
+class _StoreMetrics:
+    """Save/restore counters + latency histograms, shared by both stores.
+
+    The instrumentation lives in the stores (not their callers) so every
+    durability path — sync saves, the async npz writer thread, orbax's
+    background commit, recovery loads, the ``checkpoints`` CLI — counts
+    through the same three instruments."""
+
+    def __init__(self, registry=None) -> None:
+        if registry is None:
+            from akka_game_of_life_tpu.obs import get_registry
+
+            registry = get_registry()
+        self.saves = registry.counter("gol_checkpoint_saves_total")
+        self.restores = registry.counter("gol_checkpoint_restores_total")
+        self._seconds = registry.histogram(
+            "gol_checkpoint_seconds", labelnames=("op",)
+        )
+        self.save_seconds = self._seconds.labels(op="save")
+        self.restore_seconds = self._seconds.labels(op="restore")
+
+    @contextlib.contextmanager
+    def timed_save(self):
+        t0 = time.perf_counter()
+        yield
+        self.save_seconds.observe(time.perf_counter() - t0)
+        self.saves.inc()
+
+    @contextlib.contextmanager
+    def timed_restore(self):
+        t0 = time.perf_counter()
+        yield
+        self.restore_seconds.observe(time.perf_counter() - t0)
+        self.restores.inc()
 
 
 @dataclasses.dataclass
@@ -97,25 +135,27 @@ class Checkpoint:
 class CheckpointStore:
     """A directory of epoch-stamped checkpoints with atomic writes."""
 
-    def __init__(self, directory: str, keep: int = 3) -> None:
+    def __init__(self, directory: str, keep: int = 3, registry=None) -> None:
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.keep = keep
+        self.metrics = _StoreMetrics(registry)
 
     def _write_epoch(self, epoch: int, payload: dict) -> Path:
         """Atomically write one epoch's npz (tmp + fsync + rename), then GC."""
         target = self.dir / f"ckpt_{epoch:012d}.npz"
-        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez_compressed(f, **payload)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, target)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        with self.metrics.timed_save():
+            fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    np.savez_compressed(f, **payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, target)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
         self._gc()
         return target
 
@@ -235,17 +275,20 @@ class CheckpointStore:
                 **(meta or {}),
             }
         )
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "w") as f:
-                f.write(doc)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, d / _COMPLETE)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        # One durable save per finalized epoch (the streamed tile files are
+        # its parts, not checkpoints of their own).
+        with self.metrics.timed_save():
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(doc)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, d / _COMPLETE)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
         self._gc()
 
     def tile_meta(self, epoch: int) -> dict:
@@ -314,6 +357,12 @@ class CheckpointStore:
         """Load a checkpoint.  With ``keep_packed=True`` a packed32-format
         checkpoint comes back with ``packed32`` set and ``board=None`` — the
         packed-kernel resume path pushes the words straight to device."""
+        with self.metrics.timed_restore():
+            return self._load(epoch, keep_packed=keep_packed)
+
+    def _load(
+        self, epoch: Optional[int] = None, *, keep_packed: bool = False
+    ) -> Checkpoint:
         epochs = self._epochs()
         if not epochs:
             raise FileNotFoundError(f"no checkpoints in {self.dir}")

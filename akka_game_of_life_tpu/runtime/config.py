@@ -207,6 +207,17 @@ class SimulationConfig:
     probe_window: Optional[Tuple[int, int, int, int]] = None
     log_file: Optional[str] = None  # reference renders to info.log
     metrics_every: int = 0
+    # Metrics exposition (obs/): Prometheus text dumped to this file at
+    # metrics cadence and on close (atomic tmp+rename — a scrape never sees
+    # a torn write) ...
+    metrics_file: Optional[str] = None
+    # ... and/or served live at http://host:metrics_port/metrics (+ /healthz)
+    # by the run and frontend roles.  0 = no HTTP endpoint.
+    metrics_port: int = 0
+    # Structured JSONL lifecycle events (crashes, recoveries, checkpoints,
+    # membership churn) appended here with monotonic timestamps and a
+    # per-node label.  None = off.
+    log_events: Optional[str] = None
     # Deferred observation: cadence points dispatch their device-side
     # observation (population / render sample / probe window) and return
     # without any host fetch; the tiny results are fetched one chunk later,
@@ -248,6 +259,11 @@ class SimulationConfig:
                 )
         if self.role not in ("standalone", "frontend", "backend"):
             raise ValueError(f"unknown role {self.role!r}")
+        if not (0 <= self.metrics_port < 65536):
+            raise ValueError(
+                f"metrics_port={self.metrics_port} must be 0 (off) or a "
+                f"valid TCP port"
+            )
         if self.checkpoint_format not in ("npz", "orbax"):
             raise ValueError(f"unknown checkpoint format {self.checkpoint_format!r}")
         if self.steps_per_call % self.halo_width:
@@ -348,7 +364,10 @@ def load_config(
         if p.suffix == ".json":
             data = json.loads(text)
         else:
-            import tomllib
+            try:
+                import tomllib  # Python >= 3.11
+            except ModuleNotFoundError:  # 3.10: same API under the old name
+                import tomli as tomllib
 
             data = tomllib.loads(text)
         merged.update(_normalize(data))

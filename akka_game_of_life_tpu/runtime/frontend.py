@@ -30,6 +30,12 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from akka_game_of_life_tpu.obs import (
+    NULL_EVENTS,
+    EventLog,
+    MetricsServer,
+    get_registry,
+)
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 from akka_game_of_life_tpu.runtime import protocol as P
 from akka_game_of_life_tpu.runtime.checkpoint import make_store
@@ -47,6 +53,12 @@ from akka_game_of_life_tpu.runtime.wire import (
 )
 
 _MAINT_INTERVAL_S = 0.05
+
+# Cadence of the frontend's --metrics-file rewrites.  The standalone runner
+# dumps at its epoch-indexed metrics cadence; the coordinator has no
+# per-epoch loop of its own, so it refreshes the exposition on wall time —
+# a file collector scrapes a live view mid-run, not only the exit snapshot.
+_METRICS_DUMP_INTERVAL_S = 5.0
 
 # Cadence of *in-memory* checkpoints when no durable cadence is configured.
 # The frontend needs a periodic per-tile snapshot anyway: it is both the
@@ -133,11 +145,27 @@ class Frontend:
         *,
         min_backends: int = 1,
         observer: Optional[BoardObserver] = None,
+        registry=None,
     ) -> None:
         if config.max_epochs is None:
             raise ValueError("frontend requires max_epochs")
         self.config = config
         self.rule = resolve_rule(config.rule)
+        # Coordinator observability: membership churn and recovery actions
+        # as counters/gauges, lifecycle as JSONL events, both exposed live
+        # at /metrics + /healthz when metrics_port is set (started in
+        # :meth:`start`).
+        self.metrics = registry if registry is not None else get_registry()
+        self.events = (
+            EventLog(config.log_events, node="frontend")
+            if config.log_events
+            else NULL_EVENTS
+        )
+        self._m_alive = self.metrics.gauge("gol_members_alive")
+        self._m_joined = self.metrics.counter("gol_members_joined_total")
+        self._m_lost = self.metrics.counter("gol_members_lost_total")
+        self._m_redeploys = self.metrics.counter("gol_redeploys_total")
+        self._metrics_server: Optional[MetricsServer] = None
         if self.rule.radius != 1:
             raise ValueError(
                 "the TCP cluster exchanges radius-1 boundary rings; "
@@ -150,6 +178,7 @@ class Frontend:
             render_max_cells=config.render_max_cells,
             metrics_every=config.metrics_every,
             log_file=config.log_file,
+            registry=self.metrics,
         )
         if config.fault_injection.enabled and config.fault_injection.epoch_indexed:
             # The cluster injector is the reference's wall-clock killer
@@ -174,7 +203,11 @@ class Frontend:
                 f"(got {config.checkpoint_format!r})"
             )
         self.store = (
-            make_store(config.checkpoint_dir, config.checkpoint_format)
+            make_store(
+                config.checkpoint_dir,
+                config.checkpoint_format,
+                registry=self.metrics,
+            )
             if config.checkpoint_dir
             else None
         )
@@ -237,10 +270,30 @@ class Frontend:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
+        if self.config.metrics_port:
+            self._metrics_server = MetricsServer(
+                self.metrics,
+                port=self.config.metrics_port,
+                health=self._health,
+            )
         for fn in (self._accept_loop, self._maintenance_loop, self._io_loop):
             t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
             t.start()
             self._threads.append(t)
+
+    def _health(self) -> dict:
+        """The /healthz document: ok until the run has errored — plus the
+        live facts an operator checks first (members, epoch floor, done)."""
+        with self._lock:
+            return {
+                "ok": self.error is None,
+                "error": self.error,
+                "members_alive": len(self.membership.alive_members()),
+                "epoch_floor": min(self.tile_epochs.values(), default=0),
+                "target_epoch": self.target_epoch,
+                "done": self.done.is_set(),
+                "paused": self.paused,
+            }
 
     def _io_loop(self) -> None:
         while True:
@@ -326,7 +379,9 @@ class Frontend:
                 self.target_epoch = self.config.max_epochs
 
             if self.config.fault_injection.enabled:
-                self.injector = CrashInjector(self.config.fault_injection)
+                self.injector = CrashInjector(
+                    self.config.fault_injection, registry=self.metrics
+                )
 
             assignments: Dict[str, List[TileId]] = {m.name: [] for m in members}
             for idx, tile in enumerate(self.layout.tile_ids):
@@ -384,6 +439,10 @@ class Frontend:
                             t: self.store.load_tile_payload(epoch0, t)
                             for t in layout.tile_ids
                         }
+                        # One restore per recovery-source load: this path
+                        # bypasses store.load(), so count it here (the
+                        # full-board fallback below counts inside load()).
+                        self.store.metrics.restores.inc()
                         return epoch0, tiles
                 except FileNotFoundError:
                     pass  # latest is a full-board file; fall through
@@ -468,6 +527,25 @@ class Frontend:
         if self.store is not None:
             # Async (orbax) saves must be durable before the process exits.
             self.store.close()
+        # Observability epilogue: final exposition dump, then tear the live
+        # endpoint and the event log down (a scrape after stop() would show
+        # a half-dead cluster).
+        if self.config.metrics_file:
+            try:
+                self.metrics.write(self.config.metrics_file)
+            except OSError as e:
+                # Teardown must complete (server + event log below) even
+                # when the exposition file became unwritable.
+                print(f"final metrics-file write failed: {e}", flush=True)
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
+        self.events.emit(
+            "frontend_stopped",
+            error=self.error,
+            done=self.done.is_set(),
+        )
+        self.events.close()
 
     # -- pause/resume (reachable, unlike BoardCreator.scala:109-112) ---------
 
@@ -553,6 +631,11 @@ class Frontend:
                 else f" (engine {engine})"
             )
             print(f"backend {member.name} joined{detail}", flush=True)
+            self._m_joined.inc()
+            self._m_alive.set(len(self.membership.alive_members()))
+            self.events.emit(
+                "member_joined", member=member.name, engine=str(engine)
+            )
             while not self._stop.is_set():
                 msg = channel.recv()
                 if msg is None:
@@ -731,6 +814,11 @@ class Frontend:
         member = self.membership.mark_dead(name)
         if member is None:
             return
+        self._m_lost.inc()
+        self._m_alive.set(len(self.membership.alive_members()))
+        self.events.emit(
+            "member_lost", member=name, tiles=len(member.tiles)
+        )
         try:
             member.channel.close()
         except OSError:
@@ -808,6 +896,16 @@ class Frontend:
             member = (others or survivors)[0]
         if tile not in member.tiles:
             member.tiles.append(tile)
+        # Counted HERE, after every escalation/no-survivor early return: an
+        # aborted reassignment redeployed nothing and must not read as
+        # recovery activity.
+        self._m_redeploys.inc()
+        self.events.emit(
+            "tile_redeploy",
+            tile=list(tile),
+            owner=member.name,
+            epoch=self._last_ckpt[0],
+        )
         self.tile_owner[tile] = member.name
         # The tile restarts at the recovery epoch: record that so the
         # ring-prune floor protects every epoch its replay will pull.
@@ -835,9 +933,25 @@ class Frontend:
     # -- maintenance: ticks, auto-down, fault injection ----------------------
 
     def _maintenance_loop(self) -> None:
+        next_dump = time.monotonic() + _METRICS_DUMP_INTERVAL_S
+        dump_warned = False
         while not self._stop.is_set() and not self.done.is_set():
             time.sleep(_MAINT_INTERVAL_S)
             now = time.monotonic()
+            # periodic --metrics-file refresh (atomic; scrape-safe mid-run)
+            if self.config.metrics_file and now >= next_dump:
+                next_dump = now + _METRICS_DUMP_INTERVAL_S
+                try:
+                    self.metrics.write(self.config.metrics_file)
+                    dump_warned = False
+                except OSError as e:
+                    # An unwritable path must not kill the maintenance
+                    # thread (ticks, eviction, chaos all ride on it) —
+                    # and a PERSISTENT failure must not flood stdout every
+                    # interval: warn once per outage, keep retrying.
+                    if not dump_warned:
+                        dump_warned = True
+                        print(f"metrics-file write failed: {e}", flush=True)
             # auto-down stale members (application.conf:23 analog)
             for m in self.membership.stale_members(now):
                 self._on_member_lost(m.name)
@@ -874,11 +988,18 @@ class Frontend:
         mode = self.config.fault_injection.mode
         if mode == "node":
             self.crash_events.append({"mode": "node", "victim": victim.name})
+            self.events.emit("crash_injected", mode="node", victim=victim.name)
             self._safe_send(victim, {"type": P.CRASH})
         else:
             tile = rng.choice(victim.tiles)
             self.crash_events.append(
                 {"mode": "tile", "victim": victim.name, "tile": tile}
+            )
+            self.events.emit(
+                "crash_injected",
+                mode="tile",
+                victim=victim.name,
+                tile=list(tile),
             )
             self._safe_send(victim, {"type": P.CRASH_TILE, "tile": list(tile)})
 

@@ -21,15 +21,26 @@ DONE_TIMEOUT = 60
 
 class ClusterHarness:
     def __init__(
-        self, config, n_backends, observer=None, engine="numpy", pallas=None
+        self,
+        config,
+        n_backends,
+        observer=None,
+        engine="numpy",
+        pallas=None,
+        registry=None,
     ):
         # numpy engine keeps test suites fast and portable; pass engine="jax"
         # (or "swar") for the accelerator/native data paths; pallas pins the
-        # jax engine's Mosaic mode (see BackendWorker).
+        # jax engine's Mosaic mode (see BackendWorker).  registry isolates
+        # the whole cluster's metrics into one MetricsRegistry (tests assert
+        # counters without cross-test bleed); None = the process default.
         self.engine = engine
         self.pallas = pallas
+        self.registry = registry
         config.port = 0  # ephemeral: parallel harnesses must not fight over 2551
-        self.frontend = Frontend(config, min_backends=n_backends, observer=observer)
+        self.frontend = Frontend(
+            config, min_backends=n_backends, observer=observer, registry=registry
+        )
         self.frontend.start()
         self.workers = []
         self.threads = []
@@ -44,6 +55,7 @@ class ClusterHarness:
             engine=self.engine,
             pallas=self.pallas,
             retry_s=0.5,
+            registry=self.registry,
         )
         w.crash_hook = w.stop  # in-thread "process death": drop the connection
         w.connect()
@@ -67,9 +79,16 @@ class ClusterHarness:
 
 
 @contextlib.contextmanager
-def cluster(config, n_backends, observer=None, engine="numpy", pallas=None):
+def cluster(
+    config, n_backends, observer=None, engine="numpy", pallas=None, registry=None
+):
     h = ClusterHarness(
-        config, n_backends, observer=observer, engine=engine, pallas=pallas
+        config,
+        n_backends,
+        observer=observer,
+        engine=engine,
+        pallas=pallas,
+        registry=registry,
     )
     try:
         yield h

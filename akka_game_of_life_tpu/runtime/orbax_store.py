@@ -31,9 +31,12 @@ class OrbaxCheckpointStore:
     device-resident (and sharded) ``jax.Array`` boards without host gather.
     """
 
-    def __init__(self, directory: str, keep: int = 3) -> None:
+    def __init__(self, directory: str, keep: int = 3, registry=None) -> None:
         import orbax.checkpoint as ocp
 
+        from akka_game_of_life_tpu.runtime.checkpoint import _StoreMetrics
+
+        self.metrics = _StoreMetrics(registry)
         self._ocp = ocp
         self.dir = Path(directory).absolute()
         self.dir.mkdir(parents=True, exist_ok=True)
@@ -48,13 +51,17 @@ class OrbaxCheckpointStore:
 
     def save(self, epoch: int, board, rule: str, meta: Optional[dict] = None):
         ocp = self._ocp
-        self._mgr.save(
-            int(epoch),
-            args=ocp.args.Composite(
-                state=ocp.args.PyTreeSave({"board": board}),
-                meta=ocp.args.JsonSave({"rule": rule, **(meta or {})}),
-            ),
-        )
+        # The timed span is the *dispatch* cost (orbax commits in the
+        # background); the save still counts here — wait()/close() surface
+        # failures, and counting at dispatch matches the async-npz writer.
+        with self.metrics.timed_save():
+            self._mgr.save(
+                int(epoch),
+                args=ocp.args.Composite(
+                    state=ocp.args.PyTreeSave({"board": board}),
+                    meta=ocp.args.JsonSave({"rule": rule, **(meta or {})}),
+                ),
+            )
         return self.dir / str(int(epoch))
 
     def wait(self) -> None:
@@ -72,6 +79,12 @@ class OrbaxCheckpointStore:
         return sorted(int(s) for s in self._mgr.all_steps())
 
     def load(
+        self, epoch: Optional[int] = None, *, keep_packed: bool = False
+    ) -> Checkpoint:
+        with self.metrics.timed_restore():
+            return self._load(epoch, keep_packed=keep_packed)
+
+    def _load(
         self, epoch: Optional[int] = None, *, keep_packed: bool = False
     ) -> Checkpoint:
         ocp = self._ocp

@@ -44,20 +44,50 @@ def annotate_epochs(name: str, epoch: int):
     return jax.profiler.StepTraceAnnotation(name, step_num=epoch)
 
 
+class TimedSpan:
+    """The measurement a :func:`timed` block yields: ``seconds`` is 0.0
+    while the block runs and the measured duration once it exits, so
+    callers can record or aggregate what used to be print-only."""
+
+    __slots__ = ("label", "seconds")
+
+    def __init__(self, label: str) -> None:
+        self.label = label
+        self.seconds = 0.0
+
+    @property
+    def ms(self) -> float:
+        return self.seconds * 1e3
+
+
 @contextlib.contextmanager
-def timed(label: str, out=None) -> Iterator[None]:
+def timed(
+    label: str, out=None, registry=None, span: Optional[str] = None
+) -> Iterator[TimedSpan]:
     """Host-side wall-clock span, printed on exit — the quick-look
-    complement to the full trace."""
+    complement to the full trace.
+
+    Yields a :class:`TimedSpan` whose ``seconds`` carries the measured
+    duration after the block exits.  With ``registry`` (a
+    :class:`~akka_game_of_life_tpu.obs.MetricsRegistry`), the duration is
+    also observed into the ``gol_span_seconds`` histogram under the
+    ``span`` label (default: ``label`` up to the first ``@`` — epoch-stamped
+    labels like ``checkpoint@512`` must not mint one series per epoch)."""
+    rec = TimedSpan(label)
     t0 = time.perf_counter()
     try:
-        yield
+        yield rec
     finally:
-        dt = time.perf_counter() - t0
-        msg = f"[profile] {label}: {dt * 1e3:.2f} ms"
+        rec.seconds = time.perf_counter() - t0
+        msg = f"[profile] {label}: {rec.ms:.2f} ms"
         if out is None:
             print(msg, flush=True)
         else:
             print(msg, file=out, flush=True)
+        if registry is not None:
+            registry.histogram(
+                "gol_span_seconds", labelnames=("span",)
+            ).labels(span=span or label.split("@", 1)[0]).observe(rec.seconds)
 
 
 def device_memory_stats() -> dict:

@@ -95,10 +95,21 @@ class BoardObserver:
         metrics_every: int = 0,
         out: Optional[IO[str]] = None,
         log_file: Optional[str] = None,
+        registry=None,
     ) -> None:
         self.render_every = render_every
         self.render_max_cells = render_max_cells
         self.metrics_every = metrics_every
+        # Progress gauges land in the metrics registry on every observed
+        # interval (standalone AND cluster paths both funnel through
+        # _note_progress) — "what is the steps/s right now" as a scrape
+        # instead of a stdout grep.
+        if registry is None:
+            from akka_game_of_life_tpu.obs import get_registry
+
+            registry = get_registry()
+        self._population_gauge = registry.gauge("gol_population")
+        self._rate_gauge = registry.gauge("gol_steps_per_second")
         self._own_file = None
         if log_file is not None:
             self._own_file = open(log_file, "a")  # reference appends to info.log
@@ -177,6 +188,9 @@ class BoardObserver:
             self._total_seconds += m.seconds
             self._total_cells += m.cells
             self._total_obs_seconds += m.obs_seconds
+            self._population_gauge.set(m.population)
+            if m.seconds > 0:
+                self._rate_gauge.set(m.epochs / m.seconds)
             if self.metrics_every and epoch % self.metrics_every == 0:
                 # obs = the observation's own share of the interval (device
                 # obs dispatch + host fetches): ms/epoch minus obs/epochs is

@@ -40,6 +40,7 @@ from akka_game_of_life_tpu.parallel.packed_halo2d import (
     sharded_packed2d_step_fn,
     word_halo_width,
 )
+from akka_game_of_life_tpu.obs import NULL_EVENTS, EventLog, get_registry
 from akka_game_of_life_tpu.runtime import profiling
 from akka_game_of_life_tpu.runtime.chaos import CrashInjector
 from akka_game_of_life_tpu.runtime.checkpoint import make_store
@@ -140,9 +141,17 @@ class Simulation:
         self,
         config: SimulationConfig,
         observer: Optional[BoardObserver] = None,
+        registry=None,
     ) -> None:
         self.config = config
         self.rule = resolve_rule(config.rule)
+        # Observability: counters/gauges/histograms land in the process-wide
+        # registry unless the embedder passes an isolated one; lifecycle
+        # events append to the JSONL log when configured.
+        self.metrics = registry if registry is not None else get_registry()
+        # Resolved once: observation runs at cadence inside the hot loop,
+        # and instrument lookup takes the registry lock.
+        self._m_obs_seconds = self.metrics.histogram("gol_obs_seconds")
         if config.distributed:
             # Must happen before ANY backend init — including the checkpoint
             # store below (orbax queries process_index/count at construction)
@@ -164,14 +173,27 @@ class Simulation:
                     "at the same epoch — or the cluster control plane's "
                     "injector for per-worker chaos."
                 )
+        self.events = (
+            EventLog(
+                config.log_events,
+                node=f"{config.role}:{jax.process_index()}",
+            )
+            if config.log_events
+            else NULL_EVENTS
+        )
         self.observer = observer or BoardObserver(
             render_every=config.render_every,
             render_max_cells=config.render_max_cells,
             metrics_every=config.metrics_every,
             log_file=config.log_file,
+            registry=self.metrics,
         )
         self.store = (
-            make_store(config.checkpoint_dir, config.checkpoint_format)
+            make_store(
+                config.checkpoint_dir,
+                config.checkpoint_format,
+                registry=self.metrics,
+            )
             if config.checkpoint_dir is not None
             else None
         )
@@ -186,7 +208,7 @@ class Simulation:
                 "checkpoint to recover from would only restart from epoch 0"
             )
         self.injector = (
-            CrashInjector(config.fault_injection)
+            CrashInjector(config.fault_injection, registry=self.metrics)
             if config.fault_injection.enabled
             else None
         )
@@ -735,6 +757,13 @@ class Simulation:
         # real interval (resumed runs with one remaining crossing would
         # otherwise observe nothing — no metrics line, no run summary).
         self.observer.start_clock(self.epoch)
+        # Hot-loop instruments, resolved once (never inside the loop: name
+        # lookup takes the registry lock).
+        epochs_c = self.metrics.counter("gol_epochs_advanced_total")
+        chunks_c = self.metrics.counter("gol_chunks_total")
+        step_h = self.metrics.histogram("gol_step_seconds")
+        epoch_g = self.metrics.gauge("gol_epoch")
+        halo_c = self.metrics.counter("gol_halo_bytes_total")
         next_tick = time.monotonic()
         try:
             while self.epoch < target:
@@ -752,6 +781,7 @@ class Simulation:
 
                 chunk = min(cfg.steps_per_call, target - self.epoch)
                 prev = self.epoch
+                chunk_t0 = time.perf_counter()
                 with profiling.annotate_epochs("advance_chunk", self.epoch):
                     new_board = self._stepper(chunk)(self.board)
                 with _shield_sigint():
@@ -759,6 +789,15 @@ class Simulation:
                     # stepped board still labeled with the previous epoch.
                     self.board = new_board
                     self.epoch += chunk
+                # Host-side chunk cost (dispatch → board swap): on a
+                # synchronous backend this is the device time; under async
+                # dispatch it is the host's share of the critical path.
+                step_h.observe(time.perf_counter() - chunk_t0)
+                epochs_c.inc(chunk)
+                chunks_c.inc()
+                epoch_g.set(self.epoch)
+                if self.mesh is not None:
+                    halo_c.inc(self._halo_bytes_per_chunk(chunk))
                 # Resolve deferred observations from EARLIER cadence points
                 # now, while the device is busy executing the chunk just
                 # dispatched above — the host fetch round-trip rides under
@@ -771,6 +810,8 @@ class Simulation:
                     self._observe(
                         render=_crosses(prev, self.epoch, cfg.render_every)
                     )
+                if _crosses(prev, self.epoch, cfg.metrics_every):
+                    self._dump_metrics()
                 if self.store is not None and _crosses(
                     prev, self.epoch, cfg.checkpoint_every
                 ):
@@ -789,6 +830,72 @@ class Simulation:
         # under; flush it now (errors here are real and propagate).
         self._obs_resolve()
         return self.epoch
+
+    def _halo_bytes_per_chunk(self, k: int) -> int:
+        """Analytic bytes one k-epoch chunk moves across the device mesh —
+        the Casper-style data-movement signal (``gol_halo_bytes_total``).
+        The exchange runs inside jit, so it cannot be counted at runtime;
+        this mirrors the stepper's exchange plan instead: exchanges per
+        chunk × perimeter bytes per exchange (packed layouts count uint32
+        words, Generations multiplies by its plane count)."""
+        cached = getattr(self, "_halo_bytes_cache", None)
+        if cached is None:
+            cached = self._halo_bytes_cache = {}
+        if k not in cached:
+            from akka_game_of_life_tpu.ops import bitpack_gen
+            from akka_game_of_life_tpu.parallel.halo import exchange_bytes
+
+            cfg = self.config
+            if self._packed:
+                # Packed exchange is asymmetric: the row phase moves `halo`
+                # rows of uint32 words, the column phase only
+                # word_halo_width(halo) word-columns (a 32-cell word column
+                # covers the whole cell halo) — pricing columns at `halo`
+                # words would overcount by up to 32x.
+                mr, mc = self._packed_mesh_shape()
+                th = cfg.height // mr
+                tw = (cfg.width // 32) // mc
+                halo = self._halo_for(k)
+                per_tile = 2 * halo * tw if mr > 1 else 0
+                if mc > 1:
+                    wh = word_halo_width(halo)
+                    per_tile += 2 * wh * (th + 2 * halo)
+                per_exchange = mr * mc * per_tile * 4
+                if self._gen:
+                    per_exchange *= bitpack_gen.n_planes(self.rule.states)
+            else:
+                # The REAL mesh shape: auto meshes factor devices as square
+                # as possible (make_grid_mesh(None)), not rows-only.
+                mesh_shape = self.mesh.devices.shape
+                tile = (cfg.height // mesh_shape[0], cfg.width // mesh_shape[1])
+                halo = self._halo_for(k)
+                per_exchange = exchange_bytes(
+                    mesh_shape, tile, halo * self.rule.radius, itemsize=1
+                )
+            cached[k] = (k // max(1, self._halo_for(k))) * per_exchange
+        return cached[k]
+
+    def _dump_metrics(self) -> None:
+        """Refresh the ``--metrics-file`` exposition (atomic; rank 0 only).
+
+        Write failures are contained: an unwritable observability file
+        (disk full, directory removed mid-run) must never abort the
+        simulation it observes.  Warned once, not per cadence point."""
+        if not self.config.metrics_file or jax.process_index() != 0:
+            return
+        try:
+            self.metrics.write(self.config.metrics_file)
+        except OSError as e:
+            if not getattr(self, "_metrics_write_warned", False):
+                self._metrics_write_warned = True
+                import sys
+
+                print(
+                    f"metrics-file write failed (will keep retrying "
+                    f"silently): {e}",
+                    file=sys.stderr,
+                    flush=True,
+                )
 
     # -- observation (device-side: nothing here is O(board) on host) ---------
 
@@ -950,6 +1057,7 @@ class Simulation:
         if on_fetched is not None:
             on_fetched()
         obs_seconds = time.perf_counter() - t0
+        self._m_obs_seconds.observe(obs_seconds)
         if jax.process_index() == 0:
             self.observer.observe_summary(
                 rec["epoch"],
@@ -995,6 +1103,7 @@ class Simulation:
         self._ckpt_wait()
         target = self.epoch
         self.crash_log.append(target)
+        self.events.emit("crash_injected", epoch=target)
         self.board = None  # the crash: live state gone
         ckpt = (
             self.store.load(keep_packed=self._packed)
@@ -1016,6 +1125,7 @@ class Simulation:
                 self._actor_board = self._actor_board_cls(restored, self.rule)
                 self._actor_epoch0 = self.epoch
             self.board = self._to_device(restored)
+        restored_epoch = self.epoch
         while self.epoch < target:
             # Replay: recompute the lost epochs (deterministic rule ⇒ the
             # trajectory is bit-identical to the pre-crash one).  Reuses the
@@ -1024,6 +1134,16 @@ class Simulation:
             chunk = min(self.config.steps_per_call, target - self.epoch)
             self.board = self._stepper(chunk)(self.board)
             self.epoch += chunk
+        self.metrics.counter("gol_chaos_recovered_total").inc()
+        self.metrics.counter("gol_chaos_replay_epochs_total").inc(
+            target - restored_epoch
+        )
+        self.events.emit(
+            "crash_recovered",
+            epoch=target,
+            restored_from=restored_epoch,
+            replayed=target - restored_epoch,
+        )
 
     def checkpoint(self, host_board: Optional[np.ndarray] = None) -> None:
         if self.store is None:
@@ -1051,6 +1171,11 @@ class Simulation:
         # correct — the checkpoint is of this epoch, whatever runs next.
         epoch, board = self.epoch, self.board
         rulestr = self.rule.rulestring()
+        self.events.emit(
+            "checkpoint_requested",
+            epoch=epoch,
+            format=self.config.checkpoint_format,
+        )
         if self._packed and host_board is None:
             # Packed runs never unpack for a checkpoint: npz receives the
             # (H, W/32) uint32 words (0.25 B/cell host transfer); orbax saves
@@ -1109,7 +1234,12 @@ class Simulation:
         elif self.config.metrics_every:
             # Checkpoint cost is an operational metric: surface it alongside
             # the throughput lines.
-            with profiling.timed(f"checkpoint@{epoch}", out=self.observer.out):
+            with profiling.timed(
+                f"checkpoint@{epoch}",
+                out=self.observer.out,
+                registry=self.metrics,
+                span="checkpoint",
+            ):
                 _save()
         else:
             _save()
@@ -1232,7 +1362,15 @@ class Simulation:
                 self._ckpt_executor = None
             if self.store is not None:
                 self.store.close()
-            self.observer.close()
+            # Final exposition dump + event-log close: the durable tail of
+            # the run's observability (the interval dumps only cover metrics
+            # cadence points).
+            try:
+                self._dump_metrics()
+            finally:
+                self.events.emit("sim_closed", epoch=self.epoch)
+                self.events.close()
+                self.observer.close()
 
     def __enter__(self):
         return self

@@ -1,0 +1,110 @@
+"""CrashInjector edge cases — the failure-path scheduler's contract.
+
+The injector is the one component whose bugs only surface DURING failures
+(a mis-counted budget keeps killing past max-crashes; an off-by-one on the
+epoch schedule desynchronizes multi-host replay), so its boundary behavior
+gets direct unit coverage: budget exhaustion, schedule boundary epochs, and
+the mutual exclusion between the wall-clock and epoch-indexed schedules.
+"""
+
+from akka_game_of_life_tpu.obs import MetricsRegistry, install
+from akka_game_of_life_tpu.runtime.chaos import CrashInjector
+from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
+
+
+def _registry():
+    return install(MetricsRegistry())
+
+
+def test_wall_clock_schedule_and_budget():
+    cfg = FaultInjectionConfig(
+        enabled=True, first_after_s=10.0, every_s=15.0, max_crashes=2
+    )
+    inj = CrashInjector(cfg, start_time=0.0, registry=_registry())
+    assert not inj.exhausted
+    assert not inj.should_crash(now=9.999)
+    assert inj.should_crash(now=10.0)  # first: exactly at the boundary
+    assert not inj.should_crash(now=10.0)  # re-ask at the same instant
+    assert not inj.should_crash(now=24.9)
+    assert inj.should_crash(now=25.0)  # rescheduled from the FIRING time
+    assert inj.exhausted
+    assert not inj.should_crash(now=1e9)  # budget spent: never again
+    assert inj.crashes == 2
+
+
+def test_wall_clock_disabled_never_fires():
+    inj = CrashInjector(
+        FaultInjectionConfig(enabled=False), start_time=0.0, registry=_registry()
+    )
+    assert not inj.should_crash(now=1e9)
+    assert inj.crashes == 0
+
+
+def test_epoch_indexed_boundary_epochs():
+    cfg = FaultInjectionConfig(
+        enabled=True, first_after_epochs=5, every_epochs=3, max_crashes=3
+    )
+    inj = CrashInjector(cfg, registry=_registry())
+    assert not inj.should_crash_at_epoch(4)  # one before the boundary
+    assert inj.should_crash_at_epoch(5)  # exactly at first_after_epochs
+    assert not inj.should_crash_at_epoch(6)  # next due at 5 + 3
+    assert not inj.should_crash_at_epoch(7)
+    assert inj.should_crash_at_epoch(8)
+    assert inj.should_crash_at_epoch(11)
+    assert inj.exhausted
+    assert not inj.should_crash_at_epoch(14)  # budget spent at the boundary
+    assert inj.crashes == 3
+
+
+def test_epoch_indexed_fires_late_when_epoch_overshoots_due():
+    # Chunked advance can step PAST a due epoch; >= (not ==) must fire.
+    cfg = FaultInjectionConfig(
+        enabled=True, first_after_epochs=5, every_epochs=10, max_crashes=2
+    )
+    inj = CrashInjector(cfg, registry=_registry())
+    assert inj.should_crash_at_epoch(9)  # overshoot of due=5 still fires
+    assert not inj.should_crash_at_epoch(9)  # next due = 5 + 10
+    assert inj.should_crash_at_epoch(15)
+
+
+def test_epoch_indexed_from_epoch_zero():
+    cfg = FaultInjectionConfig(
+        enabled=True, first_after_epochs=0, every_epochs=1, max_crashes=2
+    )
+    inj = CrashInjector(cfg, registry=_registry())
+    assert inj.should_crash_at_epoch(0)  # boundary: epoch 0 is schedulable
+    assert inj.should_crash_at_epoch(1)
+    assert inj.exhausted
+
+
+def test_schedules_are_mutually_exclusive():
+    epoch_cfg = FaultInjectionConfig(
+        enabled=True, first_after_epochs=2, every_epochs=2
+    )
+    inj = CrashInjector(epoch_cfg, start_time=0.0, registry=_registry())
+    assert not inj.should_crash(now=1e9)  # wall-clock path: inert
+    wall_cfg = FaultInjectionConfig(enabled=True, first_after_s=0.0)
+    inj2 = CrashInjector(wall_cfg, start_time=0.0, registry=_registry())
+    assert not inj2.should_crash_at_epoch(10**9)  # epoch path: inert
+
+
+def test_exhausted_reflects_preexisting_overrun():
+    # A crash count at (or past) the budget reads exhausted even before the
+    # next should_crash poll — the property is state, not an event.
+    cfg = FaultInjectionConfig(enabled=True, max_crashes=1, first_after_s=0.0)
+    inj = CrashInjector(cfg, start_time=0.0, registry=_registry())
+    assert inj.should_crash(now=0.0)
+    assert inj.exhausted
+    inj.crashes = 5  # overrun (e.g. restored from some external count)
+    assert inj.exhausted
+
+
+def test_fired_crashes_count_into_registry():
+    reg = _registry()
+    cfg = FaultInjectionConfig(
+        enabled=True, first_after_epochs=0, every_epochs=2, max_crashes=3
+    )
+    inj = CrashInjector(cfg, registry=reg)
+    for e in range(10):
+        inj.should_crash_at_epoch(e)
+    assert reg.value("gol_chaos_crashes_total") == inj.crashes == 3

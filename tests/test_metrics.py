@@ -1,0 +1,450 @@
+"""The observability subsystem: registry semantics, exposition format,
+event-log round-trip, the live HTTP endpoint, the documented-catalog lint,
+and the end-to-end acceptance paths (CLI ``--metrics-file``; soak-style
+counter increments under injected faults).
+"""
+
+import io
+import json
+import math
+import os
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from akka_game_of_life_tpu.obs import (
+    CATALOG,
+    EventLog,
+    MetricsRegistry,
+    MetricsServer,
+    install,
+    read_events,
+)
+from akka_game_of_life_tpu.obs.catalog import names as catalog_names
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# -- registry semantics -------------------------------------------------------
+
+
+def test_counter_is_monotonic():
+    r = MetricsRegistry()
+    c = r.counter("t_total", "help")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value == 3.5
+
+
+def test_gauge_set_inc_dec():
+    r = MetricsRegistry()
+    g = r.gauge("t_gauge")
+    g.set(10)
+    g.inc(5)
+    g.dec(2)
+    assert g.value == 13
+
+
+def test_get_or_create_is_idempotent_and_type_safe():
+    r = MetricsRegistry()
+    assert r.counter("t_total") is r.counter("t_total")
+    with pytest.raises(ValueError):
+        r.gauge("t_total")  # same name, different kind
+    with pytest.raises(ValueError):
+        r.counter("t_total", labelnames=("mode",))  # different labels
+
+
+def test_invalid_metric_names_rejected():
+    r = MetricsRegistry()
+    for bad in ("", "1abc", "with-dash", "with space", "unié"):
+        with pytest.raises(ValueError):
+            r.counter(bad)
+
+
+def test_histogram_bucketing_and_cumulative_counts():
+    r = MetricsRegistry()
+    h = r.histogram("t_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 100.0):
+        h.observe(v)
+    snap = h._default().snapshot()
+    # Cumulative per upper bound: le counts include every smaller bucket,
+    # and observations exactly AT a bound land inside it.
+    assert snap["buckets"][0.1] == 2
+    assert snap["buckets"][1.0] == 4
+    assert snap["buckets"][10.0] == 5
+    assert snap["buckets"][math.inf] == 6
+    assert snap["count"] == 6
+    assert snap["sum"] == pytest.approx(106.65)
+
+
+def test_labeled_series_are_independent():
+    r = MetricsRegistry()
+    c = r.counter("t_total", labelnames=("mode",))
+    c.labels(mode="a").inc(3)
+    c.labels(mode="b").inc()
+    assert r.value("t_total", mode="a") == 3
+    assert r.value("t_total", mode="b") == 1
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+    with pytest.raises(ValueError):
+        c.inc()  # labeled family has no default child
+
+
+def test_registry_is_thread_safe_under_concurrent_increments():
+    import threading
+
+    r = MetricsRegistry()
+    c = r.counter("t_total")
+
+    def spin():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=spin) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+
+def test_prometheus_golden_output():
+    r = MetricsRegistry()
+    r.counter("app_requests_total", "Requests served").inc(3)
+    r.gauge("app_temp", "Temperature").set(2.5)
+    h = r.histogram("app_lat_seconds", "Latency", buckets=(0.5, 1.0))
+    h.observe(0.25)  # dyadic values: the rendered sum is exact
+    h.observe(0.75)
+    assert r.render() == (
+        "# HELP app_lat_seconds Latency\n"
+        "# TYPE app_lat_seconds histogram\n"
+        'app_lat_seconds_bucket{le="0.5"} 1\n'
+        'app_lat_seconds_bucket{le="1"} 2\n'
+        'app_lat_seconds_bucket{le="+Inf"} 2\n'
+        "app_lat_seconds_sum 1.0\n"
+        "app_lat_seconds_count 2\n"
+        "# HELP app_requests_total Requests served\n"
+        "# TYPE app_requests_total counter\n"
+        "app_requests_total 3\n"
+        "# HELP app_temp Temperature\n"
+        "# TYPE app_temp gauge\n"
+        "app_temp 2.5\n"
+    )
+
+
+def test_label_value_escaping():
+    r = MetricsRegistry()
+    c = r.counter("t_total", labelnames=("path",))
+    c.labels(path='a\\b"c\nd').inc()
+    line = [l for l in r.render().splitlines() if l.startswith("t_total{")][0]
+    assert line == 't_total{path="a\\\\b\\"c\\nd"} 1'
+
+
+def test_help_text_escaping_and_labeled_family_headers():
+    r = MetricsRegistry()
+    r.counter("t_total", "multi\nline", labelnames=("m",))  # no children yet
+    text = r.render()
+    assert "# HELP t_total multi\\nline" in text
+    assert "# TYPE t_total counter" in text  # name visible with zero series
+    assert "\nt_total{" not in text
+
+
+def test_catalog_installs_every_family_with_zero_samples():
+    r = install(MetricsRegistry())
+    text = r.render()
+    for name in catalog_names():
+        assert f"# TYPE {name} " in text, name
+    # The acceptance-named counters are unlabeled: visible at literal zero.
+    for name in (
+        "gol_epochs_advanced_total",
+        "gol_peer_retries_total",
+        "gol_chaos_crashes_total",
+    ):
+        assert f"\n{name} 0\n" in "\n" + text
+    assert len(CATALOG) == len(catalog_names())
+
+
+def test_atomic_write_and_reload(tmp_path):
+    r = MetricsRegistry()
+    r.counter("t_total").inc(7)
+    path = tmp_path / "sub" / "m.prom"  # parent dir is created
+    r.write(str(path))
+    assert path.read_text() == r.render()
+    assert not [p for p in path.parent.iterdir() if p.name.startswith(".metrics_")]
+
+
+# -- event log ----------------------------------------------------------------
+
+
+def test_event_log_round_trip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    with EventLog(str(path), node="frontend") as log:
+        log.emit("member_joined", member="w0", engine="numpy")
+        log.emit("crash_injected", mode="tile", tile=[0, 1])
+    with EventLog(str(path), node="w0") as log:  # append, second node
+        log.emit("tile_redeploy", epoch=30)
+    events = read_events(str(path))
+    assert [e["event"] for e in events] == [
+        "member_joined",
+        "crash_injected",
+        "tile_redeploy",
+    ]
+    assert [e["node"] for e in events] == ["frontend", "frontend", "w0"]
+    assert events[1]["tile"] == [0, 1]
+    # Monotonic timestamps order the log even across wall-clock jumps.
+    assert events[0]["t_mono"] <= events[1]["t_mono"]
+    for e in events:
+        assert isinstance(e["t_wall"], float)
+
+
+def test_event_log_reserved_keys_and_non_json_fields(tmp_path):
+    path = tmp_path / "e.jsonl"
+    with EventLog(str(path)) as log:
+        log.emit("x", event="spoofed", node="spoofed", obj={1, 2})  # set: default=str
+    (e,) = read_events(str(path))
+    assert e["event"] == "x" and e["node"] == "standalone"
+    assert isinstance(e["obj"], str)
+
+
+def test_disabled_event_log_is_noop():
+    log = EventLog(None)
+    assert not log.enabled
+    log.emit("anything", harmless=True)  # must not raise
+    log.close()
+    log.emit("after_close")  # still a no-op
+
+
+# -- HTTP endpoint ------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read().decode()
+
+
+def test_http_metrics_and_healthz():
+    r = install(MetricsRegistry())
+    r.counter("gol_epochs_advanced_total").inc(42)
+    health = {"ok": True, "epoch": 42}
+    with MetricsServer(r, port=0, host="127.0.0.1", health=lambda: health) as s:
+        status, ctype, body = _get(f"http://127.0.0.1:{s.port}/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert "gol_epochs_advanced_total 42" in body
+        status, ctype, body = _get(f"http://127.0.0.1:{s.port}/healthz")
+        assert status == 200 and json.loads(body) == health
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(f"http://127.0.0.1:{s.port}/nope")
+        assert err.value.code == 404
+
+
+def test_http_healthz_unhealthy_is_503():
+    r = MetricsRegistry()
+    with MetricsServer(
+        r, port=0, host="127.0.0.1", health=lambda: {"ok": False, "error": "x"}
+    ) as s:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(f"http://127.0.0.1:{s.port}/healthz")
+        assert err.value.code == 503
+
+
+# -- profiling.timed() exposes its measurement --------------------------------
+
+
+def test_timed_returns_duration_and_records_to_registry(capsys):
+    from akka_game_of_life_tpu.runtime import profiling
+
+    r = install(MetricsRegistry())
+    with profiling.timed("checkpoint@128", registry=r) as span:
+        time.sleep(0.01)
+    assert span.seconds >= 0.01
+    assert span.ms == pytest.approx(span.seconds * 1e3)
+    assert "checkpoint@128" in capsys.readouterr().out
+    # Recorded under the @-stripped span label: epoch-stamped labels must
+    # not mint one series per epoch.
+    h = r.get("gol_span_seconds").labels(span="checkpoint")
+    assert h.count == 1 and h.sum == pytest.approx(span.seconds, rel=0.5)
+
+
+# -- doc lint (tier-1: the metric catalog cannot rot) -------------------------
+
+
+def test_every_metric_in_code_is_documented():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import check_metrics_doc
+    finally:
+        sys.path.pop(0)
+    found = check_metrics_doc.metric_names_in_code()
+    # The scan sees the real catalog (sanity: it must find the acceptance
+    # names, or the lint would vacuously pass).
+    for must in ("gol_epochs_advanced_total", "gol_chaos_crashes_total"):
+        assert must in found
+    missing = check_metrics_doc.undocumented()
+    assert not missing, (
+        f"metrics registered in code but missing from docs/OPERATIONS.md: "
+        f"{sorted(missing)}"
+    )
+
+
+# -- acceptance: CLI run writes valid exposition ------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9eE.+-]+$|^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \+Inf$"
+)
+
+
+def test_cli_run_writes_prometheus_file_and_events(tmp_path):
+    """`python -m akka_game_of_life_tpu run --metrics-file ...` on a small
+    board writes valid Prometheus text exposition carrying the acceptance
+    names, and `--log-events` captures the run's lifecycle."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""  # single host device: the in-process suite's
+    # virtual 8-device mesh must not leak into the child
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    mfile = tmp_path / "m.prom"
+    efile = tmp_path / "events.jsonl"
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "akka_game_of_life_tpu", "run",
+            "--platform", "cpu", "--height", "32", "--width", "32",
+            "--seed", "3", "--max-epochs", "8", "--steps-per-call", "4",
+            "--metrics-every", "4", "--metrics-file", str(mfile),
+            "--log-events", str(efile),
+        ],
+        env=env,
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    text = mfile.read_text()
+    for required in (
+        "gol_epochs_advanced_total",
+        "gol_peer_retries_total",
+        "gol_chaos_crashes_total",
+    ):
+        assert re.search(rf"^{required} \d", text, re.M), (required, text)
+    assert re.search(r"^gol_epochs_advanced_total 8$", text, re.M)
+    assert re.search(r"^gol_step_seconds_count [1-9]", text, re.M)
+    assert re.search(r'^gol_step_seconds_bucket\{le="\+Inf"\} [1-9]', text, re.M)
+    # Every sample line is well-formed 0.0.4 text format.
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert _SAMPLE_RE.match(line), line
+    events = read_events(str(efile))
+    assert events and events[-1]["event"] == "sim_closed"
+    assert all(e["node"] == "standalone:0" for e in events)
+
+
+# -- soak: counters actually move under injected faults -----------------------
+
+
+def test_soak_retry_and_crash_counters_increment_under_faults(tmp_path):
+    """A cluster run with tile-kill chaos plus a stalled worker: the chaos
+    counter, the peer-retry counter, and the redeploy counter must all
+    increment — the failure paths are observable, not just survivable.
+
+    The stall (a worker pause long enough for its neighbor's halo pulls to
+    cross retry_s) exists because the in-thread "crash" hook leaves via
+    GOODBYE, which redeploys tiles faster than a pull can ever go stale —
+    retries need a silent-but-alive window, the exact condition the retry
+    loop was built for."""
+    import numpy as np
+
+    from akka_game_of_life_tpu.runtime.config import (
+        FaultInjectionConfig,
+        SimulationConfig,
+    )
+    from akka_game_of_life_tpu.runtime.harness import cluster
+    from akka_game_of_life_tpu.runtime.render import BoardObserver
+
+    reg = install(MetricsRegistry())
+    cfg = SimulationConfig(
+        height=32, width=32, seed=5, max_epochs=80, tick_s=0.01,
+        checkpoint_dir=str(tmp_path), checkpoint_every=10,
+        fault_injection=FaultInjectionConfig(
+            enabled=True, first_after_s=0.1, every_s=0.3,
+            max_crashes=2, mode="tile",
+        ),
+        log_events=str(tmp_path / "events.jsonl"),
+    )
+    obs = BoardObserver(out=io.StringIO(), registry=reg)
+    with cluster(cfg, 2, observer=obs, registry=reg) as h:
+        for w in h.workers:
+            w.retry_s = 0.1
+        assert h.frontend.wait_for_backends(timeout=10)
+        h.frontend.start_simulation()
+        deadline = time.monotonic() + 30
+        while min(h.frontend.tile_epochs.values(), default=0) < 10:
+            assert time.monotonic() < deadline, "no progress before the stall"
+            assert h.frontend.error is None, h.frontend.error
+            time.sleep(0.01)
+        # Stall one worker: silent (no rings) but alive (heartbeats flow) —
+        # its neighbor's pulls go stale and the retry loop must fire.  Short
+        # enough that GATHER_FAILED escalation (max_pull_retries * retry_s)
+        # never triggers a redeploy of the stalled tiles.
+        h.workers[1].paused = True
+        time.sleep(0.6)
+        h.workers[1].paused = False
+        h.workers[1]._kick()
+        assert h.frontend.done.wait(60), "cluster did not finish"
+        assert h.frontend.error is None, h.frontend.error
+        final = h.frontend.final_board
+    assert final is not None and final.shape == (32, 32)
+    assert reg.value("gol_chaos_crashes_total") >= 1
+    assert reg.value("gol_peer_retries_total") >= 1, (
+        "halo pulls never went stale during the stall — retry path untested"
+    )
+    assert reg.value("gol_redeploys_total") >= 1
+    assert reg.value("gol_peer_sends_total") >= 1
+    assert reg.value("gol_peer_receives_total") >= 1
+    assert reg.value("gol_checkpoint_saves_total") >= 1
+    # The event log saw the same story.
+    events = read_events(str(tmp_path / "events.jsonl"))
+    kinds = {e["event"] for e in events}
+    assert "crash_injected" in kinds
+    assert "tile_redeploy" in kinds
+    assert np.asarray(final).dtype == np.uint8
+
+
+def test_standalone_chaos_counters_via_simulation(tmp_path):
+    """Standalone injected crash: crashes fired, recovery counted, replayed
+    epochs accounted — on the actor backend (portable, no device mesh)."""
+    from akka_game_of_life_tpu.runtime.config import load_config
+    from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+    reg = install(MetricsRegistry())
+    cfg = load_config(None, {
+        "height": 20, "width": 20, "seed": 7, "backend": "actor",
+        "max_epochs": 16, "steps_per_call": 2,
+        "checkpoint_dir": str(tmp_path), "checkpoint_every": 4,
+        "checkpoint_async": False,
+        "fault_injection": {
+            "enabled": True, "first_after_epochs": 6, "every_epochs": 100,
+        },
+    })
+    with Simulation(cfg, registry=reg) as sim:
+        sim.advance()
+    assert sim.epoch == 16
+    assert sim.crash_log == [6]
+    assert reg.value("gol_chaos_crashes_total") == 1
+    assert reg.value("gol_chaos_recovered_total") == 1
+    # Crash at 6 restores the epoch-4 checkpoint and replays 2 epochs.
+    assert reg.value("gol_chaos_replay_epochs_total") == 2
+    assert reg.value("gol_epochs_advanced_total") == 16
+    assert reg.value("gol_checkpoint_restores_total") >= 1
+    assert reg.value("gol_epoch") == 16

@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Lint: every metric name registered in code is documented.
+
+Scans ``akka_game_of_life_tpu/**/*.py`` for ``gol_*`` metric-name string
+literals (which covers the catalog AND any ad-hoc registration that bypasses
+it) and asserts each appears in ``docs/OPERATIONS.md``'s "Metrics & events"
+catalog — so the operator-facing doc cannot silently rot as instrumentation
+grows.  Driven by ``tests/test_metrics.py::test_every_metric_in_code_is_
+documented`` (tier-1), and runnable standalone:
+
+    python tools/check_metrics_doc.py       # exit 1 + list when stale
+
+No third-party imports: usable before the environment is set up.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DOC = REPO / "docs" / "OPERATIONS.md"
+PACKAGE = REPO / "akka_game_of_life_tpu"
+
+# A metric-name literal: the gol_ prefix is the package's namespace, so any
+# quoted gol_* identifier in the source IS a metric name (nothing else in
+# the codebase uses the prefix).
+_METRIC_LITERAL = re.compile(r"""["'](gol_[a-z0-9_]+)["']""")
+
+
+def metric_names_in_code() -> set:
+    names = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        names.update(_METRIC_LITERAL.findall(path.read_text(encoding="utf-8")))
+    return names
+
+
+def undocumented() -> set:
+    doc = DOC.read_text(encoding="utf-8")
+    return {name for name in metric_names_in_code() if name not in doc}
+
+
+def main() -> int:
+    names = metric_names_in_code()
+    if not names:
+        print("check_metrics_doc: found NO gol_* metric literals — the scan "
+              "is broken, not the doc", file=sys.stderr)
+        return 2
+    missing = sorted(undocumented())
+    if missing:
+        print(f"{len(missing)} metric(s) registered in code but missing "
+              f"from {DOC.relative_to(REPO)}:", file=sys.stderr)
+        for name in missing:
+            print(f"  - {name}", file=sys.stderr)
+        return 1
+    print(f"check_metrics_doc: {len(names)} metric names all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
