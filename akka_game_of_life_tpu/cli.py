@@ -127,6 +127,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "timestamps and per-node labels",
     )
     p.add_argument(
+        "--trace-file",
+        metavar="PATH",
+        help="write the run's causally-linked span buffer here as Chrome "
+        "trace-event / Perfetto JSON on exit (open in ui.perfetto.dev or "
+        "chrome://tracing; the live view is /trace on --metrics-port)",
+    )
+    p.add_argument(
+        "--flight-dir",
+        metavar="DIR",
+        help="directory for automatic flight-recorder dumps (last N spans+"
+        "events) on crashes, redeploys, and SIGTERM (default: artifacts; "
+        "empty string disables)",
+    )
+    p.add_argument(
         "--obs-defer",
         action="store_true",
         default=None,
@@ -198,6 +212,8 @@ def _overrides(args: argparse.Namespace) -> dict:
         "metrics_file": args.metrics_file,
         "metrics_port": args.metrics_port,
         "log_events": args.log_events,
+        "trace_file": args.trace_file,
+        "flight_dir": args.flight_dir,
         "obs_defer": args.obs_defer,
         "log_file": args.log_file,
         "distributed": args.distributed,
@@ -355,6 +371,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="append worker-labeled JSONL lifecycle events here",
     )
     be_p.add_argument(
+        "--trace-file",
+        metavar="PATH",
+        help="write this worker's span buffer as Perfetto JSON on exit "
+        "(same trace ids as the frontend's — merge the files by trace_id)",
+    )
+    be_p.add_argument(
+        "--flight-dir",
+        metavar="DIR",
+        default="artifacts",
+        help="directory for this worker's flight-recorder crash dumps "
+        "(default: artifacts; empty string disables)",
+    )
+    be_p.add_argument(
         "--pallas",
         choices=["auto", "off", "interpret"],
         default=None,
@@ -392,7 +421,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             cfg.max_epochs = 100
         sim = Simulation(cfg)
 
-        with _sigterm_as_interrupt(), _metrics_endpoint(cfg, sim):
+        # SIGTERM order matters: the interrupt mapping installs first, the
+        # flight dump wraps it — an orchestrator stop dumps the span/event
+        # ring, THEN follows the graceful KeyboardInterrupt path.
+        from akka_game_of_life_tpu.runtime.signals import flight_dump_on_signals
+
+        with _sigterm_as_interrupt(), flight_dump_on_signals(
+            sim.tracer.flight
+        ), _metrics_endpoint(cfg, sim):
             try:
                 return _run_simulation(args, cfg, sim)
             except KeyboardInterrupt:
@@ -425,7 +461,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         except ImportError as e:  # pragma: no cover
             raise SystemExit(f"frontend role unavailable: {e}")
 
-        with _sigterm_as_interrupt():
+        from akka_game_of_life_tpu.obs import get_tracer
+        from akka_game_of_life_tpu.runtime.signals import flight_dump_on_signals
+
+        with _sigterm_as_interrupt(), flight_dump_on_signals(
+            get_tracer().flight
+        ):
             try:
                 return run_frontend(cfg, min_backends=args.min_backends)
             except KeyboardInterrupt:
@@ -451,8 +492,9 @@ def _metrics_endpoint(cfg, sim):
         sim.metrics,
         port=cfg.metrics_port,
         health=lambda: {"ok": True, "epoch": sim.epoch},
+        tracer=sim.tracer,
     )
-    print(f"metrics on :{server.port}/metrics (+/healthz)", flush=True)
+    print(f"metrics on :{server.port}/metrics (+/healthz,/trace)", flush=True)
     try:
         yield
     finally:
@@ -650,7 +692,12 @@ def _other_commands(args) -> int:
         except ImportError as e:  # pragma: no cover
             raise SystemExit(f"backend role unavailable: {e}")
 
-        with _sigterm_as_interrupt():
+        from akka_game_of_life_tpu.obs import get_tracer
+        from akka_game_of_life_tpu.runtime.signals import flight_dump_on_signals
+
+        with _sigterm_as_interrupt(), flight_dump_on_signals(
+            get_tracer().flight
+        ):
             try:
                 return run_backend(
                     host=args.host,
@@ -661,6 +708,8 @@ def _other_commands(args) -> int:
                     metrics_file=args.metrics_file,
                     metrics_port=args.metrics_port,
                     log_events=args.log_events,
+                    trace_file=args.trace_file,
+                    flight_dir=args.flight_dir,
                 )
             except KeyboardInterrupt:
                 # run_backend handles interrupts inside its serve loop; this

@@ -30,8 +30,14 @@ class EventLog:
         *,
         node: str = "standalone",
         stream: Optional[IO[str]] = None,
+        recorder=None,
     ) -> None:
         self.node = node
+        # Optional flight-recorder tee: every emitted event also lands in the
+        # crash ring buffer, so a post-mortem dump interleaves lifecycle
+        # events with trace spans (obs/flight.py).  Tees even when the file
+        # sink is disabled — the ring is cheap and the dump wants history.
+        self.recorder = recorder
         self._lock = threading.Lock()
         self._own_file = None
         if stream is not None:
@@ -50,7 +56,7 @@ class EventLog:
         """Write one event line.  ``fields`` must be JSON-serializable
         (non-serializable values degrade to ``str``); reserved keys
         (event/node/t_mono/t_wall) cannot be overridden."""
-        if self._out is None:
+        if self._out is None and self.recorder is None:
             return
         rec = {
             "event": event,
@@ -61,6 +67,10 @@ class EventLog:
         for k, v in fields.items():
             if k not in rec:
                 rec[k] = v
+        if self.recorder is not None:
+            self.recorder.record_event(rec)
+        if self._out is None:
+            return
         line = json.dumps(rec, separators=(",", ":"), default=str)
         with self._lock:
             if self._out is None:

@@ -1,15 +1,24 @@
-"""Live exposition endpoint: ``/metrics`` + ``/healthz`` over plain HTTP.
+"""Live exposition endpoint: ``/metrics`` + ``/healthz`` + ``/trace``.
 
-``--metrics-port N`` on the ``run`` and ``frontend`` roles starts this
-server; ``curl localhost:N/metrics`` scrapes the registry in Prometheus
-text format, ``curl localhost:N/healthz`` answers a one-line JSON health
-document (HTTP 200 while the role considers itself healthy, 503 once it
-does not — the shape load balancers and k8s probes expect).
+``--metrics-port N`` on the ``run``, ``frontend``, and ``backend`` roles
+starts this server; ``curl localhost:N/metrics`` scrapes the registry in
+Prometheus text format, ``curl localhost:N/healthz`` answers a one-line JSON
+health document (HTTP 200 while the role considers itself healthy, 503 once
+it does not — the shape load balancers and k8s probes expect), and
+``curl localhost:N/trace`` returns the live span buffer as Chrome
+trace-event / Perfetto JSON (open it in ui.perfetto.dev or
+``chrome://tracing``) when a tracer is attached.
 
 Stdlib-only (``http.server``), threaded, daemonized: a scrape can never
 block the simulation loop, and an abandoned server cannot hold the process
 open.  Port 0 binds an ephemeral port (tests); the bound port is on
 ``server.port``.
+
+Response discipline: every endpoint renders its body fully — taking
+whatever registry/tracer locks rendering needs — BEFORE the first header
+byte is written, so no internal lock is ever held across a socket write to
+a possibly-slow scraper, concurrent scrapes serialize only on the in-memory
+render, and every response (including 404s) carries ``Content-Length``.
 
 The default bind is ``0.0.0.0`` — deliberate: probes and scrapers reach a
 containerized role over the pod/VM network, not loopback (the exporter
@@ -31,7 +40,8 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class MetricsServer:
-    """Serve one registry's exposition until :meth:`close`."""
+    """Serve one registry's exposition (and one tracer's span buffer) until
+    :meth:`close`."""
 
     def __init__(
         self,
@@ -39,36 +49,56 @@ class MetricsServer:
         port: int = 0,
         host: str = "0.0.0.0",
         health: Optional[Callable[[], dict]] = None,
+        tracer=None,
     ) -> None:
         self.registry = registry
+        self.tracer = tracer
         # Health contract: return a JSON-serializable dict; "ok" (default
         # True) picks the status code.  Exceptions read as unhealthy.
         self._health = health or (lambda: {"ok": True})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _respond(self, code: int, ctype: str, body: bytes) -> None:
+                # Headers + body only AFTER the body is a finished byte
+                # string: rendering (and its locks) never overlaps the
+                # socket write, and Content-Length is always exact.
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 — http.server API
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
-                    body = outer.registry.render().encode("utf-8")
-                    self.send_response(200)
-                    self.send_header("Content-Type", CONTENT_TYPE)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._respond(
+                        200, CONTENT_TYPE, outer.registry.render().encode("utf-8")
+                    )
                 elif path == "/healthz":
                     try:
                         doc = dict(outer._health())
                     except Exception as e:  # noqa: BLE001 — report, not raise
                         doc = {"ok": False, "error": repr(e)}
-                    body = (json.dumps(doc) + "\n").encode("utf-8")
-                    self.send_response(200 if doc.get("ok", True) else 503)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._respond(
+                        200 if doc.get("ok", True) else 503,
+                        "application/json",
+                        (json.dumps(doc) + "\n").encode("utf-8"),
+                    )
+                elif path == "/trace" and outer.tracer is not None:
+                    self._respond(
+                        200,
+                        "application/json",
+                        outer.tracer.export_json().encode("utf-8"),
+                    )
                 else:
-                    self.send_error(404)
+                    self._respond(
+                        404,
+                        "application/json",
+                        (json.dumps({"error": f"no route {path}"}) + "\n").encode(
+                            "utf-8"
+                        ),
+                    )
 
             def log_message(self, fmt, *args):  # scrapes must not spam stdout
                 pass

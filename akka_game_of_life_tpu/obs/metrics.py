@@ -83,11 +83,14 @@ def _labels_suffix(labels: Mapping[str, str]) -> str:
 class _Child:
     """One (labelset, value) series of an instrument."""
 
-    __slots__ = ("_lock", "_value")
+    __slots__ = ("_lock", "_value", "touched")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._value = 0.0
+        # Ever mutated?  snapshot() filters on this, not the value — a gauge
+        # that was set and legitimately returned to 0 is still reported.
+        self.touched = False
 
     @property
     def value(self) -> float:
@@ -101,20 +104,24 @@ class _CounterChild(_Child):
             raise ValueError(f"counters are monotonic; cannot inc by {amount}")
         with self._lock:
             self._value += amount
+            self.touched = True
 
 
 class _GaugeChild(_Child):
     def set(self, value: float) -> None:
         with self._lock:
             self._value = float(value)
+            self.touched = True
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value += amount
+            self.touched = True
 
     def dec(self, amount: float = 1.0) -> None:
         with self._lock:
             self._value -= amount
+            self.touched = True
 
 
 class _HistogramChild:
@@ -126,6 +133,10 @@ class _HistogramChild:
         self.counts = [0] * (len(buckets) + 1)  # +1 for the +Inf bucket
         self.sum = 0.0
         self.count = 0
+
+    @property
+    def touched(self) -> bool:
+        return self.count > 0
 
     def observe(self, value: float) -> None:
         with self._lock:
@@ -330,27 +341,36 @@ class MetricsRegistry:
                     )
         return "\n".join(lines) + "\n"
 
+    def snapshot(self, nonzero_only: bool = True) -> Dict[str, object]:
+        """A compact JSON-able view of every live series — the shape bench
+        records embed so a throughput line carries its halo-bytes and
+        span-latency context.  Counters/gauges map name (with a label
+        suffix for labeled series) to value; histograms map to
+        ``{"count", "sum"}``.  ``nonzero_only`` drops never-*touched*
+        series (so the pre-installed catalog doesn't bloat every record) —
+        a gauge that was set and legitimately returned to 0 stays in."""
+        out: Dict[str, object] = {}
+        with self._lock:
+            families = [self._instruments[n] for n in sorted(self._instruments)]
+        for inst in families:
+            for labels, child in inst.series():
+                if nonzero_only and not child.touched:
+                    continue
+                key = f"{inst.name}{_labels_suffix(labels)}"
+                if inst.kind == "histogram":
+                    snap = child.snapshot()
+                    out[key] = {"count": snap["count"], "sum": snap["sum"]}
+                else:
+                    out[key] = child.value
+        return out
+
     def write(self, path: str) -> None:
         """Dump the exposition atomically (tmp + rename): a scrape of the
         file never sees a torn write, matching the checkpoint store's
         durability idiom."""
-        import os
-        import tempfile
+        from akka_game_of_life_tpu.obs.ioutil import atomic_write_text
 
-        text = self.render()
-        d = os.path.dirname(os.path.abspath(path)) or "."
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".metrics_")
-        try:
-            with os.fdopen(fd, "w", encoding="utf-8") as f:
-                f.write(text)
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        atomic_write_text(path, self.render(), prefix=".metrics_")
 
 
 _GLOBAL_LOCK = threading.Lock()

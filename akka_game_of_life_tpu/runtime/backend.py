@@ -51,12 +51,18 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from akka_game_of_life_tpu.obs import get_registry
+from akka_game_of_life_tpu.obs.tracing import get_tracer
 from akka_game_of_life_tpu.ops.npkernel import step_padded_np
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 from akka_game_of_life_tpu.runtime import protocol as P
 from akka_game_of_life_tpu.runtime.boundary import BoundaryStore, Halo
 from akka_game_of_life_tpu.runtime.tiles import Ring, TileId, TileLayout
-from akka_game_of_life_tpu.runtime.wire import Channel, pack_tile, unpack_tile
+from akka_game_of_life_tpu.runtime.wire import (
+    Channel,
+    extract_trace,
+    pack_tile,
+    unpack_tile,
+)
 
 
 class _Tile:
@@ -333,6 +339,7 @@ class BackendWorker:
         peer_host: str = "0.0.0.0",
         crash_hook: Optional[Callable[[], None]] = None,
         registry=None,
+        tracer=None,
     ) -> None:
         if engine not in ("numpy", "jax", "swar", "actor", "actor-native"):
             raise ValueError(
@@ -364,6 +371,11 @@ class BackendWorker:
         # stream never surfaced (how many rings flowed, how many pulls went
         # stale); counters make them first-class.
         reg = registry if registry is not None else get_registry()
+        # Tracing: step/halo/retry spans parent themselves under the trace
+        # context the frontend embeds in TICK/DEPLOY envelopes, so a
+        # frontend epoch span links to every chunk this worker steps for it.
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self._trace_ctx: Optional[dict] = None
         self._m_sends = reg.counter("gol_peer_sends_total")
         self._m_receives = reg.counter("gol_peer_receives_total")
         self._m_retries = reg.counter("gol_peer_retries_total")
@@ -394,6 +406,15 @@ class BackendWorker:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self.stopped_reason: Optional[str] = None
+        # Run-once hooks fired just before the control channel closes on
+        # ANY orderly exit (SHUTDOWN, stop()) and on CRASH — the span
+        # forwarder drains its pending batch here so the frontend's trace
+        # file doesn't lose the run's final second.  Guarded by a dedicated
+        # lock, NOT self._lock: the CRASH path runs these and must never
+        # wait behind a compute step holding the worker lock.
+        self._pre_stop_hooks: List[Callable[[], None]] = []
+        self._pre_stop_lock = threading.Lock()
+        self._pre_stop_done = False
 
         # -- peer-to-peer data plane -----------------------------------------
         self.layout: Optional[TileLayout] = None
@@ -462,8 +483,21 @@ class BackendWorker:
             self._stop.set()
         return 0 if self.stopped_reason == "shutdown" else 1
 
+    def _run_pre_stop_hooks(self) -> None:
+        with self._pre_stop_lock:
+            if self._pre_stop_done:
+                return
+            self._pre_stop_done = True
+            hooks = list(self._pre_stop_hooks)
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — shutdown must complete
+                pass
+
     def stop(self) -> None:
         self._stop.set()
+        self._run_pre_stop_hooks()
         if self.channel is not None:
             try:
                 # Graceful leave (cluster down): distinguishable from a crash.
@@ -514,10 +548,16 @@ class BackendWorker:
         elif kind == P.PEER_RING:
             self._m_receives.inc()
             if self.store is not None:
-                # push_ring fires queued local pull callbacks (_apply_halo).
-                self.store.push_ring(
-                    tuple(msg["tile"]), int(msg["epoch"]), _ring_of_msg(msg)
-                )
+                # push_ring fires queued local pull callbacks (_apply_halo),
+                # so the span also covers any tile chunks this ring unblocks.
+                with self.tracer.span(
+                    "halo.recv", parent=self._trace_ctx,
+                    node=self.name or "backend", tile=str(tuple(msg["tile"])),
+                    epoch=int(msg["epoch"]),
+                ):
+                    self.store.push_ring(
+                        tuple(msg["tile"]), int(msg["epoch"]), _ring_of_msg(msg)
+                    )
         elif kind == P.PEER_PULL:
             # Serve every ring we have from the asked epoch forward: a
             # redeployed neighbor replaying from a checkpoint streams its
@@ -525,12 +565,19 @@ class BackendWorker:
             # round-trip per epoch.
             tile, epoch = tuple(msg["tile"]), int(msg["epoch"])
             rings = self.store.rings_from(tile, epoch) if self.store else []
-            for e, ring in rings:
-                try:
-                    channel.send(_ring_msg(tile, e, ring))
-                    self._m_sends.inc()
-                except OSError:
-                    return
+            if not rings:
+                return
+            with self.tracer.span(
+                "halo.serve", parent=self._trace_ctx,
+                node=self.name or "backend", tile=str(tile), epoch=epoch,
+                rings=len(rings),
+            ):
+                for e, ring in rings:
+                    try:
+                        channel.send(_ring_msg(tile, e, ring))
+                        self._m_sends.inc()
+                    except OSError:
+                        return
 
     def _peer_channel(self, owner: str) -> Optional[Channel]:
         """The dialed channel to a peer worker, connecting on first use."""
@@ -627,21 +674,43 @@ class BackendWorker:
                 # One wakeup that found work; one retry per stale tile.
                 self._m_wakeups.inc()
                 self._m_retries.inc(len(stale))
-            for tid, epoch in stale:
-                self._ask_missing(tid, epoch)
+                with self.tracer.span(
+                    "halo.retry", parent=self._trace_ctx,
+                    node=self.name or "backend", tiles=len(stale),
+                    epochs=str([e for _, e in stale]),
+                ):
+                    for tid, epoch in stale:
+                        self._ask_missing(tid, epoch)
             for tid, epoch in failed:
-                try:
-                    self.channel.send(
-                        {"type": P.GATHER_FAILED, "tile": list(tid), "epoch": epoch}
-                    )
-                    self._m_gather_failures.inc()
-                except OSError:
-                    pass
+                with self.tracer.span(
+                    "gather.escalate", parent=self._trace_ctx,
+                    node=self.name or "backend", tile=str(tid), epoch=epoch,
+                ):
+                    try:
+                        self.channel.send(
+                            {
+                                "type": P.GATHER_FAILED,
+                                "tile": list(tid),
+                                "epoch": epoch,
+                            }
+                        )
+                        self._m_gather_failures.inc()
+                    except OSError:
+                        pass
 
     # -- dispatch ------------------------------------------------------------
 
     def _dispatch(self, msg: dict) -> None:
         kind = msg.get("type")
+        if kind in (P.DEPLOY, P.TICK, P.CRASH, P.CRASH_TILE):
+            # Adopt the frontend's span context: everything this worker does
+            # from here until the next announcement is caused by it.  Plain
+            # attribute store, NO worker lock: a compute step holds that
+            # lock for whole chunks, and the CRASH path below must stay
+            # abrupt — it cannot queue behind a multi-second step.
+            ctx = extract_trace(msg)
+            if ctx is not None:
+                self._trace_ctx = ctx
         if kind == P.DEPLOY:
             self._on_deploy(msg)
         elif kind == P.OWNERS:
@@ -661,12 +730,31 @@ class BackendWorker:
                 self.paused = False
             self._kick()
         elif kind == P.CRASH:
+            # The post-mortem artifact BEFORE dying: the default crash_hook
+            # is os._exit, so this dump is the node's last act.
+            with self.tracer.span(
+                "backend.crash", parent=self._trace_ctx, node=self.name or "backend",
+                mode="node",
+            ):
+                self.tracer.flight.dump("crash", node=self.name or "backend")
+            # Drain pending forwarded spans (including the backend.crash one
+            # just finished) while the socket is still open — the default
+            # crash_hook is os._exit, which would strand the 1 s flush batch
+            # and leave the frontend trace without the victim's last second.
+            self._run_pre_stop_hooks()
             self.crash_hook()
         elif kind == P.CRASH_TILE:
-            self._on_crash_tile(tuple(msg["tile"]))
+            with self.tracer.span(
+                "backend.crash", parent=self._trace_ctx, node=self.name or "backend",
+                mode="tile", tile=str(tuple(msg["tile"])),
+            ):
+                self.tracer.flight.dump("tile_crash", node=self.name or "backend")
+                self._on_crash_tile(tuple(msg["tile"]))
         elif kind == P.SHUTDOWN:
             self.stopped_reason = "shutdown"
             self._stop.set()
+            # Last words while the socket is still open (span-batch drain).
+            self._run_pre_stop_hooks()
             self.channel.close()
 
     def _on_owners(self, msg: dict) -> None:
@@ -901,12 +989,16 @@ class BackendWorker:
                     tile.awaiting_since = None  # paused/short target: clear latch
                 return False
             padded = halo.pad(tile.arr)
-            if self.engine in ("actor", "actor-native"):
-                # Actor engines exchange per-epoch (the frontend rejects them
-                # when exchange_width > 1), so c == 1 here.
-                tile.arr = self._actor_engines[tid].step(padded)
-            else:
-                tile.arr = self._step_chunk(padded, c, self.exchange_width)
+            with self.tracer.span(
+                "backend.step", parent=self._trace_ctx,
+                node=self.name or "backend", tile=str(tid), epoch=epoch, chunk=c,
+            ):
+                if self.engine in ("actor", "actor-native"):
+                    # Actor engines exchange per-epoch (the frontend rejects
+                    # them when exchange_width > 1), so c == 1 here.
+                    tile.arr = self._actor_engines[tid].step(padded)
+                else:
+                    tile.arr = self._step_chunk(padded, c, self.exchange_width)
             tile.epoch += c
             tile.awaiting_since = None
             tile.retries = 0
@@ -949,8 +1041,13 @@ class BackendWorker:
                 + sum(np.asarray(c).nbytes for c in ring.corners.values())
             )
             self._m_ring_bytes.inc(payload * len(remote_owners))
-        for owner in remote_owners:
-            self._send_peer(owner, msg)
+            with self.tracer.span(
+                "halo.send", parent=self._trace_ctx,
+                node=self.name or "backend", tile=str(tid), epoch=epoch,
+                peers=len(remote_owners), bytes=payload * len(remote_owners),
+            ):
+                for owner in remote_owners:
+                    self._send_peer(owner, msg)
         # Control-plane progress ping (no arrays): feeds the frontend's
         # prune floor, stuck detection, and lag accounting.
         try:
@@ -1017,6 +1114,55 @@ class BackendWorker:
             pass
 
 
+_SPAN_FORWARD_INTERVAL_S = 1.0
+_SPAN_FORWARD_PENDING_CAP = 8192
+
+
+def _start_span_forwarding(worker: BackendWorker, tracer) -> None:
+    """Batch this process's finished spans to the frontend (P.SPANS) so its
+    --trace-file / /trace is the cluster-wide causal document.
+
+    Only the multi-process CLI role forwards — the in-process harness
+    shares one tracer with the frontend, and forwarding there would
+    duplicate every span.  The pending queue is bounded (drop-oldest): a
+    frontend that stops draining must not grow worker memory, and trace
+    loss under backpressure is the same drop-oldest contract the tracer's
+    own ring has."""
+    from collections import deque
+
+    # Same drop-oldest idiom as the tracer ring and the flight recorder.
+    pending: deque = deque(maxlen=_SPAN_FORWARD_PENDING_CAP)
+    lock = threading.Lock()
+
+    def sink(d: dict) -> None:
+        with lock:
+            pending.append(d)
+
+    tracer.add_sink(sink)
+
+    def flush() -> None:
+        with lock:
+            batch = list(pending)
+            pending.clear()
+        if batch:
+            worker.channel.send({"type": P.SPANS, "spans": batch})
+
+    def flush_loop() -> None:
+        while not worker._stop.wait(_SPAN_FORWARD_INTERVAL_S):
+            try:
+                flush()
+            except OSError:
+                return
+
+    # Final drain before the control channel closes on an orderly exit, so
+    # the frontend's trace file carries this worker's last spans (the tail
+    # of the run, and — on a SHUTDOWN right after a fault — the recovery).
+    worker._pre_stop_hooks.append(flush)
+    threading.Thread(
+        target=flush_loop, daemon=True, name="span-forward"
+    ).start()
+
+
 def run_backend(
     host: str,
     port: int,
@@ -1026,30 +1172,37 @@ def run_backend(
     metrics_file: Optional[str] = None,
     metrics_port: int = 0,
     log_events: Optional[str] = None,
+    trace_file: Optional[str] = None,
+    flight_dir: str = "artifacts",
 ) -> int:
     """CLI worker entry.  The worker's data-plane counters (peer sends/
     receives/retries, heartbeats, ring bytes) live in THIS process's
     registry — the frontend's /metrics is a different process — so the
     backend role carries its own exposition: ``metrics_file`` is rewritten
-    every few seconds and on exit, ``metrics_port`` serves live
-    /metrics + /healthz, ``log_events`` appends worker-labeled JSONL."""
+    every few seconds and on exit (the shared MetricsDumper policy),
+    ``metrics_port`` serves live /metrics + /healthz + /trace,
+    ``log_events`` appends worker-labeled JSONL, ``trace_file`` exports the
+    worker's span buffer on exit (same trace ids as the frontend's —
+    mergeable), and ``flight_dir`` receives the crash dumps."""
     from akka_game_of_life_tpu.obs import (
-        NULL_EVENTS,
         EventLog,
+        MetricsDumper,
         MetricsServer,
         get_registry,
+        get_tracer,
     )
 
     registry = get_registry()
+    tracer = get_tracer()
     worker = BackendWorker(
-        host, port, name=name, engine=engine, pallas=pallas, registry=registry
+        host, port, name=name, engine=engine, pallas=pallas,
+        registry=registry, tracer=tracer,
     )
     worker.connect()
-    events = (
-        EventLog(log_events, node=worker.name or "backend")
-        if log_events
-        else NULL_EVENTS
-    )
+    node = worker.name or "backend"
+    tracer.node = node  # nodeless spans attribute to this worker
+    tracer.flight.configure(directory=flight_dir, node=node)
+    events = EventLog(log_events, node=node, recorder=tracer.flight)
     events.emit("backend_joined", frontend=f"{host}:{port}", engine=engine)
     server = None
     if metrics_port:
@@ -1061,26 +1214,13 @@ def run_backend(
                 "tiles": len(worker.tiles),
                 "target_epoch": worker.target,
             },
+            tracer=tracer,
         )
-        print(f"metrics on :{server.port}/metrics (+/healthz)", flush=True)
-    if metrics_file:
-
-        def _dump_loop() -> None:
-            warned = False
-            while not worker._stop.wait(5.0):
-                try:
-                    registry.write(metrics_file)
-                except OSError as e:
-                    # Keep trying: a transient failure (ENOSPC blip, NFS
-                    # hiccup) must not freeze the exposition file for the
-                    # rest of a long soak.  Warn once, not every 5 s.
-                    if not warned:
-                        warned = True
-                        print(f"metrics-file write failed: {e}", flush=True)
-
-        threading.Thread(
-            target=_dump_loop, daemon=True, name="metrics-dump"
-        ).start()
+        print(f"metrics on :{server.port}/metrics (+/healthz,/trace)", flush=True)
+    dumper = MetricsDumper(registry, metrics_file) if metrics_file else None
+    if dumper is not None:
+        dumper.start_thread(worker._stop)
+    _start_span_forwarding(worker, tracer)
     print(f"backend {worker.name} joined {host}:{port}", flush=True)
     try:
         return worker.run()
@@ -1095,11 +1235,13 @@ def run_backend(
             worker.stop()
         return 130
     finally:
-        if metrics_file:
+        if dumper is not None:
+            dumper.final()
+        if trace_file:
             try:
-                registry.write(metrics_file)
-            except OSError:
-                pass
+                tracer.write(trace_file)
+            except OSError as e:
+                print(f"trace-file write failed: {e}", flush=True)
         if server is not None:
             server.close()
         events.emit("backend_stopped", reason=worker.stopped_reason)

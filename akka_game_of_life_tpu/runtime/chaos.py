@@ -37,6 +37,7 @@ class CrashInjector:
         *,
         start_time: Optional[float] = None,
         registry=None,
+        flight=None,
     ) -> None:
         self.config = config
         self.crashes = 0
@@ -52,6 +53,20 @@ class CrashInjector:
 
             registry = get_registry()
         self._crash_counter = registry.counter("gol_chaos_crashes_total")
+        # Same at-the-source rule for the flight ring: the schedule firing
+        # is on record even if the consumer dies before its own dump.
+        if flight is None:
+            from akka_game_of_life_tpu.obs.tracing import get_tracer
+
+            flight = get_tracer().flight
+        self._flight = flight
+
+    def _fired(self, **fields) -> None:
+        self.crashes += 1
+        self._crash_counter.inc()
+        self._flight.record(
+            "chaos_crash_due", n=self.crashes, mode=self.config.mode, **fields
+        )
 
     @property
     def exhausted(self) -> bool:
@@ -65,8 +80,7 @@ class CrashInjector:
         now = now if now is not None else time.monotonic()
         if now < self._next_due:
             return False
-        self.crashes += 1
-        self._crash_counter.inc()
+        self._fired(schedule="wall_clock")
         self._next_due = now + self.config.every_s
         return True
 
@@ -83,6 +97,5 @@ class CrashInjector:
         due = self.config.first_after_epochs + self.crashes * self.config.every_epochs
         if epoch < due:
             return False
-        self.crashes += 1
-        self._crash_counter.inc()
+        self._fired(schedule="epoch_indexed", epoch=epoch)
         return True

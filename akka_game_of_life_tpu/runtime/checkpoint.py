@@ -61,7 +61,9 @@ def _existing_format(directory: str) -> Optional[str]:
     return None
 
 
-def make_store(directory: str, fmt: str = "npz", keep: int = 3, registry=None):
+def make_store(
+    directory: str, fmt: str = "npz", keep: int = 3, registry=None, tracer=None
+):
     """Checkpoint store factory: ``npz`` (host, synchronous, packed) or
     ``orbax`` (device-native, async, shard-parallel).
 
@@ -78,10 +80,14 @@ def make_store(directory: str, fmt: str = "npz", keep: int = 3, registry=None):
             f"checkpoints; refusing to start a {fmt}-format store there"
         )
     if fmt == "npz":
-        return CheckpointStore(directory, keep=keep, registry=registry)
+        return CheckpointStore(
+            directory, keep=keep, registry=registry, tracer=tracer
+        )
     from akka_game_of_life_tpu.runtime.orbax_store import OrbaxCheckpointStore
 
-    return OrbaxCheckpointStore(directory, keep=keep, registry=registry)
+    return OrbaxCheckpointStore(
+        directory, keep=keep, registry=registry, tracer=tracer
+    )
 
 
 class _StoreMetrics:
@@ -92,7 +98,8 @@ class _StoreMetrics:
     background commit, recovery loads, the ``checkpoints`` CLI — counts
     through the same three instruments."""
 
-    def __init__(self, registry=None) -> None:
+    def __init__(self, registry=None, tracer=None) -> None:
+        self.tracer = tracer
         if registry is None:
             from akka_game_of_life_tpu.obs import get_registry
 
@@ -107,17 +114,33 @@ class _StoreMetrics:
 
     @contextlib.contextmanager
     def timed_save(self):
-        t0 = time.perf_counter()
-        yield
-        self.save_seconds.observe(time.perf_counter() - t0)
-        self.saves.inc()
+        with self._span("checkpoint.save"):
+            t0 = time.perf_counter()
+            yield
+            self.save_seconds.observe(time.perf_counter() - t0)
+            self.saves.inc()
 
     @contextlib.contextmanager
     def timed_restore(self):
-        t0 = time.perf_counter()
-        yield
-        self.restore_seconds.observe(time.perf_counter() - t0)
-        self.restores.inc()
+        with self._span("checkpoint.restore"):
+            t0 = time.perf_counter()
+            yield
+            self.restore_seconds.observe(time.perf_counter() - t0)
+            self.restores.inc()
+
+    @contextlib.contextmanager
+    def _span(self, name: str):
+        """Every durability op is also a trace span, so checkpoint IO shows
+        up on the epoch timeline.  On the sync path the thread-local stack
+        parents it under the active chunk/epoch span; on the async writer
+        thread it roots its own trace (still exported + flight-recorded)."""
+        tracer = self.tracer
+        if tracer is None:
+            from akka_game_of_life_tpu.obs.tracing import get_tracer
+
+            tracer = self.tracer = get_tracer()
+        with tracer.span(name):
+            yield
 
 
 @dataclasses.dataclass
@@ -135,11 +158,13 @@ class Checkpoint:
 class CheckpointStore:
     """A directory of epoch-stamped checkpoints with atomic writes."""
 
-    def __init__(self, directory: str, keep: int = 3, registry=None) -> None:
+    def __init__(
+        self, directory: str, keep: int = 3, registry=None, tracer=None
+    ) -> None:
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.keep = keep
-        self.metrics = _StoreMetrics(registry)
+        self.metrics = _StoreMetrics(registry, tracer=tracer)
 
     def _write_epoch(self, epoch: int, payload: dict) -> Path:
         """Atomically write one epoch's npz (tmp + fsync + rename), then GC."""

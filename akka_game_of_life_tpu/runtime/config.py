@@ -218,6 +218,16 @@ class SimulationConfig:
     # membership churn) appended here with monotonic timestamps and a
     # per-node label.  None = off.
     log_events: Optional[str] = None
+    # Distributed span tracing (obs/tracing.py): write the run's span buffer
+    # here as Chrome trace-event / Perfetto JSON on close.  The span buffer
+    # is always recording (bounded); this only controls the file export —
+    # the live view is the obs endpoint's /trace.  None = no file.
+    trace_file: Optional[str] = None
+    # Crash flight recorder (obs/flight.py): directory for the automatic
+    # last-N-spans+events dumps written on injected crashes, supervision
+    # replays, node-loss redeploys, and SIGTERM.  Empty string disables
+    # dumping (the ring still records for /trace continuity).
+    flight_dir: str = "artifacts"
     # Deferred observation: cadence points dispatch their device-side
     # observation (population / render sample / probe window) and return
     # without any host fetch; the tiny results are fetched one chunk later,

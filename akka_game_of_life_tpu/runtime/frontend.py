@@ -31,11 +31,12 @@ from typing import Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from akka_game_of_life_tpu.obs import (
-    NULL_EVENTS,
     EventLog,
+    MetricsDumper,
     MetricsServer,
     get_registry,
 )
+from akka_game_of_life_tpu.obs.tracing import get_tracer
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 from akka_game_of_life_tpu.runtime import protocol as P
 from akka_game_of_life_tpu.runtime.checkpoint import make_store
@@ -48,6 +49,7 @@ from akka_game_of_life_tpu.runtime.tiles import TileId, TileLayout, layout_for_w
 from akka_game_of_life_tpu.runtime.wire import (
     MAX_FRAME,
     Channel,
+    attach_trace,
     pack_tile,
     unpack_tile,
 )
@@ -146,6 +148,7 @@ class Frontend:
         min_backends: int = 1,
         observer: Optional[BoardObserver] = None,
         registry=None,
+        tracer=None,
     ) -> None:
         if config.max_epochs is None:
             raise ValueError("frontend requires max_epochs")
@@ -153,13 +156,35 @@ class Frontend:
         self.rule = resolve_rule(config.rule)
         # Coordinator observability: membership churn and recovery actions
         # as counters/gauges, lifecycle as JSONL events, both exposed live
-        # at /metrics + /healthz when metrics_port is set (started in
-        # :meth:`start`).
+        # at /metrics + /healthz + /trace when metrics_port is set (started
+        # in :meth:`start`).  The tracer's epoch span context rides inside
+        # TICK/DEPLOY/CRASH envelopes so worker spans join the epoch trace.
         self.metrics = registry if registry is not None else get_registry()
-        self.events = (
-            EventLog(config.log_events, node="frontend")
-            if config.log_events
-            else NULL_EVENTS
+        if tracer is None:
+            tracer = get_tracer()
+            # Role-label the process tracer so nodeless spans (checkpoint
+            # IO on the io thread) attribute to this role, not "proc".
+            tracer.node = "frontend"
+        self.tracer = tracer
+        self.tracer.flight.configure(
+            directory=config.flight_dir, node="frontend"
+        )
+        self.events = EventLog(
+            config.log_events, node="frontend", recorder=self.tracer.flight
+        )
+        # cluster.run is the whole simulation; epoch is one epoch-target
+        # announcement (the whole run in free-running mode, one tick in
+        # paced mode) — the span every backend step links back to.
+        self._run_span = None
+        self._epoch_span = None
+        self._metrics_dumper = (
+            MetricsDumper(
+                self.metrics,
+                config.metrics_file,
+                interval_s=_METRICS_DUMP_INTERVAL_S,
+            )
+            if config.metrics_file
+            else None
         )
         self._m_alive = self.metrics.gauge("gol_members_alive")
         self._m_joined = self.metrics.counter("gol_members_joined_total")
@@ -207,6 +232,7 @@ class Frontend:
                 config.checkpoint_dir,
                 config.checkpoint_format,
                 registry=self.metrics,
+                tracer=self.tracer,
             )
             if config.checkpoint_dir
             else None
@@ -275,6 +301,7 @@ class Frontend:
                 self.metrics,
                 port=self.config.metrics_port,
                 health=self._health,
+                tracer=self.tracer,
             )
         for fn in (self._accept_loop, self._maintenance_loop, self._io_loop):
             t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
@@ -380,8 +407,22 @@ class Frontend:
 
             if self.config.fault_injection.enabled:
                 self.injector = CrashInjector(
-                    self.config.fault_injection, registry=self.metrics
+                    self.config.fault_injection,
+                    registry=self.metrics,
+                    flight=self.tracer.flight,
                 )
+
+            # Root the run's trace: every backend step/halo/recovery span
+            # links back here through the context TICK/DEPLOY carry.
+            self._run_span = self.tracer.start(
+                "cluster.run", node="frontend",
+                shape=str(self.config.shape), max_epochs=self.config.max_epochs,
+                members=len(members),
+            )
+            self._epoch_span = self.tracer.start(
+                "epoch", parent=self._run_span, node="frontend",
+                target=self.target_epoch,
+            )
 
             assignments: Dict[str, List[TileId]] = {m.name: [] for m in members}
             for idx, tile in enumerate(self.layout.tile_ids):
@@ -502,7 +543,12 @@ class Frontend:
                 # TILE_STATE pushes; the observer stitches the exact window
                 # (O(window) on the wire at any board size).
                 msg["probe_window"] = list(self.config.probe_window)
-        self._safe_send(member, msg)
+            attach_trace(msg, self._epoch_span)
+        with self.tracer.span(
+            "cluster.deploy", parent=self._epoch_span, node="frontend",
+            member=member.name, tiles=len(tiles), epoch=epoch,
+        ):
+            self._safe_send(member, msg)
 
     def _safe_send(self, member: Member, msg: dict) -> None:
         try:
@@ -517,6 +563,16 @@ class Frontend:
                 m.channel.send({"type": P.SHUTDOWN})
             except OSError:
                 pass
+        if self.config.trace_file:
+            # Drain the workers' final P.SPANS batches before the export
+            # below: each worker flushes its pending spans on SHUTDOWN
+            # receipt and then closes, and its reader thread here ingests
+            # everything sent before the EOF — so "every member gone" means
+            # the tail has landed.  Bounded: a wedged worker costs 2 s, not
+            # the shutdown.
+            deadline = time.monotonic() + 2.0
+            while self.membership.alive_members() and time.monotonic() < deadline:
+                time.sleep(0.01)
         try:
             self._listener.close()
         except OSError:
@@ -527,16 +583,25 @@ class Frontend:
         if self.store is not None:
             # Async (orbax) saves must be durable before the process exits.
             self.store.close()
-        # Observability epilogue: final exposition dump, then tear the live
-        # endpoint and the event log down (a scrape after stop() would show
-        # a half-dead cluster).
-        if self.config.metrics_file:
+        # Observability epilogue: close out the run's spans, final
+        # exposition + trace dumps, then tear the live endpoint and the
+        # event log down (a scrape after stop() would show a half-dead
+        # cluster).  Every step is failure-contained so teardown completes.
+        with self._lock:
+            # Under the lock: the paced-mode rotation also runs under it
+            # (and skips once _stop is set), so the span finished here is
+            # always the last one minted.
+            if self._epoch_span is not None:
+                self._epoch_span.set(done=self.done.is_set()).finish()
+            if self._run_span is not None:
+                self._run_span.set(error=self.error).finish()
+        if self._metrics_dumper is not None:
+            self._metrics_dumper.final()
+        if self.config.trace_file:
             try:
-                self.metrics.write(self.config.metrics_file)
-            except OSError as e:
-                # Teardown must complete (server + event log below) even
-                # when the exposition file became unwritable.
-                print(f"final metrics-file write failed: {e}", flush=True)
+                self.tracer.write(self.config.trace_file)
+            except Exception as e:  # noqa: BLE001 — teardown must complete
+                print(f"trace-file write failed: {e!r}", flush=True)
         if self._metrics_server is not None:
             self._metrics_server.close()
             self._metrics_server = None
@@ -636,10 +701,24 @@ class Frontend:
             self.events.emit(
                 "member_joined", member=member.name, engine=str(engine)
             )
-            while not self._stop.is_set():
+            while True:
                 msg = channel.recv()
                 if msg is None:
                     break
+                if self._stop.is_set():
+                    # Post-stop: drain ONLY the workers' final span batches
+                    # (flushed on SHUTDOWN receipt, just before their EOF —
+                    # and possibly queued behind a last heartbeat/progress
+                    # frame); everything else from a stopping cluster is
+                    # stale.  Looping to EOF is what makes stop()'s
+                    # "members gone ⇒ tail ingested" drain wait sound.
+                    if (
+                        isinstance(msg, dict)
+                        and msg.get("type") == P.SPANS
+                        and isinstance(msg.get("spans"), list)
+                    ):
+                        self.tracer.ingest(msg["spans"])
+                    continue
                 try:
                     # Validate structure BEFORE dispatch: a malformed message
                     # drops the worker with a one-line reason (tiles
@@ -671,6 +750,14 @@ class Frontend:
         kind = msg.get("type")
         if kind == P.HEARTBEAT:
             pass
+        elif kind == P.SPANS:
+            # Worker-forwarded finished spans: fold them into this tracer so
+            # --trace-file / /trace export the cluster-wide causal timeline
+            # from one process (ids are verbatim, so parent links to the
+            # epoch spans we minted here just work).
+            spans = msg.get("spans")
+            if isinstance(spans, list):
+                self.tracer.ingest(spans)
         elif kind == P.PROGRESS:
             # Control-plane ping only — ring bytes ride worker-to-worker
             # (PEER_RING); the frontend just tracks lag for the prune floor
@@ -833,31 +920,39 @@ class Frontend:
         member.tiles = []
         if not tiles:
             return
-        survivors = self.membership.alive_members()
-        if not survivors:
+        # A node loss with tiles to recover is exactly the moment a
+        # post-mortem wants context for: dump the flight ring and trace the
+        # whole redeploy under the epoch it interrupts.
+        self.tracer.flight.dump("node_loss", node="frontend")
+        with self.tracer.span(
+            "member.lost", parent=self._epoch_span, node="frontend",
+            member=name, tiles=len(tiles),
+        ):
+            survivors = self.membership.alive_members()
+            if not survivors:
+                with self._lock:
+                    self.error = "all backends lost"
+                self.done.set()
+                return
             with self._lock:
-                self.error = "all backends lost"
-            self.done.set()
-            return
-        with self._lock:
-            # Assign every orphaned tile first, then wire and deploy once —
-            # one OWNERS broadcast carrying the final assignment, not one
-            # per tile, and no intermediate state advertising the dead
-            # member for not-yet-reassigned tiles.
-            assigned: Dict[str, List[TileId]] = {}
-            for idx, tile in enumerate(tiles):
-                m = self._assign_tile(
-                    tile, preferred=survivors[idx % len(survivors)].name
-                )
-                if m is None:
-                    return  # budget/survivor escalation already set error
-                assigned.setdefault(m.name, []).append(tile)
-            self._broadcast_owners()
-        # Bulk sends outside the lock (see _send_deploy).
-        for name, batch in assigned.items():
-            m = self.membership.get(name)
-            if m is not None and m.alive:
-                self._send_deploy(m, batch)
+                # Assign every orphaned tile first, then wire and deploy
+                # once — one OWNERS broadcast carrying the final assignment,
+                # not one per tile, and no intermediate state advertising
+                # the dead member for not-yet-reassigned tiles.
+                assigned: Dict[str, List[TileId]] = {}
+                for idx, tile in enumerate(tiles):
+                    m = self._assign_tile(
+                        tile, preferred=survivors[idx % len(survivors)].name
+                    )
+                    if m is None:
+                        return  # budget/survivor escalation already set error
+                    assigned.setdefault(m.name, []).append(tile)
+                self._broadcast_owners()
+            # Bulk sends outside the lock (see _send_deploy).
+            for owner, batch in assigned.items():
+                m = self.membership.get(owner)
+                if m is not None and m.alive:
+                    self._send_deploy(m, batch)
 
     def _assign_tile(
         self,
@@ -900,12 +995,19 @@ class Frontend:
         # aborted reassignment redeployed nothing and must not read as
         # recovery activity.
         self._m_redeploys.inc()
-        self.events.emit(
-            "tile_redeploy",
-            tile=list(tile),
-            owner=member.name,
-            epoch=self._last_ckpt[0],
-        )
+        # The supervision-replay span: the recovery decision itself, linked
+        # under the epoch it interrupts (the deploy that ships the state is
+        # its sibling cluster.deploy span).
+        with self.tracer.span(
+            "recover.redeploy", parent=self._epoch_span, node="frontend",
+            tile=str(tile), owner=member.name, epoch=self._last_ckpt[0],
+        ):
+            self.events.emit(
+                "tile_redeploy",
+                tile=list(tile),
+                owner=member.name,
+                epoch=self._last_ckpt[0],
+            )
         self.tile_owner[tile] = member.name
         # The tile restarts at the recovery epoch: record that so the
         # ring-prune floor protects every epoch its replay will pull.
@@ -921,6 +1023,9 @@ class Frontend:
         """Redeploy one tile from the recovery source (last checkpoint or the
         deterministic initial board); the new owner replays forward by
         pulling epoch-tagged halos (the ``onCellTermination`` path)."""
+        # Supervision replay in flight: dump the ring so the artifact holds
+        # the spans/events that led to this tile needing a restart.
+        self.tracer.flight.dump("tile_redeploy", node="frontend")
         with self._lock:
             member = self._assign_tile(tile, preferred=preferred, avoid=avoid)
             if member is None:
@@ -933,25 +1038,15 @@ class Frontend:
     # -- maintenance: ticks, auto-down, fault injection ----------------------
 
     def _maintenance_loop(self) -> None:
-        next_dump = time.monotonic() + _METRICS_DUMP_INTERVAL_S
-        dump_warned = False
         while not self._stop.is_set() and not self.done.is_set():
             time.sleep(_MAINT_INTERVAL_S)
             now = time.monotonic()
-            # periodic --metrics-file refresh (atomic; scrape-safe mid-run)
-            if self.config.metrics_file and now >= next_dump:
-                next_dump = now + _METRICS_DUMP_INTERVAL_S
-                try:
-                    self.metrics.write(self.config.metrics_file)
-                    dump_warned = False
-                except OSError as e:
-                    # An unwritable path must not kill the maintenance
-                    # thread (ticks, eviction, chaos all ride on it) —
-                    # and a PERSISTENT failure must not flood stdout every
-                    # interval: warn once per outage, keep retrying.
-                    if not dump_warned:
-                        dump_warned = True
-                        print(f"metrics-file write failed: {e}", flush=True)
+            # periodic --metrics-file refresh (atomic; scrape-safe mid-run;
+            # failure containment lives in the shared MetricsDumper — an
+            # unwritable path must not kill the maintenance thread, which
+            # ticks, evicts, and injects).
+            if self._metrics_dumper is not None:
+                self._metrics_dumper.maybe(now)
             # auto-down stale members (application.conf:23 analog)
             for m in self.membership.stale_members(now):
                 self._on_member_lost(m.name)
@@ -965,12 +1060,28 @@ class Frontend:
                 and self.target_epoch < self.config.max_epochs
             ):
                 with self._lock:
+                    if self._stop.is_set() or self.done.is_set():
+                        # stop() is concurrently finishing the run's spans
+                        # (under this lock): rotating now would mint an
+                        # epoch span nobody ever finishes.
+                        continue
                     self.target_epoch += 1
                     self._next_tick = now + self.config.tick_s
+                    # One epoch span per announcement in paced mode: close
+                    # the previous target's span, open the next under the
+                    # run root, and ride its context on every TICK.
+                    if self._epoch_span is not None:
+                        self._epoch_span.finish()
+                    self._epoch_span = self.tracer.start(
+                        "epoch", parent=self._run_span, node="frontend",
+                        target=self.target_epoch,
+                    )
+                    msg = attach_trace(
+                        {"type": P.TICK, "target": self.target_epoch},
+                        self._epoch_span,
+                    )
                     for m in self.membership.alive_members():
-                        self._safe_send(
-                            m, {"type": P.TICK, "target": self.target_epoch}
-                        )
+                        self._safe_send(m, msg)
             # fault injection (BoardCreator.scala:97-102 analog)
             if (
                 self.injector is not None
@@ -989,7 +1100,11 @@ class Frontend:
         if mode == "node":
             self.crash_events.append({"mode": "node", "victim": victim.name})
             self.events.emit("crash_injected", mode="node", victim=victim.name)
-            self._safe_send(victim, {"type": P.CRASH})
+            # Trace context on the kill order: the victim's backend.crash
+            # span (and its flight dump) link to the epoch they interrupt.
+            self._safe_send(
+                victim, attach_trace({"type": P.CRASH}, self._epoch_span)
+            )
         else:
             tile = rng.choice(victim.tiles)
             self.crash_events.append(
@@ -1001,7 +1116,12 @@ class Frontend:
                 victim=victim.name,
                 tile=list(tile),
             )
-            self._safe_send(victim, {"type": P.CRASH_TILE, "tile": list(tile)})
+            self._safe_send(
+                victim,
+                attach_trace(
+                    {"type": P.CRASH_TILE, "tile": list(tile)}, self._epoch_span
+                ),
+            )
 
 
 def run_frontend(config: SimulationConfig, *, min_backends: int = 1) -> int:

@@ -28,18 +28,27 @@ class ClusterHarness:
         engine="numpy",
         pallas=None,
         registry=None,
+        tracer=None,
     ):
         # numpy engine keeps test suites fast and portable; pass engine="jax"
         # (or "swar") for the accelerator/native data paths; pallas pins the
-        # jax engine's Mosaic mode (see BackendWorker).  registry isolates
-        # the whole cluster's metrics into one MetricsRegistry (tests assert
-        # counters without cross-test bleed); None = the process default.
+        # jax engine's Mosaic mode (see BackendWorker).  registry/tracer
+        # isolate the whole cluster's metrics and spans (tests assert
+        # counters and causal trees without cross-test bleed); None = the
+        # process defaults.  With one shared tracer the frontend's epoch
+        # span and every worker's step/halo spans land in one buffer — the
+        # in-process analog of merging per-process trace files.
         self.engine = engine
         self.pallas = pallas
         self.registry = registry
+        self.tracer = tracer
         config.port = 0  # ephemeral: parallel harnesses must not fight over 2551
         self.frontend = Frontend(
-            config, min_backends=n_backends, observer=observer, registry=registry
+            config,
+            min_backends=n_backends,
+            observer=observer,
+            registry=registry,
+            tracer=tracer,
         )
         self.frontend.start()
         self.workers = []
@@ -56,6 +65,7 @@ class ClusterHarness:
             pallas=self.pallas,
             retry_s=0.5,
             registry=self.registry,
+            tracer=self.tracer,
         )
         w.crash_hook = w.stop  # in-thread "process death": drop the connection
         w.connect()
@@ -80,7 +90,13 @@ class ClusterHarness:
 
 @contextlib.contextmanager
 def cluster(
-    config, n_backends, observer=None, engine="numpy", pallas=None, registry=None
+    config,
+    n_backends,
+    observer=None,
+    engine="numpy",
+    pallas=None,
+    registry=None,
+    tracer=None,
 ):
     h = ClusterHarness(
         config,
@@ -89,6 +105,7 @@ def cluster(
         engine=engine,
         pallas=pallas,
         registry=registry,
+        tracer=tracer,
     )
     try:
         yield h

@@ -31,12 +31,14 @@ class OrbaxCheckpointStore:
     device-resident (and sharded) ``jax.Array`` boards without host gather.
     """
 
-    def __init__(self, directory: str, keep: int = 3, registry=None) -> None:
+    def __init__(
+        self, directory: str, keep: int = 3, registry=None, tracer=None
+    ) -> None:
         import orbax.checkpoint as ocp
 
         from akka_game_of_life_tpu.runtime.checkpoint import _StoreMetrics
 
-        self.metrics = _StoreMetrics(registry)
+        self.metrics = _StoreMetrics(registry, tracer=tracer)
         self._ocp = ocp
         self.dir = Path(directory).absolute()
         self.dir.mkdir(parents=True, exist_ok=True)

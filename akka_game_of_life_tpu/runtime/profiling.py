@@ -88,6 +88,13 @@ def timed(
             registry.histogram(
                 "gol_span_seconds", labelnames=("span",)
             ).labels(span=span or label.split("@", 1)[0]).observe(rec.seconds)
+        # Tracing bridge: when a trace span is active on this thread, the
+        # timed block becomes its child (same @-stripped naming rule as the
+        # histogram) — every existing timed() site lights up on the epoch
+        # timeline for free.  No active span = no-op.
+        from akka_game_of_life_tpu.obs import tracing
+
+        tracing.record_timed(label, rec.seconds, span=span)
 
 
 def device_memory_stats() -> dict:

@@ -37,6 +37,14 @@ GOODBYE                     graceful leave (cluster down)
 
 Wire form: each message is a JSON object with a ``type`` field from the
 constants below; numpy arrays ride as base64 (see :mod:`wire`).
+
+Trace propagation: frontend→backend envelopes (TICK, DEPLOY, CRASH,
+CRASH_TILE) may carry the sender's span context under
+:data:`akka_game_of_life_tpu.obs.tracing.TRACE_KEY` (attached by
+``wire.attach_trace``), so a worker's step/halo/recovery spans become
+children of the frontend epoch span that caused them.  The key is
+underscored — it can never collide with a payload field — and decoders
+that ignore it lose nothing but causality.
 """
 
 from __future__ import annotations
@@ -49,6 +57,11 @@ TILE_STATE = "tile_state"
 REDEPLOY_REQUEST = "redeploy_request"
 GATHER_FAILED = "gather_failed"
 GOODBYE = "goodbye"
+# (new) batched finished trace spans, so the frontend's --trace-file /
+# /trace holds the whole cluster's causal timeline in one document (the
+# multi-process CLI roles forward; the in-process harness shares a tracer
+# and never needs to)
+SPANS = "spans"
 
 # frontend → backend
 WELCOME = "welcome"

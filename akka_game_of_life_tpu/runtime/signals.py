@@ -15,6 +15,65 @@ import threading
 
 
 @contextlib.contextmanager
+def flight_dump_on_signals(recorder, *, reason: str = "sigterm", signals=None):
+    """Dump the flight recorder when SIGTERM lands, then run the previous
+    handler.
+
+    Installed around a role's serve loop (inside the CLI's
+    SIGTERM→KeyboardInterrupt mapping), so an orchestrator stop leaves the
+    same post-mortem artifact an injected crash does — the last N spans and
+    events at the moment the stop arrived — before the graceful-shutdown
+    path runs.  The dump itself is failure-contained (it never raises), so
+    it cannot break the shutdown it decorates.
+
+    Chains: our handler dumps, then delegates to whatever handler was
+    installed before (the CLI's KeyboardInterrupt-raiser in practice); a
+    SIG_DFL/SIG_IGN predecessor is restored and left to fire naturally.
+    Main thread only; C-installed handlers (getsignal() → None) are left
+    untouched, same policy as :func:`mask_interrupts`.
+    """
+    if signals is None:
+        signals = (signal.SIGTERM,)
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    installed = []
+
+    def _make(sig, prev):
+        def handler(signum, frame):
+            recorder.dump(reason)
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                # Re-deliver with the default disposition (usually: die).
+                signal.signal(signum, signal.SIG_DFL)
+                import os
+
+                os.kill(os.getpid(), signum)
+
+        return handler
+
+    try:
+        for sig in signals:
+            prev = signal.getsignal(sig)
+            if prev is None:
+                continue  # C-installed: unrestorable through this module
+            installed.append((sig, signal.signal(sig, _make(sig, prev))))
+    except BaseException as e:
+        for sig, old in installed:
+            signal.signal(sig, old)
+        if isinstance(e, ValueError):  # no signal support in this context
+            yield
+            return
+        raise
+    try:
+        yield
+    finally:
+        for sig, old in installed:
+            signal.signal(sig, old)
+
+
+@contextlib.contextmanager
 def mask_interrupts():
     """Ignore SIGINT/SIGTERM for the duration of a graceful drain.
 

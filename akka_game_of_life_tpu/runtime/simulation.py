@@ -40,7 +40,8 @@ from akka_game_of_life_tpu.parallel.packed_halo2d import (
     sharded_packed2d_step_fn,
     word_halo_width,
 )
-from akka_game_of_life_tpu.obs import NULL_EVENTS, EventLog, get_registry
+from akka_game_of_life_tpu.obs import EventLog, MetricsDumper, get_registry
+from akka_game_of_life_tpu.obs.tracing import get_tracer
 from akka_game_of_life_tpu.runtime import profiling
 from akka_game_of_life_tpu.runtime.chaos import CrashInjector
 from akka_game_of_life_tpu.runtime.checkpoint import make_store
@@ -142,13 +143,17 @@ class Simulation:
         config: SimulationConfig,
         observer: Optional[BoardObserver] = None,
         registry=None,
+        tracer=None,
     ) -> None:
         self.config = config
         self.rule = resolve_rule(config.rule)
         # Observability: counters/gauges/histograms land in the process-wide
         # registry unless the embedder passes an isolated one; lifecycle
-        # events append to the JSONL log when configured.
+        # events append to the JSONL log when configured; spans (advance,
+        # per-chunk, chaos crash/recover, checkpoint IO via timed()) record
+        # into the tracer, whose flight ring dumps on injected crashes.
         self.metrics = registry if registry is not None else get_registry()
+        self.tracer = tracer if tracer is not None else get_tracer()
         # Resolved once: observation runs at cadence inside the hot loop,
         # and instrument lookup takes the registry lock.
         self._m_obs_seconds = self.metrics.histogram("gol_obs_seconds")
@@ -173,13 +178,21 @@ class Simulation:
                     "at the same epoch — or the cluster control plane's "
                     "injector for per-worker chaos."
                 )
-        self.events = (
-            EventLog(
-                config.log_events,
-                node=f"{config.role}:{jax.process_index()}",
-            )
-            if config.log_events
-            else NULL_EVENTS
+        self._node = f"{config.role}:{jax.process_index()}"
+        if tracer is None:
+            # Role-label the process tracer so nodeless spans (checkpoint
+            # IO on the async writer thread) attribute to this run.
+            self.tracer.node = self._node
+        self.tracer.flight.configure(
+            directory=config.flight_dir, node=self._node
+        )
+        self.events = EventLog(
+            config.log_events, node=self._node, recorder=self.tracer.flight
+        )
+        self._metrics_dumper = (
+            MetricsDumper(self.metrics, config.metrics_file)
+            if config.metrics_file
+            else None
         )
         self.observer = observer or BoardObserver(
             render_every=config.render_every,
@@ -193,6 +206,7 @@ class Simulation:
                 config.checkpoint_dir,
                 config.checkpoint_format,
                 registry=self.metrics,
+                tracer=self.tracer,
             )
             if config.checkpoint_dir is not None
             else None
@@ -208,7 +222,11 @@ class Simulation:
                 "checkpoint to recover from would only restart from epoch 0"
             )
         self.injector = (
-            CrashInjector(config.fault_injection, registry=self.metrics)
+            CrashInjector(
+                config.fault_injection,
+                registry=self.metrics,
+                flight=self.tracer.flight,
+            )
             if config.fault_injection.enabled
             else None
         )
@@ -765,6 +783,14 @@ class Simulation:
         epoch_g = self.metrics.gauge("gol_epoch")
         halo_c = self.metrics.counter("gol_halo_bytes_total")
         next_tick = time.monotonic()
+        # The run-level trace root: chunk spans, chaos crash/recover spans,
+        # and every timed()/checkpoint span inside the loop nest under it
+        # via the thread-local stack.
+        advance_span = self.tracer.span(
+            "sim.advance", node=self._node,
+            from_epoch=self.epoch, epochs=target - self.epoch,
+        )
+        advance_span.__enter__()
         try:
             while self.epoch < target:
                 if cfg.tick_s > 0:
@@ -782,13 +808,17 @@ class Simulation:
                 chunk = min(cfg.steps_per_call, target - self.epoch)
                 prev = self.epoch
                 chunk_t0 = time.perf_counter()
-                with profiling.annotate_epochs("advance_chunk", self.epoch):
-                    new_board = self._stepper(chunk)(self.board)
-                with _shield_sigint():
-                    # Atomic wrt ^C: an interrupt-checkpoint must never see a
-                    # stepped board still labeled with the previous epoch.
-                    self.board = new_board
-                    self.epoch += chunk
+                with self.tracer.span(
+                    "sim.chunk", node=self._node, epoch=prev, chunk=chunk
+                ):
+                    with profiling.annotate_epochs("advance_chunk", self.epoch):
+                        new_board = self._stepper(chunk)(self.board)
+                    with _shield_sigint():
+                        # Atomic wrt ^C: an interrupt-checkpoint must never
+                        # see a stepped board still labeled with the
+                        # previous epoch.
+                        self.board = new_board
+                        self.epoch += chunk
                 # Host-side chunk cost (dispatch → board swap): on a
                 # synchronous backend this is the device time; under async
                 # dispatch it is the host's share of the critical path.
@@ -826,6 +856,8 @@ class Simulation:
             except Exception:  # noqa: BLE001
                 pass
             raise
+        finally:
+            advance_span.set(reached=self.epoch).__exit__(None, None, None)
         # A cadence crossing on the final chunk has no next chunk to ride
         # under; flush it now (errors here are real and propagate).
         self._obs_resolve()
@@ -878,24 +910,13 @@ class Simulation:
     def _dump_metrics(self) -> None:
         """Refresh the ``--metrics-file`` exposition (atomic; rank 0 only).
 
-        Write failures are contained: an unwritable observability file
-        (disk full, directory removed mid-run) must never abort the
-        simulation it observes.  Warned once, not per cadence point."""
-        if not self.config.metrics_file or jax.process_index() != 0:
+        Cadence gating lives in the caller; failure containment (warn once
+        per outage, keep retrying — an unwritable observability file must
+        never abort the simulation it observes) lives in the shared
+        :class:`~akka_game_of_life_tpu.obs.dump.MetricsDumper`."""
+        if self._metrics_dumper is None or jax.process_index() != 0:
             return
-        try:
-            self.metrics.write(self.config.metrics_file)
-        except OSError as e:
-            if not getattr(self, "_metrics_write_warned", False):
-                self._metrics_write_warned = True
-                import sys
-
-                print(
-                    f"metrics-file write failed (will keep retrying "
-                    f"silently): {e}",
-                    file=sys.stderr,
-                    flush=True,
-                )
+        self._metrics_dumper.dump()
 
     # -- observation (device-side: nothing here is O(board) on host) ---------
 
@@ -1103,37 +1124,57 @@ class Simulation:
         self._ckpt_wait()
         target = self.epoch
         self.crash_log.append(target)
-        self.events.emit("crash_injected", epoch=target)
-        self.board = None  # the crash: live state gone
-        ckpt = (
-            self.store.load(keep_packed=self._packed)
-            if self.store.latest_epoch() is not None
-            else None
+        with self.tracer.span(
+            "chaos.crash", node=self._node, epoch=target
+        ):
+            self.events.emit("crash_injected", epoch=target)
+            self.board = None  # the crash: live state gone
+            # The crash IS the post-mortem moment: dump the last-N ring
+            # (spans up to and including this one's parents, lifecycle
+            # events) before recovery overwrites the story.
+            self.tracer.flight.dump("crash_injected", node=self._node)
+        recover_span = self.tracer.span(
+            "chaos.recover", node=self._node, epoch=target
         )
-        if ckpt is None:
-            self.epoch = 0
-            self.board = self._to_device(initial_board(self.config))
-        elif ckpt.packed32 is not None:
-            self.epoch = ckpt.epoch
-            self.board = self._words_to_device(ckpt.packed32)
-        else:
-            self.epoch = ckpt.epoch
-            restored = ckpt.board
-            if self._actor_board is not None:
-                # Fresh actors reseeded from the restored board (supervision
-                # restart at the checkpoint, not epoch 0).
-                self._actor_board = self._actor_board_cls(restored, self.rule)
-                self._actor_epoch0 = self.epoch
-            self.board = self._to_device(restored)
-        restored_epoch = self.epoch
-        while self.epoch < target:
-            # Replay: recompute the lost epochs (deterministic rule ⇒ the
-            # trajectory is bit-identical to the pre-crash one).  Reuses the
-            # steps_per_call stepper so no extra compilation beyond at most
-            # one partial chunk.
-            chunk = min(self.config.steps_per_call, target - self.epoch)
-            self.board = self._stepper(chunk)(self.board)
-            self.epoch += chunk
+        recover_span.__enter__()
+        restored_epoch = None
+        try:
+            ckpt = (
+                self.store.load(keep_packed=self._packed)
+                if self.store.latest_epoch() is not None
+                else None
+            )
+            if ckpt is None:
+                self.epoch = 0
+                self.board = self._to_device(initial_board(self.config))
+            elif ckpt.packed32 is not None:
+                self.epoch = ckpt.epoch
+                self.board = self._words_to_device(ckpt.packed32)
+            else:
+                self.epoch = ckpt.epoch
+                restored = ckpt.board
+                if self._actor_board is not None:
+                    # Fresh actors reseeded from the restored board
+                    # (supervision restart at the checkpoint, not epoch 0).
+                    self._actor_board = self._actor_board_cls(restored, self.rule)
+                    self._actor_epoch0 = self.epoch
+                self.board = self._to_device(restored)
+            restored_epoch = self.epoch
+            while self.epoch < target:
+                # Replay: recompute the lost epochs (deterministic rule ⇒
+                # the trajectory is bit-identical to the pre-crash one).
+                # Reuses the steps_per_call stepper so no extra compilation
+                # beyond at most one partial chunk.
+                chunk = min(self.config.steps_per_call, target - self.epoch)
+                self.board = self._stepper(chunk)(self.board)
+                self.epoch += chunk
+        finally:
+            if restored_epoch is not None:
+                recover_span.set(
+                    restored_from=restored_epoch,
+                    replayed=target - restored_epoch,
+                )
+            recover_span.__exit__(None, None, None)
         self.metrics.counter("gol_chaos_recovered_total").inc()
         self.metrics.counter("gol_chaos_replay_epochs_total").inc(
             target - restored_epoch
@@ -1362,11 +1403,16 @@ class Simulation:
                 self._ckpt_executor = None
             if self.store is not None:
                 self.store.close()
-            # Final exposition dump + event-log close: the durable tail of
-            # the run's observability (the interval dumps only cover metrics
-            # cadence points).
+            # Final exposition + trace dumps + event-log close: the durable
+            # tail of the run's observability (the interval dumps only
+            # cover metrics cadence points).
             try:
                 self._dump_metrics()
+                if self.config.trace_file and jax.process_index() == 0:
+                    try:
+                        self.tracer.write(self.config.trace_file)
+                    except OSError as e:
+                        print(f"trace-file write failed: {e}", flush=True)
             finally:
                 self.events.emit("sim_closed", epoch=self.epoch)
                 self.events.close()

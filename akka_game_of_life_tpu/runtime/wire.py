@@ -150,6 +150,37 @@ class Channel:
         self.sock.close()
 
 
+# -- trace-context envelope helpers -------------------------------------------
+#
+# Span context rides INSIDE the message JSON under obs.tracing.TRACE_KEY
+# (an underscored key no protocol payload uses), so propagation needs no
+# frame-format change and decoders that predate tracing simply ignore it.
+
+
+def attach_trace(msg: Dict[str, Any], span) -> Dict[str, Any]:
+    """Embed ``span``'s propagation context into a message envelope (no-op
+    when ``span`` is None).  Mutates and returns ``msg`` — callers attach
+    just before ``Channel.send``."""
+    from akka_game_of_life_tpu.obs.tracing import TRACE_KEY
+
+    if span is not None:
+        msg[TRACE_KEY] = span.ctx if hasattr(span, "ctx") else dict(span)
+    return msg
+
+
+def extract_trace(msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The sender's span context from a received envelope, or None.  The
+    returned dict is what ``Tracer.span(parent=...)`` accepts."""
+    ctx = msg.get(_trace_key())
+    return ctx if isinstance(ctx, dict) else None
+
+
+def _trace_key() -> str:
+    from akka_game_of_life_tpu.obs.tracing import TRACE_KEY
+
+    return TRACE_KEY
+
+
 # -- tile payload helpers -----------------------------------------------------
 
 

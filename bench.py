@@ -436,6 +436,14 @@ def main() -> None:
         }
         if fallback_note is not None:
             headline_line["note"] = fallback_note
+        # Observability context rides with the scored number (halo bytes,
+        # span latencies — whatever non-zero series this process touched),
+        # so the BENCH_*.json trajectory carries its own attribution.
+        from bench_suite import registry_snapshot
+
+        snap = registry_snapshot()
+        if snap:
+            headline_line["metrics"] = snap
     print(json.dumps(headline_line), flush=True)
 
     if not args.headline_only:

@@ -51,6 +51,20 @@ REFERENCE_CEILING = 49 / 3.0
 V5E_HBM_BPS = 819e9
 
 
+def registry_snapshot() -> dict:
+    """The process registry's live non-zero series, for embedding into
+    bench records: a throughput line then carries its own halo-bytes /
+    peer-retry / span-latency context (the BENCH_*.json perf trajectory
+    stays interpretable without a separate metrics scrape).  Never raises —
+    a bench line must not die to an observability import."""
+    try:
+        from akka_game_of_life_tpu.obs import get_registry
+
+        return get_registry().snapshot()
+    except Exception:  # noqa: BLE001 — context, not the measurement
+        return {}
+
+
 def _emit(
     config: str,
     metric: str,
@@ -74,6 +88,12 @@ def _emit(
         line["bytes_per_cell"] = bytes_per_cell
         line["hbm_bytes_per_sec"] = value * bytes_per_cell
         line["hbm_frac_v5e"] = value * bytes_per_cell / V5E_HBM_BPS
+    snap = registry_snapshot()
+    if snap:
+        # Cumulative process-level counters at emit time (the cluster
+        # configs move gol_peer_*/gol_ring_bytes_total; jit-only configs
+        # stay lean because snapshot() drops zero series).
+        line["metrics"] = snap
     print(json.dumps(line), flush=True)
 
 
@@ -512,18 +532,19 @@ def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
             "cell-updates/sec",
             REFERENCE_CEILING,
         )
-    print(
-        json.dumps(
-            {
-                "config": f"cluster-exchange-{size}",
-                "metric": "width-8 / width-1 exchange throughput ratio",
-                "value": rates[8] / rates[1],
-                "unit": "x",
-                "vs_baseline": rates[8] / rates[1],
-            }
-        ),
-        flush=True,
-    )
+    ratio_line = {
+        "config": f"cluster-exchange-{size}",
+        "metric": "width-8 / width-1 exchange throughput ratio",
+        "value": rates[8] / rates[1],
+        "unit": "x",
+        "vs_baseline": rates[8] / rates[1],
+    }
+    snap = registry_snapshot()
+    if snap:
+        # The standing record of WHY the ratio is what it is: ring bytes,
+        # peer sends/receives, retry counts accumulated across both runs.
+        ratio_line["metrics"] = snap
+    print(json.dumps(ratio_line), flush=True)
 
 
 def main() -> None:

@@ -39,6 +39,36 @@ def test_timed_prints_label(capsys):
     assert "unit-test-span" in capsys.readouterr().out
 
 
+def test_timed_becomes_child_span_of_active_trace(capsys):
+    from akka_game_of_life_tpu.obs import FlightRecorder, Tracer
+
+    t = Tracer(node="n0", recorder=FlightRecorder(directory=None))
+    with t.span("sim.advance") as parent:
+        with profiling.timed("checkpoint@64"):
+            pass
+    spans = {s["name"]: s for s in t.finished()}
+    # The @-stripped label (same rule as the gol_span_seconds histogram):
+    # epoch-stamped labels must not mint one span name per epoch.
+    child = spans["checkpoint"]
+    assert child["parent_id"] == parent.span_id
+    assert child["trace_id"] == parent.trace_id
+    assert child["node"] == "n0"
+    assert child["attrs"]["label"] == "checkpoint@64"
+    assert child["duration"] >= 0
+    capsys.readouterr()  # drain the [profile] print
+
+
+def test_timed_without_active_trace_records_no_span(capsys):
+    from akka_game_of_life_tpu.obs import get_tracer, tracing
+
+    assert tracing.current() is None
+    before = len(get_tracer().finished())
+    with profiling.timed("orphan-span"):
+        pass
+    assert len(get_tracer().finished()) == before
+    capsys.readouterr()
+
+
 def test_device_memory_stats_shape():
     stats = profiling.device_memory_stats()
     for _, v in stats.items():

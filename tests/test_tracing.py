@@ -1,0 +1,400 @@
+"""The tracing subsystem: span nesting and ids, wire-protocol context
+propagation (unit round-trip AND a chaos cluster soak proving the
+acceptance shape — a frontend epoch span with child spans from two backend
+nodes plus a recovery span), the Perfetto JSON golden, the flight
+recorder's ring/dump semantics, the `/trace` endpoint, and the span-name
+doc lint (tier-1)."""
+
+import json
+import socket
+import sys
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from akka_game_of_life_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    MetricsServer,
+    Tracer,
+    install,
+    read_flight,
+)
+from akka_game_of_life_tpu.obs import tracing
+from akka_game_of_life_tpu.obs.tracing import SPAN_CATALOG
+from akka_game_of_life_tpu.runtime.wire import Channel, attach_trace, extract_trace
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _tracer(**kw):
+    # Disabled-dump recorder: unit tests must not litter artifacts/.
+    kw.setdefault("recorder", FlightRecorder(directory=None))
+    return Tracer(node="test", **kw)
+
+
+# -- span semantics -----------------------------------------------------------
+
+
+def test_span_nesting_parents_via_thread_stack():
+    t = _tracer()
+    with t.span("sim.advance") as outer:
+        assert tracing.current() is outer
+        with t.span("sim.chunk", epoch=4) as inner:
+            assert tracing.current() is inner
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+        assert tracing.current() is outer
+    assert tracing.current() is None
+    done = t.finished()
+    assert [s["name"] for s in done] == ["sim.chunk", "sim.advance"]
+    assert done[0]["attrs"] == {"epoch": 4}
+    assert all(s["duration"] >= 0 for s in done)
+
+
+def test_root_spans_get_distinct_trace_ids():
+    t = _tracer()
+    with t.span("epoch"):
+        pass
+    with t.span("epoch"):
+        pass
+    a, b = t.finished()
+    assert a["trace_id"] != b["trace_id"]
+    assert a["parent_id"] is None and b["parent_id"] is None
+    assert a["span_id"] != b["span_id"]
+
+
+def test_explicit_parent_crosses_threads():
+    import threading
+
+    t = _tracer()
+    root = t.start("epoch", node="frontend")
+    out = {}
+
+    def worker():
+        with t.span("backend.step", parent=root.ctx, node="w0") as s:
+            out["span"] = s
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    root.finish()
+    child = out["span"]
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.node == "w0"
+
+
+def test_finish_is_idempotent():
+    t = _tracer()
+    s = t.start("epoch")
+    s.finish()
+    d = s.duration
+    s.finish()
+    assert s.duration == d
+    assert len(t.finished()) == 1
+
+
+def test_buffer_bounded_with_drop_count():
+    t = _tracer(max_spans=4)
+    for i in range(10):
+        t.start("epoch", i=i).finish()
+    assert len(t.finished()) == 4
+    assert t.dropped == 6
+    assert [s["attrs"]["i"] for s in t.finished()] == [6, 7, 8, 9]
+
+
+def test_sink_and_ingest_forward_spans_across_tracers():
+    # The cluster's span-forwarding shape: a worker tracer's sink batches
+    # finished span dicts; the frontend tracer ingests them verbatim, so
+    # parent links into its own epoch spans survive the hop.
+    frontend = _tracer()
+    epoch = frontend.start("epoch", node="frontend")
+    worker = _tracer()
+    batch = []
+    worker.add_sink(batch.append)
+    with worker.span("backend.step", parent=epoch.ctx, node="w0"):
+        pass
+    epoch.finish()
+    assert len(batch) == 1
+    frontend.ingest(batch + [{"junk": True}, "not-a-dict"])  # junk skipped
+    names = {s["name"]: s for s in frontend.finished()}
+    assert names["backend.step"]["parent_id"] == epoch.span_id
+    assert names["backend.step"]["trace_id"] == epoch.trace_id
+    assert "junk" not in str(sorted(names))
+
+
+# -- wire-protocol context propagation ----------------------------------------
+
+
+def test_trace_context_round_trips_through_the_wire():
+    t = _tracer()
+    span = t.start("epoch", node="frontend")
+    a, b = socket.socketpair()
+    ca, cb = Channel(a), Channel(b)
+    try:
+        ca.send(attach_trace({"type": "tick", "target": 8}, span))
+        msg = cb.recv()
+    finally:
+        ca.close()
+        cb.close()
+    ctx = extract_trace(msg)
+    assert ctx == span.ctx
+    # The received context parents a span into the sender's trace.
+    with t.span("backend.step", parent=ctx, node="w0") as child:
+        pass
+    assert child.trace_id == span.trace_id
+    assert child.parent_id == span.span_id
+    # No-span attach is a no-op; absent key extracts to None.
+    assert extract_trace(attach_trace({"type": "tick"}, None)) is None
+
+
+# -- Perfetto / Chrome trace-event export -------------------------------------
+
+
+def test_perfetto_export_golden():
+    # Deterministic ids (seeded rng), clocks, and thread ids → the exact
+    # exported document is a golden.
+    mono = iter([10.0, 10.5, 11.0, 12.0]).__next__
+    wall = iter([1000.0, 1010.0, 1010.5, 1011.0, 1012.0]).__next__
+    t = Tracer(
+        node="n0", recorder=FlightRecorder(directory=None), seed=0,
+        clock=mono, wallclock=wall, ident=lambda: 7,
+    )
+    with t.span("epoch", node="frontend", target=8):
+        with t.span("backend.step", node="w0", tile="(0, 0)"):
+            pass
+    doc = t.export()
+    r = __import__("random").Random(0)
+    trace_id = f"{r.getrandbits(128):032x}"
+    epoch_id = f"{r.getrandbits(64):016x}"
+    step_id = f"{r.getrandbits(64):016x}"
+    # pids follow finish order (the step span finishes first): w0 = 0.
+    assert doc == {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 0,
+             "args": {"name": "w0"}},
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "frontend"}},
+            {"ph": "X", "name": "backend.step", "cat": "gol", "pid": 0,
+             "tid": 7, "ts": 10500000.0, "dur": 500000.0,
+             "args": {"trace_id": trace_id, "span_id": step_id,
+                      "parent_id": epoch_id, "tile": "(0, 0)"}},
+            {"ph": "X", "name": "epoch", "cat": "gol", "pid": 1,
+             "tid": 7, "ts": 10000000.0, "dur": 2000000.0,
+             "args": {"trace_id": trace_id, "span_id": epoch_id,
+                      "parent_id": None, "target": 8}},
+        ],
+        "displayTimeUnit": "ms",
+    }
+
+
+def test_trace_write_is_atomic_and_loadable(tmp_path):
+    t = _tracer()
+    with t.span("epoch"):
+        pass
+    path = tmp_path / "sub" / "trace.json"  # parent dir is created
+    t.write(str(path))
+    doc = json.loads(path.read_text())
+    assert any(e.get("name") == "epoch" for e in doc["traceEvents"])
+    assert not [p for p in path.parent.iterdir() if p.name.startswith(".trace_")]
+
+
+# -- /trace endpoint ----------------------------------------------------------
+
+
+def test_http_trace_endpoint_serves_perfetto_json():
+    t = _tracer()
+    with t.span("epoch"):
+        pass
+    r = install(MetricsRegistry())
+    with MetricsServer(r, port=0, host="127.0.0.1", tracer=t) as s:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{s.port}/trace", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/json"
+            body = resp.read()
+            assert int(resp.headers["Content-Length"]) == len(body)
+        doc = json.loads(body)
+        assert any(e.get("name") == "epoch" for e in doc["traceEvents"])
+        # Without a tracer the route 404s (with a body + Content-Length).
+    with MetricsServer(r, port=0, host="127.0.0.1") as s:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"http://127.0.0.1:{s.port}/trace", timeout=5)
+        assert err.value.code == 404
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_ring_bounds_and_dump_round_trip(tmp_path):
+    rec = FlightRecorder(node="w0", capacity=4, directory=str(tmp_path))
+    for i in range(7):
+        rec.record("tick", i=i)
+    path = rec.dump("crash")
+    assert path is not None and Path(path).name.startswith("flightrec-w0-")
+    doc = read_flight(path)
+    assert doc["node"] == "w0" and doc["reason"] == "crash"
+    assert [r["i"] for r in doc["records"]] == [3, 4, 5, 6]  # last N only
+    for r in doc["records"]:
+        assert "t_mono" in r and "t_wall" in r
+
+
+def test_flight_dump_rate_limit_and_cap(tmp_path):
+    rec = FlightRecorder(
+        node="n", directory=str(tmp_path), max_dumps=2, min_interval_s=60.0
+    )
+    rec.record("x")
+    assert rec.dump("crash") is not None
+    assert rec.dump("crash") is None  # same reason inside the interval
+    assert rec.dump("redeploy") is not None  # different reason passes
+    assert rec.dump("other") is None  # per-process cap reached
+    assert len(list(tmp_path.glob("flightrec-*.json"))) == 2
+
+
+def test_flight_disabled_records_but_never_dumps(tmp_path):
+    rec = FlightRecorder(node="n", directory=None)
+    rec.record("x")
+    assert rec.dump("crash") is None
+    assert not rec.enabled
+    # configure() arms it late with the history intact.
+    rec.configure(directory=str(tmp_path))
+    path = rec.dump("crash")
+    assert path is not None
+    assert read_flight(path)["records"][0]["kind"] == "x"
+
+
+def test_tracer_tees_finished_spans_into_flight_ring():
+    rec = FlightRecorder(node="n", directory=None)
+    t = Tracer(node="n", recorder=rec)
+    with t.span("epoch", target=4):
+        pass
+    (r,) = rec.records()
+    assert r["kind"] == "span" and r["name"] == "epoch"
+    assert r["attrs"] == {"target": 4}
+
+
+def test_event_log_tees_into_flight_ring_even_without_file():
+    from akka_game_of_life_tpu.obs import EventLog
+
+    rec = FlightRecorder(node="n", directory=None)
+    log = EventLog(None, node="w0", recorder=rec)
+    log.emit("member_lost", member="w1")
+    (r,) = rec.records()
+    assert r["kind"] == "event" and r["event"] == "member_lost"
+    assert r["node"] == "w0"
+
+
+# -- doc lint (tier-1: the span table cannot rot) -----------------------------
+
+
+def test_every_span_name_is_documented():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import check_trace_names
+    finally:
+        sys.path.pop(0)
+    emitted = check_trace_names.span_names_in_code()
+    # Sanity: the scan must see the acceptance names, or it passes vacuously.
+    for must in ("epoch", "backend.step", "halo.retry", "recover.redeploy"):
+        assert must in emitted, must
+    # The textual catalog parse matches the real module constant.
+    assert check_trace_names.catalog_names() == {n for n, _ in SPAN_CATALOG}
+    assert check_trace_names.problems() == []
+
+
+# -- acceptance: chaos cluster soak -------------------------------------------
+
+
+def test_cluster_chaos_trace_links_epoch_to_backends_and_leaves_flight_dump(
+    tmp_path,
+):
+    """The PR's acceptance shape, in-process: a chaos-enabled cluster run
+    produces (a) a Perfetto-loadable trace in which a frontend epoch span
+    has child spans from >= 2 backend nodes and the trace contains a retry
+    or recovery span, and (b) an injected crash leaves a flight-recorder
+    dump."""
+    import io
+    import time
+
+    from akka_game_of_life_tpu.runtime.config import (
+        FaultInjectionConfig,
+        SimulationConfig,
+    )
+    from akka_game_of_life_tpu.runtime.harness import cluster
+    from akka_game_of_life_tpu.runtime.render import BoardObserver
+
+    flight_dir = tmp_path / "art"
+    reg = install(MetricsRegistry())
+    tracer = Tracer(
+        node="cluster",
+        recorder=FlightRecorder(node="cluster", directory=str(flight_dir)),
+    )
+    cfg = SimulationConfig(
+        height=32, width=32, seed=5, max_epochs=60, tick_s=0.01,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=10,
+        fault_injection=FaultInjectionConfig(
+            enabled=True, first_after_s=0.1, every_s=0.4,
+            max_crashes=2, mode="tile",
+        ),
+        flight_dir=str(flight_dir),
+        trace_file=str(tmp_path / "trace.json"),
+    )
+    obs = BoardObserver(out=io.StringIO(), registry=reg)
+    with cluster(cfg, 2, observer=obs, registry=reg, tracer=tracer) as h:
+        assert h.frontend.wait_for_backends(timeout=10)
+        h.frontend.start_simulation()
+        deadline = time.monotonic() + 60
+        while not h.frontend.done.wait(0.05):
+            assert time.monotonic() < deadline, "cluster did not finish"
+        assert h.frontend.error is None, h.frontend.error
+
+    spans = tracer.finished()
+    epochs = {s["span_id"]: s for s in spans if s["name"] == "epoch"}
+    assert epochs, "no frontend epoch span"
+    # At least one epoch span has step children from both workers —
+    # propagated through the TICK/DEPLOY wire envelopes, not thread state.
+    by_parent = {}
+    for s in spans:
+        by_parent.setdefault(s["parent_id"], []).append(s)
+    linked = False
+    for sid, epoch in epochs.items():
+        nodes = {
+            c["node"] for c in by_parent.get(sid, ())
+            if c["name"] == "backend.step"
+        }
+        if len(nodes) >= 2:
+            linked = True
+            assert all(
+                c["trace_id"] == epoch["trace_id"] for c in by_parent[sid]
+            )
+            break
+    assert linked, "no epoch span with backend.step children from 2 nodes"
+    # The injected fault produced a recovery (or retry) span in the trace.
+    recovery = [
+        s for s in spans
+        if s["name"] in ("recover.redeploy", "backend.crash", "halo.retry")
+    ]
+    assert recovery, "chaos run produced no retry/recovery spans"
+    # Checkpoint durability shows on the timeline too.
+    assert any(s["name"] == "checkpoint.save" for s in spans)
+
+    # Perfetto-loadable export from the frontend's stop() (trace_file).
+    doc = json.loads((tmp_path / "trace.json").read_text())
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"epoch", "backend.step"} <= names
+    procs = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert {"frontend", "w0", "w1"} <= procs
+
+    # The injected crash left a flight-recorder dump with real history.
+    dumps = sorted(flight_dir.glob("flightrec-*.json"))
+    assert dumps, "no flight-recorder dump under the flight dir"
+    reasons = {read_flight(str(p))["reason"] for p in dumps}
+    assert reasons & {"tile_crash", "crash", "tile_redeploy", "node_loss"}
+    assert any(read_flight(str(p))["records"] for p in dumps)
