@@ -165,6 +165,109 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--process-id", type=int)
 
 
+def _add_chaos_net(p: argparse.ArgumentParser) -> None:
+    """The network chaos plane's knobs (``runtime/netchaos.py``).  Every
+    ``--chaos-net-X`` flag maps 1:1 onto ``NetworkChaosConfig.X`` (dashes to
+    underscores; bare ``--chaos-net`` maps to ``enabled``) —
+    ``tools/check_chaos_config.py`` lint-enforces the bijection."""
+    g = p.add_argument_group(
+        "network chaos",
+        "seeded wire-fault injection: drops/delays/duplicates/reorders per "
+        "message plus scheduled partitions with heal times; any flag below "
+        "arms the plane (see docs/OPERATIONS.md \"Network chaos\")",
+    )
+    g.add_argument(
+        "--chaos-net",
+        action="store_true",
+        default=None,
+        help="arm the network chaos plane with config/default knobs",
+    )
+    g.add_argument("--chaos-net-seed", type=int, default=None, metavar="N")
+    g.add_argument(
+        "--chaos-net-drop-p", type=float, default=None, metavar="P",
+        help="probability a sent message is silently dropped",
+    )
+    g.add_argument(
+        "--chaos-net-delay-p", type=float, default=None, metavar="P",
+        help="probability a sent message is delayed",
+    )
+    g.add_argument(
+        "--chaos-net-delay-s", default=None, metavar="DUR",
+        help="max injected latency per delayed message (e.g. 50ms)",
+    )
+    g.add_argument(
+        "--chaos-net-duplicate-p", type=float, default=None, metavar="P",
+        help="probability a sent message is sent twice",
+    )
+    g.add_argument(
+        "--chaos-net-reorder-p", type=float, default=None, metavar="P",
+        help="probability a sent message is overtaken by the next one",
+    )
+    g.add_argument(
+        "--chaos-net-partition-after-s", default=None, metavar="DUR",
+        help="first scheduled partition fires this long after start",
+    )
+    g.add_argument(
+        "--chaos-net-partition-every-s", default=None, metavar="DUR",
+        help="further partitions fire at this period",
+    )
+    g.add_argument(
+        "--chaos-net-partition-heal-s", default=None, metavar="DUR",
+        help="each partition heals after this long",
+    )
+    g.add_argument(
+        "--chaos-net-max-partitions", type=int, default=None, metavar="N",
+        help="partition budget (0 = probabilistic faults only)",
+    )
+    g.add_argument(
+        "--chaos-net-scope",
+        choices=["peer", "control", "all"],
+        default=None,
+        help="which planes the chaos wraps: the worker-to-worker data "
+        "plane, the frontend-worker control plane, or both",
+    )
+
+
+def _chaos_net_overrides(args: argparse.Namespace) -> Optional[dict]:
+    """``--chaos-net-*`` flags → a NetworkChaosConfig kwargs dict (None when
+    no flag was given).  Any knob arms the plane; durations accept the
+    config style ("50ms")."""
+    out = {
+        "seed": args.chaos_net_seed,
+        "drop_p": args.chaos_net_drop_p,
+        "delay_p": args.chaos_net_delay_p,
+        "delay_s": (
+            parse_duration(args.chaos_net_delay_s)
+            if args.chaos_net_delay_s is not None
+            else None
+        ),
+        "duplicate_p": args.chaos_net_duplicate_p,
+        "reorder_p": args.chaos_net_reorder_p,
+        "partition_after_s": (
+            parse_duration(args.chaos_net_partition_after_s)
+            if args.chaos_net_partition_after_s is not None
+            else None
+        ),
+        "partition_every_s": (
+            parse_duration(args.chaos_net_partition_every_s)
+            if args.chaos_net_partition_every_s is not None
+            else None
+        ),
+        "partition_heal_s": (
+            parse_duration(args.chaos_net_partition_heal_s)
+            if args.chaos_net_partition_heal_s is not None
+            else None
+        ),
+        "max_partitions": args.chaos_net_max_partitions,
+        "scope": args.chaos_net_scope,
+    }
+    out = {k: v for k, v in out.items() if v is not None}
+    if not out and not args.chaos_net:
+        return None
+    out["enabled"] = True
+    return out
+
+
 def _parse_window(spec):
     """"Y0:Y1,X0:X1" → (y0, y1, x0, x1); None passes through."""
     if spec is None:
@@ -285,6 +388,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="boundary-ring width k: one peer exchange buys k local epochs "
         "per tile (communication-avoiding; cadences must be multiples of k)",
     )
+    _add_chaos_net(fe_p)
 
     st_p = sub.add_parser(
         "selftest",
@@ -337,6 +441,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     _add_platform(ck_p)
 
     be_p = sub.add_parser("backend", help="control-plane worker (RunBackend)")
+    be_p.add_argument(
+        "--config",
+        help="TOML or JSON config file; the worker consumes its [net_chaos] "
+        "block (share one file with the frontend so the drill is one "
+        "coherent fault script) — flags below override it",
+    )
     be_p.add_argument("--port", type=int, default=2551, help="frontend port to join")
     be_p.add_argument("--host", default="127.0.0.1")
     be_p.add_argument("--name", default=None)
@@ -383,6 +493,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="directory for this worker's flight-recorder crash dumps "
         "(default: artifacts; empty string disables)",
     )
+    _add_chaos_net(be_p)
     be_p.add_argument(
         "--pallas",
         choices=["auto", "off", "interpret"],
@@ -454,6 +565,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 if args.wait_for_backends is not None
                 else None
             ),
+            net_chaos=_chaos_net_overrides(args),
         )
         cfg = load_config(args.config, overrides)
         try:
@@ -695,6 +807,17 @@ def _other_commands(args) -> int:
         from akka_game_of_life_tpu.obs import get_tracer
         from akka_game_of_life_tpu.runtime.signals import flight_dump_on_signals
 
+        # The worker's chaos policy layers exactly like the frontend's:
+        # config-file [net_chaos] block < --chaos-net-* flags.
+        chaos_kwargs = _chaos_net_overrides(args)
+        if args.config or chaos_kwargs is not None:
+            cfg = load_config(
+                args.config,
+                {"net_chaos": chaos_kwargs} if chaos_kwargs else None,
+            )
+            chaos_cfg = cfg.net_chaos if cfg.net_chaos.enabled else None
+        else:
+            chaos_cfg = None
         with _sigterm_as_interrupt(), flight_dump_on_signals(
             get_tracer().flight
         ):
@@ -710,6 +833,7 @@ def _other_commands(args) -> int:
                     log_events=args.log_events,
                     trace_file=args.trace_file,
                     flight_dir=args.flight_dir,
+                    net_chaos=chaos_cfg,
                 )
             except KeyboardInterrupt:
                 # run_backend handles interrupts inside its serve loop; this

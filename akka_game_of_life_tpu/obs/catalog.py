@@ -62,6 +62,34 @@ CATALOG = (
      "Workers lost (EOF, stale heartbeat, or GOODBYE)", ()),
     ("gol_redeploys_total", "counter",
      "Tile redeployments (crash recovery, stuck escalation, node loss)", ()),
+    # -- network chaos plane / hardened comms (PR 3) ---------------------------
+    ("gol_net_chaos_dropped_total", "counter",
+     "Messages dropped by the network chaos policy (random drops + "
+     "partition blocks, send and recv side)", ()),
+    ("gol_net_chaos_delayed_total", "counter",
+     "Messages delayed by the network chaos policy", ()),
+    ("gol_net_chaos_duplicated_total", "counter",
+     "Messages duplicated by the network chaos policy", ()),
+    ("gol_net_chaos_reordered_total", "counter",
+     "Messages held so the next send overtakes them", ()),
+    ("gol_net_partitions_total", "counter",
+     "Network partitions opened (scheduled or manual)", ()),
+    ("gol_net_partition_heals_total", "counter",
+     "Network partitions healed", ()),
+    ("gol_breaker_state", "gauge",
+     "Per-peer circuit breaker state (0=closed, 1=open, 2=half-open)",
+     ("peer",)),
+    ("gol_breaker_open_total", "counter",
+     "Circuit breaker closed-to-open transitions", ()),
+    ("gol_breaker_skipped_sends_total", "counter",
+     "Peer sends refused by an open circuit breaker", ()),
+    ("gol_retry_backoff_seconds", "histogram",
+     "Backoff delay chosen per halo re-pull retry (decorrelated jitter)",
+     ()),
+    ("gol_degraded_mode", "gauge",
+     "1 while the frontend is in partition-degraded mode", ()),
+    ("gol_degraded_entries_total", "counter",
+     "Times the frontend entered degraded mode", ()),
     # -- chaos / failure paths -----------------------------------------------
     ("gol_chaos_crashes_total", "counter",
      "Crashes fired by the chaos injector (any mode)", ()),

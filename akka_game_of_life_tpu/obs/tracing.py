@@ -67,6 +67,10 @@ SPAN_CATALOG = (
     ("halo.retry", "stale-halo retry round (re-asks to missing rings' owners)"),
     ("gather.escalate", "GATHER_FAILED escalation after the retry budget"),
     ("backend.crash", "CRASH/CRASH_TILE handled on the worker"),
+    # -- network chaos plane / hardened comms ---------------------------------
+    ("net.partition", "one injected partition, open to heal"),
+    ("breaker.open", "one circuit-breaker open interval, open to re-close"),
+    ("cluster.degraded", "frontend degraded mode, quorum-stranded to heal"),
     # -- durability -----------------------------------------------------------
     ("checkpoint.save", "one checkpoint save made durable"),
     ("checkpoint.restore", "one checkpoint load"),

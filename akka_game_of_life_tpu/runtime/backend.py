@@ -43,6 +43,7 @@ reference's history-buffered asynchrony (``CellActor.scala:41-47``)."""
 from __future__ import annotations
 
 import os
+import random
 import socket
 import threading
 import time
@@ -56,6 +57,12 @@ from akka_game_of_life_tpu.ops.npkernel import step_padded_np
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 from akka_game_of_life_tpu.runtime import protocol as P
 from akka_game_of_life_tpu.runtime.boundary import BoundaryStore, Halo
+from akka_game_of_life_tpu.runtime.netchaos import (
+    ChaosChannel,
+    CircuitBreaker,
+    NetworkChaos,
+    wrap_channel,
+)
 from akka_game_of_life_tpu.runtime.tiles import Ring, TileId, TileLayout
 from akka_game_of_life_tpu.runtime.wire import (
     Channel,
@@ -66,11 +73,16 @@ from akka_game_of_life_tpu.runtime.wire import (
 
 
 class _Tile:
-    def __init__(self, arr: np.ndarray, epoch: int) -> None:
+    def __init__(self, arr: np.ndarray, epoch: int, retry_s: float = 1.0) -> None:
         self.arr = arr
         self.epoch = epoch
         self.awaiting_since: Optional[float] = None  # the waitingForNewState latch
         self.retries = 0
+        # Adaptive re-pull pacing (decorrelated-jitter backoff): the delay
+        # the LAST retry chose (feeds the next draw) and the deadline the
+        # next retry fires at.  Both reset when a pull succeeds.
+        self.retry_delay = retry_s
+        self.next_retry_at = 0.0
 
 
 # VMEM row block for the cluster's Mosaic chunk sweeps (the measured-best
@@ -335,11 +347,16 @@ class BackendWorker:
         engine: str = "jax",
         pallas: Optional[str] = None,
         retry_s: float = 1.0,
+        retry_max_s: float = 8.0,
         max_pull_retries: int = 10,
+        breaker_failures: int = 3,
+        breaker_cooldown_s: float = 2.0,
+        send_deadline_s: float = 0.0,
         peer_host: str = "0.0.0.0",
         crash_hook: Optional[Callable[[], None]] = None,
         registry=None,
         tracer=None,
+        netchaos: Optional[NetworkChaos] = None,
     ) -> None:
         if engine not in ("numpy", "jax", "swar", "actor", "actor-native"):
             raise ValueError(
@@ -360,8 +377,19 @@ class BackendWorker:
         # XLA scan (the operator's escape hatch if Mosaic compiles but
         # regresses), "interpret" forces the sweep CPU-side (tests).
         self.pallas = pallas
+        # Retry policy (cluster config, overridden by WELCOME): base
+        # interval, backoff cap, and the per-tile budget before escalation.
         self.retry_s = retry_s
+        self.retry_max_s = max(retry_s, retry_max_s)
         self.max_pull_retries = max_pull_retries
+        self.send_deadline_s = send_deadline_s
+        # Decorrelated-jitter draws; reseeded per worker name in connect()
+        # so a seeded cluster run's retry timing is reproducible per node.
+        self._retry_rng = random.Random(f"retry:{name}")
+        # Wire-fault policy (None = clean wire) and the per-peer breaker it
+        # exercises; the breaker exists unconditionally — real dead peers
+        # trip it with no chaos installed.
+        self.netchaos = netchaos
         # DoCrashMsg → throw (CellActor.scala:95-96): default is an abrupt
         # process death; in-thread harnesses override to simulate it.
         self.crash_hook = crash_hook or (lambda: os._exit(42))
@@ -384,6 +412,14 @@ class BackendWorker:
         self._m_heartbeats = reg.counter("gol_heartbeats_total")
         self._m_gather_failures = reg.counter("gol_gather_failures_total")
         self._m_ring_bytes = reg.counter("gol_ring_bytes_total")
+        self._m_backoff = reg.histogram("gol_retry_backoff_seconds")
+        self.breaker = CircuitBreaker(
+            failures=breaker_failures,
+            cooldown_s=breaker_cooldown_s,
+            registry=reg,
+            tracer=self.tracer,
+            node=name or "backend",
+        )
 
         self.tiles: Dict[TileId, _Tile] = {}
         self.rule: Optional[Rule] = None
@@ -433,6 +469,14 @@ class BackendWorker:
         sock = socket.create_connection((self.host, self.port), timeout=10)
         sock.settimeout(None)
         self.channel = Channel(sock)
+        if self.netchaos is not None and self.netchaos.config.wraps_control:
+            # Control-plane chaos drops silently (fail_blocked=False): a
+            # partitioned control link looks like a lossy wire, and the
+            # heartbeat/eviction machinery — not an exception — judges it.
+            self.channel = wrap_channel(
+                self.channel, self.netchaos,
+                src=self.name or "", dst="frontend",
+            )
         self.channel.send(
             {
                 "type": P.REGISTER,
@@ -452,11 +496,28 @@ class BackendWorker:
             raise ConnectionError("frontend did not welcome us")
         self.name = welcome["name"]
         heartbeat_s = float(welcome.get("heartbeat_s", 0.5))
-        # Retry policy is cluster config, owned by the frontend
-        # (SimulationConfig.max_pull_retries); the constructor value is only
-        # the standalone/test default.
+        # Retry/breaker/deadline policy is cluster config, owned by the
+        # frontend (SimulationConfig); the constructor values are only the
+        # standalone/test defaults — every worker of a cluster shares ONE
+        # policy source of truth.
         if "max_pull_retries" in welcome:
             self.max_pull_retries = int(welcome["max_pull_retries"])
+        if "retry_s" in welcome:
+            self.retry_s = float(welcome["retry_s"])
+        if "retry_max_s" in welcome:
+            self.retry_max_s = max(self.retry_s, float(welcome["retry_max_s"]))
+        if "breaker_failures" in welcome:
+            self.breaker.failures = max(1, int(welcome["breaker_failures"]))
+        if "breaker_cooldown_s" in welcome:
+            self.breaker.cooldown_s = float(welcome["breaker_cooldown_s"])
+        if "send_deadline_s" in welcome:
+            self.send_deadline_s = float(welcome["send_deadline_s"])
+        self._retry_rng = random.Random(f"retry:{self.name}")
+        self.breaker.node = self.name or "backend"
+        if isinstance(self.channel, ChaosChannel):
+            self.channel.src = self.name or ""
+        if self.send_deadline_s:
+            self.channel.set_send_deadline(self.send_deadline_s)
         self.exchange_width = int(welcome.get("exchange_width", 1))
         threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_s,), daemon=True
@@ -522,8 +583,17 @@ class BackendWorker:
                 sock, _ = self._peer_listener.accept()
             except OSError:
                 return
+            ch = Channel(sock, send_deadline_s=self.send_deadline_s)
+            if self.netchaos is not None and self.netchaos.config.wraps_peer:
+                # dst is learned from the PEER_HELLO (see _on_peer_msg);
+                # until then the wrapper applies only the probabilistic
+                # faults, not partition sides.
+                ch = wrap_channel(
+                    ch, self.netchaos,
+                    src=self.name or "", fail_blocked=True,
+                )
             threading.Thread(
-                target=self._serve_peer, args=(Channel(sock),), daemon=True
+                target=self._serve_peer, args=(ch,), daemon=True
             ).start()
 
     def _serve_peer(self, channel: Channel) -> None:
@@ -543,6 +613,10 @@ class BackendWorker:
             # peer links are symmetric, so one TCP connection per pair.
             name = msg.get("name")
             if name:
+                if isinstance(channel, ChaosChannel):
+                    # Now we know who the far end is: partition sides apply.
+                    channel.dst = name
+                    self.netchaos.register_node(name)
                 with self._peer_lock:
                     self._peers.setdefault(name, channel)
         elif kind == P.PEER_RING:
@@ -594,7 +668,12 @@ class BackendWorker:
                 sock.settimeout(None)
             except OSError:
                 return None
-            ch = Channel(sock)
+            ch = Channel(sock, send_deadline_s=self.send_deadline_s)
+            if self.netchaos is not None and self.netchaos.config.wraps_peer:
+                ch = wrap_channel(
+                    ch, self.netchaos,
+                    src=self.name or "", dst=owner, fail_blocked=True,
+                )
             self._peers[owner] = ch
         # Peer channels are bidirectional: the accepting side serves our
         # PEER_PULLs and may push rings back on the same socket.
@@ -618,16 +697,24 @@ class BackendWorker:
             return {name: (host, port) for name, host, port in self.owners.values()}
 
     def _send_peer(self, owner: str, msg: dict) -> None:
+        # Breaker first: a dead/partitioned peer costs one state read here,
+        # not a connect timeout — the retry loop (backoff) and the breaker's
+        # own half-open probes are the only traffic that re-tests it.
+        if not self.breaker.allow(owner):
+            return
         ch = self._peer_channel(owner)
         if ch is None:
+            self.breaker.failure(owner)
             return
         try:
             ch.send(msg)
             self._m_sends.inc()
+            self.breaker.success(owner)
         except OSError:
-            # Stale address or dead peer: drop; OWNERS rewiring + the retry
-            # loop's PEER_PULLs recover.
+            # Stale address, dead peer, partition, or send deadline: drop;
+            # OWNERS rewiring + the retry loop's PEER_PULLs recover.
             self._drop_peer(owner)
+            self.breaker.failure(owner)
 
     # -- helper threads ------------------------------------------------------
 
@@ -643,6 +730,15 @@ class BackendWorker:
     def _retry_loop(self) -> None:
         """The gatherer's Retry timer: re-ask the owners of missing rings.
 
+        Hardened pacing: the first re-ask fires ``retry_s`` after the pull
+        queued; each further consecutive re-ask of the same tile backs off
+        with decorrelated jitter — ``delay = min(retry_max_s,
+        uniform(retry_s, 3 * last_delay))`` — so a partitioned or lossy
+        neighborhood sees a handful of desynchronized probes per cooling
+        window instead of every stale tile re-asking in lockstep each
+        ``retry_s`` (the retry-storm that makes heal moments worse than the
+        fault).  A successful pull resets the tile's delay to the base.
+
         After ``max_pull_retries`` unanswered re-asks the worker escalates
         with GATHER_FAILED — the reference's gatherer gives up after 2 ask
         rounds and fires ``FailedToGatherInfoMsg`` so its parent repairs the
@@ -650,26 +746,30 @@ class BackendWorker:
         ``CellActor.scala:92-94``).  The tile keeps its state and keeps
         retrying; the frontend decides whether a blocking neighbor is
         genuinely stuck."""
-        while not self._stop.is_set():
-            time.sleep(self.retry_s / 4)
+        while not self._stop.wait(max(0.01, self.retry_s / 4)):
             now = time.monotonic()
             failed: List[Tuple[TileId, int]] = []
             stale: List[Tuple[TileId, int]] = []
+            delays: List[float] = []
             with self._lock:
                 if self.paused:
                     continue
                 for tid, t in self.tiles.items():
-                    if (
-                        t.awaiting_since is None
-                        or now - t.awaiting_since <= self.retry_s
-                    ):
+                    if t.awaiting_since is None or now < t.next_retry_at:
                         continue
                     t.retries += 1
                     if t.retries > self.max_pull_retries:
                         t.retries = 0  # re-arm: escalate again if still stuck
                         failed.append((tid, t.epoch))
-                    t.awaiting_since = now
+                    t.retry_delay = min(
+                        self.retry_max_s,
+                        self._retry_rng.uniform(self.retry_s, 3 * t.retry_delay),
+                    )
+                    t.next_retry_at = now + t.retry_delay
+                    delays.append(t.retry_delay)
                     stale.append((tid, t.epoch))
+            for d in delays:
+                self._m_backoff.observe(d)
             if stale:
                 # One wakeup that found work; one retry per stale tile.
                 self._m_wakeups.inc()
@@ -780,6 +880,15 @@ class BackendWorker:
         if dropped and self.store is not None:
             for tid in dropped:
                 self.store.drop_pending_for_owner([tid])
+        # Breaker hygiene: a peer that left the cluster (evicted, renamed)
+        # must not leave an open breaker behind — its gauge would read open
+        # forever and its breaker.open span would never finish.  Names still
+        # in the wiring keep their state (an open breaker on a live-but-dead
+        # link is exactly what the half-open probes are for).
+        with self._lock:
+            owner_names = {name for name, _, _ in self.owners.values()}
+        for peer in set(self.breaker.peers()) - owner_names:
+            self.breaker.reset(peer)
 
     def _on_deploy(self, msg: dict) -> None:
         outbound: List[Tuple[TileId, np.ndarray, int]] = []
@@ -847,7 +956,10 @@ class BackendWorker:
             self.probe_window = tuple(pw) if pw is not None else None
             for spec in msg["tiles"]:
                 tid: TileId = tuple(spec["id"])
-                tile = _Tile(unpack_tile(spec["state"]), int(spec["epoch"]))
+                tile = _Tile(
+                    unpack_tile(spec["state"]), int(spec["epoch"]),
+                    retry_s=self.retry_s,
+                )
                 self.tiles[tid] = tile
                 self.origins[tid] = tuple(spec.get("origin", (0, 0)))
                 if self.engine == "actor":
@@ -926,6 +1038,7 @@ class BackendWorker:
                 # The waitingForNewState latch (CellActor.scala:32): set
                 # before the pull so concurrent kicks don't double-drive.
                 tile.awaiting_since = time.monotonic()
+                tile.next_retry_at = tile.awaiting_since + self.retry_s
             halo = self.store.pull_halo_now(
                 tid, epoch, lambda h, e=epoch: self._on_halo_ready(tid, e, h)
             )
@@ -1002,6 +1115,7 @@ class BackendWorker:
             tile.epoch += c
             tile.awaiting_since = None
             tile.retries = 0
+            tile.retry_delay = self.retry_s  # backoff resets on success
             # Snapshot (arr, epoch) while still holding the lock: the sends
             # below run unlocked, and a concurrent kick may step the tile
             # again in between — publishing from the live tile there would
@@ -1174,6 +1288,7 @@ def run_backend(
     log_events: Optional[str] = None,
     trace_file: Optional[str] = None,
     flight_dir: str = "artifacts",
+    net_chaos=None,
 ) -> int:
     """CLI worker entry.  The worker's data-plane counters (peer sends/
     receives/retries, heartbeats, ring bytes) live in THIS process's
@@ -1183,7 +1298,9 @@ def run_backend(
     ``metrics_port`` serves live /metrics + /healthz + /trace,
     ``log_events`` appends worker-labeled JSONL, ``trace_file`` exports the
     worker's span buffer on exit (same trace ids as the frontend's —
-    mergeable), and ``flight_dir`` receives the crash dumps."""
+    mergeable), and ``flight_dir`` receives the crash dumps.  ``net_chaos``
+    (a :class:`runtime.config.NetworkChaosConfig`) arms this worker's wire
+    chaos — same seed/schedule on every role for a coherent drill."""
     from akka_game_of_life_tpu.obs import (
         EventLog,
         MetricsDumper,
@@ -1194,9 +1311,14 @@ def run_backend(
 
     registry = get_registry()
     tracer = get_tracer()
+    chaos = (
+        NetworkChaos(net_chaos, registry=registry, tracer=tracer)
+        if net_chaos is not None and net_chaos.enabled
+        else None
+    )
     worker = BackendWorker(
         host, port, name=name, engine=engine, pallas=pallas,
-        registry=registry, tracer=tracer,
+        registry=registry, tracer=tracer, netchaos=chaos,
     )
     worker.connect()
     node = worker.name or "backend"

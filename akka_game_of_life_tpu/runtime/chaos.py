@@ -10,6 +10,11 @@ What a "crash" means is the consumer's choice (the seam between standalone
 and cluster modes): the standalone simulation loses its in-memory board and
 must restore from checkpoint + deterministic replay; the control-plane
 frontend kills a live backend worker process.
+
+This injector faults what the runtime *hosts*; its wire-layer sibling —
+:mod:`akka_game_of_life_tpu.runtime.netchaos` — faults what it *says*
+(drops, delays, duplicates, reorders, partitions), on the same
+schedule/budget contract.  Run both for the full drill.
 """
 
 from __future__ import annotations

@@ -93,6 +93,72 @@ class FaultInjectionConfig:
 
 
 @dataclasses.dataclass
+class NetworkChaosConfig:
+    """Wire-layer fault injection knobs — the network analog of
+    :class:`FaultInjectionConfig`.
+
+    Where the crash injector kills processes/tiles the runtime *hosts*, this
+    policy corrupts the traffic *between* them: seeded probabilistic drops,
+    delays, duplicates, and reorders per message, plus scheduled
+    bidirectional partitions between node groups with heal times (the
+    Jepsen-style drill).  Applied by wrapping :class:`runtime.wire.Channel`
+    in a :class:`runtime.netchaos.ChaosChannel` — the frame format is never
+    touched, only whether/when frames flow.
+
+    Every field here maps to a ``--chaos-net-*`` CLI flag
+    (``tools/check_chaos_config.py`` lint-enforces the bijection).
+    """
+
+    enabled: bool = False
+    seed: int = 0
+    # Per-message probabilistic faults (applied on send).
+    drop_p: float = 0.0
+    delay_p: float = 0.0
+    delay_s: float = 0.02  # max injected latency (uniform 0..delay_s)
+    duplicate_p: float = 0.0
+    reorder_p: float = 0.0  # hold a message and let the next overtake it
+    # Partition schedule — the CrashInjector's schedule/budget contract on
+    # the wire: first partition after partition_after_s, then every
+    # partition_every_s, each healing after partition_heal_s, at most
+    # max_partitions times.  0 partitions when max_partitions == 0.
+    partition_after_s: float = 10.0
+    partition_every_s: float = 30.0
+    partition_heal_s: float = 5.0
+    max_partitions: int = 0
+    # Which planes the chaos channel wraps: the worker↔worker data plane
+    # ("peer"), the frontend↔worker control plane ("control"), or both
+    # ("all").  Peer-plane partition blocks FAIL the send (a broken link
+    # the circuit breaker sees); control-plane blocks drop silently (lost
+    # frames the heartbeat/eviction machinery sees).
+    scope: str = "peer"
+
+    def __post_init__(self) -> None:
+        for name in ("drop_p", "delay_p", "duplicate_p", "reorder_p"):
+            p = getattr(self, name)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"net chaos {name}={p} must be in [0, 1]")
+        if self.delay_s < 0 or self.partition_heal_s < 0:
+            raise ValueError("net chaos durations must be >= 0")
+        if self.max_partitions < 0:
+            raise ValueError(
+                f"max_partitions={self.max_partitions} must be >= 0"
+            )
+        if self.scope not in ("peer", "control", "all"):
+            raise ValueError(
+                f"unknown net chaos scope {self.scope!r}; use peer, "
+                f"control, or all"
+            )
+
+    @property
+    def wraps_peer(self) -> bool:
+        return self.enabled and self.scope in ("peer", "all")
+
+    @property
+    def wraps_control(self) -> bool:
+        return self.enabled and self.scope in ("control", "all")
+
+
+@dataclasses.dataclass
 class SimulationConfig:
     """All simulation knobs, mirroring ``application.conf``'s game-of-life
     block and extending it with the TPU runtime's own."""
@@ -182,6 +248,30 @@ class SimulationConfig:
     # protocol level but wedged in compute, which heartbeats cannot catch.
     max_pull_retries: int = 10
     stuck_timeout_s: float = 60.0
+    # Halo re-pull retry policy (the gatherer's 1 s Retry timer,
+    # NextStateCellGathererActor.scala:28 — hardened): retry_s is the BASE
+    # interval; consecutive unanswered retries of the same tile back off
+    # exponentially with decorrelated jitter up to retry_max_s, so a
+    # lossy/partitioned link sees a handful of probes per cooling window
+    # instead of a fixed-rate re-ask storm.  Frontend-owned cluster policy:
+    # shipped to every worker in WELCOME (the constructor default is only
+    # the standalone fallback).
+    retry_s: float = 0.5
+    retry_max_s: float = 8.0
+    # Per-peer circuit breaker on the worker data plane: after
+    # breaker_failures CONSECUTIVE send failures to one peer the breaker
+    # opens (sends to that peer are skipped instead of burning the hot path
+    # on connect timeouts); after breaker_cooldown_s it half-opens and lets
+    # one probe through — success closes it, failure re-opens.
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 2.0
+    # Optional deadline on cluster channel sends (seconds; 0 = block
+    # forever, the classic TCP behavior).  With a deadline, a send into a
+    # wedged peer's full socket buffer raises after this long instead of
+    # blocking the sending thread (heartbeats, ring publishes) forever;
+    # the channel is then treated as dead (a partial frame may have been
+    # written, so it cannot be reused).
+    send_deadline_s: float = 0.0
 
     # Checkpoint / resume (capability the reference lacks — SURVEY.md §5).
     checkpoint_dir: Optional[str] = None
@@ -240,6 +330,9 @@ class SimulationConfig:
     fault_injection: FaultInjectionConfig = dataclasses.field(
         default_factory=FaultInjectionConfig
     )
+    net_chaos: NetworkChaosConfig = dataclasses.field(
+        default_factory=NetworkChaosConfig
+    )
 
     def __post_init__(self) -> None:
         if self.height <= 0 or self.width <= 0:
@@ -278,6 +371,25 @@ class SimulationConfig:
             raise ValueError(f"unknown checkpoint format {self.checkpoint_format!r}")
         if self.steps_per_call % self.halo_width:
             raise ValueError("steps_per_call must be a multiple of halo_width")
+        if self.retry_s <= 0:
+            raise ValueError(f"retry_s={self.retry_s} must be > 0")
+        if self.retry_max_s < self.retry_s:
+            raise ValueError(
+                f"retry_max_s={self.retry_max_s} must be >= retry_s="
+                f"{self.retry_s} (it is the backoff cap)"
+            )
+        if self.breaker_failures < 1:
+            raise ValueError(
+                f"breaker_failures={self.breaker_failures} must be >= 1"
+            )
+        if self.breaker_cooldown_s <= 0:
+            raise ValueError(
+                f"breaker_cooldown_s={self.breaker_cooldown_s} must be > 0"
+            )
+        if self.send_deadline_s < 0:
+            raise ValueError(
+                f"send_deadline_s={self.send_deadline_s} must be >= 0 (0 = off)"
+            )
         if self.exchange_width < 1:
             raise ValueError(f"exchange_width must be >= 1, got {self.exchange_width}")
         if self.exchange_width > 1:
@@ -311,6 +423,14 @@ _DURATION_FIELDS = {
     "stuck_timeout_s",
     "first_after_s",
     "every_s",
+    "retry_s",
+    "retry_max_s",
+    "breaker_cooldown_s",
+    "send_deadline_s",
+    "delay_s",
+    "partition_after_s",
+    "partition_every_s",
+    "partition_heal_s",
 }
 
 # Accept the reference's config spellings as aliases.
@@ -335,7 +455,10 @@ def _normalize(data: Mapping[str, Any], *, nested: bool = False) -> Dict[str, An
             # (application.conf:41) but it belongs to the fault injector.
             out.setdefault("fault_injection", {})["max_crashes"] = value
             continue
-        if isinstance(value, Mapping) and key not in ("fault_injection",):
+        if isinstance(value, Mapping) and key not in (
+            "fault_injection",
+            "net_chaos",
+        ):
             # Flatten one nesting level (e.g. the reference's board {x, y} /
             # error {delay, every} sub-blocks).
             if key in ("board", "game_of_life"):
@@ -345,8 +468,8 @@ def _normalize(data: Mapping[str, Any], *, nested: bool = False) -> Dict[str, An
                 fi = out.setdefault("fault_injection", {})
                 fi.update(_normalize(value, nested=True))
                 continue
-        if key == "fault_injection" and isinstance(value, Mapping):
-            out.setdefault("fault_injection", {}).update(_normalize(value, nested=True))
+        if key in ("fault_injection", "net_chaos") and isinstance(value, Mapping):
+            out.setdefault(key, {}).update(_normalize(value, nested=True))
             continue
         if key in _DURATION_FIELDS and value is not None:
             value = parse_duration(value)
@@ -384,15 +507,22 @@ def load_config(
     if overrides:
         deep = _normalize({k: v for k, v in overrides.items() if v is not None})
         fi = {**merged.get("fault_injection", {}), **deep.pop("fault_injection", {})}
+        nc = {**merged.get("net_chaos", {}), **deep.pop("net_chaos", {})}
         merged.update(deep)
         if fi:
             merged["fault_injection"] = fi
+        if nc:
+            merged["net_chaos"] = nc
 
     fi_kwargs = merged.pop("fault_injection", {})
+    nc_kwargs = merged.pop("net_chaos", {})
     unknown = set(merged) - _field_names(SimulationConfig)
     unknown_fi = set(fi_kwargs) - _field_names(FaultInjectionConfig)
-    if unknown or unknown_fi:
-        raise ValueError(f"unknown config keys: {sorted(unknown | unknown_fi)}")
+    unknown_nc = set(nc_kwargs) - _field_names(NetworkChaosConfig)
+    if unknown or unknown_fi or unknown_nc:
+        raise ValueError(
+            f"unknown config keys: {sorted(unknown | unknown_fi | unknown_nc)}"
+        )
 
     if "mesh_shape" in merged and merged["mesh_shape"] is not None:
         merged["mesh_shape"] = tuple(merged["mesh_shape"])
@@ -401,5 +531,7 @@ def load_config(
     if "probe_window" in merged and merged["probe_window"] is not None:
         merged["probe_window"] = tuple(merged["probe_window"])
     return SimulationConfig(
-        fault_injection=FaultInjectionConfig(**fi_kwargs), **merged
+        fault_injection=FaultInjectionConfig(**fi_kwargs),
+        net_chaos=NetworkChaosConfig(**nc_kwargs),
+        **merged,
     )

@@ -43,6 +43,11 @@ from akka_game_of_life_tpu.runtime.checkpoint import make_store
 from akka_game_of_life_tpu.runtime.chaos import CrashInjector
 from akka_game_of_life_tpu.runtime.config import SimulationConfig
 from akka_game_of_life_tpu.runtime.membership import Member, Membership
+from akka_game_of_life_tpu.runtime.netchaos import (
+    ChaosChannel,
+    NetworkChaos,
+    wrap_channel,
+)
 from akka_game_of_life_tpu.runtime.render import BoardObserver
 from akka_game_of_life_tpu.runtime.simulation import initial_board
 from akka_game_of_life_tpu.runtime.tiles import TileId, TileLayout, layout_for_workers
@@ -190,7 +195,29 @@ class Frontend:
         self._m_joined = self.metrics.counter("gol_members_joined_total")
         self._m_lost = self.metrics.counter("gol_members_lost_total")
         self._m_redeploys = self.metrics.counter("gol_redeploys_total")
+        self._m_degraded = self.metrics.gauge("gol_degraded_mode")
+        self._m_degraded_entries = self.metrics.counter(
+            "gol_degraded_entries_total"
+        )
         self._metrics_server: Optional[MetricsServer] = None
+        # Wire-fault policy (config/CLI --chaos-net-*): one seeded instance
+        # per process; the in-process harness hands this same instance to
+        # its workers so partition sides are consistent cluster-wide.
+        self.netchaos = (
+            NetworkChaos(
+                config.net_chaos, registry=self.metrics, tracer=self.tracer
+            )
+            if config.net_chaos.enabled
+            else None
+        )
+        if self.netchaos is not None:
+            self.netchaos.register_node("frontend")
+        # Degraded mode: a partition has stranded a quorum of tiles past
+        # stuck_timeout_s — the run checkpoints what it has and WAITS for
+        # the heal instead of auto-downing live members / thrashing the
+        # restart budget on tiles nobody can actually step.
+        self.degraded = False
+        self._degraded_span = None
         if self.rule.radius != 1:
             raise ValueError(
                 "the TCP cluster exchanges radius-1 boundary rings; "
@@ -320,6 +347,7 @@ class Frontend:
                 "target_epoch": self.target_epoch,
                 "done": self.done.is_set(),
                 "paused": self.paused,
+                "degraded": self.degraded,
             }
 
     def _io_loop(self) -> None:
@@ -591,6 +619,9 @@ class Frontend:
             # Under the lock: the paced-mode rotation also runs under it
             # (and skips once _stop is set), so the span finished here is
             # always the last one minted.
+            if self._degraded_span is not None:
+                self._degraded_span.set(healed=False).finish()
+                self._degraded_span = None
             if self._epoch_span is not None:
                 self._epoch_span.set(done=self.done.is_set()).finish()
             if self._run_span is not None:
@@ -634,7 +665,12 @@ class Frontend:
                 sock, _ = self._listener.accept()
             except OSError:
                 return
-            channel = Channel(sock)
+            channel = Channel(sock, send_deadline_s=self.config.send_deadline_s)
+            if self.netchaos is not None and self.netchaos.config.wraps_control:
+                # Control-plane chaos drops silently: a cut control link is
+                # judged by heartbeats/eviction, not by send exceptions.
+                # dst is labeled once REGISTER names the worker.
+                channel = wrap_channel(channel, self.netchaos, src="frontend")
             t = threading.Thread(
                 target=self._serve_connection, args=(channel,), daemon=True
             )
@@ -680,6 +716,9 @@ class Frontend:
                 peer_host=peer_host,
                 peer_port=int(hello.get("peer_port", 0)),
             )
+            if isinstance(channel, ChaosChannel):
+                channel.dst = member.name
+                self.netchaos.register_node(member.name)
             channel.send(
                 {
                     "type": P.WELCOME,
@@ -687,6 +726,13 @@ class Frontend:
                     "heartbeat_s": self.config.heartbeat_s,
                     "max_pull_retries": self.config.max_pull_retries,
                     "exchange_width": self.config.exchange_width,
+                    # One retry/breaker/deadline policy source of truth for
+                    # every worker of this cluster (SimulationConfig).
+                    "retry_s": self.config.retry_s,
+                    "retry_max_s": self.config.retry_max_s,
+                    "breaker_failures": self.config.breaker_failures,
+                    "breaker_cooldown_s": self.config.breaker_cooldown_s,
+                    "send_deadline_s": self.config.send_deadline_s,
                 }
             )
             engine = hello.get("engine", "?")
@@ -883,6 +929,19 @@ class Frontend:
         with self._lock:
             if self.tile_owner.get(tile) != member.name or self.layout is None:
                 return
+            if (
+                self.degraded
+                and self.netchaos is not None
+                and self.netchaos.partitioned()
+            ):
+                # A KNOWN partition (the injected chaos plane is
+                # self-announcing): redeploying blocked neighbors would
+                # thrash the restart budget without making any halo arrive —
+                # wait for the heal instead.  A stall with no announced
+                # partition keeps this recovery path (a wedged-but-alive
+                # worker's tiles MUST move to healthy members; an external
+                # partition is then guarded by the restart budget).
+                return
             now = time.monotonic()
             stuck = [
                 ntile
@@ -1047,9 +1106,21 @@ class Frontend:
             # ticks, evicts, and injects).
             if self._metrics_dumper is not None:
                 self._metrics_dumper.maybe(now)
-            # auto-down stale members (application.conf:23 analog)
-            for m in self.membership.stale_members(now):
-                self._on_member_lost(m.name)
+            # Advance the wire-chaos partition schedule even when no
+            # traffic flows (blocked links poll on send; a fully-stalled
+            # cluster still needs the heal clock to tick).
+            if self.netchaos is not None:
+                self.netchaos.poll(now)
+            # Degraded-mode detection BEFORE auto-down: a partition that
+            # strands a quorum of tiles must flip the cluster into waiting,
+            # not evict every silent-but-alive member.
+            self._check_degraded(now)
+            # auto-down stale members (application.conf:23 analog) —
+            # suppressed while degraded: silence during a partition is the
+            # partition's fault, not the members'
+            if not self.degraded:
+                for m in self.membership.stale_members(now):
+                    self._on_member_lost(m.name)
             # paced epoch announcements
             if (
                 self._started.is_set()
@@ -1089,6 +1160,74 @@ class Frontend:
                 and self.injector.should_crash(now)
             ):
                 self._inject_crash()
+
+    def _check_degraded(self, now: float) -> None:
+        """Enter/leave degraded mode.
+
+        *Stranded* means a tile has pushed no ring/progress for
+        ``stuck_timeout_s``; when at least half the board is stranded the
+        stall is systemic.  Degraded mode makes the recovery source durable
+        (checkpoint what we have), logs ``cluster.degraded``, and suspends
+        heartbeat auto-down — silence during a partition is the partition's
+        fault, and evicting live members would orphan state that will
+        resume on heal.  Stuck-neighbor redeploys stay available unless the
+        injected chaos plane announces an active partition (see
+        ``_on_gather_failed``): a wedged-but-alive worker's tiles must
+        still move to healthy members.  When rings flow again the mode
+        lifts and the cluster resumes cleanly from live state.
+        """
+        with self._lock:
+            if not self._started.is_set() or self.paused or self.layout is None:
+                return
+            tiles = self.layout.tile_ids
+            stranded = sum(
+                1
+                for t in tiles
+                if now - self._last_ring_time.get(t, now)
+                > self.config.stuck_timeout_s
+            )
+            quorum = 2 * stranded >= len(tiles)
+            if quorum and not self.degraded:
+                self.degraded = True
+                self._m_degraded.set(1)
+                self._m_degraded_entries.inc()
+                self._degraded_span = self.tracer.start(
+                    "cluster.degraded", parent=self._run_span, node="frontend",
+                    stranded=stranded, tiles=len(tiles),
+                    epoch=self._last_ckpt[0],
+                )
+                self.tracer.flight.dump("degraded", node="frontend")
+                self.events.emit(
+                    "cluster_degraded",
+                    stranded=stranded,
+                    tiles=len(tiles),
+                    epoch=self._last_ckpt[0],
+                )
+                # Checkpoint what we have: the last consistent per-tile set
+                # becomes durable NOW — if the partition outlives the
+                # operator's patience, a restarted frontend resumes from it.
+                if self.store is not None:
+                    epoch, payloads = self._last_ckpt
+                    for t, payload in payloads.items():
+                        self._io_queue.put(("tile", (epoch, t, payload)))
+                    self._io_queue.put(
+                        (
+                            "finalize",
+                            (
+                                epoch,
+                                self.rule.rulestring(),
+                                self.layout.grid,
+                                self.config.shape,
+                            ),
+                        )
+                    )
+            elif self.degraded and not quorum:
+                self.degraded = False
+                self._m_degraded.set(0)
+                if self._degraded_span is not None:
+                    self._degraded_span.set(healed=True).finish()
+                    self._degraded_span = None
+                self.events.emit("cluster_degraded_healed")
 
     def _inject_crash(self) -> None:
         members = [m for m in self.membership.alive_members() if m.tiles]

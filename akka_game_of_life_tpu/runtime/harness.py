@@ -37,7 +37,10 @@ class ClusterHarness:
         # counters and causal trees without cross-test bleed); None = the
         # process defaults.  With one shared tracer the frontend's epoch
         # span and every worker's step/halo spans land in one buffer — the
-        # in-process analog of merging per-process trace files.
+        # in-process analog of merging per-process trace files.  When
+        # config.net_chaos is enabled, the frontend's NetworkChaos instance
+        # is shared by every worker, so partition sides and the seeded
+        # fault stream are consistent cluster-wide (netchaos attribute).
         self.engine = engine
         self.pallas = pallas
         self.registry = registry
@@ -51,21 +54,25 @@ class ClusterHarness:
             tracer=tracer,
         )
         self.frontend.start()
+        self.netchaos = self.frontend.netchaos
         self.workers = []
         self.threads = []
         for i in range(n_backends):
             self.add_worker(f"w{i}")
 
     def add_worker(self, name):
+        # No retry/breaker knobs here: WELCOME ships the frontend's
+        # SimulationConfig policy (retry_s, retry_max_s, breaker_*,
+        # send_deadline_s), so tests and CLI share one source of truth.
         w = BackendWorker(
             "127.0.0.1",
             self.frontend.port,
             name=name,
             engine=self.engine,
             pallas=self.pallas,
-            retry_s=0.5,
             registry=self.registry,
             tracer=self.tracer,
+            netchaos=self.frontend.netchaos,
         )
         w.crash_hook = w.stop  # in-thread "process death": drop the connection
         w.connect()

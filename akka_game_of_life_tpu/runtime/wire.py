@@ -80,12 +80,38 @@ class Channel:
     (the DeathWatch analog), not an exception.
     """
 
-    def __init__(self, sock: socket.socket) -> None:
+    def __init__(
+        self, sock: socket.socket, send_deadline_s: float = 0.0
+    ) -> None:
         import threading
 
         self.sock = sock
         self._rfile = sock.makefile("rb")
         self._wlock = threading.Lock()
+        # Optional send deadline (seconds; 0 = block forever): a send into a
+        # wedged peer's full socket buffer raises an OSError (every existing
+        # handler treats that as a dead channel) after roughly this long
+        # instead of blocking the sending thread — heartbeats, ring
+        # publishes — forever.  Implemented with SO_SNDTIMEO, which bounds
+        # ONLY send-side blocking — settimeout() would race with a reader
+        # thread blocked in recv on the same (bidirectional) socket.  A
+        # timed-out send may have written a PARTIAL frame, so the channel
+        # must not be reused after one: callers' OSError paths already
+        # drop/close it.
+        self.send_deadline_s = 0.0
+        if send_deadline_s:
+            self.set_send_deadline(send_deadline_s)
+
+    def set_send_deadline(self, seconds: float) -> None:
+        """Install/replace the per-send deadline (0 disables).  A method —
+        not a bare attribute write — so chaos wrappers can delegate it to
+        the real channel."""
+        tv = struct.pack("ll", int(seconds), int((seconds % 1.0) * 1e6))
+        try:
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+        except (OSError, ValueError):  # platform without timeval sockopts
+            return
+        self.send_deadline_s = seconds
 
     def send(self, msg: Dict[str, Any]) -> None:
         blobs: List[bytes] = []
@@ -171,14 +197,10 @@ def attach_trace(msg: Dict[str, Any], span) -> Dict[str, Any]:
 def extract_trace(msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """The sender's span context from a received envelope, or None.  The
     returned dict is what ``Tracer.span(parent=...)`` accepts."""
-    ctx = msg.get(_trace_key())
-    return ctx if isinstance(ctx, dict) else None
-
-
-def _trace_key() -> str:
     from akka_game_of_life_tpu.obs.tracing import TRACE_KEY
 
-    return TRACE_KEY
+    ctx = msg.get(TRACE_KEY)
+    return ctx if isinstance(ctx, dict) else None
 
 
 # -- tile payload helpers -----------------------------------------------------

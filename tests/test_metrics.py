@@ -287,13 +287,24 @@ def test_every_metric_in_code_is_documented():
         sys.path.pop(0)
     found = check_metrics_doc.metric_names_in_code()
     # The scan sees the real catalog (sanity: it must find the acceptance
-    # names, or the lint would vacuously pass).
-    for must in ("gol_epochs_advanced_total", "gol_chaos_crashes_total"):
+    # names — including the network-chaos/breaker families — or the lint
+    # would vacuously pass).
+    for must in (
+        "gol_epochs_advanced_total",
+        "gol_chaos_crashes_total",
+        "gol_net_partitions_total",
+        "gol_breaker_state",
+    ):
         assert must in found
     missing = check_metrics_doc.undocumented()
     assert not missing, (
         f"metrics registered in code but missing from docs/OPERATIONS.md: "
         f"{sorted(missing)}"
+    )
+    stray = check_metrics_doc.uncataloged()
+    assert not stray, (
+        f"metrics registered in code but missing from obs/catalog.py "
+        f"(scrapes would not pre-register them): {sorted(stray)}"
     )
 
 

@@ -1,0 +1,587 @@
+"""Network chaos plane + hardened comms stack — policy contracts, wrapper
+semantics, breaker state machine, degraded mode, and the partition soak.
+
+The wire layer's faults (drops, delays, duplicates, reorders, partitions)
+are the failure class the crash injector (`runtime/chaos.py`) cannot
+exercise; these tests pin (1) the seeded policy's schedule/budget contract
+(the CrashInjector contract on the wire), (2) the ChaosChannel's per-fault
+semantics over real sockets, (3) the per-peer circuit breaker's state
+machine, (4) frontend degraded mode, and (5) the acceptance drill: a
+2-worker cluster survives a mid-run partition-and-heal with a final board
+bit-identical to the fault-free run while the partition/breaker metrics
+move."""
+
+import socket
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.obs import MetricsRegistry, install
+from akka_game_of_life_tpu.obs.tracing import Tracer
+from akka_game_of_life_tpu.runtime.config import (
+    NetworkChaosConfig,
+    SimulationConfig,
+)
+from akka_game_of_life_tpu.runtime.harness import cluster
+from akka_game_of_life_tpu.runtime.netchaos import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    ChaosChannel,
+    CircuitBreaker,
+    NetworkChaos,
+)
+from akka_game_of_life_tpu.runtime.simulation import initial_board
+from akka_game_of_life_tpu.runtime.wire import Channel
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _registry():
+    return install(MetricsRegistry())
+
+
+def _chaos(registry=None, **kwargs):
+    cfg = NetworkChaosConfig(enabled=True, **kwargs)
+    return NetworkChaos(
+        cfg,
+        start_time=0.0,
+        registry=registry if registry is not None else _registry(),
+        tracer=Tracer(seed=0),
+    )
+
+
+# -- policy: the partition schedule/budget contract ---------------------------
+
+
+def test_partition_schedule_and_budget():
+    reg = _registry()
+    ch = _chaos(
+        reg,
+        partition_after_s=10.0,
+        partition_every_s=30.0,
+        partition_heal_s=5.0,
+        max_partitions=2,
+    )
+    ch.register_node("a")
+    ch.register_node("b")
+    assert not ch.blocked("a", "b", now=9.999)
+    assert ch.blocked("a", "b", now=10.0)  # first: exactly at the boundary
+    assert ch.partitioned()
+    assert ch.blocked("a", "b", now=14.999)
+    assert not ch.blocked("a", "b", now=15.0)  # healed at fire + heal_s
+    assert not ch.partitioned()
+    assert not ch.blocked("a", "b", now=39.999)
+    assert ch.blocked("a", "b", now=40.0)  # rescheduled from the firing time
+    assert not ch.blocked("a", "b", now=45.0)
+    assert ch.exhausted
+    assert not ch.blocked("a", "b", now=1e9)  # budget spent: never again
+    assert ch.partitions == 2
+    assert reg.value("gol_net_partitions_total") == 2
+    assert reg.value("gol_net_partition_heals_total") == 2
+
+
+def test_partition_waits_for_two_nodes():
+    ch = _chaos(partition_after_s=1.0, max_partitions=1)
+    ch.register_node("only")
+    ch.poll(now=100.0)
+    assert not ch.partitioned()  # the slot stays armed, not consumed
+    ch.register_node("other")
+    ch.poll(now=100.1)
+    assert ch.partitioned()
+    assert ch.partitions == 1
+
+
+def test_partition_budget_zero_never_fires():
+    ch = _chaos(partition_after_s=0.0, max_partitions=0)
+    ch.register_node("a")
+    ch.register_node("b")
+    assert not ch.blocked("a", "b", now=1e9)
+    assert ch.partitions == 0
+
+
+def test_manual_partition_and_heal():
+    reg = _registry()
+    tracer = Tracer(seed=0)
+    ch = NetworkChaos(
+        NetworkChaosConfig(enabled=True), registry=reg, tracer=tracer
+    )
+    ch.start_partition(("a",), ("b", "c"), heal_s=1e9)
+    assert ch.blocked("a", "b") and ch.blocked("c", "a")
+    assert not ch.blocked("b", "c")  # same side
+    assert not ch.blocked("a", "unknown")  # unknown endpoints never block
+    assert not ch.blocked("", "a")
+    ch.heal()
+    assert not ch.blocked("a", "b")
+    # The partition interval is one finished net.partition span.
+    spans = [s for s in tracer.finished() if s["name"] == "net.partition"]
+    assert len(spans) == 1
+
+
+def test_disabled_policy_rules_nothing():
+    ch = NetworkChaos(
+        NetworkChaosConfig(enabled=False),
+        registry=_registry(),
+        tracer=Tracer(seed=0),
+    )
+    d = ch.on_send("a", "b")
+    assert not (d.blocked or d.drop or d.delay_s or d.duplicate or d.reorder)
+
+
+# -- ChaosChannel semantics over real sockets ---------------------------------
+
+
+def _wrapped_pair(chaos, **kwargs):
+    a, b = socket.socketpair()
+    return ChaosChannel(Channel(a), chaos, **kwargs), Channel(b)
+
+
+def test_chaos_channel_drop():
+    reg = _registry()
+    chaos = _chaos(reg, drop_p=1.0)
+    tx, rx = _wrapped_pair(chaos, src="a", dst="b")
+    tx.send({"n": 1})  # vanishes
+    chaos.config.drop_p = 0.0
+    tx.send({"n": 2})
+    assert rx.recv() == {"n": 2}
+    assert reg.value("gol_net_chaos_dropped_total") == 1
+
+
+def test_chaos_channel_duplicate():
+    reg = _registry()
+    chaos = _chaos(reg, duplicate_p=1.0)
+    tx, rx = _wrapped_pair(chaos, src="a", dst="b")
+    tx.send({"n": 1})
+    assert rx.recv() == {"n": 1}
+    assert rx.recv() == {"n": 1}
+    assert reg.value("gol_net_chaos_duplicated_total") == 1
+
+
+def test_chaos_channel_reorder():
+    reg = _registry()
+    chaos = _chaos(reg, reorder_p=1.0)
+    tx, rx = _wrapped_pair(chaos, src="a", dst="b")
+    tx.send({"n": 1})  # held
+    tx.send({"n": 2})  # overtakes, then flushes the held frame
+    assert rx.recv() == {"n": 2}
+    assert rx.recv() == {"n": 1}
+    assert reg.value("gol_net_chaos_reordered_total") >= 1
+
+
+def test_chaos_channel_held_frame_flushes_on_close():
+    chaos = _chaos(reorder_p=1.0)
+    tx, rx = _wrapped_pair(chaos, src="a", dst="b")
+    tx.send({"n": 1})  # held with no follow-up send
+    tx.close()
+    assert rx.recv() == {"n": 1}
+    assert rx.recv() is None
+
+
+def test_chaos_channel_delay_delivers_late():
+    reg = _registry()
+    chaos = _chaos(reg, delay_p=1.0, delay_s=0.05)
+    tx, rx = _wrapped_pair(chaos, src="a", dst="b")
+    tx.send({"n": 1})
+    assert rx.recv() == {"n": 1}  # recv blocks until the timer fires
+    assert reg.value("gol_net_chaos_delayed_total") == 1
+
+
+def test_chaos_channel_delayed_message_still_duplicates():
+    # delay and duplicate compose: the late send carries the copy too.
+    reg = _registry()
+    chaos = _chaos(reg, delay_p=1.0, delay_s=0.03, duplicate_p=1.0)
+    tx, rx = _wrapped_pair(chaos, src="a", dst="b")
+    tx.send({"n": 1})
+    assert rx.recv() == {"n": 1}
+    assert rx.recv() == {"n": 1}
+    assert reg.value("gol_net_chaos_duplicated_total") == 1
+
+
+def test_chaos_channel_close_does_not_flush_held_across_partition():
+    chaos = _chaos(reorder_p=1.0)
+    tx, rx = _wrapped_pair(chaos, src="a", dst="b")
+    tx.send({"n": 1})  # held
+    chaos.start_partition(("a",), ("b",), heal_s=1e9)
+    tx.close()  # the flush is still a send: it must not cross the cut
+    assert rx.recv() is None
+
+
+def test_chaos_channel_partition_fail_blocked_raises():
+    chaos = _chaos()
+    chaos.start_partition(("a",), ("b",), heal_s=1e9)
+    tx, _rx = _wrapped_pair(chaos, src="a", dst="b", fail_blocked=True)
+    with pytest.raises(OSError):  # the breaker/drop machinery's signal
+        tx.send({"n": 1})
+
+
+def test_chaos_channel_partition_silent_on_control_plane():
+    chaos = _chaos()
+    chaos.start_partition(("a",), ("b",), heal_s=1e9)
+    tx, rx = _wrapped_pair(chaos, src="a", dst="b", fail_blocked=False)
+    tx.send({"n": 1})  # silently gone
+    chaos.heal()
+    tx.send({"n": 2})
+    assert rx.recv() == {"n": 2}
+
+
+def test_chaos_channel_recv_filters_partitioned_frames():
+    # Wrap only the RECEIVING side: frames ARRIVING during an active
+    # partition are dropped, so a one-sided install still cuts both
+    # directions.
+    import threading
+
+    chaos = _chaos()
+    a, b = socket.socketpair()
+    tx = Channel(a)  # raw sender — no chaos on its side
+    rx = ChaosChannel(Channel(b), chaos, src="b", dst="a")
+    chaos.start_partition(("a",), ("b",), heal_s=1e9)
+    got = []
+    t = threading.Thread(target=lambda: got.append(rx.recv()))
+    t.start()
+    tx.send({"n": 1})  # received while partitioned: filtered, recv re-blocks
+    time.sleep(0.2)
+    assert not got, "a frame crossed the active partition"
+    chaos.heal()
+    tx.send({"n": 2})
+    t.join(5)
+    assert got == [{"n": 2}]
+
+
+def test_chaos_channel_delegates_to_inner():
+    chaos = _chaos()
+    tx, _rx = _wrapped_pair(chaos, src="a", dst="b")
+    assert tx.sock is tx.inner.sock  # attribute passthrough
+    tx.set_send_deadline(0.5)  # method passthrough reaches the real channel
+    assert tx.inner.send_deadline_s == 0.5
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+def test_breaker_state_machine():
+    reg = _registry()
+    t = [0.0]
+    br = CircuitBreaker(
+        failures=3, cooldown_s=1.0, registry=reg, tracer=Tracer(seed=0),
+        node="w0", clock=lambda: t[0],
+    )
+    # Closed: failures below the threshold keep it closed.
+    assert br.allow("p")
+    br.failure("p")
+    br.failure("p")
+    assert br.state("p") == CLOSED and br.allow("p")
+    # A success resets the consecutive count.
+    br.success("p")
+    br.failure("p")
+    br.failure("p")
+    assert br.state("p") == CLOSED
+    # The third consecutive failure opens it.
+    br.failure("p")
+    assert br.state("p") == OPEN
+    assert not br.allow("p")
+    assert reg.value("gol_breaker_open_total") == 1
+    assert reg.value("gol_breaker_skipped_sends_total") == 1
+    assert reg.value("gol_breaker_state", peer="p") == OPEN
+    # Cooldown elapses: exactly one half-open probe is admitted.
+    t[0] = 1.5
+    assert br.allow("p")
+    assert br.state("p") == HALF_OPEN
+    assert not br.allow("p")  # the probe is singular per cooldown
+    # Probe fails: back to OPEN for another cooldown.
+    br.failure("p")
+    assert br.state("p") == OPEN
+    assert not br.allow("p")
+    t[0] = 3.0
+    assert br.allow("p")  # next probe
+    br.success("p")
+    assert br.state("p") == CLOSED and br.allow("p")
+    assert reg.value("gol_breaker_state", peer="p") == CLOSED
+
+
+def test_breaker_open_interval_is_one_span():
+    tracer = Tracer(seed=0)
+    t = [0.0]
+    br = CircuitBreaker(
+        failures=1, cooldown_s=0.5, registry=_registry(), tracer=tracer,
+        node="w0", clock=lambda: t[0],
+    )
+    br.failure("p")  # opens
+    t[0] = 1.0
+    assert br.allow("p")
+    br.success("p")  # closes — finishes the span
+    spans = [s for s in tracer.finished() if s["name"] == "breaker.open"]
+    assert len(spans) == 1
+    assert spans[0]["attrs"]["peer"] == "p"
+    assert spans[0]["attrs"]["outcome"] == "closed"
+
+
+def test_breaker_peers_are_independent():
+    br = CircuitBreaker(
+        failures=1, cooldown_s=1e9, registry=_registry(), tracer=Tracer(seed=0),
+    )
+    br.failure("dead")
+    assert br.state("dead") == OPEN
+    assert br.allow("alive")
+    assert br.state("alive") == CLOSED
+    assert br.peers() == ["dead"]
+
+
+def test_breaker_resets_when_peer_leaves_owners():
+    """OWNERS rewiring that drops a peer clears its breaker: the gauge
+    returns to closed and the open span finishes (outcome=reset) instead of
+    leaking to end-of-run."""
+    from akka_game_of_life_tpu.runtime.backend import BackendWorker
+
+    reg = _registry()
+    tracer = Tracer(seed=0)
+    w = BackendWorker(
+        "127.0.0.1", 1, name="w0", engine="numpy",
+        breaker_failures=1, registry=reg, tracer=tracer,
+    )
+    try:
+        w.breaker.failure("w1")
+        assert w.breaker.state("w1") == OPEN
+        # w1 evicted: the new wiring only names w0 and a fresh w2.
+        w._on_owners(
+            {
+                "grid": [1, 2],
+                "shape": [16, 32],
+                "tiles": [
+                    [[0, 0], "w0", "h", 1],
+                    [[0, 1], "w2", "h", 2],
+                ],
+            }
+        )
+        assert w.breaker.state("w1") == CLOSED
+        assert reg.value("gol_breaker_state", peer="w1") == CLOSED
+        spans = [s for s in tracer.finished() if s["name"] == "breaker.open"]
+        assert len(spans) == 1 and spans[0]["attrs"]["outcome"] == "reset"
+        # w2 is live wiring: an open breaker there must survive rewiring.
+        w.breaker.failure("w2")
+        w._on_owners(
+            {
+                "grid": [1, 2],
+                "shape": [16, 32],
+                "tiles": [
+                    [[0, 0], "w0", "h", 1],
+                    [[0, 1], "w2", "h", 2],
+                ],
+            }
+        )
+        assert w.breaker.state("w2") == OPEN
+    finally:
+        w._peer_listener.close()
+
+
+# -- config / CLI lint (tier-1: the knob surface cannot rot) ------------------
+
+
+def test_every_chaos_net_flag_maps_to_config():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import check_chaos_config
+    finally:
+        sys.path.pop(0)
+    flags = check_chaos_config.flag_names()
+    # Sanity: the scan sees the real surface.
+    assert "--chaos-net" in flags and "--chaos-net-drop-p" in flags
+    fields = check_chaos_config.config_fields()
+    assert "drop_p" in fields and "enabled" in fields
+    assert check_chaos_config.problems() == []
+
+
+def test_net_chaos_config_layering(tmp_path):
+    from akka_game_of_life_tpu.runtime.config import load_config
+
+    p = tmp_path / "c.toml"
+    p.write_text(
+        "[net_chaos]\nenabled = true\ndrop_p = 0.1\ndelay_s = \"50ms\"\n"
+        "partition-after-s = \"2s\"\n"
+    )
+    cfg = load_config(str(p), {"net_chaos": {"seed": 4}, "retry_s": "250ms"})
+    assert cfg.net_chaos.enabled and cfg.net_chaos.seed == 4
+    assert cfg.net_chaos.drop_p == 0.1
+    assert cfg.net_chaos.delay_s == 0.05  # duration strings parse
+    assert cfg.net_chaos.partition_after_s == 2.0  # dashed keys normalize
+    assert cfg.retry_s == 0.25
+    with pytest.raises(ValueError, match="unknown config keys"):
+        load_config(None, {"net_chaos": {"not_a_knob": 1}})
+
+
+def test_net_chaos_config_validates():
+    with pytest.raises(ValueError, match="drop_p"):
+        NetworkChaosConfig(drop_p=1.5)
+    with pytest.raises(ValueError, match="scope"):
+        NetworkChaosConfig(scope="wat")
+    with pytest.raises(ValueError, match="max_partitions"):
+        NetworkChaosConfig(max_partitions=-1)
+
+
+def test_retry_policy_rides_welcome(tmp_path):
+    """The frontend's SimulationConfig retry/breaker policy is the single
+    source of truth: workers adopt it at WELCOME (harness passes nothing)."""
+    cfg = SimulationConfig(
+        height=16, width=16, seed=3, max_epochs=4,
+        retry_s=0.25, retry_max_s=3.0, breaker_failures=5,
+        breaker_cooldown_s=1.25, flight_dir="",
+    )
+    with cluster(cfg, 2, registry=_registry(), tracer=Tracer(seed=0)) as h:
+        h.run_to_completion()
+        for w in h.workers:
+            assert w.retry_s == 0.25
+            assert w.retry_max_s == 3.0
+            assert w.breaker.failures == 5
+            assert w.breaker.cooldown_s == 1.25
+
+
+# -- cluster drills -----------------------------------------------------------
+
+
+def _oracle(cfg, epochs):
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.models import get_model
+
+    return np.asarray(
+        get_model("conway").run(epochs)(jnp.asarray(initial_board(cfg)))
+    )
+
+
+def _wait(predicate, timeout, what):
+    t0 = time.monotonic()
+    while not predicate():
+        assert time.monotonic() - t0 < timeout, f"timed out waiting for {what}"
+        time.sleep(0.01)
+
+
+def test_partition_soak_converges_bit_identical(tmp_path):
+    """The acceptance drill: a seeded 2-worker cluster takes a mid-run
+    bidirectional partition that heals, and still converges to a final
+    board bit-identical to the fault-free run — with the partition counter
+    and breaker open/close transitions observed to move."""
+    epochs = 60
+    reg = _registry()
+    tracer = Tracer(seed=0)
+    cfg = SimulationConfig(
+        height=48, width=48, seed=11, max_epochs=epochs,
+        tick_s=0.02, start_delay_s=0.01, flight_dir="",
+        # Fast drill policy: quick re-pulls, quick breaker trips/probes.
+        retry_s=0.05, retry_max_s=0.5,
+        breaker_failures=2, breaker_cooldown_s=0.1,
+        net_chaos=NetworkChaosConfig(enabled=True, seed=7, scope="peer"),
+    )
+    with cluster(cfg, 2, registry=reg, tracer=tracer) as h:
+        assert h.frontend.wait_for_backends(timeout=10)
+        h.frontend.start_simulation()
+        assert h.netchaos is not None  # the harness shares one policy
+
+        # Let the cluster make real progress, then cut w0 from w1.
+        _wait(
+            lambda: min(h.frontend.tile_epochs.values(), default=0) >= 9,
+            30, "pre-partition progress",
+        )
+        h.netchaos.start_partition(("w0",), ("w1",), heal_s=1.0)
+        _wait(lambda: not h.netchaos.partitioned(), 30, "heal")
+
+        assert h.frontend.done.wait(60), "cluster did not finish after heal"
+        assert h.frontend.error is None, h.frontend.error
+        final = h.frontend.final_board
+
+    np.testing.assert_array_equal(final, _oracle(cfg, epochs))
+    # The drill really happened: the partition opened and healed...
+    assert reg.value("gol_net_partitions_total") == 1
+    assert reg.value("gol_net_partition_heals_total") == 1
+    assert reg.value("gol_net_chaos_dropped_total") >= 1
+    # ... breakers tripped on the cut link and re-closed after it healed
+    # (state gauges back to CLOSED for every peer that opened) ...
+    assert reg.value("gol_breaker_open_total") >= 1
+    assert reg.value("gol_breaker_skipped_sends_total") >= 1
+    for w in h.workers:
+        for peer in ("w0", "w1"):
+            assert w.breaker.state(peer) == CLOSED
+    # ... the open intervals are finished breaker.open spans, and the
+    # partition is a finished net.partition span.
+    names = [s["name"] for s in tracer.finished()]
+    assert "net.partition" in names
+    assert any(
+        s["name"] == "breaker.open" and s["attrs"].get("outcome") == "closed"
+        for s in tracer.finished()
+    )
+    # ... and the adaptive retry loop backed off while stranded.
+    backoff = reg.snapshot().get("gol_retry_backoff_seconds")
+    assert backoff is not None and backoff["count"] >= 1
+
+
+def test_degraded_mode_checkpoints_waits_and_heals(tmp_path):
+    """A partition that strands every tile past stuck_timeout_s flips the
+    frontend into degraded mode: recovery source made durable, redeploy/
+    auto-down suppressed, and a clean resume on heal (still bit-identical)."""
+    epochs = 60
+    reg = _registry()
+    tracer = Tracer(seed=0)
+    cfg = SimulationConfig(
+        height=48, width=48, seed=23, max_epochs=epochs,
+        tick_s=0.02, start_delay_s=0.01, flight_dir="",
+        retry_s=0.05, retry_max_s=0.5,
+        breaker_failures=2, breaker_cooldown_s=0.1,
+        stuck_timeout_s=0.35,  # degrade fast once the wire is cut
+        checkpoint_dir=str(tmp_path),  # "checkpoint what it has" target
+        net_chaos=NetworkChaosConfig(enabled=True, seed=9, scope="peer"),
+    )
+    with cluster(cfg, 2, registry=reg, tracer=tracer) as h:
+        assert h.frontend.wait_for_backends(timeout=10)
+        h.frontend.start_simulation()
+        _wait(
+            lambda: min(h.frontend.tile_epochs.values(), default=0) >= 6,
+            30, "pre-partition progress",
+        )
+        h.netchaos.start_partition(("w0",), ("w1",), heal_s=30.0)
+        _wait(lambda: h.frontend.degraded, 15, "degraded entry")
+        assert reg.value("gol_degraded_mode") == 1
+        # Degraded means wait, not thrash: no redeploys, members alive.
+        assert reg.value("gol_redeploys_total") == 0
+        assert len(h.frontend.membership.alive_members()) == 2
+        # "Checkpoint what it has": the recovery source became durable.
+        _wait(
+            lambda: h.frontend.store.latest_epoch() is not None,
+            15, "degraded checkpoint",
+        )
+        h.netchaos.heal()
+        _wait(lambda: not h.frontend.degraded, 30, "degraded exit")
+        assert reg.value("gol_degraded_mode") == 0
+
+        assert h.frontend.done.wait(60), "cluster did not finish after heal"
+        assert h.frontend.error is None, h.frontend.error
+        final = h.frontend.final_board
+
+    np.testing.assert_array_equal(final, _oracle(cfg, epochs))
+    assert reg.value("gol_degraded_entries_total") == 1
+    assert reg.value("gol_redeploys_total") == 0  # never thrashed
+    spans = [s for s in tracer.finished() if s["name"] == "cluster.degraded"]
+    assert len(spans) == 1 and spans[0]["attrs"]["healed"] is True
+
+
+def test_lossy_wire_soak_converges(tmp_path):
+    """Probabilistic wire faults on the peer plane — drops, duplicates,
+    reorders, delays all at once — and the run still converges exactly:
+    the retry loop re-pulls what vanished, ring pushes are idempotent, and
+    epoch tags make reordering harmless."""
+    epochs = 40
+    reg = _registry()
+    cfg = SimulationConfig(
+        height=32, width=32, seed=31, max_epochs=epochs, flight_dir="",
+        retry_s=0.05, retry_max_s=0.4,
+        net_chaos=NetworkChaosConfig(
+            enabled=True, seed=5, scope="peer",
+            drop_p=0.15, duplicate_p=0.1, reorder_p=0.1,
+            delay_p=0.1, delay_s=0.02,
+        ),
+    )
+    with cluster(cfg, 2, registry=reg, tracer=Tracer(seed=0)) as h:
+        final = h.run_to_completion(timeout=120)
+    np.testing.assert_array_equal(final, _oracle(cfg, epochs))
+    assert reg.value("gol_net_chaos_dropped_total") >= 1
+    assert reg.value("gol_peer_retries_total") >= 1

@@ -297,8 +297,12 @@ def test_every_span_name_is_documented():
     finally:
         sys.path.pop(0)
     emitted = check_trace_names.span_names_in_code()
-    # Sanity: the scan must see the acceptance names, or it passes vacuously.
-    for must in ("epoch", "backend.step", "halo.retry", "recover.redeploy"):
+    # Sanity: the scan must see the acceptance names — including the
+    # network-chaos/breaker families — or it passes vacuously.
+    for must in (
+        "epoch", "backend.step", "halo.retry", "recover.redeploy",
+        "net.partition", "breaker.open", "cluster.degraded",
+    ):
         assert must in emitted, must
     # The textual catalog parse matches the real module constant.
     assert check_trace_names.catalog_names() == {n for n, _ in SPAN_CATALOG}

@@ -1,0 +1,182 @@
+"""The wire protocol's own contract — frame round-trips, malformed-frame
+surfaces, size caps, tile payload helpers, and the send deadline.
+
+Every cluster behavior rides :mod:`runtime.wire`; until now it was tested
+only through the cluster suites.  These tests pin the layer's own edges:
+what a well-formed frame preserves, what a truncated/corrupt one surfaces
+(None for EOF, ValueError for malformation — the two signals the serve
+loops dispatch on), and that MAX_FRAME is enforced on BOTH directions."""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.runtime.wire import (
+    MAX_FRAME,
+    Channel,
+    attach_trace,
+    extract_trace,
+    pack_tile,
+    unpack_tile,
+)
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return Channel(a), Channel(b)
+
+
+def test_frame_round_trip_mixed_dtypes():
+    tx, rx = _pair()
+    msg = {
+        "type": "x",
+        "board": np.arange(12, dtype=np.uint8).reshape(3, 4),
+        "packed": np.array([1, 2**31, 7], dtype=np.uint32),
+        "counters": np.array([-5, 2**40], dtype=np.int64),
+        "f": np.array([[0.5, -1.25]], dtype=np.float64),
+        "nested": {"inner": [np.zeros((2, 2), dtype=np.uint8), "s", 3]},
+        "scalars": [np.int64(7), np.float32(0.5)],
+    }
+    tx.send(msg)
+    out = rx.recv()
+    assert out["type"] == "x"
+    for key in ("board", "packed", "counters", "f"):
+        np.testing.assert_array_equal(out[key], msg[key])
+        assert out[key].dtype == msg[key].dtype
+    np.testing.assert_array_equal(out["nested"]["inner"][0], np.zeros((2, 2)))
+    assert out["nested"]["inner"][1:] == ["s", 3]
+    # numpy scalars flatten to JSON numbers (documented encode behavior).
+    assert out["scalars"] == [7, 0.5]
+    tx.close()
+    assert rx.recv() is None  # clean EOF at a frame boundary
+
+
+def test_truncated_mid_frame_returns_none():
+    a, b = socket.socketpair()
+    rx = Channel(b)
+    # A valid header promising 100 payload bytes, then EOF after 10.
+    a.sendall(struct.pack("<BIH", 0x47, 100, 0) + b"x" * 10)
+    a.close()
+    assert rx.recv() is None
+
+
+def test_truncated_mid_blob_lengths_returns_none():
+    a, b = socket.socketpair()
+    rx = Channel(b)
+    # Header claims 2 blobs but EOF lands inside the length table.
+    a.sendall(struct.pack("<BIH", 0x47, 5, 2) + b"\x01\x02")
+    a.close()
+    assert rx.recv() is None
+
+
+def test_bad_magic_raises():
+    a, b = socket.socketpair()
+    rx = Channel(b)
+    a.sendall(struct.pack("<BIH", 0x13, 2, 0) + b"{}")
+    with pytest.raises(ValueError, match="magic"):
+        rx.recv()
+
+
+def test_malformed_payload_raises_valueerror():
+    # A blob reference pointing past the shipped blobs is a malformed FRAME
+    # (ValueError), not a KeyError/IndexError escaping into a serve loop.
+    a, b = socket.socketpair()
+    rx = Channel(b)
+    payload = b'{"arr": {"__blob__": 3, "dtype": "|u1", "shape": [1]}}'
+    a.sendall(struct.pack("<BIH", 0x47, len(payload), 0) + payload)
+    with pytest.raises(ValueError, match="malformed frame payload"):
+        rx.recv()
+
+
+def test_max_frame_enforced_on_send():
+    tx, _rx = _pair()
+    with pytest.raises(ValueError, match="exceeds MAX_FRAME"):
+        # Never allocated/sent: the size check sums blob lengths first.
+        tx.send({"big": np.zeros(MAX_FRAME + 1, dtype=np.uint8)})
+
+
+def test_max_frame_enforced_on_recv():
+    a, b = socket.socketpair()
+    rx = Channel(b)
+    # A tiny wire prefix CLAIMING an over-cap blob: recv must refuse before
+    # trying to allocate/read it.
+    hdr = struct.pack("<BIH", 0x47, 2, 1) + struct.pack("<Q", MAX_FRAME)
+    a.sendall(hdr)
+    with pytest.raises(ValueError, match="exceeds MAX_FRAME"):
+        rx.recv()
+
+
+def test_pack_tile_binary_bitpacks():
+    arr = (np.arange(64).reshape(8, 8) % 2).astype(np.uint8)
+    payload = pack_tile(arr)
+    assert payload["enc"] == "bits"
+    assert payload["data"].nbytes == 8  # 64 cells at 8 cells/byte
+    np.testing.assert_array_equal(unpack_tile(payload), arr)
+
+
+def test_pack_tile_binary_non_multiple_of_8():
+    arr = (np.arange(35).reshape(5, 7) % 2).astype(np.uint8)
+    np.testing.assert_array_equal(unpack_tile(pack_tile(arr)), arr)
+
+
+def test_pack_tile_multistate_rides_raw():
+    arr = (np.arange(30).reshape(5, 6) % 5).astype(np.uint8)
+    payload = pack_tile(arr)
+    assert payload["enc"] == "raw"
+    np.testing.assert_array_equal(unpack_tile(payload), arr)
+
+
+def test_pack_tile_round_trips_over_wire():
+    tx, rx = _pair()
+    arr = (np.arange(64).reshape(8, 8) % 3).astype(np.uint8)
+    tx.send({"state": pack_tile(arr)})
+    np.testing.assert_array_equal(unpack_tile(rx.recv()["state"]), arr)
+
+
+def test_attach_extract_trace_round_trip():
+    tx, rx = _pair()
+    msg = attach_trace({"type": "tick"}, {"trace_id": "t1", "span_id": "s1"})
+    tx.send(msg)
+    out = rx.recv()
+    assert extract_trace(out) == {"trace_id": "t1", "span_id": "s1"}
+    assert extract_trace({"type": "tick"}) is None
+    assert extract_trace({"_trace": "not-a-dict"}) is None
+
+
+def test_send_deadline_unblocks_wedged_send():
+    """A peer that never reads must not block send forever: with a deadline
+    the send raises an OSError within (roughly) the deadline."""
+    a, b = socket.socketpair()
+    # Tiny buffers so the wedge happens fast.
+    a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+    b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    tx = Channel(a, send_deadline_s=0.2)
+    assert tx.send_deadline_s == 0.2
+    msg = {"blob": np.zeros(1 << 22, dtype=np.uint8)}  # 4 MiB >> buffers
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        tx.send(msg)
+    assert time.monotonic() - t0 < 5.0
+    tx.close()
+    b.close()
+
+
+def test_send_without_deadline_completes_with_reader():
+    """The deadline-armed path still completes normal sends (a reader
+    draining concurrently)."""
+    a, b = socket.socketpair()
+    tx, rx = Channel(a, send_deadline_s=1.0), Channel(b)
+    out = {}
+    t = threading.Thread(target=lambda: out.setdefault("msg", rx.recv()))
+    t.start()
+    tx.send({"blob": np.ones(1 << 20, dtype=np.uint8)})
+    t.join(5)
+    assert not t.is_alive()
+    np.testing.assert_array_equal(
+        out["msg"]["blob"], np.ones(1 << 20, dtype=np.uint8)
+    )
+    tx.close()

@@ -1,16 +1,24 @@
 #!/usr/bin/env python3
-"""Lint: every metric name registered in code is documented.
+"""Lint: every metric name registered in code is documented AND cataloged.
 
 Scans ``akka_game_of_life_tpu/**/*.py`` for ``gol_*`` metric-name string
 literals (which covers the catalog AND any ad-hoc registration that bypasses
-it) and asserts each appears in ``docs/OPERATIONS.md``'s "Metrics & events"
-catalog — so the operator-facing doc cannot silently rot as instrumentation
-grows.  Driven by ``tests/test_metrics.py::test_every_metric_in_code_is_
+it) and asserts each appears in
+
+1. ``docs/OPERATIONS.md``'s "Metrics & events" catalog — so the
+   operator-facing doc cannot silently rot as instrumentation grows;
+2. ``obs/catalog.py``'s ``CATALOG`` tuple — so every name is pre-registered
+   and a scrape always shows the full metric surface, zeros included (an
+   ad-hoc registration that skips the catalog would only appear after its
+   path first fired).
+
+Driven by ``tests/test_metrics.py::test_every_metric_in_code_is_
 documented`` (tier-1), and runnable standalone:
 
     python tools/check_metrics_doc.py       # exit 1 + list when stale
 
-No third-party imports: usable before the environment is set up.
+No third-party imports, and the catalog is parsed textually (not imported):
+usable before the environment is set up.
 """
 
 from __future__ import annotations
@@ -22,6 +30,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 DOC = REPO / "docs" / "OPERATIONS.md"
 PACKAGE = REPO / "akka_game_of_life_tpu"
+CATALOG = PACKAGE / "obs" / "catalog.py"
 
 # A metric-name literal: the gol_ prefix is the package's namespace, so any
 # quoted gol_* identifier in the source IS a metric name (nothing else in
@@ -36,9 +45,19 @@ def metric_names_in_code() -> set:
     return names
 
 
+def catalog_names() -> set:
+    text = CATALOG.read_text(encoding="utf-8")
+    block = text.split("CATALOG = (", 1)[1].split("\n)\n", 1)[0]
+    return set(_METRIC_LITERAL.findall(block))
+
+
 def undocumented() -> set:
     doc = DOC.read_text(encoding="utf-8")
     return {name for name in metric_names_in_code() if name not in doc}
+
+
+def uncataloged() -> set:
+    return metric_names_in_code() - catalog_names()
 
 
 def main() -> int:
@@ -47,15 +66,26 @@ def main() -> int:
         print("check_metrics_doc: found NO gol_* metric literals — the scan "
               "is broken, not the doc", file=sys.stderr)
         return 2
+    rc = 0
     missing = sorted(undocumented())
     if missing:
         print(f"{len(missing)} metric(s) registered in code but missing "
               f"from {DOC.relative_to(REPO)}:", file=sys.stderr)
         for name in missing:
             print(f"  - {name}", file=sys.stderr)
-        return 1
-    print(f"check_metrics_doc: {len(names)} metric names all documented")
-    return 0
+        rc = 1
+    stray = sorted(uncataloged())
+    if stray:
+        print(f"{len(stray)} metric(s) registered in code but missing from "
+              f"obs/catalog.py CATALOG (add them so scrapes pre-register "
+              f"the full surface):", file=sys.stderr)
+        for name in stray:
+            print(f"  - {name}", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"check_metrics_doc: {len(names)} metric names all documented "
+              f"and cataloged")
+    return rc
 
 
 if __name__ == "__main__":
