@@ -165,6 +165,54 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--process-id", type=int)
 
 
+def _add_ring_plane(p: argparse.ArgumentParser) -> None:
+    """The halo data plane's wire-encoding knobs.  Every ``--ring-X`` flag
+    maps 1:1 onto ``SimulationConfig.ring_X`` (dashes to underscores) —
+    ``tools/check_ring_config.py`` lint-enforces the bijection.  Frontend
+    role only: the policy is cluster config, shipped to workers in WELCOME."""
+    g = p.add_argument_group(
+        "halo data plane",
+        "wire encoding of the worker-to-worker boundary-ring exchange "
+        "(see docs/OPERATIONS.md \"Wire format\")",
+    )
+    g.add_argument(
+        "--ring-pack",
+        choices=["on", "off"],
+        default=None,
+        help="bit-pack binary-rule boundary rings 32 cells/uint32 word on "
+        "the wire (~8x fewer payload bytes; default on; multi-state rules "
+        "always ride raw uint8)",
+    )
+    g.add_argument(
+        "--ring-batch",
+        choices=["on", "off"],
+        default=None,
+        help="coalesce all rings bound for one peer in an epoch/chunk into "
+        "a single PEER_RING_BATCH frame (default on; off = one frame per "
+        "ring, the reference's shape)",
+    )
+    g.add_argument(
+        "--ring-queue-depth",
+        type=int,
+        default=None,
+        metavar="N",
+        help="bound on each per-peer async send queue; a full queue drops "
+        "oldest entries (recovered by halo re-pulls) instead of blocking "
+        "the step loop",
+    )
+
+
+def _ring_plane_overrides(args: argparse.Namespace) -> dict:
+    """``--ring-*`` flags → SimulationConfig override kwargs (empty entries
+    are dropped by load_config's None filtering)."""
+    on_off = {"on": True, "off": False, None: None}
+    return {
+        "ring_pack": on_off[args.ring_pack],
+        "ring_batch": on_off[args.ring_batch],
+        "ring_queue_depth": args.ring_queue_depth,
+    }
+
+
 def _add_chaos_net(p: argparse.ArgumentParser) -> None:
     """The network chaos plane's knobs (``runtime/netchaos.py``).  Every
     ``--chaos-net-X`` flag maps 1:1 onto ``NetworkChaosConfig.X`` (dashes to
@@ -388,6 +436,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="boundary-ring width k: one peer exchange buys k local epochs "
         "per tile (communication-avoiding; cadences must be multiples of k)",
     )
+    fe_p.add_argument(
+        "--tiles-per-worker",
+        type=int,
+        default=None,
+        help="tile oversubscription: each worker hosts this many tiles "
+        "(default 1) — >1 gives the batched halo plane several rings per "
+        "peer per epoch to coalesce",
+    )
+    _add_ring_plane(fe_p)
     _add_chaos_net(fe_p)
 
     st_p = sub.add_parser(
@@ -560,6 +617,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             host=args.host,
             port=args.port,
             exchange_width=args.exchange_width,
+            tiles_per_worker=args.tiles_per_worker,
+            **_ring_plane_overrides(args),
             wait_for_backends_s=(
                 parse_duration(args.wait_for_backends)
                 if args.wait_for_backends is not None

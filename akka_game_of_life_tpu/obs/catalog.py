@@ -21,7 +21,13 @@ from __future__ import annotations
 
 from akka_game_of_life_tpu.obs.metrics import DEFAULT_BUCKETS, MetricsRegistry
 
-# (name, kind, help, labelnames) — histograms all use DEFAULT_BUCKETS.
+# Rings-per-frame buckets for gol_ring_batch_size: batch sizes are small
+# integer counts, not latencies, so the shared latency buckets would bin
+# everything into one bucket.
+RING_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+# (name, kind, help, labelnames[, buckets]) — histograms use DEFAULT_BUCKETS
+# unless an entry carries its own.
 CATALOG = (
     # -- simulation hot path (L3) --------------------------------------------
     ("gol_epochs_advanced_total", "counter",
@@ -55,7 +61,20 @@ CATALOG = (
     ("gol_gather_failures_total", "counter",
      "GATHER_FAILED escalations sent after the retry budget", ()),
     ("gol_ring_bytes_total", "counter",
-     "Boundary-ring payload bytes pushed to remote peers", ()),
+     "Boundary-ring payload bytes pushed to remote peers (dense cell "
+     "bytes, whatever the wire encoding)", ()),
+    ("gol_ring_packed_bytes_total", "counter",
+     "Boundary-ring bytes actually put on the wire (bit-packed for binary "
+     "rules when ring_pack is on; ratio to gol_ring_bytes_total is the "
+     "packing win)", ()),
+    ("gol_ring_batch_size", "histogram",
+     "Rings coalesced into each PEER_RING_BATCH frame (count = frames "
+     "sent)", (), RING_BATCH_BUCKETS),
+    ("gol_peer_send_queue_depth", "gauge",
+     "Entries queued in a peer's async send lane", ("peer",)),
+    ("gol_peer_send_queue_drops_total", "counter",
+     "Ring/ask entries dropped oldest-first by a full peer send queue "
+     "(recovered via halo re-pulls)", ()),
     ("gol_members_alive", "gauge", "Cluster members currently alive", ()),
     ("gol_members_joined_total", "counter", "Workers that ever joined", ()),
     ("gol_members_lost_total", "counter",
@@ -114,15 +133,17 @@ CATALOG = (
 
 def install(registry: MetricsRegistry) -> MetricsRegistry:
     """Pre-register every cataloged family into ``registry`` (idempotent)."""
-    for name, kind, help, labelnames in CATALOG:
+    for entry in CATALOG:
+        name, kind, help, labelnames = entry[:4]
         if kind == "counter":
             registry.counter(name, help, labelnames)
         elif kind == "gauge":
             registry.gauge(name, help, labelnames)
         else:
-            registry.histogram(name, help, labelnames, buckets=DEFAULT_BUCKETS)
+            buckets = entry[4] if len(entry) > 4 else DEFAULT_BUCKETS
+            registry.histogram(name, help, labelnames, buckets=buckets)
     return registry
 
 
 def names() -> tuple:
-    return tuple(n for n, _, _, _ in CATALOG)
+    return tuple(entry[0] for entry in CATALOG)

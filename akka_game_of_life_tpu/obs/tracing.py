@@ -61,7 +61,8 @@ SPAN_CATALOG = (
     ("member.lost", "node loss handled (eviction + orphaned-tile recovery)"),
     # -- cluster backend ------------------------------------------------------
     ("backend.step", "one tile chunk stepped on a worker"),
-    ("halo.send", "boundary ring pushed to remote peer owners"),
+    ("halo.send", "boundary ring encoded and queued for remote peer owners"),
+    ("halo.batch_send", "one coalesced PEER_RING_BATCH frame written to a peer"),
     ("halo.recv", "PEER_RING received and stored"),
     ("halo.serve", "PEER_PULL answered from the local ring store"),
     ("halo.retry", "stale-halo retry round (re-asks to missing rings' owners)"),

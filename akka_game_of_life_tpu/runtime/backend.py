@@ -47,7 +47,8 @@ import random
 import socket
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -66,8 +67,12 @@ from akka_game_of_life_tpu.runtime.netchaos import (
 from akka_game_of_life_tpu.runtime.tiles import Ring, TileId, TileLayout
 from akka_game_of_life_tpu.runtime.wire import (
     Channel,
+    decode_ring,
+    encode_ring,
     extract_trace,
     pack_tile,
+    ring_entry_nbytes,
+    split_ring_batches,
     unpack_tile,
 )
 
@@ -311,6 +316,222 @@ def _np_chunk(padded: np.ndarray, steps: int, halo: int, rule: Rule) -> np.ndarr
     return out[m : m + h, m : m + w]
 
 
+# Batch linger: a pending outbound ring batch that has not been sealed by
+# its expected contributors (tiles redeployed away, catch-up replay at mixed
+# epochs) flushes after this long.  A backstop, not the steady-state path —
+# in steady state the LAST contributing tile's publish seals the batch with
+# zero added latency — and even a wedged batch self-heals through the
+# receiver's PEER_PULL re-asks (our rings are always in our local store).
+_RING_LINGER_S = 0.02
+
+
+class _PeerSender:
+    """One peer's async outbound lane: a bounded queue drained by a writer
+    thread, so ``_publish_ring`` never blocks the step loop on a slow
+    socket, a connect timeout, or a chaos-blocked link.
+
+    Ring entries coalesce: entries for one epoch accumulate into a pending
+    batch that *seals* (becomes one PEER_RING_BATCH frame) when every local
+    tile known to border this peer has contributed, when an entry for a
+    different epoch arrives, or after ``_RING_LINGER_S`` — whichever comes
+    first.  Control messages (PEER_PULL asks, unbatched rings) bypass the
+    pending batch but share the queue, the depth bound, and the writer.
+
+    The writer composes with the rest of the hardened stack unchanged: the
+    per-peer circuit breaker gates each drain, the channel may be a
+    ``ChaosChannel`` (partition blocks raise here, on the writer — never on
+    a compute thread), and a send deadline surfaces as the same ``OSError``
+    drop-and-redial path."""
+
+    def __init__(self, worker: "BackendWorker", owner: str) -> None:
+        self.worker = worker
+        self.owner = owner
+        self._cond = threading.Condition()
+        # ("batch", [entry, ...]) | ("msg", dict) — sealed, ready to send.
+        self._items: Deque[Tuple[str, object]] = deque()
+        self._pending: List[dict] = []
+        self._pending_tiles: set = set()
+        self._expect: set = set()
+        self._pending_epoch: Optional[int] = None
+        self._pending_since = 0.0
+        self._depth = 0  # running entry count (pending + items), O(1) trim
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"peer-send-{owner}"
+        )
+        self._thread.start()
+
+    # -- producer side (compute/serve threads; never touches the socket) -----
+
+    def enqueue_msg(self, msg: dict) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._items.append(("msg", msg))
+            self._depth += 1
+            self._trim_locked()
+            self._cond.notify()
+
+    def enqueue_ring(self, entry: dict, expect) -> None:
+        """Add one encoded ring entry to the peer's building batch.
+        ``expect`` is the set of local tiles currently bordering this peer —
+        the seal condition that gives full batches with zero added latency
+        in steady state."""
+        with self._cond:
+            if self._closed:
+                return
+            epoch = entry["epoch"]
+            if self._pending and epoch != self._pending_epoch:
+                self._seal_locked()
+            if not self._pending:
+                self._pending_epoch = epoch
+                self._expect = set(expect)
+                self._pending_since = time.monotonic()
+            self._pending.append(entry)
+            self._pending_tiles.add(tuple(entry["tile"]))
+            self._depth += 1
+            if self._pending_tiles >= self._expect:
+                self._seal_locked()
+            self._trim_locked()
+            self._cond.notify()
+
+    def _seal_locked(self) -> None:
+        if self._pending:
+            self._items.append(("batch", self._pending))
+            self._pending = []
+            self._pending_tiles = set()
+            self._pending_epoch = None
+
+    def _trim_locked(self) -> None:
+        """Bounded queue, drop-OLDEST: a wedged peer must not grow worker
+        memory, and anything dropped is recoverable — the receiver's retry
+        loop re-asks via PEER_PULL and our rings stay in the local store.
+        ``_depth`` is a running counter so the hot enqueue path stays O(1)
+        even when the queue is full (the wedged-peer case is exactly when
+        an O(queue) rescan per publish would hurt most)."""
+        w = self.worker
+        while self._depth > w.ring_queue_depth and self._items:
+            kind, payload = self._items.popleft()
+            dropped = len(payload) if kind == "batch" else 1
+            self._depth -= dropped
+            w._m_queue_drops.inc(dropped)
+        w._m_queue_depth.labels(peer=self.owner).set(self._depth)
+
+    # -- writer side ----------------------------------------------------------
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            # Gauge hygiene (the breaker-reset discipline): a departed
+            # peer must not leave a stale non-zero queue-depth series.
+            # Under the condition lock, and mirrored by the writer's own
+            # exit path — whichever runs last leaves the series at 0.
+            self.worker._m_queue_depth.labels(peer=self.owner).set(0)
+            self._cond.notify()
+
+    def _run(self) -> None:
+        w = self.worker
+        while True:
+            with self._cond:
+                while not self._items:
+                    if self._closed or w._stop.is_set():
+                        w._m_queue_depth.labels(peer=self.owner).set(0)
+                        return
+                    timeout = 0.2  # poll _stop even if nobody notifies
+                    if self._pending:
+                        timeout = (
+                            self._pending_since + _RING_LINGER_S
+                            - time.monotonic()
+                        )
+                        if timeout <= 0:
+                            self._seal_locked()
+                            break
+                        timeout = min(timeout, 0.2)
+                    self._cond.wait(timeout)
+                items = list(self._items)
+                self._items.clear()
+                self._depth = len(self._pending)
+                w._m_queue_depth.labels(peer=self.owner).set(self._depth)
+            self._send(items)
+
+    @staticmethod
+    def _coalesce_pulls(
+        items: List[Tuple[str, object]]
+    ) -> List[Tuple[str, object]]:
+        """Merge queued PEER_PULL asks for the same epoch into one frame —
+        the ask-side analog of ring batching.  When several local tiles go
+        stale on the same peer in the same chunk (the common case: they all
+        wait on one in-flight batch), the drain sends O(epochs) ask frames
+        instead of O(tiles)."""
+        merged: List[Tuple[str, object]] = []
+        pulls: Dict[int, dict] = {}
+        for kind, payload in items:
+            if (
+                kind == "msg"
+                and isinstance(payload, dict)
+                and payload.get("type") == P.PEER_PULL
+            ):
+                tiles = [
+                    list(t)
+                    for t in (payload.get("tiles") or [payload["tile"]])
+                ]
+                epoch = int(payload["epoch"])
+                m = pulls.get(epoch)
+                if m is None:
+                    m = {"type": P.PEER_PULL, "tiles": tiles, "epoch": epoch}
+                    pulls[epoch] = m
+                    merged.append(("msg", m))
+                else:
+                    seen = {tuple(t) for t in m["tiles"]}
+                    m["tiles"].extend(
+                        t for t in tiles if tuple(t) not in seen
+                    )
+                continue
+            merged.append((kind, payload))
+        return merged
+
+    def _send(self, items: List[Tuple[str, object]]) -> None:
+        w = self.worker
+        items = self._coalesce_pulls(items)
+        # Breaker first: a dead/partitioned peer costs one state read per
+        # drain, not a connect timeout — the retry loop (backoff) and the
+        # breaker's own half-open probes are the only traffic re-testing it.
+        if not w.breaker.allow(self.owner):
+            return
+        ch = w._peer_channel(self.owner)
+        if ch is None:
+            w.breaker.failure(self.owner)
+            return
+        try:
+            for kind, payload in items:
+                if kind == "batch":
+                    for frame in split_ring_batches(payload):
+                        with w.tracer.span(
+                            "halo.batch_send", parent=w._trace_ctx,
+                            node=w.name or "backend", peer=self.owner,
+                            rings=len(frame),
+                        ):
+                            ch.send(
+                                {"type": P.PEER_RING_BATCH, "rings": frame}
+                            )
+                        w._m_batch_size.observe(len(frame))
+                        w._m_sends.inc()
+                else:
+                    ch.send(payload)
+                    w._m_sends.inc()
+            w.breaker.success(self.owner)
+        except (OSError, ValueError):
+            # OSError: stale address, dead peer, partition, send deadline.
+            # ValueError: Channel.send's MAX_FRAME backstop — same
+            # dead-channel class the serve loops treat it as; either way,
+            # NEVER let it escape and kill this writer thread (a dead lane
+            # would silently eat every future send to this peer).  Drop
+            # the rest of this drain; OWNERS rewiring + the retry loop's
+            # PEER_PULLs recover anything the peer still needs.
+            w._drop_peer(self.owner)
+            w.breaker.failure(self.owner)
+
+
 def _ring_msg(tid: TileId, epoch: int, ring: Ring) -> dict:
     return {
         "type": P.PEER_RING,
@@ -352,6 +573,9 @@ class BackendWorker:
         breaker_failures: int = 3,
         breaker_cooldown_s: float = 2.0,
         send_deadline_s: float = 0.0,
+        ring_pack: bool = True,
+        ring_batch: bool = True,
+        ring_queue_depth: int = 1024,
         peer_host: str = "0.0.0.0",
         crash_hook: Optional[Callable[[], None]] = None,
         registry=None,
@@ -383,6 +607,12 @@ class BackendWorker:
         self.retry_max_s = max(retry_s, retry_max_s)
         self.max_pull_retries = max_pull_retries
         self.send_deadline_s = send_deadline_s
+        # Halo-plane wire policy (cluster config, overridden by WELCOME):
+        # bit-pack binary rings on the wire, coalesce per-peer batches, and
+        # bound each peer's async send queue.
+        self.ring_pack = ring_pack
+        self.ring_batch = ring_batch
+        self.ring_queue_depth = max(1, int(ring_queue_depth))
         # Decorrelated-jitter draws; reseeded per worker name in connect()
         # so a seeded cluster run's retry timing is reproducible per node.
         self._retry_rng = random.Random(f"retry:{name}")
@@ -413,6 +643,22 @@ class BackendWorker:
         self._m_gather_failures = reg.counter("gol_gather_failures_total")
         self._m_ring_bytes = reg.counter("gol_ring_bytes_total")
         self._m_backoff = reg.histogram("gol_retry_backoff_seconds")
+        # Halo wire-plane accounting: actual encoded bytes enqueued for the
+        # wire (vs gol_ring_bytes_total's dense cell bytes — the packed/raw
+        # ratio IS the packing win), rings per coalesced frame, and the
+        # per-peer async queue's live depth / overflow drops.
+        from akka_game_of_life_tpu.obs.catalog import RING_BATCH_BUCKETS
+
+        self._m_packed_bytes = reg.counter("gol_ring_packed_bytes_total")
+        self._m_batch_size = reg.histogram(
+            "gol_ring_batch_size", buckets=RING_BATCH_BUCKETS
+        )
+        self._m_queue_depth = reg.gauge(
+            "gol_peer_send_queue_depth",
+            "Entries queued in a peer's async send lane",
+            ("peer",),
+        )
+        self._m_queue_drops = reg.counter("gol_peer_send_queue_drops_total")
         self.breaker = CircuitBreaker(
             failures=breaker_failures,
             cooldown_s=breaker_cooldown_s,
@@ -459,6 +705,17 @@ class BackendWorker:
         self.owners: Dict[TileId, Tuple[str, str, int]] = {}
         self._peers: Dict[str, Channel] = {}  # dialed, by owner name
         self._peer_lock = threading.Lock()
+        # One async outbound lane per peer (bounded queue + writer thread);
+        # created on first send to an owner, closed on stop/rewiring.
+        self._senders: Dict[str, _PeerSender] = {}
+        self._sender_lock = threading.Lock()
+        # Publish-path cache, invariant between OWNERS/DEPLOY changes:
+        # per local tile its remote owners, and per remote owner the set of
+        # local tiles bordering it (the batch-seal expectation).  Rebuilt
+        # lazily; None = stale.  Guarded by self._lock.
+        self._owner_map: Optional[
+            Tuple[Dict[TileId, List[str]], Dict[str, set]]
+        ] = None
         self._peer_listener = socket.create_server((peer_host, 0))
         self.peer_port = self._peer_listener.getsockname()[1]
         threading.Thread(target=self._peer_accept_loop, daemon=True).start()
@@ -512,6 +769,12 @@ class BackendWorker:
             self.breaker.cooldown_s = float(welcome["breaker_cooldown_s"])
         if "send_deadline_s" in welcome:
             self.send_deadline_s = float(welcome["send_deadline_s"])
+        if "ring_pack" in welcome:
+            self.ring_pack = bool(welcome["ring_pack"])
+        if "ring_batch" in welcome:
+            self.ring_batch = bool(welcome["ring_batch"])
+        if "ring_queue_depth" in welcome:
+            self.ring_queue_depth = max(1, int(welcome["ring_queue_depth"]))
         self._retry_rng = random.Random(f"retry:{self.name}")
         self.breaker.node = self.name or "backend"
         if isinstance(self.channel, ChaosChannel):
@@ -570,6 +833,11 @@ class BackendWorker:
             self._peer_listener.close()
         except OSError:
             pass
+        with self._sender_lock:
+            senders = list(self._senders.values())
+            self._senders.clear()
+        for s in senders:
+            s.close()
         with self._peer_lock:
             for ch in self._peers.values():
                 ch.close()
@@ -603,8 +871,28 @@ class BackendWorker:
                 if msg is None:
                     return
                 self._on_peer_msg(msg, channel)
-        except (OSError, ValueError):
+        except OSError:
             pass
+        except ValueError as e:
+            # Malformed frame or un-decodable ring entry (the mixed-version
+            # case): the fail-LOUD contract — name the reason and kill the
+            # link, so the far end sees a dropped peer (breaker, re-dial)
+            # instead of a silently deaf socket nobody reads.
+            print(
+                f"{self.name or 'backend'}: dropping peer channel: {e}",
+                flush=True,
+            )
+            with self._peer_lock:
+                owner = next(
+                    (k for k, v in self._peers.items() if v is channel), None
+                )
+            if owner is not None:
+                self._drop_peer(owner)
+            else:
+                try:
+                    channel.close()
+                except OSError:
+                    pass
 
     def _on_peer_msg(self, msg: dict, channel: Channel) -> None:
         kind = msg.get("type")
@@ -622,6 +910,11 @@ class BackendWorker:
         elif kind == P.PEER_RING:
             self._m_receives.inc()
             if self.store is not None:
+                ring = (
+                    decode_ring(msg["ring"])
+                    if "ring" in msg
+                    else _ring_of_msg(msg)
+                )
                 # push_ring fires queued local pull callbacks (_apply_halo),
                 # so the span also covers any tile chunks this ring unblocks.
                 with self.tracer.span(
@@ -630,28 +923,84 @@ class BackendWorker:
                     epoch=int(msg["epoch"]),
                 ):
                     self.store.push_ring(
-                        tuple(msg["tile"]), int(msg["epoch"]), _ring_of_msg(msg)
+                        tuple(msg["tile"]), int(msg["epoch"]), ring
                     )
+        elif kind == P.PEER_RING_BATCH:
+            entries = msg.get("rings") or []
+            if not entries or self.store is None:
+                return  # an empty batch frame is a no-op, not an error
+            self._m_receives.inc(len(entries))
+            # Decode + store the WHOLE batch before any unblocked tile
+            # steps (push_rings fires callbacks after the last store), so
+            # dependent tiles step back-to-back and their outbound rings
+            # coalesce in turn.  A malformed entry raises ValueError —
+            # the serve loop drops the peer connection, loudly.
+            items = [
+                (tuple(e["tile"]), int(e["epoch"]), decode_ring(e["ring"]))
+                for e in entries
+            ]
+            with self.tracer.span(
+                "halo.recv", parent=self._trace_ctx,
+                node=self.name or "backend", rings=len(items),
+                epoch=items[0][1],
+            ):
+                self.store.push_rings(items)
         elif kind == P.PEER_PULL:
-            # Serve every ring we have from the asked epoch forward: a
-            # redeployed neighbor replaying from a checkpoint streams its
-            # whole catch-up window in one exchange instead of one
-            # round-trip per epoch.
-            tile, epoch = tuple(msg["tile"]), int(msg["epoch"])
-            rings = self.store.rings_from(tile, epoch) if self.store else []
-            if not rings:
+            # Serve every ring we have from the asked epoch forward, for
+            # EVERY tile the peer asks about (one frame asks a whole
+            # neighborhood): a redeployed neighbor replaying from a
+            # checkpoint streams its catch-up window in one exchange
+            # instead of one round-trip per tile per epoch.
+            epoch = int(msg["epoch"])
+            tiles = [tuple(t) for t in (msg.get("tiles") or [msg["tile"]])]
+            if self.store is None:
                 return
+            served: List[Tuple[TileId, int, Ring]] = []
+            for tile in tiles:
+                served.extend(
+                    (tile, e, ring) for e, ring in self.store.rings_from(tile, epoch)
+                )
+            if not served:
+                return
+            pack = (
+                self.ring_pack and self.rule is not None and self.rule.is_binary
+            )
             with self.tracer.span(
                 "halo.serve", parent=self._trace_ctx,
-                node=self.name or "backend", tile=str(tile), epoch=epoch,
-                rings=len(rings),
+                node=self.name or "backend", tiles=len(tiles), epoch=epoch,
+                rings=len(served),
             ):
-                for e, ring in rings:
-                    try:
-                        channel.send(_ring_msg(tile, e, ring))
-                        self._m_sends.inc()
-                    except OSError:
-                        return
+                try:
+                    if self.ring_batch:
+                        entries = [
+                            {
+                                "tile": list(tile),
+                                "epoch": e,
+                                "ring": encode_ring(ring, pack),
+                            }
+                            for tile, e, ring in served
+                        ]
+                        for frame in split_ring_batches(entries):
+                            channel.send(
+                                {"type": P.PEER_RING_BATCH, "rings": frame}
+                            )
+                            self._m_batch_size.observe(len(frame))
+                            self._m_sends.inc()
+                    else:
+                        for tile, e, ring in served:
+                            channel.send(
+                                {
+                                    "type": P.PEER_RING,
+                                    "tile": list(tile),
+                                    "epoch": e,
+                                    "ring": encode_ring(ring, pack),
+                                }
+                                if pack
+                                else _ring_msg(tile, e, ring)
+                            )
+                            self._m_sends.inc()
+                except OSError:
+                    return
 
     def _peer_channel(self, owner: str) -> Optional[Channel]:
         """The dialed channel to a peer worker, connecting on first use."""
@@ -696,25 +1045,33 @@ class BackendWorker:
         with self._lock:
             return {name: (host, port) for name, host, port in self.owners.values()}
 
+    def _sender(self, owner: str) -> Optional[_PeerSender]:
+        """The async outbound lane to a peer, created on first use — or
+        None for an owner no longer in the wiring.  The membership check
+        runs INSIDE the creation critical section: a publish that
+        snapshotted its owner set just before an OWNERS rewiring must not
+        resurrect the departed peer's lane after the rewiring reaped it
+        (leaked writer thread + gauge series dialing a stale address).
+        Lock order _sender_lock → worker lock is acyclic: no path holds
+        the worker lock while taking _sender_lock."""
+        with self._sender_lock:
+            s = self._senders.get(owner)
+            if s is None:
+                with self._lock:
+                    known = {name for name, _, _ in self.owners.values()}
+                if known and owner not in known:
+                    return None
+                s = self._senders[owner] = _PeerSender(self, owner)
+            return s
+
     def _send_peer(self, owner: str, msg: dict) -> None:
-        # Breaker first: a dead/partitioned peer costs one state read here,
-        # not a connect timeout — the retry loop (backoff) and the breaker's
-        # own half-open probes are the only traffic that re-tests it.
-        if not self.breaker.allow(owner):
-            return
-        ch = self._peer_channel(owner)
-        if ch is None:
-            self.breaker.failure(owner)
-            return
-        try:
-            ch.send(msg)
-            self._m_sends.inc()
-            self.breaker.success(owner)
-        except OSError:
-            # Stale address, dead peer, partition, or send deadline: drop;
-            # OWNERS rewiring + the retry loop's PEER_PULLs recover.
-            self._drop_peer(owner)
-            self.breaker.failure(owner)
+        """Queue a control message for ``owner``'s writer thread.  Never
+        touches the socket: dialing, the circuit breaker, and failure
+        handling all run on the peer's writer (``_PeerSender._send``), so
+        no compute or serve thread can block on a wedged link."""
+        s = self._sender(owner)
+        if s is not None:
+            s.enqueue_msg(msg)
 
     # -- helper threads ------------------------------------------------------
 
@@ -877,6 +1234,7 @@ class BackendWorker:
                 del self.tiles[tid]
                 self._actor_engines.pop(tid, None)
                 dropped.append(tid)
+            self._owner_map = None  # wiring changed: publish cache is stale
         if dropped and self.store is not None:
             for tid in dropped:
                 self.store.drop_pending_for_owner([tid])
@@ -889,6 +1247,14 @@ class BackendWorker:
             owner_names = {name for name, _, _ in self.owners.values()}
         for peer in set(self.breaker.peers()) - owner_names:
             self.breaker.reset(peer)
+        # Same hygiene for the async send lanes: a departed peer's writer
+        # thread (and anything still queued for it) must not outlive the
+        # wiring that named it.
+        with self._sender_lock:
+            gone = [o for o in self._senders if o not in owner_names]
+            senders = [self._senders.pop(o) for o in gone]
+        for s in senders:
+            s.close()
 
     def _on_deploy(self, msg: dict) -> None:
         outbound: List[Tuple[TileId, np.ndarray, int]] = []
@@ -977,6 +1343,7 @@ class BackendWorker:
 
                     self._actor_engines[tid] = NativeActorTileEngine(rule)
                 outbound.append((tid, tile.arr, tile.epoch))
+            self._owner_map = None  # tiles (re)deployed: publish cache is stale
         for tid, arr, epoch in outbound:
             # Announce our boundary at the deployed epoch so neighbors can
             # assemble their halos (History seeding, CellActor.scala:34).
@@ -992,6 +1359,7 @@ class BackendWorker:
             if tid in self.tiles:
                 del self.tiles[tid]
             self._actor_engines.pop(tid, None)
+            self._owner_map = None  # tile dropped: publish cache is stale
         try:
             self.channel.send({"type": P.REDEPLOY_REQUEST, "tile": list(tid)})
         except OSError:
@@ -1052,25 +1420,22 @@ class BackendWorker:
                 return
 
     def _ask_missing(self, tid: TileId, epoch: int) -> None:
-        asks: List[Tuple[str, dict]] = []
+        # One PEER_PULL frame per owner, carrying EVERY missing tile of
+        # that owner — the ask side of the coalescing contract (replies
+        # batch the same way), so a stale neighborhood costs O(peers)
+        # frames, not O(missing rings).
+        asks: Dict[str, List[list]] = {}
         with self._lock:
             if self.store is None:
                 return
             for ntile in self.store.missing_neighbor_rings(tid, epoch):
                 entry = self.owners.get(ntile)
                 if entry is not None and entry[0] != self.name:
-                    asks.append(
-                        (
-                            entry[0],
-                            {
-                                "type": P.PEER_PULL,
-                                "tile": list(ntile),
-                                "epoch": epoch,
-                            },
-                        )
-                    )
-        for owner, msg in asks:
-            self._send_peer(owner, msg)
+                    asks.setdefault(entry[0], []).append(list(ntile))
+        for owner, tiles in asks.items():
+            self._send_peer(
+                owner, {"type": P.PEER_PULL, "tiles": tiles, "epoch": epoch}
+            )
 
     def _on_halo_ready(self, tid: TileId, epoch: int, halo: Halo) -> None:
         """Queued-pull completion, on whichever thread pushed the last ring."""
@@ -1125,45 +1490,92 @@ class BackendWorker:
         self._report_state(tid, arr, epoch_now)
         return True
 
+    def _owner_rings_locked(self, tid: TileId) -> Tuple[List[str], Dict[str, set]]:
+        """For one publishing tile: the distinct remote owners of its 8
+        neighbors, plus — per remote owner — the set of ALL local tiles
+        bordering that owner (the batch-seal expectation).  Served from a
+        cache invalidated on OWNERS/DEPLOY/tile changes — the map is
+        invariant between rewirings, and the publish path runs once per
+        tile per chunk under the worker lock.  Caller holds the lock."""
+        if self.layout is None:
+            return [], {}
+        if self._owner_map is None:
+            by_tile: Dict[TileId, List[str]] = {}
+            expect: Dict[str, set] = {}
+            for t in self.tiles:
+                remote = {
+                    self.owners[ntile][0]
+                    for ntile in self.layout.neighbors(t).values()
+                    if ntile in self.owners
+                    and self.owners[ntile][0] != self.name
+                }
+                by_tile[t] = sorted(remote)
+                for owner in remote:
+                    expect.setdefault(owner, set()).add(t)
+            self._owner_map = (by_tile, expect)
+        by_tile, expect = self._owner_map
+        return by_tile.get(tid, []), expect
+
     def _publish_ring(self, tid: TileId, arr: np.ndarray, epoch: int) -> None:
         """Store our ring locally (answers our own and co-located pulls) and
-        push it to each distinct remote owner among the tile's 8 neighbors —
-        the direct neighbor-to-neighbor data plane.  Takes an (arr, epoch)
-        snapshot captured under the worker lock, never the live tile."""
+        queue it for each distinct remote owner among the tile's 8 neighbors
+        — the direct neighbor-to-neighbor data plane.  Takes an (arr, epoch)
+        snapshot captured under the worker lock, never the live tile.
+
+        Hot-path shape: the ring is encoded ONCE (bit-packed for binary
+        rules when ring_pack is on), the owner set and payload accounting
+        are computed once per publish, and the per-owner loop only enqueues
+        onto async sender lanes — no socket work, no re-encoding, no
+        blocking on a slow peer."""
         ring = Ring.of(arr, self.exchange_width)
         if self.store is not None:
             self.store.push_ring(tid, epoch, ring)
         with self._lock:
-            remote_owners = sorted(
-                {
-                    self.owners[ntile][0]
-                    for ntile in self.layout.neighbors(tid).values()
-                    if ntile in self.owners and self.owners[ntile][0] != self.name
-                }
-                if self.layout is not None
-                else set()
-            )
-        msg = _ring_msg(tid, epoch, ring)
-        if remote_owners:
-            # Wire-cost accounting (the Casper data-movement signal at the
-            # cluster layer): payload array bytes per remote copy pushed.
-            payload = (
-                ring.top.nbytes
-                + ring.bottom.nbytes
-                + ring.left.nbytes
-                + ring.right.nbytes
-                + sum(np.asarray(c).nbytes for c in ring.corners.values())
-            )
-            self._m_ring_bytes.inc(payload * len(remote_owners))
-            with self.tracer.span(
-                "halo.send", parent=self._trace_ctx,
-                node=self.name or "backend", tile=str(tid), epoch=epoch,
-                peers=len(remote_owners), bytes=payload * len(remote_owners),
-            ):
+            remote_owners, expect = self._owner_rings_locked(tid)
+        if not remote_owners:
+            self._progress_ping(tid, epoch)
+            return
+        pack = self.ring_pack and self.rule is not None and self.rule.is_binary
+        # Wire-cost accounting (the Casper data-movement signal at the
+        # cluster layer): dense cell bytes AND actual encoded wire bytes
+        # per remote copy — their ratio is the packing win.  The raw
+        # unbatched baseline ships the legacy per-field message, so its
+        # wire bytes ARE the dense bytes and nothing needs encoding — the
+        # A/B baseline must not pay a concatenate+copy it never sends.
+        if pack or self.ring_batch:
+            enc = encode_ring(ring, pack)
+            wire = ring_entry_nbytes(enc)
+        else:
+            enc, wire = None, ring.nbytes
+        self._m_ring_bytes.inc(ring.nbytes * len(remote_owners))
+        self._m_packed_bytes.inc(wire * len(remote_owners))
+        with self.tracer.span(
+            "halo.send", parent=self._trace_ctx,
+            node=self.name or "backend", tile=str(tid), epoch=epoch,
+            peers=len(remote_owners), bytes=wire * len(remote_owners),
+        ):
+            if self.ring_batch:
+                entry = {"tile": list(tid), "epoch": epoch, "ring": enc}
+                for owner in remote_owners:
+                    s = self._sender(owner)
+                    if s is not None:  # departed between snapshot and here
+                        s.enqueue_ring(entry, expect.get(owner, ()))
+            else:
+                # Frame-per-ring mode (the reference's wire shape, kept for
+                # A/B measurement): still async, still encoded at most once.
+                msg = (
+                    {"type": P.PEER_RING, "tile": list(tid), "epoch": epoch,
+                     "ring": enc}
+                    if pack
+                    else _ring_msg(tid, epoch, ring)
+                )
                 for owner in remote_owners:
                     self._send_peer(owner, msg)
-        # Control-plane progress ping (no arrays): feeds the frontend's
-        # prune floor, stuck detection, and lag accounting.
+        self._progress_ping(tid, epoch)
+
+    def _progress_ping(self, tid: TileId, epoch: int) -> None:
+        """Control-plane progress ping (no arrays): feeds the frontend's
+        prune floor, stuck detection, and lag accounting."""
         try:
             self.channel.send(
                 {"type": P.PROGRESS, "tile": list(tid), "epoch": epoch}

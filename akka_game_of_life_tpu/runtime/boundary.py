@@ -76,11 +76,23 @@ class BoundaryStore:
 
     def push_ring(self, tile: TileId, epoch: int, ring: Ring) -> None:
         """Store a ring; answer any queued pulls it completes."""
+        self.push_rings([(tile, epoch, ring)])
+
+    def push_rings(self, items: List[Tuple[TileId, int, Ring]]) -> None:
+        """Store a whole batch of rings under ONE lock acquisition, then
+        answer the queued pulls the batch completes.  Callbacks fire only
+        after every ring of the batch is stored: a coalesced PEER_RING_BATCH
+        unblocks all its dependent tiles at once, so their steps (and the
+        outbound rings those produce) run back-to-back — which is exactly
+        what lets the sender's next batch fill up."""
         ready: List[Tuple[Callable[[Halo], None], Halo]] = []
         with self._lock:
-            self._rings[(tile, epoch)] = ring
+            epochs = set()
+            for tile, epoch, ring in items:
+                self._rings[(tile, epoch)] = ring
+                epochs.add(epoch)
             for (want_tile, want_epoch), callbacks in list(self._pending.items()):
-                if want_epoch != epoch:
+                if want_epoch not in epochs:
                     continue
                 halo = self._assemble_locked(want_tile, want_epoch)
                 if halo is not None:

@@ -239,6 +239,30 @@ class SimulationConfig:
     # Requires every observation cadence to land on chunk boundaries:
     # render/metrics/checkpoint cadences must be multiples of this.
     exchange_width: int = 1
+    # Tile oversubscription: each worker hosts this many tiles (the tile
+    # grid has n_workers * tiles_per_worker tiles, assigned round-robin).
+    # >1 gives the coalescing data plane multiple rings per peer per epoch
+    # to batch, and gives node-loss recovery finer redistribution units.
+    tiles_per_worker: int = 1
+    # -- halo data-plane wire encoding (frontend-owned cluster policy,
+    # shipped to every worker in WELCOME like the retry/breaker policy) --
+    # ring_pack: binary-rule boundary rings bit-pack 32 cells per uint32
+    # word before hitting the wire (~8x fewer payload bytes); multi-state
+    # rules always ride raw uint8 regardless.  The receiver decodes by the
+    # entry's self-describing encoding, so this only controls senders.
+    ring_pack: bool = True
+    # ring_batch: coalesce every ring bound for one peer in an epoch/chunk
+    # into a single PEER_RING_BATCH frame (PEER_PULL replies batch the same
+    # way), collapsing frame+JSON overhead from O(tiles x epochs x peers)
+    # to O(peers x chunks).  Off = one PEER_RING frame per ring (the
+    # reference's per-message shape, kept for A/B measurement).
+    ring_batch: bool = True
+    # Bound on each per-peer outbound send queue (ring entries + control
+    # asks).  A slow/wedged peer's queue drops OLDEST entries once full
+    # (counted in gol_peer_send_queue_drops_total) — the retry loop's
+    # PEER_PULL re-asks recover anything dropped, so the step loop never
+    # blocks and worker memory never grows unboundedly.
+    ring_queue_depth: int = 1024
     # Worker-side gather escalation (the reference's gatherer gives up after
     # 2 ask rounds and fires FailedToGatherInfoMsg → neighbor-ref refresh,
     # NextStateCellGathererActor.scala:49-58).  After this many unanswered
@@ -389,6 +413,14 @@ class SimulationConfig:
         if self.send_deadline_s < 0:
             raise ValueError(
                 f"send_deadline_s={self.send_deadline_s} must be >= 0 (0 = off)"
+            )
+        if self.tiles_per_worker < 1:
+            raise ValueError(
+                f"tiles_per_worker must be >= 1, got {self.tiles_per_worker}"
+            )
+        if self.ring_queue_depth < 1:
+            raise ValueError(
+                f"ring_queue_depth must be >= 1, got {self.ring_queue_depth}"
             )
         if self.exchange_width < 1:
             raise ValueError(f"exchange_width must be >= 1, got {self.exchange_width}")

@@ -389,7 +389,14 @@ class Frontend:
                 raise RuntimeError(
                     f"only {len(members)} backends joined, need {self.min_backends}"
                 )
-            self.layout = layout_for_workers(self.config.shape, len(members))
+            # Oversubscription: tiles_per_worker > 1 deals several tiles to
+            # each worker (round-robin below), giving the coalescing data
+            # plane multiple rings per peer per epoch to batch into one
+            # frame and node-loss recovery finer redistribution units.
+            self.layout = layout_for_workers(
+                self.config.shape,
+                len(members) * self.config.tiles_per_worker,
+            )
             th, tw = self.layout.tile_shape
             tile_bytes = th * tw // 8 if self.rule.states == 2 else th * tw
             if tile_bytes > MAX_FRAME - (1 << 20):
@@ -733,6 +740,14 @@ class Frontend:
                     "breaker_failures": self.config.breaker_failures,
                     "breaker_cooldown_s": self.config.breaker_cooldown_s,
                     "send_deadline_s": self.config.send_deadline_s,
+                    # Halo-plane wire policy: every worker of a cluster
+                    # packs/batches identically (the negotiation — a worker
+                    # never has to guess a peer's encoding, and the entries
+                    # self-describe anyway, so a mismatch fails loud in
+                    # decode_ring rather than mis-assembling halos).
+                    "ring_pack": self.config.ring_pack,
+                    "ring_batch": self.config.ring_batch,
+                    "ring_queue_depth": self.config.ring_queue_depth,
                 }
             )
             engine = hello.get("engine", "?")

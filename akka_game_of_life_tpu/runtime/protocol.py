@@ -20,8 +20,13 @@ PROGRESS                    a cell's state landing in History, as a control
                             (CellActor.scala:81)
 PEER_RING (worker↔worker)   neighbor state push between cells — direct, no
                             coordinator relay (NextStateCellGathererActor:32-36)
+PEER_RING_BATCH             (new) every ring bound for one peer in an
+                            epoch/chunk, coalesced into a single frame
+                            (bit-packed entries for binary rules); PEER_PULL
+                            replies ride the same frame kind
 PEER_PULL (worker↔worker)   GetStateFromEpoch re-ask to a specific neighbor
-                            (NextStateCellGathererActor.scala:49-53)
+                            (NextStateCellGathererActor.scala:49-53); carries
+                            every missing tile of that owner in one frame
 PRUNE                       (new) bounded-history floor broadcast
 TILE_STATE                  CellStateMsg to the logger (BoardCreator.scala:159)
 CRASH / CRASH_TILE          DoCrashMsg fault injection (CellActor.scala:53-55)
@@ -78,4 +83,5 @@ SHUTDOWN = "shutdown"
 # worker ↔ worker (the peer-to-peer data plane)
 PEER_HELLO = "peer_hello"
 PEER_RING = "peer_ring"
+PEER_RING_BATCH = "peer_ring_batch"
 PEER_PULL = "peer_pull"

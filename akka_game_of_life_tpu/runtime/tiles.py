@@ -134,3 +134,17 @@ class Ring:
     @property
     def width(self) -> int:
         return len(self.top)  # (k, w): first axis is the ring width
+
+    @property
+    def nbytes(self) -> int:
+        """Dense (unpacked) cell bytes of the ring — the logical payload
+        size the wire-cost counters account, whatever the encoding."""
+        import numpy as np
+
+        return int(
+            sum(
+                np.asarray(p).size
+                for p in (self.top, self.bottom, self.left, self.right)
+            )
+            + sum(np.asarray(c).size for c in self.corners.values())
+        )

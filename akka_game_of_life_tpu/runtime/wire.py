@@ -206,6 +206,130 @@ def extract_trace(msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
 # -- tile payload helpers -----------------------------------------------------
 
 
+# -- boundary-ring payload codec ----------------------------------------------
+#
+# The peer data plane's wire unit (PEER_RING / PEER_RING_BATCH).  A Ring has
+# 8 components (top/bottom/left/right + 4 corners); shipping them as 8 raw
+# uint8 blobs costs 8 blob-length headers and 8 JSON placeholders per ring.
+# Here the whole ring concatenates into ONE blob, and binary-rule rings
+# additionally bit-pack 32 cells per uint32 word (the ops/bitpack layout:
+# LSB-first within the word) — ~8x fewer payload bytes on the wire.  The
+# entry self-describes via "enc", so the receiver decodes without knowing
+# the sender's pack setting; an unknown "enc" raises ValueError, which every
+# peer serve loop treats as a dead channel (mixed-version peers fail loud,
+# never silently mis-decode).
+
+# Fixed component order of the concatenated ring blob.
+_RING_PARTS = ("top", "bottom", "left", "right", "nw", "ne", "sw", "se")
+
+
+def _ring_shapes(h: int, w: int, k: int) -> List[tuple]:
+    """Component shapes of a width-k ring of an (h, w) tile, in
+    ``_RING_PARTS`` order."""
+    return [(k, w), (k, w), (h, k), (h, k), (k, k), (k, k), (k, k), (k, k)]
+
+
+def encode_ring(ring, pack: bool) -> Dict[str, Any]:
+    """A :class:`runtime.tiles.Ring` → one wire entry.
+
+    ``pack=True`` (binary rules only — cells must be 0/1) packs the
+    concatenated components 32 cells per uint32 word; ``pack=False`` ships
+    the concatenation as raw uint8 (any state alphabet).  Either way the
+    ring is ONE blob + a 4-int header instead of 8 blobs."""
+    k = ring.width
+    h = ring.left.shape[0]
+    w = ring.top.shape[1]
+    parts = [ring.top, ring.bottom, ring.left, ring.right] + [
+        ring.corners[c] for c in ("nw", "ne", "sw", "se")
+    ]
+    flat = np.concatenate(
+        [np.ascontiguousarray(p, dtype=np.uint8).ravel() for p in parts]
+    )
+    if pack:
+        bits = np.packbits(flat, bitorder="little")
+        pad = (-bits.size) % 4
+        if pad:
+            bits = np.concatenate([bits, np.zeros(pad, np.uint8)])
+        return {"enc": "bits1", "h": h, "w": w, "k": k, "data": bits.view(np.uint32)}
+    return {"enc": "raw", "h": h, "w": w, "k": k, "data": flat}
+
+
+def decode_ring(entry: Dict[str, Any]):
+    """Inverse of :func:`encode_ring`; bit-exact round-trip.  Raises
+    ``ValueError`` on an unknown encoding or a size mismatch (a
+    wrong-version or corrupt peer must fail loud, not yield garbage
+    halos)."""
+    from akka_game_of_life_tpu.runtime.tiles import Ring
+
+    h, w, k = int(entry["h"]), int(entry["w"]), int(entry["k"])
+    shapes = _ring_shapes(h, w, k)
+    n = sum(a * b for a, b in shapes)
+    enc = entry.get("enc")
+    data = entry["data"]
+    if enc == "bits1":
+        raw = np.asarray(data, dtype=np.uint32)
+        if raw.view(np.uint8).size * 8 < n:
+            raise ValueError(
+                f"packed ring blob holds {raw.view(np.uint8).size * 8} bits, "
+                f"needs {n}"
+            )
+        flat = np.unpackbits(raw.view(np.uint8), count=n, bitorder="little")
+    elif enc == "raw":
+        flat = np.asarray(data, dtype=np.uint8).ravel()
+        if flat.size != n:
+            raise ValueError(f"raw ring blob holds {flat.size} cells, needs {n}")
+    else:
+        raise ValueError(f"unknown ring encoding {enc!r}")
+    views = []
+    off = 0
+    for shape in shapes:
+        size = shape[0] * shape[1]
+        views.append(flat[off : off + size].reshape(shape).copy())
+        off += size
+    top, bottom, left, right, nw, ne, sw, se = views
+    return Ring(
+        top=top, bottom=bottom, left=left, right=right,
+        corners={"nw": nw, "ne": ne, "sw": sw, "se": se},
+    )
+
+
+def ring_entry_nbytes(entry: Dict[str, Any]) -> int:
+    """Wire payload bytes of one encoded ring entry (the blob only — the
+    JSON envelope is the per-frame overhead batching amortizes)."""
+    return int(np.asarray(entry["data"]).nbytes)
+
+
+# Per-entry JSON overhead allowance when splitting batches against
+# MAX_FRAME: placeholder + tile/epoch/header ints, generously rounded up.
+_ENTRY_JSON_OVERHEAD = 256
+# Keep one batch frame well under MAX_FRAME: rings are small, so a quarter
+# of the cap leaves room for the envelope while still batching thousands.
+RING_BATCH_MAX_BYTES = MAX_FRAME // 4
+
+
+def split_ring_batches(
+    entries: List[Dict[str, Any]], max_bytes: int = RING_BATCH_MAX_BYTES
+) -> List[List[Dict[str, Any]]]:
+    """Split a list of batch entries (``{"tile", "epoch", "ring"}`` dicts)
+    into sub-lists whose payload bytes each stay under ``max_bytes`` — one
+    PEER_RING_BATCH frame per sub-list.  Order is preserved; an oversize
+    single entry still gets its own frame (the Channel's MAX_FRAME check is
+    the hard backstop).  Empty input → no frames."""
+    frames: List[List[Dict[str, Any]]] = []
+    cur: List[Dict[str, Any]] = []
+    cur_bytes = 0
+    for entry in entries:
+        nbytes = ring_entry_nbytes(entry["ring"]) + _ENTRY_JSON_OVERHEAD
+        if cur and cur_bytes + nbytes > max_bytes:
+            frames.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(entry)
+        cur_bytes += nbytes
+    if cur:
+        frames.append(cur)
+    return frames
+
+
 def pack_tile(arr: np.ndarray) -> Dict[str, Any]:
     """Encode a tile for bulk shipping: binary boards bit-pack 8 cells/byte
     (the only honest way a 65536²-class tile fits a frame); multi-state

@@ -275,6 +275,7 @@ def main() -> None:
             f"torus ({kernel} kernel, 1 chip)"
         )
 
+    fallback = None  # set to "cpu" when the device probe exhausts retries
     if args.probe_timeout > 0:
 
         def provisional(reason: str) -> None:
@@ -309,7 +310,30 @@ def main() -> None:
             window_s=max(0.0, args.probe_retry_window),
             on_first_failure=provisional,
         )
-        if failure is not None:
+        if failure is not None and args.platform is None:
+            # The TPU/axon probe exhausted its retry window.  Before
+            # recording a failure, probe the host CPU: a wedged tunnel must
+            # not leave the round without a real headline number (rounds
+            # 1-5 all recorded rc=1 probe failures).  Only the DEFAULT
+            # platform falls back — an explicit --platform is an order, and
+            # honoring it with a different backend would mislabel the
+            # number.  The fallback run is flagged in the emitted record.
+            print(
+                "[bench] device probe exhausted; probing cpu fallback",
+                file=sys.stderr,
+                flush=True,
+            )
+            if probe_device(min(args.probe_timeout, 120.0), 1, "cpu") is None:
+                fallback = "cpu"
+                if args.size == 65536:
+                    # The chip headline size takes ~17 min on this host's
+                    # CPU (~8e8 cell-updates/s measured); scale the
+                    # fallback run to about a minute.  The metric label
+                    # carries the actual size, and the fallback flags
+                    # below already mark the line non-comparable to chip
+                    # rounds either way.
+                    args.size = 16384
+        if failure is not None and fallback is None:
             # Structured, parseable record of the failure — never a hang or a
             # raw traceback (the round-1 artifact failure modes).
             print(
@@ -350,7 +374,7 @@ def main() -> None:
     # program skip the 20-40 s tunnel compile.
     from akka_game_of_life_tpu.cli import _apply_platform
 
-    _apply_platform(args.platform)
+    _apply_platform(args.platform or fallback)
 
     from akka_game_of_life_tpu.models import get_model
     from akka_game_of_life_tpu.ops import bitpack
@@ -425,6 +449,9 @@ def main() -> None:
             "vs_baseline": None,
             "error": fallback_note,
         }
+        if fallback is not None:
+            headline_line["fallback_platform"] = fallback
+            headline_line["probe_error"] = failure
     else:
         headline_line = {
             # The benchmark computation is a plain single-device jit, so
@@ -436,6 +463,16 @@ def main() -> None:
         }
         if fallback_note is not None:
             headline_line["note"] = fallback_note
+        if fallback is not None:
+            # The number is real but NOT the chip's: flag it machine-
+            # readably so the trajectory can never mistake a CPU-fallback
+            # round for a TPU regression (or recovery).
+            headline_line["fallback_platform"] = fallback
+            headline_line["probe_error"] = failure
+            headline_line["fallback_note"] = (
+                "TPU/axon probe exhausted its retries; measured on the "
+                "host CPU instead — not comparable to chip rounds"
+            )
         # Observability context rides with the scored number (halo bytes,
         # span latencies — whatever non-zero series this process touched),
         # so the BENCH_*.json trajectory carries its own attribution.
@@ -462,8 +499,8 @@ def main() -> None:
             str(pathlib.Path(__file__).resolve().parent / "bench_suite.py"),
             "--config", "1", "2", "3", "4", "7", "8",
         ]
-        if args.platform:
-            cmd += ["--platform", args.platform]
+        if args.platform or fallback:
+            cmd += ["--platform", args.platform or fallback]
         try:
             proc = subprocess.run(
                 cmd, timeout=args.aux_timeout, env=dict(_os.environ)

@@ -1,0 +1,225 @@
+"""Cluster data-plane throughput benchmark: the halo wire plane, A/B.
+
+``bench.py`` measures the compute side (the Mosaic stencil); this bench
+measures the side that bounds the cluster at scale — the worker↔worker
+boundary-ring exchange (Casper's framing: stencil performance is a
+data-movement problem, bytes moved per updated cell).  It runs the SAME
+seeded multi-worker loopback cluster twice:
+
+  A. ``raw``     — ring_pack=off, ring_batch=off: one frame per ring, dense
+                   uint8 payloads (the reference's per-message wire shape);
+  B. ``packed``  — ring_pack=on, ring_batch=on: 32 cells/uint32 word on the
+                   wire, all rings for one peer per epoch coalesced into one
+                   PEER_RING_BATCH frame, sent from the per-peer async lane.
+
+and reports, in the BENCH record format (one JSON line each): aggregate
+cell-updates/sec, peer-plane frames/epoch, and wire bytes/epoch per
+variant, then the A/B reduction ratios.  Both runs' final boards are
+checked bit-identical to the dense single-process oracle — a wire-format
+optimization that changes the simulation is not an optimization.
+
+Usage:
+  python bench_cluster.py                    # defaults (CPU-friendly)
+  python bench_cluster.py --size 2048 --epochs 64 --engine jax
+
+Also wired into ``bench_suite.py`` as config 9.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import time
+
+import numpy as np
+
+# The reference's throughput ceiling (cells/tick at its 6x6 default on a
+# 3 s tick — BASELINE.md), the baseline every cluster line compares to.
+REFERENCE_CEILING = 49 / 3.0
+
+
+def _oracle(cfg, epochs):
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.models import get_model
+    from akka_game_of_life_tpu.runtime.simulation import initial_board
+
+    return np.asarray(
+        get_model(cfg.rule).run(epochs)(jnp.asarray(initial_board(cfg)))
+    )
+
+
+def _run_variant(
+    *, size, epochs, workers, tiles_per_worker, exchange_width, engine,
+    ring_pack, ring_batch,
+):
+    from akka_game_of_life_tpu.obs.catalog import install
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.runtime.harness import cluster
+    from akka_game_of_life_tpu.runtime.render import BoardObserver
+
+    cfg = SimulationConfig(
+        height=size, width=size, seed=0, max_epochs=epochs,
+        exchange_width=exchange_width, tiles_per_worker=tiles_per_worker,
+        ring_pack=ring_pack, ring_batch=ring_batch, flight_dir="",
+    )
+    registry = install(MetricsRegistry())
+    t0 = time.perf_counter()
+    with cluster(
+        cfg, workers, observer=BoardObserver(out=io.StringIO()),
+        engine=engine, registry=registry,
+    ) as h:
+        final = h.run_to_completion(timeout=1200)
+    dt = time.perf_counter() - t0
+    snap = registry.snapshot()
+    return cfg, final, dt, {
+        # Peer data-plane frames (ring/batch frames + pull asks + hellos)
+        # and the bytes that actually hit the wire, per simulated epoch.
+        "frames_per_epoch": snap.get("gol_peer_sends_total", 0.0) / epochs,
+        "wire_bytes_per_epoch": (
+            snap.get("gol_ring_packed_bytes_total", 0.0) / epochs
+        ),
+        "dense_bytes_per_epoch": (
+            snap.get("gol_ring_bytes_total", 0.0) / epochs
+        ),
+        "rings_per_frame": (
+            snap["gol_ring_batch_size"]["sum"]
+            / snap["gol_ring_batch_size"]["count"]
+            if snap.get("gol_ring_batch_size", {}).get("count")
+            else 1.0
+        ),
+        "cells_per_sec": size * size * epochs / dt,
+        "metrics": {
+            k: v
+            for k, v in snap.items()
+            if k.startswith(("gol_peer", "gol_ring"))
+        },
+    }
+
+
+def bench_cluster_halo(
+    size: int = 1024,
+    epochs: int = 32,
+    workers: int = 2,
+    # 8 tiles/worker gives the coalescer a full batch per peer per epoch:
+    # measured ~3.7x frames/epoch and 8.0x wire-bytes/epoch reduction at
+    # the defaults on this host (4 tiles/worker hovers near 2.0x because
+    # pull-ask frames — equal in both variants — dilute the ratio).
+    tiles_per_worker: int = 8,
+    exchange_width: int = 4,
+    engine: str = "numpy",
+    emit=print,
+) -> dict:
+    """Run the A/B and emit BENCH-format JSON lines; returns the summary
+    record (the last line emitted)."""
+    config = f"cluster-halo-{size}"
+    stats = {}
+    finals = {}
+    for label, pack, batch in (("raw", False, False), ("packed", True, True)):
+        cfg, final, dt, s = _run_variant(
+            size=size, epochs=epochs, workers=workers,
+            tiles_per_worker=tiles_per_worker,
+            exchange_width=exchange_width, engine=engine,
+            ring_pack=pack, ring_batch=batch,
+        )
+        stats[label], finals[label] = s, final
+        emit(
+            json.dumps(
+                {
+                    "config": config,
+                    "metric": (
+                        f"cell-updates/sec aggregate, conway {size}x{size} "
+                        f"TCP cluster ({workers} workers x "
+                        f"{tiles_per_worker} tiles, {engine} engine, "
+                        f"exchange_width={exchange_width}, halo wire="
+                        f"{label})"
+                    ),
+                    "value": s["cells_per_sec"],
+                    "unit": "cell-updates/sec",
+                    "vs_baseline": s["cells_per_sec"] / REFERENCE_CEILING,
+                    "frames_per_epoch": s["frames_per_epoch"],
+                    "wire_bytes_per_epoch": s["wire_bytes_per_epoch"],
+                    "dense_bytes_per_epoch": s["dense_bytes_per_epoch"],
+                    "rings_per_frame": s["rings_per_frame"],
+                    "metrics": s["metrics"],
+                },
+            ),
+            flush=True,
+        )
+
+    oracle = _oracle(cfg, epochs)
+    oracle_ok = all(np.array_equal(f, oracle) for f in finals.values())
+
+    def _ratio(a: float, b: float):
+        # A single-worker run has no remote peer traffic at all: report
+        # null ratios (with the fields still present) instead of dying on
+        # a ZeroDivisionError after both simulations already ran.
+        return a / b if b else None
+
+    byte_ratio = _ratio(
+        stats["raw"]["wire_bytes_per_epoch"],
+        stats["packed"]["wire_bytes_per_epoch"],
+    )
+    frame_ratio = _ratio(
+        stats["raw"]["frames_per_epoch"],
+        stats["packed"]["frames_per_epoch"],
+    )
+    summary = {
+        "config": config,
+        "metric": (
+            "halo wire A/B: raw / packed+batched reduction "
+            "(bytes x, frames x)"
+        ),
+        "value": byte_ratio,
+        "unit": "x",
+        "vs_baseline": byte_ratio,
+        "wire_bytes_reduction": byte_ratio,
+        "frames_reduction": frame_ratio,
+        "oracle_bit_identical": oracle_ok,
+    }
+    emit(json.dumps(summary), flush=True)
+    if not oracle_ok:
+        raise AssertionError(
+            f"{config}: a variant's final board diverged from the dense "
+            f"oracle — the wire plane is corrupting the simulation"
+        )
+    return summary
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size", type=int, default=1024)
+    parser.add_argument("--epochs", type=int, default=32)
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--tiles-per-worker", type=int, default=8)
+    parser.add_argument("--exchange-width", type=int, default=4)
+    parser.add_argument(
+        "--engine", choices=["numpy", "jax", "swar"], default="numpy",
+        help="worker tile engine (numpy = portable default; the wire "
+        "plane under test is engine-independent)",
+    )
+    parser.add_argument(
+        "--platform", default=None, help="pin jax platform (e.g. cpu)"
+    )
+    args = parser.parse_args()
+
+    from akka_game_of_life_tpu.cli import _apply_platform
+
+    _apply_platform(args.platform)
+    bench_cluster_halo(
+        size=args.size,
+        epochs=args.epochs,
+        workers=args.workers,
+        tiles_per_worker=args.tiles_per_worker,
+        exchange_width=args.exchange_width,
+        engine=args.engine,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

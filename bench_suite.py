@@ -23,6 +23,11 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
                          separable shift-add window-sum kernel.
   8. wireworld-8192      WireWorld dense baseline vs the 2-bit-plane SWAR
                          kernel (heads counted by the shared adder network).
+  9. cluster-halo        bit-packed + coalesced + async halo wire plane
+                         A/B'd against the raw frame-per-ring wire on a
+                         seeded 2-worker loopback cluster (bench_cluster.py):
+                         cell-updates/sec, frames/epoch, wire bytes/epoch,
+                         and the reduction ratios, oracle-checked.
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -550,7 +555,7 @@ def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--config", type=int, nargs="*", default=[1, 2, 3, 4, 5, 6, 7, 8]
+        "--config", type=int, nargs="*", default=[1, 2, 3, 4, 5, 6, 7, 8, 9]
     )
     parser.add_argument(
         "--scale", type=float, default=1.0,
@@ -597,6 +602,12 @@ def main() -> None:
         bench_dense(s(8192), "wireworld", "wireworld-8192", steps=16, density=0.5)
         bench_packed_gen(s(8192), "wireworld", "wireworld-8192")
         bench_pallas_gen(s(8192), "wireworld", "wireworld-8192")
+    if 9 in args.config:
+        # The halo wire plane A/B (PR 4): raw frame-per-ring vs
+        # bit-packed + coalesced + async, oracle-checked.
+        from bench_cluster import bench_cluster_halo
+
+        bench_cluster_halo(size=s(1024), epochs=32)
 
 
 if __name__ == "__main__":

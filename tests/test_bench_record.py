@@ -14,6 +14,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
@@ -99,6 +101,49 @@ def test_full_run_tags_repeated_headline_line(tmp_path):
     assert last.pop("repeat") is True
     assert last == first
     assert lines[-1]["value"] == first["value"]  # repeat is the final line
+
+
+@pytest.mark.slow
+def test_default_platform_probe_exhaustion_falls_back_to_cpu():
+    # On this image the default (axon) platform probe hangs; once the
+    # retry budget exhausts, bench must fall back to the host CPU and
+    # emit a REAL headline number, rc=0, flagged as a fallback — every
+    # round gets a number (rounds 1-5 all recorded rc=1 probe failures).
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "bench.py",
+            "--headline-only",
+            "--kernel",
+            "bitpack",
+            "--size",
+            "1024",
+            "--steps-per-call",
+            "8",
+            "--timed-calls",
+            "1",
+            "--probe-timeout",
+            "20",
+            "--probe-attempts",
+            "1",
+            "--probe-retry-window",
+            "0",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.splitlines()[-1])
+    assert rec["value"] and rec["value"] > 0
+    if "fallback_platform" not in rec:
+        # A host whose default platform probe just works (no wedged axon
+        # tunnel) never exercises the fallback; the rc=0 + real-value
+        # assertions above are all that hold there.
+        pytest.skip("default platform probe succeeded; fallback not taken")
+    assert rec["fallback_platform"] == "cpu"
+    assert "probe" in rec["probe_error"]
 
 
 def test_probe_failure_still_emits_structured_record_with_last_measured():
