@@ -149,6 +149,16 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "round-trip from the critical path (observer lines for a cadence "
         "point appear one chunk late; values are identical)",
     )
+    p.add_argument(
+        "--obs-digest",
+        action="store_true",
+        default=None,
+        help="compute the 64-bit on-device board digest at observation "
+        "cadence (~8 fetched bytes; printed as digest=<16 hex> on metrics "
+        "lines) — O(1)-byte state certification at any board size; on the "
+        "frontend role, workers digest tiles locally and the frontend "
+        "merges them (see docs/OPERATIONS.md \"Digest certification\")",
+    )
     p.add_argument("--log-file")
     p.add_argument("--inject-faults", action="store_true", default=None)
     p.add_argument(
@@ -366,6 +376,7 @@ def _overrides(args: argparse.Namespace) -> dict:
         "trace_file": args.trace_file,
         "flight_dir": args.flight_dir,
         "obs_defer": args.obs_defer,
+        "obs_digest": args.obs_digest,
         "log_file": args.log_file,
         "distributed": args.distributed,
         "coordinator_address": args.coordinator,

@@ -118,6 +118,16 @@ CATALOG = (
      "gol_redeploys_total)", ()),
     ("gol_chaos_replay_epochs_total", "counter",
      "Epochs recomputed during standalone crash-recovery replay", ()),
+    # -- digest certification plane ------------------------------------------
+    ("gol_digest_checks_total", "counter",
+     "Board digests computed/merged (standalone cadence observation, "
+     "frontend tile-digest merges, recovery-source certification)", ()),
+    ("gol_digest_mismatches_total", "counter",
+     "Digest comparisons that disagreed (corrupt recovery source / "
+     "diverged state — always a fault, never expected)", ()),
+    ("gol_digest_seconds", "histogram",
+     "Wall seconds per digest compute+fetch (device) or merge (frontend)",
+     ()),
     # -- checkpoint / durability ---------------------------------------------
     ("gol_checkpoint_saves_total", "counter",
      "Checkpoint saves made durable (full-board or finalized per-tile)", ()),

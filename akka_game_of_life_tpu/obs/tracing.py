@@ -75,6 +75,9 @@ SPAN_CATALOG = (
     # -- durability -----------------------------------------------------------
     ("checkpoint.save", "one checkpoint save made durable"),
     ("checkpoint.restore", "one checkpoint load"),
+    # -- digest certification plane -------------------------------------------
+    ("obs.digest", "one board digest: computed+fetched on device "
+     "(standalone) or merged from per-tile lanes (frontend)"),
 )
 
 _SPAN_NAMES = frozenset(n for n, _ in SPAN_CATALOG)

@@ -27,4 +27,9 @@ from akka_game_of_life_tpu.parallel.pallas_halo import (  # noqa: F401
     sharded_gen_pallas_step_fn,
     sharded_pallas_step_fn,
 )
+from akka_game_of_life_tpu.parallel.digest import (  # noqa: F401
+    sharded_dense_digest_fn,
+    sharded_gen_digest_fn,
+    sharded_packed2d_digest_fn,
+)
 from akka_game_of_life_tpu.parallel import distributed  # noqa: F401

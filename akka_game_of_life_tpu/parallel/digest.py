@@ -1,0 +1,123 @@
+"""Sharded digest fold: per-shard fingerprints merged by ``psum`` — the
+mesh paths certify state without ever gathering a board.
+
+Each device digests its local tile with its GLOBAL cell offsets (derived
+from ``axis_index``) and the lane sums fold across the mesh with one
+``psum`` — O(devices) scalar traffic over ICI, ~8 bytes to the host,
+regardless of board size.  One builder per sharded layout:
+
+- :func:`sharded_dense_digest_fn` — dense uint8 (H, W) over the 2-D
+  ("row", "col") grid mesh (``parallel/halo.py``'s layout);
+- :func:`sharded_packed2d_digest_fn` — bit-packed (H, W/32) uint32 words
+  over the same grid mesh (``parallel/packed_halo2d.py``'s layout, which
+  is ALSO the sharded Pallas path's layout — ``parallel/pallas_halo.py``
+  steps the identical row×word-column sharding, so this one fold
+  certifies both the bitpack and Mosaic kernels);
+- :func:`sharded_gen_digest_fn` — (m, H, W/32) Generations/WireWorld bit
+  planes over ``GEN_SPEC`` (plane dim replicated).
+
+Every builder returns a jitted ``board -> (2,) uint32 lanes`` closure
+whose value is bit-identical to the single-device/host digests in
+:mod:`akka_game_of_life_tpu.ops.digest` — that equality IS the
+cross-path certification contract, pinned by ``tests/test_digest.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from akka_game_of_life_tpu.ops.digest import (
+    digest_dense,
+    digest_packed,
+    digest_planes,
+)
+from akka_game_of_life_tpu.parallel.mesh import (
+    COL_AXIS,
+    GEN_SPEC,
+    GRID_SPEC,
+    ROW_AXIS,
+)
+
+_AXES = (ROW_AXIS, COL_AXIS)
+
+
+def _shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` where available, the experimental spelling on
+    older jax — the digest fold is the certification plane, so it must
+    run on CPU test environments pinned to pre-``jax.shard_map`` releases
+    as well as on the TPU image."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def _origin(mesh: Mesh, tile_rows: int, tile_cols: int):
+    """Per-shard global (row0, col0) from the mesh coordinates (traced)."""
+    r0 = jax.lax.axis_index(ROW_AXIS) * tile_rows
+    c0 = jax.lax.axis_index(COL_AXIS) * tile_cols
+    return r0, c0
+
+
+def sharded_dense_digest_fn(
+    mesh: Mesh, shape: Tuple[int, int]
+) -> Callable[[jax.Array], jax.Array]:
+    """Digest of a GRID_SPEC-sharded dense (H, W) uint8 board."""
+    h, w = shape
+    rows, cols = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    th, tw = h // rows, w // cols
+
+    def local(tile: jax.Array) -> jax.Array:
+        r0, c0 = _origin(mesh, th, tw)
+        return jax.lax.psum(digest_dense(tile, r0, c0, width=w), _AXES)
+
+    mapped = _shard_map(local, mesh, GRID_SPEC, PartitionSpec())
+    return jax.jit(
+        mapped, in_shardings=NamedSharding(mesh, GRID_SPEC)
+    )
+
+
+def sharded_packed2d_digest_fn(
+    mesh: Mesh, shape: Tuple[int, int]
+) -> Callable[[jax.Array], jax.Array]:
+    """Digest of a GRID_SPEC-sharded packed (H, W/32) uint32 board
+    (bitpack AND sharded-Pallas kernels — same layout)."""
+    h, w = shape
+    rows, cols = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    th, tw = h // rows, (w // 32) // cols
+
+    def local(tile: jax.Array) -> jax.Array:
+        r0, wc0 = _origin(mesh, th, tw)
+        return jax.lax.psum(digest_packed(tile, w, r0, wc0), _AXES)
+
+    mapped = _shard_map(local, mesh, GRID_SPEC, PartitionSpec())
+    return jax.jit(
+        mapped, in_shardings=NamedSharding(mesh, GRID_SPEC)
+    )
+
+
+def sharded_gen_digest_fn(
+    mesh: Mesh, shape: Tuple[int, int], states: int
+) -> Callable[[jax.Array], jax.Array]:
+    """Digest of GEN_SPEC-sharded (m, H, W/32) Generations bit planes."""
+    from akka_game_of_life_tpu.ops.bitpack_gen import n_planes
+
+    h, w = shape
+    m = n_planes(states)
+    rows, cols = mesh.shape[ROW_AXIS], mesh.shape[COL_AXIS]
+    th, tw = h // rows, (w // 32) // cols
+
+    def local(planes: jax.Array) -> jax.Array:
+        assert planes.shape[0] == m, (planes.shape, m)
+        r0, wc0 = _origin(mesh, th, tw)
+        return jax.lax.psum(digest_planes(planes, w, r0, wc0), _AXES)
+
+    mapped = _shard_map(local, mesh, GEN_SPEC, PartitionSpec())
+    return jax.jit(
+        mapped, in_shardings=NamedSharding(mesh, GEN_SPEC)
+    )

@@ -613,6 +613,11 @@ class BackendWorker:
         self.ring_pack = ring_pack
         self.ring_batch = ring_batch
         self.ring_queue_depth = max(1, int(ring_queue_depth))
+        # Digest plane (cluster config, shipped in WELCOME): at digest-due
+        # epochs (metrics/checkpoint cadence + final) each tile's 64-bit
+        # fingerprint lanes ride the PROGRESS ping — O(tiles) bytes for the
+        # frontend to certify cluster state, no board assembly anywhere.
+        self.obs_digest = False
         # Decorrelated-jitter draws; reseeded per worker name in connect()
         # so a seeded cluster run's retry timing is reproducible per node.
         self._retry_rng = random.Random(f"retry:{name}")
@@ -775,6 +780,8 @@ class BackendWorker:
             self.ring_batch = bool(welcome["ring_batch"])
         if "ring_queue_depth" in welcome:
             self.ring_queue_depth = max(1, int(welcome["ring_queue_depth"]))
+        if "obs_digest" in welcome:
+            self.obs_digest = bool(welcome["obs_digest"])
         self._retry_rng = random.Random(f"retry:{self.name}")
         self.breaker.node = self.name or "backend"
         if isinstance(self.channel, ChaosChannel):
@@ -1533,7 +1540,7 @@ class BackendWorker:
         with self._lock:
             remote_owners, expect = self._owner_rings_locked(tid)
         if not remote_owners:
-            self._progress_ping(tid, epoch)
+            self._progress_ping(tid, epoch, arr)
             return
         pack = self.ring_pack and self.rule is not None and self.rule.is_binary
         # Wire-cost accounting (the Casper data-movement signal at the
@@ -1571,15 +1578,42 @@ class BackendWorker:
                 )
                 for owner in remote_owners:
                     self._send_peer(owner, msg)
-        self._progress_ping(tid, epoch)
+        self._progress_ping(tid, epoch, arr)
 
-    def _progress_ping(self, tid: TileId, epoch: int) -> None:
+    def _digest_due(self, epoch: int) -> bool:
+        """Epochs whose PROGRESS ping carries the tile's digest lanes:
+        metrics and checkpoint cadence crossings plus the final epoch —
+        exactly the points the frontend certifies or makes durable."""
+        if not self.obs_digest or epoch <= 0:
+            return False
+        if epoch == self.final_epoch:
+            return True
+        if self.checkpoint_every and epoch % self.checkpoint_every == 0:
+            return True
+        return bool(self.metrics_every and epoch % self.metrics_every == 0)
+
+    def _progress_ping(
+        self, tid: TileId, epoch: int, arr: Optional[np.ndarray] = None
+    ) -> None:
         """Control-plane progress ping (no arrays): feeds the frontend's
-        prune floor, stuck detection, and lag accounting."""
+        prune floor, stuck detection, and lag accounting.  At digest-due
+        epochs it additionally carries the tile's 64-bit fingerprint lanes
+        (~8 bytes — the mergeable per-tile form of the digest plane), so
+        the frontend certifies whole-cluster state in O(tiles) bytes."""
+        msg = {"type": P.PROGRESS, "tile": list(tid), "epoch": epoch}
+        if arr is not None and self._digest_due(epoch):
+            from akka_game_of_life_tpu.ops import digest as odigest
+
+            with self._lock:
+                origin = self.origins.get(tid, (0, 0))
+                width = (
+                    self.layout.board_shape[1] if self.layout is not None
+                    else arr.shape[1]
+                )
+            lanes = odigest.digest_dense_np(arr, origin, width)
+            msg["digest"] = [int(lanes[0]), int(lanes[1])]
         try:
-            self.channel.send(
-                {"type": P.PROGRESS, "tile": list(tid), "epoch": epoch}
-            )
+            self.channel.send(msg)
         except OSError:
             pass
 

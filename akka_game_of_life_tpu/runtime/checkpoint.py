@@ -191,10 +191,31 @@ class CheckpointStore:
         )
 
     def save(
-        self, epoch: int, board: np.ndarray, rule: str, meta: Optional[dict] = None
+        self,
+        epoch: int,
+        board: np.ndarray,
+        rule: str,
+        meta: Optional[dict] = None,
+        record_digest: bool = False,
     ) -> Path:
         board = np.asarray(board, dtype=np.uint8)
         binary = bool((board <= 1).all())
+        # A durable epoch may carry its 64-bit state certificate in meta:
+        # two stores (or a store and a live run) then compare by 16 hex
+        # digits, never by unpacking boards (docs/OPERATIONS.md "Digest
+        # certification").  Callers with a device-resident board pass the
+        # digest in ``meta`` (Simulation computes it ON DEVICE for ~8
+        # fetched bytes); ``record_digest=True`` is the host-side
+        # convenience for embedders holding only this array — an opt-in,
+        # because the host recompute is O(board) work that a flagship-size
+        # save must not pay for a feature nobody enabled.
+        meta = dict(meta or {})
+        if record_digest and "digest" not in meta:
+            from akka_game_of_life_tpu.ops import digest as odigest
+
+            meta["digest"] = odigest.format_digest(
+                odigest.value(odigest.digest_dense_np(board))
+            )
         return self._write_epoch(
             epoch,
             {
@@ -213,6 +234,7 @@ class CheckpointStore:
         shape: Tuple[int, int],
         rule: str,
         meta: Optional[dict] = None,
+        record_digest: bool = False,
     ) -> Path:
         """Save an already-bit-packed board as it arrived from the device —
         the packed-kernel runtime never unpacks on host, so a 65536²
@@ -235,6 +257,19 @@ class CheckpointStore:
             fmt = 3  # Generations bit planes, LSB plane first
         if words.shape != expect:
             raise ValueError(f"packed words {words.shape} != {expect}")
+        meta = dict(meta or {})
+        if record_digest and "digest" not in meta:
+            # Host-side opt-in (see save()): computed straight from the
+            # packed words — the packed save path never unpacks, for
+            # digests either.  Device-holding callers pass meta instead.
+            from akka_game_of_life_tpu.ops import digest as odigest
+
+            lanes = (
+                odigest.digest_packed_np(words, w)
+                if fmt == 2
+                else odigest.digest_planes_np(words, w)
+            )
+            meta["digest"] = odigest.format_digest(odigest.value(lanes))
         return self._write_epoch(
             epoch,
             {
@@ -334,6 +369,34 @@ class CheckpointStore:
         from akka_game_of_life_tpu.runtime.wire import unpack_tile
 
         return unpack_tile(self.load_tile_payload(epoch, tile))
+
+    def tile_digest(self, epoch: int) -> int:
+        """Recompute a per-tile epoch's merged 64-bit digest — one tile in
+        memory at a time, the board never assembled (the validation path
+        behind ``checkpoints --validate``'s tile-dir branch; the frontend's
+        recovery-source certification is its payload-level twin).  Also
+        verifies every tile decodes to the layout's shape — a truncated or
+        mis-shaped tile raises ValueError rather than digesting garbage."""
+        from akka_game_of_life_tpu.ops import digest as odigest
+
+        meta = self.tile_meta(epoch)
+        rows, cols = meta["grid"]
+        h, w = (int(v) for v in meta["shape"])
+        th, tw = h // rows, w // cols
+
+        def tile_lanes(i: int, j: int) -> np.ndarray:
+            tile = self.load_tile(epoch, (i, j))
+            if tile.shape != (th, tw):
+                raise ValueError(
+                    f"tile ({i}, {j}) of epoch {epoch} has shape "
+                    f"{tile.shape}, layout expects {(th, tw)}"
+                )
+            return odigest.digest_dense_np(tile, (i * th, j * tw), w)
+
+        lanes = odigest.merge_lanes(
+            tile_lanes(i, j) for i in range(rows) for j in range(cols)
+        )
+        return odigest.value(lanes)
 
     def _epochs(self):
         """(epoch, path) of every durable checkpoint — full-board files and
@@ -519,6 +582,8 @@ def describe_store(directory: str, validate: bool = False):
                     tiles=len(tiles),
                     bytes=sum(t.stat().st_size for t in tiles),
                 )
+                if meta.get("digest"):
+                    info["digest"] = meta["digest"]
             else:
                 with np.load(path) as z:
                     meta = json.loads(bytes(z["meta"].tobytes()).decode())
@@ -529,6 +594,12 @@ def describe_store(directory: str, validate: bool = False):
                         shape=[int(v) for v in z["shape"]],
                         bytes=path.stat().st_size,
                     )
+                    if meta.get("digest"):
+                        # The recorded state certificate: two stores (an
+                        # A/B pair, a live run's metrics line) compare by
+                        # this field alone — no tile unpacking, no board
+                        # fetch.
+                        info["digest"] = meta["digest"]
         except Exception as e:
             # Unreadable metadata is itself a finding, not a crash.
             info.update(error=f"{type(e).__name__}: {e}")
@@ -538,24 +609,65 @@ def describe_store(directory: str, validate: bool = False):
             continue
         if validate:
             try:
-                # Packed epochs validate in packed form: keep_packed skips
-                # the O(board) host unpack, so a 65536² packed32 checkpoint
-                # validates through its 512 MiB of words, not 4 GiB of
-                # cells.  Dense/tile epochs still load fully.
-                ck = store.load(epoch, keep_packed=True)
-                if ck.packed32 is not None:
-                    shape = info.get("shape")
-                    h, words = (
-                        ck.packed32.shape[-2],
-                        ck.packed32.shape[-1],
-                    )
-                    info["ok"] = shape is None or (
-                        list(shape) == [h, words * 32]
-                    )
+                from akka_game_of_life_tpu.ops import digest as odigest
+
+                recorded = info.get("digest")
+                computed = None
+                if path.is_dir():
+                    # Per-tile epochs validate tile-by-tile: every tile is
+                    # read, shape-checked, and digested with its global
+                    # origin — the board is NEVER assembled (exactly the
+                    # no-assembly discipline the digest plane exists for;
+                    # the old path stitched a 65536²-class board here).
+                    computed = odigest.format_digest(store.tile_digest(epoch))
+                    info["ok"] = True
                 else:
-                    info["ok"] = ck.board is not None and list(
-                        ck.board.shape
-                    ) == list(info.get("shape") or ck.board.shape)
+                    # Packed epochs validate in packed form: keep_packed
+                    # skips the O(board) host unpack, so a 65536² packed32
+                    # checkpoint validates through its 512 MiB of words,
+                    # not 4 GiB of cells.
+                    ck = store.load(epoch, keep_packed=True)
+                    if ck.packed32 is not None:
+                        shape = info.get("shape")
+                        h, words = (
+                            ck.packed32.shape[-2],
+                            ck.packed32.shape[-1],
+                        )
+                        info["ok"] = shape is None or (
+                            list(shape) == [h, words * 32]
+                        )
+                    else:
+                        info["ok"] = ck.board is not None and list(
+                            ck.board.shape
+                        ) == list(info.get("shape") or ck.board.shape)
+                    if recorded is not None and info["ok"]:
+                        # Re-derive the certificate from the payload on
+                        # disk: a bit flip anywhere in the board surfaces
+                        # here, which a shape check can never see.
+                        if ck.packed32 is not None:
+                            w = int(info["shape"][1])
+                            lanes = (
+                                odigest.digest_packed_np(ck.packed32, w)
+                                if ck.packed32.ndim == 2
+                                else odigest.digest_planes_np(ck.packed32, w)
+                            )
+                            computed = odigest.format_digest(
+                                odigest.value(lanes)
+                            )
+                        else:
+                            computed = odigest.format_digest(
+                                odigest.value(
+                                    odigest.digest_dense_np(ck.board)
+                                )
+                            )
+                if recorded is not None and computed is not None:
+                    info["digest_ok"] = computed == recorded
+                    if not info["digest_ok"]:
+                        info["ok"] = False
+                        info["error"] = (
+                            f"digest mismatch: stored {recorded}, "
+                            f"computed {computed}"
+                        )
             except Exception as e:
                 info.update(ok=False, error=f"{type(e).__name__}: {e}")
         yield info

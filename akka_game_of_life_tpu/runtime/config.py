@@ -350,6 +350,16 @@ class SimulationConfig:
     # leaves the critical path.  Observer lines for a cadence point are
     # emitted one chunk late; values and totals are identical to sync mode.
     obs_defer: bool = False
+    # Digest observation mode (docs/OPERATIONS.md "Digest certification"):
+    # cadence observations additionally compute the 64-bit board digest
+    # (ops/digest.py) on device and fetch ~8 bytes — state certification
+    # without board transfer.  Standalone: the digest rides the cadence
+    # observation (and obs_defer's deferred fetch) and prints on the
+    # metrics line.  Cluster: workers digest their tiles locally and
+    # attach the lanes to PROGRESS pings at metrics/checkpoint/final
+    # epochs; the frontend merges them in O(tiles) bytes and records the
+    # merged digest in finalized checkpoint metadata.
+    obs_digest: bool = False
 
     fault_injection: FaultInjectionConfig = dataclasses.field(
         default_factory=FaultInjectionConfig

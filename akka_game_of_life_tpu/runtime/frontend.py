@@ -123,6 +123,16 @@ def _validate_msg(msg) -> None:
             )
     if "epoch" in required and not isinstance(msg["epoch"], int):
         raise MalformedMessage(f"{kind} epoch {msg['epoch']!r} is not an int")
+    if kind == P.PROGRESS and "digest" in msg:
+        d = msg["digest"]
+        if not (
+            isinstance(d, (list, tuple))
+            and len(d) == 2
+            and all(isinstance(v, int) for v in d)
+        ):
+            raise MalformedMessage(
+                f"progress digest {d!r} is not an integer (lo, hi) pair"
+            )
     if kind == P.TILE_STATE:
         reasons = msg.get("reasons", [])
         if not isinstance(reasons, (list, tuple)) or not all(
@@ -199,6 +209,11 @@ class Frontend:
         self._m_degraded_entries = self.metrics.counter(
             "gol_degraded_entries_total"
         )
+        self._m_digest_checks = self.metrics.counter("gol_digest_checks_total")
+        self._m_digest_mismatches = self.metrics.counter(
+            "gol_digest_mismatches_total"
+        )
+        self._m_digest_seconds = self.metrics.histogram("gol_digest_seconds")
         self._metrics_server: Optional[MetricsServer] = None
         # Wire-fault policy (config/CLI --chaos-net-*): one seeded instance
         # per process; the in-process harness hands this same instance to
@@ -302,6 +317,16 @@ class Frontend:
         self._ckpt_pending: Dict[int, Dict[TileId, dict]] = {}
         self._final_tiles: Dict[TileId, dict] = {}
         self.final_board: Optional[np.ndarray] = None
+        # Digest plane (obs_digest): per-tile fingerprint lanes arrive on
+        # PROGRESS pings at digest-due epochs and merge here in O(tiles)
+        # bytes — the cluster's whole-board state certificate without any
+        # board assembly.  epoch_digests holds the last few merged 64-bit
+        # values (finalized checkpoints copy theirs into COMPLETE.json);
+        # final_digest is the max_epochs certificate bench/tests compare.
+        self._digest_partial: Dict[int, Dict[TileId, Tuple[int, int]]] = {}
+        self._digest_floor: Optional[int] = None
+        self.epoch_digests: Dict[int, int] = {}
+        self.final_digest: Optional[int] = None
         self.error: Optional[str] = None
 
         self._lock = threading.RLock()
@@ -361,7 +386,10 @@ class Frontend:
                 if kind == "tile":
                     self.store.save_tile(*args)
                 elif kind == "finalize":
-                    self.store.finalize_epoch(*args)
+                    epoch, rule, grid, shape, meta = args
+                    self.store.finalize_epoch(
+                        epoch, rule, grid, shape, meta=meta
+                    )
             except Exception as e:  # any write failure: fail loudly, never
                 # strand stop() on an unjoinable queue
                 with self._lock:
@@ -508,13 +536,15 @@ class Frontend:
             meta = getattr(self.store, "tile_meta", None)
             if meta is not None:
                 try:
-                    if tuple(self.store.tile_meta(epoch0)["grid"]) == layout.grid:
+                    epoch_meta = self.store.tile_meta(epoch0)
+                    if tuple(epoch_meta["grid"]) == layout.grid:
                         # Stored payloads go straight back onto the wire —
                         # no unpack/repack, no full-tile materialization.
                         tiles = {
                             t: self.store.load_tile_payload(epoch0, t)
                             for t in layout.tile_ids
                         }
+                        self._certify_recovery_tiles(epoch_meta, tiles)
                         # One restore per recovery-source load: this path
                         # bypasses store.load(), so count it here (the
                         # full-board fallback below counts inside load()).
@@ -530,6 +560,47 @@ class Frontend:
         return epoch0, {
             t: pack_tile(layout.extract(board, t)) for t in layout.tile_ids
         }
+
+    def _certify_recovery_tiles(
+        self, epoch_meta: dict, tiles: Dict[TileId, dict]
+    ) -> None:
+        """Certify a per-tile recovery source against the 64-bit digest its
+        finalize recorded (present when the saving run had obs_digest on):
+        re-derive per-tile lanes from the payloads — one tile at a time,
+        no board assembly — merge, and fail LOUDLY on mismatch.  A corrupt
+        checkpoint deployed as a recovery source would otherwise fork the
+        whole cluster's trajectory silently."""
+        from akka_game_of_life_tpu.ops import digest as odigest
+
+        recorded = epoch_meta.get("digest")
+        if not recorded:
+            return
+        t0 = time.perf_counter()
+        computed = odigest.format_digest(
+            odigest.value(
+                odigest.merge_lanes(
+                    odigest.digest_payload_np(
+                        payload, self.layout.origin(t), self.config.width
+                    )
+                    for t, payload in tiles.items()
+                )
+            )
+        )
+        self._m_digest_checks.inc()
+        self._m_digest_seconds.observe(time.perf_counter() - t0)
+        if computed != recorded:
+            self._m_digest_mismatches.inc()
+            self.events.emit(
+                "digest_mismatch",
+                epoch=int(epoch_meta.get("epoch", -1)),
+                stored=recorded,
+                computed=computed,
+            )
+            raise ValueError(
+                f"recovery checkpoint failed digest certification: stored "
+                f"{recorded}, computed {computed} — refusing to deploy a "
+                f"corrupt recovery source"
+            )
 
     def _send_deploy(self, member: Member, tiles: List[TileId]) -> None:
         """Ship tiles to a worker.  Callers must NOT hold the frontend lock:
@@ -748,6 +819,9 @@ class Frontend:
                     "ring_pack": self.config.ring_pack,
                     "ring_batch": self.config.ring_batch,
                     "ring_queue_depth": self.config.ring_queue_depth,
+                    # Digest plane: workers attach per-tile fingerprint
+                    # lanes to PROGRESS at digest-due epochs when on.
+                    "obs_digest": self.config.obs_digest,
                 }
             )
             engine = hello.get("engine", "?")
@@ -830,6 +904,8 @@ class Frontend:
                     return  # stale ping from an evicted owner
                 self.tile_epochs[tile] = max(self.tile_epochs.get(tile, 0), epoch)
                 self._last_ring_time[tile] = time.monotonic()
+                if "digest" in msg:
+                    self._note_tile_digest(tile, epoch, msg["digest"])
         elif kind == P.TILE_STATE:
             self._on_tile_state(member, msg)
         elif kind == P.REDEPLOY_REQUEST:
@@ -866,6 +942,7 @@ class Frontend:
                                     self.rule.rulestring(),
                                     self.layout.grid,
                                     self.config.shape,
+                                    self._digest_meta(epoch),
                                 ),
                             )
                         )
@@ -896,6 +973,7 @@ class Frontend:
                                     self.rule.rulestring(),
                                     self.layout.grid,
                                     self.config.shape,
+                                    self._digest_meta(epoch),
                                 ),
                             )
                         )
@@ -926,6 +1004,57 @@ class Frontend:
                     )
             if "metrics" in reasons:
                 self.observer.add_population(epoch, tile, int(msg["population"]))
+
+    def _digest_meta(self, epoch: int) -> Optional[dict]:
+        """Checkpoint metadata carrying the epoch's merged digest, or None.
+        The merge always completes before the finalize enqueue: each
+        tile's PROGRESS (with lanes) precedes its TILE_STATE on the same
+        channel, and the finalize fires on the LAST tile's state.  Caller
+        holds the lock."""
+        from akka_game_of_life_tpu.ops import digest as odigest
+
+        if epoch not in self.epoch_digests:
+            return None
+        return {"digest": odigest.format_digest(self.epoch_digests[epoch])}
+
+    def _note_tile_digest(self, tile: TileId, epoch: int, lanes) -> None:
+        """One tile's digest lanes from a PROGRESS ping; when every tile of
+        the epoch has reported, fold them (lane-wise uint32 sum — the same
+        merge rule as the mesh ``psum``) into the epoch's 64-bit value.
+        O(tiles) bytes total; the board is never assembled.  Re-reports
+        from replaying/redeployed tiles are recognized by the monotone
+        completion floor (the ``_complete_epoch`` discipline).  Caller
+        holds the lock."""
+        from akka_game_of_life_tpu.ops import digest as odigest
+
+        if self.layout is None or (
+            self._digest_floor is not None and epoch <= self._digest_floor
+        ):
+            return
+        t0 = time.perf_counter()
+        parts = self._digest_partial.setdefault(epoch, {})
+        parts[tile] = (int(lanes[0]), int(lanes[1]))
+        if len(parts) < len(self.layout.tile_ids):
+            return
+        del self._digest_partial[epoch]
+        self._digest_floor = epoch
+        for e in [e for e in self._digest_partial if e <= epoch]:
+            del self._digest_partial[e]
+        merged = odigest.value(odigest.merge_lanes(parts.values()))
+        self.epoch_digests[epoch] = merged
+        while len(self.epoch_digests) > 16:  # bounded: certificates, not history
+            del self.epoch_digests[min(self.epoch_digests)]
+        if epoch == self.config.max_epochs:
+            self.final_digest = merged
+        hexd = odigest.format_digest(merged)
+        self._m_digest_checks.inc()
+        self._m_digest_seconds.observe(time.perf_counter() - t0)
+        with self.tracer.span(
+            "obs.digest", parent=self._epoch_span, node="frontend",
+            epoch=epoch, digest=hexd, tiles=len(parts),
+        ):
+            self.events.emit("digest", epoch=epoch, digest=hexd)
+        print(f"epoch {epoch}: digest={hexd}", file=self.observer.out, flush=True)
 
     def _assemble(self, tiles: Dict[TileId, dict]) -> np.ndarray:
         from akka_game_of_life_tpu.runtime.tiles import stitch
@@ -1233,6 +1362,7 @@ class Frontend:
                                 self.rule.rulestring(),
                                 self.layout.grid,
                                 self.config.shape,
+                                self._digest_meta(epoch),
                             ),
                         )
                     )

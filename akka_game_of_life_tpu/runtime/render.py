@@ -68,6 +68,9 @@ class StepMetrics:
     # fetches) inside ``seconds`` — the product-vs-bench breakdown: the
     # stepper's own share of the interval is seconds - obs_seconds.
     obs_seconds: float = 0.0
+    # 64-bit on-device board digest at this epoch (obs_digest mode), or
+    # None — the O(1)-byte state certificate (ops/digest.py).
+    digest: Optional[int] = None
 
     @property
     def updates_per_sec(self) -> float:
@@ -169,6 +172,7 @@ class BoardObserver:
         population: int,
         total_cells: int,
         obs_seconds: float = 0.0,
+        digest: Optional[int] = None,
     ) -> None:
         """Advance the metrics clock and emit a metrics line at cadence."""
         now = time.perf_counter()
@@ -182,6 +186,7 @@ class BoardObserver:
                 cells=total_cells * epochs,
                 population=population,
                 obs_seconds=obs_seconds,
+                digest=digest,
             )
             self.history.append(m)
             self._total_epochs += m.epochs
@@ -201,18 +206,25 @@ class BoardObserver:
                     if m.obs_seconds > 0
                     else ""
                 )
+                # The state certificate rides the line it certifies: two
+                # runs agree at this epoch iff these 16 hex digits match.
+                dig = f" digest={m.digest:016x}" if m.digest is not None else ""
                 print(
                     f"epoch {epoch}: pop={m.population} "
                     f"{m.updates_per_sec:.3e} cell-updates/s "
-                    f"({m.seconds_per_epoch * 1e3:.2f} ms/epoch)" + obs,
+                    f"({m.seconds_per_epoch * 1e3:.2f} ms/epoch)" + obs + dig,
                     file=self.out,
                     flush=True,
                 )
         self._last_time = now
         self._last_epoch = epoch
 
-    def observe(self, epoch: int, board: np.ndarray) -> None:
-        self._note_progress(epoch, int((board == 1).sum()), board.size)
+    def observe(
+        self, epoch: int, board: np.ndarray, digest: Optional[int] = None
+    ) -> None:
+        self._note_progress(
+            epoch, int((board == 1).sum()), board.size, digest=digest
+        )
         if self.render_every and epoch % self.render_every == 0:
             print(f"epoch {epoch}:", file=self.out)
             print(render_ascii(board, self.render_max_cells), file=self.out, flush=True)
@@ -225,6 +237,7 @@ class BoardObserver:
         view: Optional[np.ndarray] = None,
         strides: Tuple[int, int] = (1, 1),
         obs_seconds: float = 0.0,
+        digest: Optional[int] = None,
     ) -> None:
         """Device-side observation: the caller computed the population and
         (at render cadence) a stride-sampled view on the accelerator, so only
@@ -234,7 +247,9 @@ class BoardObserver:
         wall cost of that observation (dispatch + fetches), surfaced on the
         metrics line."""
         h, w = board_shape
-        self._note_progress(epoch, population, h * w, obs_seconds=obs_seconds)
+        self._note_progress(
+            epoch, population, h * w, obs_seconds=obs_seconds, digest=digest
+        )
         if self.render_every and epoch % self.render_every == 0 and view is not None:
             print(f"epoch {epoch}:", file=self.out)
             print(

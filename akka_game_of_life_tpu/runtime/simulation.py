@@ -157,6 +157,8 @@ class Simulation:
         # Resolved once: observation runs at cadence inside the hot loop,
         # and instrument lookup takes the registry lock.
         self._m_obs_seconds = self.metrics.histogram("gol_obs_seconds")
+        self._m_digest_checks = self.metrics.counter("gol_digest_checks_total")
+        self._m_digest_seconds = self.metrics.histogram("gol_digest_seconds")
         if config.distributed:
             # Must happen before ANY backend init — including the checkpoint
             # store below (orbax queries process_index/count at construction)
@@ -942,6 +944,50 @@ class Simulation:
                 self._obs_fns[name] = jax.jit(core)
         return self._obs_fns[name]
 
+    def _digest_fn(self) -> Callable:
+        """The board-digest closure for this run's layout (cached): dense,
+        packed words, or Generations planes — and, on a mesh, the
+        shard_map+psum fold (``parallel/digest.py``) so certification
+        never gathers a board.  The sharded-Pallas kernel steps the same
+        packed2d layout as bitpack, so one fold covers both."""
+        if "digest" not in self._obs_fns:
+            from akka_game_of_life_tpu.ops import digest as odigest
+
+            cfg = self.config
+            if self.mesh is not None:
+                from akka_game_of_life_tpu.parallel import digest as pdigest
+
+                if self._gen:
+                    fn = pdigest.sharded_gen_digest_fn(
+                        self.mesh, cfg.shape, self.rule.states
+                    )
+                elif self._packed:
+                    fn = pdigest.sharded_packed2d_digest_fn(self.mesh, cfg.shape)
+                else:
+                    fn = pdigest.sharded_dense_digest_fn(self.mesh, cfg.shape)
+            elif self._gen:
+                fn = jax.jit(lambda b: odigest.digest_planes(b, cfg.width))
+            elif self._packed:
+                fn = jax.jit(lambda b: odigest.digest_packed(b, cfg.width))
+            else:
+                fn = jax.jit(odigest.digest_dense)
+            self._obs_fns["digest"] = fn
+        return self._obs_fns["digest"]
+
+    def board_digest(self) -> int:
+        """The 64-bit on-device digest of the CURRENT board — ~8 fetched
+        bytes at any board size (the certification primitive; cadence
+        observation uses the same closure).  Works on every kernel/mesh
+        combination and the actor backends."""
+        from akka_game_of_life_tpu.ops import digest as odigest
+
+        if self._actor_board is not None:
+            return odigest.value(odigest.digest_dense_np(np.asarray(self.board)))
+        lanes = np.asarray(
+            dist.fetch(self._digest_fn()(self.board)), dtype=np.uint32
+        )
+        return odigest.value(lanes)
+
     def _probe_due(self, render: bool) -> bool:
         """Window probes follow the same gate as rendered frames (an exact
         ``render_every`` multiple) so probe epochs always line up with frame
@@ -965,7 +1011,13 @@ class Simulation:
         (VERDICT.md round-3 weak #3)."""
         if self._actor_board is not None:
             if jax.process_index() == 0:
-                self.observer.observe(self.epoch, np.asarray(self.board))
+                self.observer.observe(
+                    self.epoch,
+                    np.asarray(self.board),
+                    digest=(
+                        self.board_digest() if self.config.obs_digest else None
+                    ),
+                )
                 if self._probe_due(render):
                     self.observer.observe_window(
                         self.epoch,
@@ -1032,6 +1084,12 @@ class Simulation:
             "view": None,
             "strides": sample_strides(cfg.shape, cfg.render_max_cells),
             "win": None,
+            # Digest mode: the certificate handle is dispatched with the
+            # rest of the observation and fetched (8 bytes) alongside it —
+            # riding obs_defer's deferred fetch like every other handle.
+            "digest": (
+                self._digest_fn()(self.board) if cfg.obs_digest else None
+            ),
         }
         if render:
             sy, sx = rec["strides"]
@@ -1061,22 +1119,43 @@ class Simulation:
         ``t0`` is where the obs clock started: dispatch time in sync mode
         (obs ms = dispatch + fetch), resolve time in deferred mode (obs ms =
         the residual fetch cost left on the critical path).  ``on_fetched``
-        fires once every device fetch has succeeded, before any observer
-        write — the deferred queue uses it to mark the record consumed
-        (a failed *write* must not leave the record queued: the metrics
-        line lands before the window line, so a requeue would duplicate
-        it on the next flush)."""
+        fires once every RAW device fetch has succeeded — immediately, and
+        in particular BEFORE the window's host-side ``post()`` and any
+        observer write — so the deferred queue marks the record consumed
+        the moment only host work remains.  Only a device fetch failure may
+        leave the record queued (the caller's retry/flush policy); a
+        deterministic ``post()`` or write error must consume it — it would
+        otherwise re-queue and poison every subsequent flush, and the
+        metrics line lands before the window line, so a requeue would also
+        duplicate it on the next flush."""
         cfg = self.config
-        population = int(
-            np.asarray(dist.fetch(rec["pops"]), dtype=np.int64).sum()
-        )
+        pops = np.asarray(dist.fetch(rec["pops"]), dtype=np.int64)
         view = dist.fetch(rec["view"]) if rec["view"] is not None else None
-        win = None
+        win_raw = post = None
         if rec["win"] is not None:
             handle, post = rec["win"]
-            win = post(dist.fetch(handle))
+            win_raw = dist.fetch(handle)
+        digest = None
+        if rec.get("digest") is not None:
+            from akka_game_of_life_tpu.ops import digest as odigest
+
+            dig_t0 = time.perf_counter()
+            with self.tracer.span(
+                "obs.digest", node=self._node, epoch=rec["epoch"]
+            ) as sp:
+                digest = odigest.value(
+                    np.asarray(dist.fetch(rec["digest"]), dtype=np.uint32)
+                )
+                sp.set(digest=odigest.format_digest(digest))
+            self._m_digest_seconds.observe(time.perf_counter() - dig_t0)
+            self._m_digest_checks.inc()
+        # Every raw device fetch succeeded: consume the record NOW, before
+        # any host-side post() or observer write can fail deterministically
+        # (see the docstring's poisoned-flush contract).
         if on_fetched is not None:
             on_fetched()
+        population = int(pops.sum())
+        win = post(win_raw) if win_raw is not None else None
         obs_seconds = time.perf_counter() - t0
         self._m_obs_seconds.observe(obs_seconds)
         if jax.process_index() == 0:
@@ -1087,6 +1166,7 @@ class Simulation:
                 view,
                 rec["strides"],
                 obs_seconds=obs_seconds,
+                digest=digest,
             )
             if win is not None:
                 self.observer.observe_window(
@@ -1190,6 +1270,15 @@ class Simulation:
         if self.store is None:
             raise RuntimeError("no checkpoint_dir configured")
         meta = {"height": self.config.height, "width": self.config.width}
+        if self.config.obs_digest:
+            # The checkpoint's state certificate, computed ON DEVICE from
+            # the live board (~8 fetched bytes — never a host-side O(board)
+            # recompute): the store records it so `checkpoints --validate`
+            # can re-derive and compare.  Runs BEFORE the npz rank gate —
+            # the mesh digest is a psum collective every rank must execute.
+            from akka_game_of_life_tpu.ops import digest as odigest
+
+            meta["digest"] = odigest.format_digest(self.board_digest())
         npz = self.config.checkpoint_format == "npz"
         if npz and jax.process_count() > 1 and jax.process_index() != 0:
             # The npz store is a host-side writer: exactly one process owns

@@ -28,6 +28,13 @@ import subprocess
 import sys
 import time
 
+from bench_params import (
+    HEADLINE_BLOCK_ROWS,
+    HEADLINE_SIZE,
+    HEADLINE_STEPS_PER_CALL,
+    HEADLINE_TIMED_CALLS,
+)
+
 PER_CHIP_TARGET = 1.0e11 / 8  # north-star aggregate spread over v5e-8 chips
 
 # A tiny device-touch run in a THROWAWAY subprocess.  On this image the TPU is
@@ -211,9 +218,13 @@ def _freshest_archived_headline() -> dict | None:
         return None
 
 
-def main() -> None:
+def build_parser() -> argparse.ArgumentParser:
+    """The headline CLI.  A module-level factory (not inlined in main) so
+    the params-lockstep test can assert these defaults equal
+    ``bench_params`` — the contract that keeps ``tools/prewarm.py``
+    compiling the exact program this benchmark runs."""
     parser = argparse.ArgumentParser()
-    parser.add_argument("--size", type=int, default=65536)
+    parser.add_argument("--size", type=int, default=HEADLINE_SIZE)
     parser.add_argument(
         "--kernel",
         choices=["auto", "bitpack", "pallas", "roll"],
@@ -225,9 +236,11 @@ def main() -> None:
         action="store_true",
         help="emit only the 65536^2 headline line (skip the other BASELINE configs)",
     )
-    parser.add_argument("--steps-per-call", type=int, default=64)
-    parser.add_argument("--timed-calls", type=int, default=2)
-    parser.add_argument("--block-rows", type=int, default=128)
+    parser.add_argument(
+        "--steps-per-call", type=int, default=HEADLINE_STEPS_PER_CALL
+    )
+    parser.add_argument("--timed-calls", type=int, default=HEADLINE_TIMED_CALLS)
+    parser.add_argument("--block-rows", type=int, default=HEADLINE_BLOCK_ROWS)
     parser.add_argument(
         "--steps-per-sweep", type=int, default=None,
         help="pallas temporal-block depth (default: auto-pick a divisor)",
@@ -265,6 +278,11 @@ def main() -> None:
         "final headline line still prints (r3b measured the full aux set "
         "at ~10 min on the chip)",
     )
+    return parser
+
+
+def main() -> None:
+    parser = build_parser()
     args = parser.parse_args()
     if args.vmem_limit_mb < 0:
         parser.error(f"--vmem-limit-mb {args.vmem_limit_mb} must be >= 0")
@@ -325,7 +343,7 @@ def main() -> None:
             )
             if probe_device(min(args.probe_timeout, 120.0), 1, "cpu") is None:
                 fallback = "cpu"
-                if args.size == 65536:
+                if args.size == HEADLINE_SIZE:
                     # The chip headline size takes ~17 min on this host's
                     # CPU (~8e8 cell-updates/s measured); scale the
                     # fallback run to about a minute.  The metric label
@@ -497,7 +515,7 @@ def main() -> None:
         cmd = [
             sys.executable,
             str(pathlib.Path(__file__).resolve().parent / "bench_suite.py"),
-            "--config", "1", "2", "3", "4", "7", "8",
+            "--config", "1", "2", "3", "4", "7", "8", "10",
         ]
         if args.platform or fallback:
             cmd += ["--platform", args.platform or fallback]

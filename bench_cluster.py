@@ -14,9 +14,15 @@ seeded multi-worker loopback cluster twice:
 
 and reports, in the BENCH record format (one JSON line each): aggregate
 cell-updates/sec, peer-plane frames/epoch, and wire bytes/epoch per
-variant, then the A/B reduction ratios.  Both runs' final boards are
-checked bit-identical to the dense single-process oracle — a wire-format
-optimization that changes the simulation is not an optimization.
+variant, then the A/B reduction ratios.  Both runs certify their final
+state against the dense single-process oracle via the 64-bit digest plane
+(``ops/digest.py``): each worker digests its tiles locally, the frontend
+merges the lanes in O(tiles) bytes, and the merged value must equal the
+oracle board's digest — a wire-format optimization that changes the
+simulation is not an optimization.  At ≤ 1024² the full boards are
+ADDITIONALLY compared bit-for-bit, which is the digest's own oracle;
+above that the digest IS the certification and no board is ever
+assembled or fetched.
 
 Usage:
   python bench_cluster.py                    # defaults (CPU-friendly)
@@ -64,6 +70,7 @@ def _run_variant(
         height=size, width=size, seed=0, max_epochs=epochs,
         exchange_width=exchange_width, tiles_per_worker=tiles_per_worker,
         ring_pack=ring_pack, ring_batch=ring_batch, flight_dir="",
+        obs_digest=True,
     )
     registry = install(MetricsRegistry())
     t0 = time.perf_counter()
@@ -72,9 +79,10 @@ def _run_variant(
         engine=engine, registry=registry,
     ) as h:
         final = h.run_to_completion(timeout=1200)
+        final_digest = h.frontend.final_digest
     dt = time.perf_counter() - t0
     snap = registry.snapshot()
-    return cfg, final, dt, {
+    return cfg, final, final_digest, dt, {
         # Peer data-plane frames (ring/batch frames + pull asks + hellos)
         # and the bytes that actually hit the wire, per simulated epoch.
         "frames_per_epoch": snap.get("gol_peer_sends_total", 0.0) / epochs,
@@ -117,14 +125,16 @@ def bench_cluster_halo(
     config = f"cluster-halo-{size}"
     stats = {}
     finals = {}
+    digests = {}
     for label, pack, batch in (("raw", False, False), ("packed", True, True)):
-        cfg, final, dt, s = _run_variant(
+        cfg, final, final_digest, dt, s = _run_variant(
             size=size, epochs=epochs, workers=workers,
             tiles_per_worker=tiles_per_worker,
             exchange_width=exchange_width, engine=engine,
             ring_pack=pack, ring_batch=batch,
         )
         stats[label], finals[label] = s, final
+        digests[label] = final_digest
         emit(
             json.dumps(
                 {
@@ -149,8 +159,18 @@ def bench_cluster_halo(
             flush=True,
         )
 
+    from akka_game_of_life_tpu.ops import digest as odigest
+
+    # Certification is digest-first: merged per-tile digests (O(tiles)
+    # bytes through the control plane) against the dense oracle's digest.
+    # Full-board comparison is retained only at ≤ 1024², where it serves
+    # as the digest's own oracle — above that nothing assembles a board.
     oracle = _oracle(cfg, epochs)
-    oracle_ok = all(np.array_equal(f, oracle) for f in finals.values())
+    oracle_digest = odigest.value(odigest.digest_dense_np(oracle))
+    digest_ok = all(d == oracle_digest for d in digests.values())
+    oracle_ok = None
+    if size <= 1024:
+        oracle_ok = all(np.array_equal(f, oracle) for f in finals.values())
 
     def _ratio(a: float, b: float):
         # A single-worker run has no remote peer traffic at all: report
@@ -177,13 +197,28 @@ def bench_cluster_halo(
         "vs_baseline": byte_ratio,
         "wire_bytes_reduction": byte_ratio,
         "frames_reduction": frame_ratio,
+        "digest_certified": digest_ok,
+        "final_digest": odigest.format_digest(oracle_digest),
+        # Bit-for-bit board comparison only at ≤ 1024² (the digest's own
+        # oracle); null above — the digest is the certification there.
         "oracle_bit_identical": oracle_ok,
     }
     emit(json.dumps(summary), flush=True)
-    if not oracle_ok:
+    if not digest_ok:
+        got = {
+            k: odigest.format_digest(v) if v is not None else None
+            for k, v in digests.items()
+        }
         raise AssertionError(
-            f"{config}: a variant's final board diverged from the dense "
-            f"oracle — the wire plane is corrupting the simulation"
+            f"{config}: a variant's merged final digest diverged from the "
+            f"dense oracle's ({got} vs "
+            f"{odigest.format_digest(oracle_digest)}) — the wire plane is "
+            f"corrupting the simulation"
+        )
+    if oracle_ok is False:
+        raise AssertionError(
+            f"{config}: digests matched but the boards differ — the digest "
+            f"plane itself is broken (collision or layout bug)"
         )
     return summary
 

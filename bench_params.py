@@ -1,0 +1,21 @@
+"""Headline benchmark parameters — ONE source of truth.
+
+The persistent-compile-cache prewarm (``tools/prewarm.py``) is only useful
+if it compiles the EXACT program the headline (``bench.py``) runs: the
+cache key is the traced program, so any drift in size, steps-per-call,
+block rows, or timed calls silently turns the prewarm stage into a no-op
+and the driver's end-of-round ``bench.py`` pays the 20-40 s tunnel compile
+again.  Both scripts import these constants, and
+``tests/test_bench_record.py::test_headline_params_lockstep`` (tier-1)
+asserts that ``bench.py``'s argparse defaults and ``tools/prewarm.py``'s
+program parameters all resolve to these values.
+"""
+
+# 65536² Conway torus — the BASELINE.json flagship config.
+HEADLINE_SIZE = 65536
+# Epochs per jitted call (one device round-trip per call).
+HEADLINE_STEPS_PER_CALL = 64
+# Mosaic VMEM row block (measured-best at 65536² — BASELINE.md).
+HEADLINE_BLOCK_ROWS = 128
+# Timed calls after the warm-up call.
+HEADLINE_TIMED_CALLS = 2

@@ -28,6 +28,13 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
                          seeded 2-worker loopback cluster (bench_cluster.py):
                          cell-updates/sec, frames/epoch, wire bytes/epoch,
                          and the reduction ratios, oracle-checked.
+ 10. digest-8192         digest certification vs full-board fetch at 8192²:
+                         host-transferred bytes and wall-clock to certify a
+                         packed board's state via the on-device 64-bit
+                         digest (~8 fetched bytes, ops/digest.py) against
+                         fetching the whole board and digesting on host —
+                         the observation/validation data-path win, plus the
+                         digest's share of a 64-step chunk's wall-clock.
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -494,6 +501,84 @@ def bench_sharded(size: int, steps: int = 64) -> None:
     )
 
 
+def bench_digest_certification(size: int, steps: int = 64) -> None:
+    """Config 10: certify a packed board's state two ways and price both.
+
+    A. **digest** — the on-device 64-bit fingerprint (ops/digest.py):
+       compute on device, fetch 8 bytes.
+    B. **full fetch** — bring the packed board to the host (size²/8 bytes)
+       and digest it there (what any host-side comparison fundamentally
+       pays; at 65536² over the ~21 MB/s tunnel that transfer alone is
+       ~24.5 s, which is why the 65536² A/Bs historically compared
+       throughput but never state).
+
+    Both must produce the SAME value — the full fetch is the digest's own
+    oracle — and the emitted record carries the bytes reduction, both
+    wall-clocks, and the digest's share of a ``steps``-epoch chunk
+    (acceptance: ≥ 50× fewer host bytes, < 5% of chunk wall-clock)."""
+    import jax
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.ops import bitpack, digest as odigest
+    from akka_game_of_life_tpu.ops.rules import CONWAY
+
+    rng = np.random.default_rng(0)
+    board = jnp.asarray(
+        rng.integers(0, 2**32, size=(size, size // 32), dtype=np.uint32)
+    )
+    run = bitpack.packed_multi_step_fn(CONWAY, steps)
+    dfn = jax.jit(lambda x: odigest.digest_packed(x, size))
+
+    board = run(board)  # a non-trivial evolved state
+    _ = np.asarray(dfn(board))  # warm the digest compile
+    _ = int(jnp.sum(jnp.bitwise_count(board)))  # warm pop + sync
+
+    t0 = time.perf_counter()
+    board = run(board)
+    assert int(jnp.sum(jnp.bitwise_count(board))) > 0  # sync the chunk
+    chunk_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    lanes = np.asarray(dfn(board), dtype=np.uint32)  # ~8-byte fetch
+    digest_s = time.perf_counter() - t0
+    digest = odigest.value(lanes)
+
+    t0 = time.perf_counter()
+    words = np.asarray(board)  # the full-board host transfer
+    full = odigest.value(odigest.digest_packed_np(words, size))
+    full_s = time.perf_counter() - t0
+
+    assert full == digest, (
+        f"digest certification diverged from the full-fetch oracle: "
+        f"{digest:016x} != {full:016x}"
+    )
+    bytes_full = int(words.nbytes)
+    bytes_digest = int(lanes.nbytes)
+    line = {
+        "config": f"digest-{size}",
+        "metric": (
+            f"digest certification: host bytes, full-board fetch / "
+            f"on-device digest, conway {size}x{size} packed"
+        ),
+        "value": bytes_full / bytes_digest,
+        "unit": "x",
+        "vs_baseline": bytes_full / bytes_digest,
+        "host_bytes_full": bytes_full,
+        "host_bytes_digest": bytes_digest,
+        "full_fetch_seconds": full_s,
+        "digest_seconds": digest_s,
+        "wallclock_reduction": full_s / digest_s if digest_s > 0 else None,
+        "chunk_seconds": chunk_s,
+        # The cost of certifying EVERY chunk (obs_digest at chunk cadence).
+        "digest_overhead_vs_chunk": digest_s / chunk_s if chunk_s > 0 else None,
+        "digest": odigest.format_digest(digest),
+    }
+    snap = registry_snapshot()
+    if snap:
+        line["metrics"] = snap
+    print(json.dumps(line), flush=True)
+
+
 def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
     """Config 6: the TCP cluster's width-k communication-avoiding exchange —
     an in-process frontend + 2 workers (jax engines) stepping a size² board
@@ -555,7 +640,8 @@ def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--config", type=int, nargs="*", default=[1, 2, 3, 4, 5, 6, 7, 8, 9]
+        "--config", type=int, nargs="*",
+        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
     )
     parser.add_argument(
         "--scale", type=float, default=1.0,
@@ -608,6 +694,10 @@ def main() -> None:
         from bench_cluster import bench_cluster_halo
 
         bench_cluster_halo(size=s(1024), epochs=32)
+    if 10 in args.config:
+        # Digest certification vs full-board fetch (PR 5): the
+        # observation/validation data-path win, in bytes and seconds.
+        bench_digest_certification(s(8192))
 
 
 if __name__ == "__main__":

@@ -20,6 +20,34 @@ REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 
 import bench  # noqa: E402
+import bench_params  # noqa: E402
+
+
+def test_headline_params_lockstep(monkeypatch):
+    """The prewarm stage is a no-op unless it compiles the EXACT headline
+    program (the compile-cache key is the traced program), so bench.py's
+    argparse defaults and tools/prewarm.py's parameters must both resolve
+    to the shared bench_params constants — drift here silently costs the
+    round its 20-40 s tunnel compile back (ADVICE r5 #1)."""
+    args = bench.build_parser().parse_args([])
+    assert args.size == bench_params.HEADLINE_SIZE
+    assert args.steps_per_call == bench_params.HEADLINE_STEPS_PER_CALL
+    assert args.block_rows == bench_params.HEADLINE_BLOCK_ROWS
+    assert args.timed_calls == bench_params.HEADLINE_TIMED_CALLS
+
+    # prewarm resolves its program parameters at import time from argv;
+    # import it bare-argv (the production spelling) and assert lockstep.
+    import importlib.util
+
+    monkeypatch.setattr(sys, "argv", ["prewarm.py"])
+    spec = importlib.util.spec_from_file_location(
+        "prewarm_under_test", REPO / "tools" / "prewarm.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.N == bench_params.HEADLINE_SIZE
+    assert mod.STEPS_PER_CALL == bench_params.HEADLINE_STEPS_PER_CALL
+    assert mod.BLOCK_ROWS == bench_params.HEADLINE_BLOCK_ROWS
 
 
 def test_freshest_archived_headline_finds_the_hardware_record():

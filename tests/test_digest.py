@@ -1,0 +1,469 @@
+"""Digest plane: O(1)-byte state certification across every path.
+
+The contract under test (docs/OPERATIONS.md "Digest certification"): one
+board, one 64-bit value — reproduced bit-identically by every layout and
+execution path that can hold that board (dense uint8, bit-packed words,
+Generations bit planes, LtL dense, the shard_map+psum mesh folds, and
+merged per-tile cluster digests), recorded in checkpoint metadata, and
+surfaced as a product observation (metrics lines, PROGRESS merges) — all
+on CPU, no TPU dependency.
+"""
+
+import io
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from akka_game_of_life_tpu.ops import bitpack, bitpack_gen
+from akka_game_of_life_tpu.ops import digest as D
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.runtime.config import SimulationConfig, load_config
+from akka_game_of_life_tpu.runtime.harness import cluster
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import Simulation, initial_board
+
+
+def _rand(h, w, states=2, seed=0):
+    return np.random.default_rng(seed).integers(0, states, (h, w), np.uint8)
+
+
+def _dense_oracle(board, rule, epochs):
+    from akka_game_of_life_tpu.models import get_model
+
+    return np.asarray(get_model(rule).run(epochs)(jnp.asarray(board)))
+
+
+# -- one value per board, every layout -----------------------------------------
+
+
+def test_dense_np_and_jit_agree_multistate():
+    b = _rand(64, 96, states=5, seed=1)
+    want = D.digest_dense_np(b)
+    got = np.asarray(jax.jit(D.digest_dense)(jnp.asarray(b)))
+    assert np.array_equal(got, want)
+    assert want.dtype == np.uint32 and want.shape == (2,)
+
+
+def test_packed_layout_matches_dense():
+    b = _rand(64, 128, seed=2)
+    want = D.digest_dense_np(b)
+    assert np.array_equal(D.digest_packed_np(bitpack.pack_np(b), 128), want)
+    got = np.asarray(
+        jax.jit(lambda x: D.digest_packed(x, 128))(jnp.asarray(bitpack.pack_np(b)))
+    )
+    assert np.array_equal(got, want)
+
+
+def test_plane_layout_matches_dense():
+    for rule, seed in (("brians-brain", 3), ("wireworld", 4), ("star-wars", 5)):
+        states = resolve_rule(rule).states
+        g = _rand(32, 64, states=states, seed=seed)
+        planes = bitpack_gen.pack_gen_np(g, states)
+        want = D.digest_dense_np(g)
+        assert np.array_equal(D.digest_planes_np(planes, 64), want), rule
+        got = np.asarray(
+            jax.jit(lambda p: D.digest_planes(p, 64))(jnp.asarray(planes))
+        )
+        assert np.array_equal(got, want), rule
+
+
+def test_kernel_families_produce_one_digest():
+    """Evolve the same board through different kernel families and assert
+    each family's NATIVE layout digests to the dense kernel's value —
+    cross-path certification, not just cross-layout encoding."""
+    # Binary: dense roll-sum vs packed SWAR, digested in their own layouts.
+    b0 = _rand(64, 64, seed=6)
+    dense = _dense_oracle(b0, "conway", 8)
+    packed = bitpack.packed_multi_step_fn("conway", 8)(
+        jnp.asarray(bitpack.pack_np(b0))
+    )
+    assert D.value(D.digest_dense_np(dense)) == D.value(
+        np.asarray(jax.jit(lambda x: D.digest_packed(x, 64))(packed))
+    )
+    # Generations: dense kernel vs bit-plane SWAR kernel.
+    g0 = _rand(32, 64, states=3, seed=7)
+    gdense = _dense_oracle(g0, "brians-brain", 6)
+    gplanes = bitpack_gen.gen_multi_step_fn("brians-brain", 6)(
+        jnp.asarray(bitpack_gen.pack_gen_np(g0, 3))
+    )
+    assert D.value(D.digest_dense_np(gdense)) == D.value(
+        np.asarray(jax.jit(lambda p: D.digest_planes(p, 64))(gplanes))
+    )
+    # LtL: radius-5 dense kernel output certifies through the dense digest.
+    l0 = _rand(48, 48, seed=8)
+    ldense = _dense_oracle(l0, "bugs", 2)
+    assert np.array_equal(
+        np.asarray(jax.jit(D.digest_dense)(jnp.asarray(ldense))),
+        D.digest_dense_np(ldense),
+    )
+
+
+def test_tile_merge_equals_whole_board():
+    b = _rand(48, 80, states=3, seed=9)
+    whole = D.digest_dense_np(b)
+    parts = [
+        D.digest_dense_np(b[:20, :32], (0, 0), 80),
+        D.digest_dense_np(b[:20, 32:], (0, 32), 80),
+        D.digest_dense_np(b[20:, :], (20, 0), 80),
+    ]
+    assert np.array_equal(D.merge_lanes(parts), whole)
+    # The payload form (what the cluster io path digests) agrees too.
+    from akka_game_of_life_tpu.runtime.wire import pack_tile
+
+    payload_parts = [
+        D.digest_payload_np(pack_tile(b[:20, :32]), (0, 0), 80),
+        D.digest_payload_np(pack_tile(b[:20, 32:]), (0, 32), 80),
+        D.digest_payload_np(pack_tile(b[20:, :]), (20, 0), 80),
+    ]
+    assert np.array_equal(D.merge_lanes(payload_parts), whole)
+
+
+def test_merge_is_order_independent():
+    parts = [D.digest_dense_np(_rand(8, 8, seed=s)) for s in range(5)]
+    a = D.merge_lanes(parts)
+    b = D.merge_lanes(reversed(parts))
+    assert np.array_equal(a, b)
+
+
+def test_value_and_format():
+    lanes = np.asarray([0x1234ABCD, 0xDEAD0001], np.uint32)
+    v = D.value(lanes)
+    assert v == (0xDEAD0001 << 32) | 0x1234ABCD
+    assert D.format_digest(v) == "dead00011234abcd"
+
+
+# -- shard_map + psum folds on the virtual 8-device mesh -----------------------
+
+
+def test_sharded_psum_folds_match_host_digests():
+    from jax.sharding import NamedSharding
+
+    from akka_game_of_life_tpu.parallel import digest as PD
+    from akka_game_of_life_tpu.parallel.mesh import (
+        GEN_SPEC,
+        make_grid_mesh,
+        shard_board,
+    )
+    from akka_game_of_life_tpu.parallel.packed_halo2d import shard_packed2d
+
+    mesh = make_grid_mesh()  # the conftest's virtual 8 devices, auto 4x2
+    h, w = 64, 256
+
+    b = _rand(h, w, seed=10)
+    want = D.digest_dense_np(b)
+    got = np.asarray(
+        PD.sharded_dense_digest_fn(mesh, (h, w))(
+            shard_board(jnp.asarray(b), mesh)
+        )
+    )
+    assert np.array_equal(got, want)
+
+    words = shard_packed2d(jnp.asarray(bitpack.pack_np(b)), mesh)
+    got = np.asarray(PD.sharded_packed2d_digest_fn(mesh, (h, w))(words))
+    assert np.array_equal(got, want)
+
+    g = _rand(h, w, states=3, seed=11)
+    planes = jax.device_put(
+        jnp.asarray(bitpack_gen.pack_gen_np(g, 3)),
+        NamedSharding(mesh, GEN_SPEC),
+    )
+    got = np.asarray(PD.sharded_gen_digest_fn(mesh, (h, w), 3)(planes))
+    assert np.array_equal(got, D.digest_dense_np(g))
+
+
+# -- collision smoke -----------------------------------------------------------
+
+
+def test_collision_smoke():
+    """No collisions across hundreds of related boards: random boards at
+    several densities/seeds, every single-cell board on a 16x16 torus
+    (pure position sensitivity), and per-state variants of one cell
+    (pure state weighting)."""
+    seen = {}
+
+    def check(label, board):
+        v = D.value(D.digest_dense_np(board))
+        assert v not in seen, f"collision: {label} vs {seen[v]}"
+        seen[v] = label
+
+    rng = np.random.default_rng(42)
+    for i in range(128):
+        check(
+            f"rand{i}",
+            (rng.random((64, 64)) < rng.uniform(0.05, 0.95)).astype(np.uint8),
+        )
+    for r in range(16):
+        for c in range(16):
+            b = np.zeros((16, 16), np.uint8)
+            b[r, c] = 1
+            check(f"cell{r},{c}", b)
+    for s in range(2, 8):
+        b = np.zeros((16, 16), np.uint8)
+        b[3, 5] = s
+        check(f"state{s}", b)
+    check("empty", np.zeros((16, 16), np.uint8))
+
+
+# -- Simulation observation mode ----------------------------------------------
+
+
+def _single_device(monkeypatch):
+    one = jax.devices()[:1]
+    monkeypatch.setattr(jax, "devices", lambda *a: one)
+
+
+def _run_sim(tmp_path, *, kernel, rule="conway", obs_defer=False, seed=3):
+    out = io.StringIO()
+    cfg = load_config(
+        overrides=dict(
+            height=64, width=64, rule=rule, seed=seed, kernel=kernel,
+            steps_per_call=10, max_epochs=40, metrics_every=20,
+            obs_digest=True, obs_defer=obs_defer,
+        )
+    )
+    sim = Simulation(
+        cfg, observer=BoardObserver(out=out, metrics_every=20)
+    )
+    sim.advance()
+    final = sim.board_host()
+    sim.close()
+    return sim, final, out.getvalue()
+
+
+@pytest.mark.parametrize("kernel,rule", [
+    ("dense", "conway"),
+    ("bitpack", "conway"),
+    ("bitpack", "brians-brain"),
+])
+def test_simulation_obs_digest_metrics_lines(monkeypatch, tmp_path, kernel, rule):
+    _single_device(monkeypatch)
+    sim, final, text = _run_sim(tmp_path, kernel=kernel, rule=rule)
+    digs = re.findall(r"digest=([0-9a-f]{16})", text)
+    assert len(digs) == 2  # epochs 20 and 40
+    # The final line's digest is the final board's digest, independently
+    # recomputed on host from the fetched board.
+    want = D.format_digest(D.value(D.digest_dense_np(final)))
+    assert digs[-1] == want
+    # And board_digest() (the certification primitive) agrees.
+    assert D.format_digest(sim.board_digest()) == want
+    assert sim.metrics.counter("gol_digest_checks_total").value >= 2
+
+
+def test_simulation_obs_digest_defer_identical(monkeypatch, tmp_path):
+    _single_device(monkeypatch)
+    _, _, sync_text = _run_sim(tmp_path, kernel="bitpack")
+    _, _, defer_text = _run_sim(tmp_path, kernel="bitpack", obs_defer=True)
+    assert re.findall(r"digest=[0-9a-f]{16}", sync_text) == re.findall(
+        r"digest=[0-9a-f]{16}", defer_text
+    )
+
+
+def test_two_kernels_same_run_same_digest_lines(monkeypatch, tmp_path):
+    """The A/B certification story end to end: the same configured run on
+    two kernels prints identical digests at every cadence point."""
+    _single_device(monkeypatch)
+    _, _, a = _run_sim(tmp_path, kernel="dense")
+    _, _, b = _run_sim(tmp_path, kernel="bitpack")
+    da = re.findall(r"digest=[0-9a-f]{16}", a)
+    assert da and da == re.findall(r"digest=[0-9a-f]{16}", b)
+
+
+# -- checkpoint stores ---------------------------------------------------------
+
+
+def test_checkpoint_records_and_validates_digest(tmp_path):
+    from akka_game_of_life_tpu.runtime.checkpoint import (
+        CheckpointStore,
+        describe_store,
+    )
+
+    store = CheckpointStore(str(tmp_path))
+    b = _rand(32, 64, states=3, seed=12)  # multi-state: dense layout
+    store.save(5, b, "/2/3", record_digest=True)
+    words = bitpack.pack_np(_rand(32, 64, seed=13))
+    store.save_packed32(9, words, (32, 64), "B3/S23", record_digest=True)
+    infos = {i["epoch"]: i for i in describe_store(str(tmp_path), validate=True)}
+    assert infos[5]["digest"] == D.format_digest(D.value(D.digest_dense_np(b)))
+    assert infos[9]["digest"] == D.format_digest(
+        D.value(D.digest_packed_np(words, 64))
+    )
+    assert all(i["ok"] and i["digest_ok"] for i in infos.values())
+
+
+def test_checkpoint_save_skips_digest_unless_asked(tmp_path):
+    """The host-side digest is an opt-in: a default save (obs_digest off)
+    must not pay O(board) digest compute — at 65536² that would add
+    minutes per packed save for a feature nobody enabled.  A caller-
+    provided meta digest is kept verbatim, never recomputed."""
+    from akka_game_of_life_tpu.runtime.checkpoint import (
+        CheckpointStore,
+        describe_store,
+    )
+
+    store = CheckpointStore(str(tmp_path))
+    store.save(1, _rand(16, 16, states=3, seed=30), "/2/3")
+    (info,) = describe_store(str(tmp_path))
+    assert "digest" not in info
+    store.save(2, _rand(16, 16, states=3, seed=30), "/2/3",
+               meta={"digest": "00000000deadbeef"}, record_digest=True)
+    infos = {i["epoch"]: i for i in describe_store(str(tmp_path))}
+    assert infos[2]["digest"] == "00000000deadbeef"
+
+
+def test_simulation_checkpoint_records_device_digest(monkeypatch, tmp_path):
+    """Product flow: an obs_digest run's checkpoints carry the ON-DEVICE
+    digest in meta (8 fetched bytes, no host recompute), and the
+    `checkpoints` CLI validates it against the stored payload."""
+    from akka_game_of_life_tpu.runtime.checkpoint import describe_store
+
+    _single_device(monkeypatch)
+    cfg = load_config(
+        overrides=dict(
+            height=64, width=64, seed=16, kernel="bitpack",
+            steps_per_call=10, max_epochs=20, obs_digest=True,
+            checkpoint_dir=str(tmp_path), checkpoint_every=10,
+            checkpoint_async=False,
+        )
+    )
+    sim = Simulation(cfg, observer=BoardObserver(out=io.StringIO()))
+    sim.advance()
+    want = D.format_digest(D.value(D.digest_dense_np(sim.board_host())))
+    sim.close()
+    infos = {i["epoch"]: i for i in describe_store(str(tmp_path), validate=True)}
+    assert infos[20]["digest"] == want
+    assert all(i["digest_ok"] for i in infos.values())
+
+
+def test_checkpoint_validate_flags_corruption(tmp_path):
+    """A bit flip in the stored payload (metadata intact) must fail
+    --validate via the digest — the corruption a shape check can't see."""
+    from akka_game_of_life_tpu.runtime.checkpoint import (
+        CheckpointStore,
+        describe_store,
+    )
+
+    store = CheckpointStore(str(tmp_path))
+    b = _rand(32, 32, states=3, seed=14)
+    path = store.save(4, b, "/2/3", record_digest=True)
+    with np.load(path) as z:
+        payload = {k: z[k].copy() for k in z.files}
+    payload["board"][0, 0] ^= 1  # one cell, meta untouched
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **payload)
+    (info,) = describe_store(str(tmp_path), validate=True)
+    assert info["digest_ok"] is False and info["ok"] is False
+    assert "digest mismatch" in info["error"]
+
+
+def test_cli_checkpoints_exits_nonzero_on_digest_mismatch(tmp_path, capsys):
+    from akka_game_of_life_tpu.cli import main
+    from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+
+    store = CheckpointStore(str(tmp_path))
+    path = store.save(
+        2, _rand(16, 16, states=3, seed=15), "/2/3", record_digest=True
+    )
+    assert main(["checkpoints", str(tmp_path), "--validate"]) == 0
+    with np.load(path) as z:
+        payload = {k: z[k].copy() for k in z.files}
+    payload["board"][1, 1] += 1
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **payload)
+    assert main(["checkpoints", str(tmp_path), "--validate"]) == 1
+    assert "digest mismatch" in capsys.readouterr().out
+
+
+# -- cluster: merged per-tile digests ------------------------------------------
+
+
+def test_cluster_digest_under_chaos_and_redeploy(tmp_path):
+    """Merged per-tile digests equal the dense oracle under injected tile
+    crashes plus an explicit mid-run redeploy — the recovery machinery
+    replays through digest-due epochs and the floor logic dedupes the
+    re-reports."""
+    import time
+
+    from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
+
+    cfg = SimulationConfig(
+        height=32, width=32, seed=21, max_epochs=40,
+        checkpoint_dir=str(tmp_path), checkpoint_every=8, metrics_every=8,
+        obs_digest=True,
+        fault_injection=FaultInjectionConfig(
+            enabled=True, first_after_s=0.05, every_s=0.2, max_crashes=2,
+            mode="tile",
+        ),
+    )
+    with cluster(cfg, 2, observer=BoardObserver(out=io.StringIO())) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        # One explicit supervision replay mid-run, on top of the injector.
+        deadline = time.monotonic() + 30
+        while min(h.frontend.tile_epochs.values(), default=0) < 8:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        h.frontend._redeploy_tile(next(iter(h.frontend.tile_owner)))
+        assert h.frontend.done.wait(60), "cluster did not finish"
+        assert h.frontend.error is None, h.frontend.error
+        fd = h.frontend.final_digest
+        assert h.frontend.crash_events, "chaos never fired"
+    oracle = _dense_oracle(initial_board(cfg), "conway", 40)
+    assert fd == D.value(D.digest_dense_np(oracle))
+
+
+def test_cluster_finalize_records_digest_and_recovery_certifies(tmp_path):
+    from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+
+    cfg = SimulationConfig(
+        height=32, width=32, seed=22, max_epochs=12,
+        checkpoint_dir=str(tmp_path), checkpoint_every=4, obs_digest=True,
+    )
+    with cluster(cfg, 2, observer=BoardObserver(out=io.StringIO())) as h:
+        h.run_to_completion()
+    store = CheckpointStore(str(tmp_path))
+    epoch = store.latest_epoch()
+    meta = store.tile_meta(epoch)
+    assert re.fullmatch(r"[0-9a-f]{16}", meta["digest"])
+    assert store.tile_digest(epoch) == int(meta["digest"], 16)
+
+    # Corrupt one stored tile (payload only); a frontend restarting from
+    # this store must refuse the recovery source, loudly.
+    tile_file = next((store._tile_dir(epoch)).glob("tile_*.npz"))
+    with np.load(tile_file) as z:
+        payload = {k: z[k].copy() for k in z.files}
+    payload["data"] = payload["data"].copy()
+    payload["data"][0] ^= 1
+    with open(tile_file, "wb") as f:
+        np.savez_compressed(f, **payload)
+    cfg2 = SimulationConfig(
+        height=32, width=32, seed=22, max_epochs=16,
+        checkpoint_dir=str(tmp_path), checkpoint_every=4, obs_digest=True,
+    )
+    with cluster(cfg2, 2, observer=BoardObserver(out=io.StringIO())) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        with pytest.raises(ValueError, match="digest certification"):
+            h.frontend.start_simulation()
+        assert (
+            h.frontend.metrics.counter("gol_digest_mismatches_total").value
+            == 1
+        )
+
+
+def test_bench_cluster_digest_certifies_small():
+    """bench_cluster's A/B at a tiny size: digest certification passes AND
+    (≤ 1024², so retained) the bit-identical oracle agrees — the digest's
+    own oracle."""
+    from bench_cluster import bench_cluster_halo
+
+    lines = []
+    summary = bench_cluster_halo(
+        size=64, epochs=8, workers=2, tiles_per_worker=2,
+        emit=lambda s, **k: lines.append(s),
+    )
+    assert summary["digest_certified"] is True
+    assert summary["oracle_bit_identical"] is True
+    assert re.fullmatch(r"[0-9a-f]{16}", summary["final_digest"])

@@ -181,3 +181,43 @@ def test_defer_dense_kernel_window_path(tmp_path):
         (m.epoch, m.population) for m in obs_d.history
     ]
     np.testing.assert_array_equal(sim_s.board_host(), sim_d.board_host())
+
+
+def test_defer_failed_window_post_consumes_record(monkeypatch):
+    """ADVICE r5 #3: ``on_fetched`` fires right after the RAW device
+    fetches — a deterministic error in the window's host-side ``post()``
+    consumes the record instead of re-queueing it, so one bad record
+    cannot poison every subsequent flush with the same failure."""
+    import jax
+
+    one = jax.devices()[:1]
+    monkeypatch.setattr(jax, "devices", lambda *a: one)
+    out = io.StringIO()
+    cfg = load_config(
+        overrides={
+            "height": 64,
+            "width": 64,
+            "pattern": "gosper-glider-gun",
+            "kernel": "bitpack",
+            "render_every": 60,
+            "probe_window": (2, 11, 2, 38),
+            "obs_defer": True,
+        }
+    )
+    observer = BoardObserver(
+        out=out, render_every=cfg.render_every, metrics_every=20
+    )
+    sim = Simulation(cfg, observer=observer)
+    # Epoch 0 is render cadence, so the record carries a probe window.
+    sim._pending_obs.append(sim._obs_dispatch(True))
+    handle, _ = sim._pending_obs[0]["win"]
+
+    def bad_post(_):
+        raise ValueError("deterministic post failure")
+
+    sim._pending_obs[0]["win"] = (handle, bad_post)
+    with pytest.raises(ValueError, match="deterministic post failure"):
+        sim._obs_resolve()
+    assert sim._pending_obs == []  # consumed the moment fetches succeeded
+    sim._obs_resolve()  # poison-free: nothing pending, nothing re-raised
+    sim.close()

@@ -140,6 +140,20 @@ def test_unpark_expired_ages_out_parked_markers(tmp_path):
     assert (tmp_path / "done" / "selftest.parked").exists()  # still fresh
 
 
+def test_unpark_expired_vanished_marker_does_not_abort_the_pass(tmp_path):
+    """A marker that disappears between glob expansion and the existence
+    check (a racing stage-success/new_window deletion, simulated with a
+    dangling symlink) must be SKIPPED, not end the function — or one race
+    would leave every remaining parked marker (here: an expired headline,
+    the round's scored stage) skipped for the whole pass (ADVICE r5 #2)."""
+    (tmp_path / "done").mkdir()
+    # Sorts before headline.parked; exists for the glob, fails -e.
+    (tmp_path / "done" / "aaa.parked").symlink_to("/nonexistent-target")
+    (tmp_path / "done" / "headline.parked").write_text("5")  # long expired
+    _bash(tmp_path, "unpark_expired")
+    assert not (tmp_path / "done" / "headline.parked").exists()
+
+
 def test_sigkill_counts_toward_separate_higher_cap(tmp_path):
     # rc=137 is ambiguous (timeout -k kill of a SIGTERM-immune wedge vs
     # the OOM killer); it must not park at the deterministic cap but also

@@ -35,13 +35,21 @@ _apply_platform(None)  # pins the image's platform + arms the compile cache
 
 from akka_game_of_life_tpu.ops import bitpack, pallas_stencil  # noqa: E402
 from akka_game_of_life_tpu.ops.rules import CONWAY  # noqa: E402
+from bench_params import (  # noqa: E402 — the shared headline constants:
+    # bench.py's argparse defaults import the SAME names, and the tier-1
+    # lockstep test pins both, so the cache key cannot silently drift and
+    # turn this stage into a no-op.
+    HEADLINE_BLOCK_ROWS,
+    HEADLINE_SIZE,
+    HEADLINE_STEPS_PER_CALL,
+)
 
 # bench.py defaults (--size / --steps-per-call / --block-rows); argv
 # overrides exist ONLY for CPU smoke tests — a non-default size compiles
 # a different program and warms nothing the headline uses.
-N = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
-STEPS_PER_CALL = int(sys.argv[2]) if len(sys.argv) > 2 else 64
-BLOCK_ROWS = 128
+N = int(sys.argv[1]) if len(sys.argv) > 1 else HEADLINE_SIZE
+STEPS_PER_CALL = int(sys.argv[2]) if len(sys.argv) > 2 else HEADLINE_STEPS_PER_CALL
+BLOCK_ROWS = HEADLINE_BLOCK_ROWS
 
 
 def _prewarm(kernel: str) -> None:

@@ -30,7 +30,10 @@ for f in "$D"/tune-*.log; do
   echo "-- $(basename "$f")"
   grep '^best:' "$f" 2>/dev/null
   grep '"tune"' "$f" 2>/dev/null   # machine-readable summary line
-  grep '"cells_per_sec"' "$f" 2>/dev/null | head -3
+  # Per-point lines only: the "tune" summary above embeds the winning
+  # point (with its cells_per_sec), so without the exclusion a short
+  # sweep prints it twice and a reader double-counts the winner.
+  grep '"cells_per_sec"' "$f" 2>/dev/null | grep -v '"tune"' | head -3
 done
 
 section "selftest"

@@ -114,7 +114,12 @@ unpark_expired() {
   local f t now
   now=$(date +%s)
   for f in "$OUT"/done/*.parked; do
-    [ -e "$f" ] || return 0
+    # continue, NOT return: a marker deleted between glob expansion and
+    # this check (a racing unpark/new_window/stage-success) must only be
+    # skipped — returning would silently skip every REMAINING parked
+    # marker for this pass.  (The unmatched-glob literal also lands here
+    # and harmlessly continues out of the one-iteration loop.)
+    [ -e "$f" ] || continue
     t=$(cat "$f" 2>/dev/null); t="${t:-0}"
     case "$t" in *[!0-9]*) t=0 ;; esac
     if [ $((now - t)) -ge "$PARK_RETRY_S" ]; then
