@@ -223,6 +223,73 @@ def _ring_plane_overrides(args: argparse.Namespace) -> dict:
     }
 
 
+def _add_rebalance(p: argparse.ArgumentParser) -> None:
+    """The elastic rebalancing knobs (``runtime/rebalance.py``).  Every
+    ``--rebalance-X`` flag maps 1:1 onto ``SimulationConfig.rebalance_X``
+    (dashes to underscores; bare ``--rebalance`` maps to
+    ``rebalance_enabled``) — ``tools/check_rebalance_config.py``
+    lint-enforces the bijection.  Frontend role only.  Graceful drain
+    (SIGTERM on a backend) works regardless; these knobs control the
+    AUTOMATIC load-driven migration planning."""
+    g = p.add_argument_group(
+        "elastic rebalancing",
+        "live digest-certified tile migration: mid-run scale-out onto late "
+        "joiners and load balancing across workers (see docs/OPERATIONS.md "
+        "\"Elastic rebalancing\"; graceful drain is always on)",
+    )
+    g.add_argument(
+        "--rebalance",
+        nargs="?",
+        choices=["on", "off"],
+        const="on",
+        default=None,
+        help="automatic load-driven tile migration (a late-joining worker "
+        "receives tiles mid-run; imbalanced workers even out); bare "
+        "--rebalance means on, --rebalance off overrides a config file "
+        "that enables it",
+    )
+    g.add_argument(
+        "--rebalance-interval-s", default=None, metavar="DUR",
+        help="how often the planner looks for imbalance (e.g. 500ms)",
+    )
+    g.add_argument(
+        "--rebalance-min-gap", type=int, default=None, metavar="N",
+        help="migrate when the most- and least-loaded workers differ by "
+        "at least N tiles (default 2)",
+    )
+    g.add_argument(
+        "--rebalance-max-inflight", type=int, default=None, metavar="N",
+        help="concurrent in-flight migrations (each freezes one tile)",
+    )
+    g.add_argument(
+        "--rebalance-deadline-s", default=None, metavar="DUR",
+        help="per-migration deadline; overdue moves roll back to the "
+        "source and retry under the jittered backoff policy",
+    )
+
+
+def _rebalance_overrides(args: argparse.Namespace) -> dict:
+    """``--rebalance-*`` flags → SimulationConfig override kwargs (empty
+    entries are dropped by load_config's None filtering)."""
+    return {
+        "rebalance_enabled": {"on": True, "off": False, None: None}[
+            args.rebalance
+        ],
+        "rebalance_interval_s": (
+            parse_duration(args.rebalance_interval_s)
+            if args.rebalance_interval_s is not None
+            else None
+        ),
+        "rebalance_min_gap": args.rebalance_min_gap,
+        "rebalance_max_inflight": args.rebalance_max_inflight,
+        "rebalance_deadline_s": (
+            parse_duration(args.rebalance_deadline_s)
+            if args.rebalance_deadline_s is not None
+            else None
+        ),
+    }
+
+
 def _add_chaos_net(p: argparse.ArgumentParser) -> None:
     """The network chaos plane's knobs (``runtime/netchaos.py``).  Every
     ``--chaos-net-X`` flag maps 1:1 onto ``NetworkChaosConfig.X`` (dashes to
@@ -456,6 +523,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "peer per epoch to coalesce",
     )
     _add_ring_plane(fe_p)
+    _add_rebalance(fe_p)
     _add_chaos_net(fe_p)
 
     st_p = sub.add_parser(
@@ -630,6 +698,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             exchange_width=args.exchange_width,
             tiles_per_worker=args.tiles_per_worker,
             **_ring_plane_overrides(args),
+            **_rebalance_overrides(args),
             wait_for_backends_s=(
                 parse_duration(args.wait_for_backends)
                 if args.wait_for_backends is not None

@@ -81,6 +81,22 @@ CATALOG = (
      "Workers lost (EOF, stale heartbeat, or GOODBYE)", ()),
     ("gol_redeploys_total", "counter",
      "Tile redeployments (crash recovery, stuck escalation, node loss)", ()),
+    # -- elastic plane: live migration, scale-out, drain (PR 6) ---------------
+    ("gol_member_heartbeat_age_seconds", "gauge",
+     "Seconds since each member's last control-plane traffic (staleness "
+     "early warning; auto-down fires at failure_timeout_s)", ("member",)),
+    ("gol_members_draining", "gauge",
+     "Members currently draining (graceful scale-in in progress)", ()),
+    ("gol_migrations_total", "counter",
+     "Live tile migrations committed (digest-certified ownership moves)", ()),
+    ("gol_migration_aborts_total", "counter",
+     "Live tile migrations rolled back (digest mismatch, deadline, or "
+     "member loss — the source kept the tile, no epoch lost)", ()),
+    ("gol_migration_seconds", "histogram",
+     "Wall seconds per committed migration (PREPARE to COMMIT)", ()),
+    ("gol_drains_total", "counter",
+     "Graceful worker drains completed (every tile migrated off before "
+     "the member left)", ()),
     # -- network chaos plane / hardened comms (PR 3) ---------------------------
     ("gol_net_chaos_dropped_total", "counter",
      "Messages dropped by the network chaos policy (random drops + "

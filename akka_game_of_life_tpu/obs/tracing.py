@@ -59,6 +59,8 @@ SPAN_CATALOG = (
     ("cluster.deploy", "one DEPLOY batch shipped to a worker"),
     ("recover.redeploy", "tile redeployed from the recovery source"),
     ("member.lost", "node loss handled (eviction + orphaned-tile recovery)"),
+    ("migrate.tile", "one live tile migration, PREPARE to COMMIT or abort"),
+    ("cluster.drain", "one graceful worker drain, request to release"),
     # -- cluster backend ------------------------------------------------------
     ("backend.step", "one tile chunk stepped on a worker"),
     ("halo.send", "boundary ring encoded and queued for remote peer owners"),

@@ -88,6 +88,13 @@ class _Tile:
         # next retry fires at.  Both reset when a pull succeeds.
         self.retry_delay = retry_s
         self.next_retry_at = 0.0
+        # Live-migration freeze (MIGRATE_PREPARE): while monotonic time is
+        # before this, the tile starts no new chunk — its state is the
+        # canonical copy a migration is shipping.  0 = not frozen.  The
+        # deadline is the self-healing rollback: if the frontend's COMMIT
+        # (an OWNERS rewiring that drops the tile) or MIGRATE_ABORT never
+        # arrives, the retry loop unfreezes and resumes at expiry.
+        self.frozen_until = 0.0
 
 
 # VMEM row block for the cluster's Mosaic chunk sweeps (the measured-best
@@ -795,7 +802,14 @@ class BackendWorker:
         threading.Thread(target=self._retry_loop, daemon=True).start()
 
     def run(self) -> int:
-        """Blocking serve loop; returns when shut down or disconnected."""
+        """Blocking serve loop; returns when shut down or disconnected.
+
+        ``_stop`` is set on every NORMAL exit (shutdown, EOF, wire error)
+        but deliberately NOT when an interrupt tears out of the loop: the
+        CLI's SIGTERM drain re-enters ``run()`` to keep serving the
+        migration protocol, and the worker must still be alive for that —
+        heartbeats beating (or the frontend would auto-down a draining
+        member) and the control channel readable."""
         if self.channel is None:
             self.connect()
         try:
@@ -805,14 +819,25 @@ class BackendWorker:
                     self.stopped_reason = self.stopped_reason or "disconnected"
                     break
                 self._dispatch(msg)
+            self._stop.set()
         except (OSError, ValueError) as e:
             # ValueError = a malformed frame from wire.recv (bad magic,
             # oversize claim, bad payload structure): same clean shutdown
             # as a connection error, with the reason on record.
             self.stopped_reason = self.stopped_reason or f"connection error ({e})"
-        finally:
             self._stop.set()
-        return 0 if self.stopped_reason == "shutdown" else 1
+        except KeyboardInterrupt:
+            # The SIGTERM drain path re-enters run(); the worker must stay
+            # alive (heartbeats beating, control channel readable) or the
+            # frontend would auto-down a draining member.
+            raise
+        except BaseException:
+            # Any other escape (a dispatch handler bug, MemoryError, ...)
+            # must still stop the heartbeat/retry daemons, or the frontend
+            # keeps seeing a healthy member whose tiles never step again.
+            self._stop.set()
+            raise
+        return 0 if self.stopped_reason in ("shutdown", "drained") else 1
 
     def _run_pre_stop_hooks(self) -> None:
         with self._pre_stop_lock:
@@ -1114,11 +1139,23 @@ class BackendWorker:
             now = time.monotonic()
             failed: List[Tuple[TileId, int]] = []
             stale: List[Tuple[TileId, int]] = []
+            thawed: List[TileId] = []
             delays: List[float] = []
             with self._lock:
                 if self.paused:
                     continue
                 for tid, t in self.tiles.items():
+                    if t.frozen_until:
+                        # Migration freeze: no re-asks, no escalation — the
+                        # tile is deliberately still.  Past the deadline the
+                        # move evidently failed mid-protocol; unfreeze and
+                        # resume (the frontend's abort already cooled the
+                        # tile down on its side).
+                        if now < t.frozen_until:
+                            continue
+                        t.frozen_until = 0.0
+                        thawed.append(tid)
+                        continue
                     if t.awaiting_since is None or now < t.next_retry_at:
                         continue
                     t.retries += 1
@@ -1132,6 +1169,11 @@ class BackendWorker:
                     t.next_retry_at = now + t.retry_delay
                     delays.append(t.retry_delay)
                     stale.append((tid, t.epoch))
+            for tid in thawed:
+                # Resume a tile whose migration never concluded: re-drive so
+                # it re-pulls its halo (rings are still in the local store —
+                # the prune floor could not pass a tile that stopped moving).
+                self._drive(tid)
             for d in delays:
                 self._m_backoff.observe(d)
             if stale:
@@ -1214,6 +1256,25 @@ class BackendWorker:
             ):
                 self.tracer.flight.dump("tile_crash", node=self.name or "backend")
                 self._on_crash_tile(tuple(msg["tile"]))
+        elif kind == P.MIGRATE_PREPARE:
+            self._on_migrate_prepare(msg)
+        elif kind == P.MIGRATE_ABORT:
+            self._on_migrate_abort(tuple(msg["tile"]))
+        elif kind == P.DRAIN_COMPLETE:
+            # The frontend released us: either every tile migrated off
+            # (drained=True → rc 0) or the drain was refused (no placeable
+            # destination → the caller falls back to the abrupt-leave path).
+            drained = bool(msg.get("drained", True))
+            self.stopped_reason = "drained" if drained else "drain_refused"
+            self._stop.set()
+            self._run_pre_stop_hooks()
+            try:
+                # Deliberate leave, distinguishable from a crash — by now we
+                # own nothing, so the frontend evicts without redeploying.
+                self.channel.send({"type": P.GOODBYE})
+            except OSError:
+                pass
+            self.channel.close()
         elif kind == P.SHUTDOWN:
             self.stopped_reason = "shutdown"
             self._stop.set()
@@ -1265,6 +1326,7 @@ class BackendWorker:
 
     def _on_deploy(self, msg: dict) -> None:
         outbound: List[Tuple[TileId, np.ndarray, int]] = []
+        seed_rings: List[Tuple[TileId, int, Ring]] = []
         with self._lock:
             rule = resolve_rule(msg["rule"])
             if rule.radius != 1:
@@ -1350,7 +1412,20 @@ class BackendWorker:
 
                     self._actor_engines[tid] = NativeActorTileEngine(rule)
                 outbound.append((tid, tile.arr, tile.epoch))
+                for e in spec.get("rings") or []:
+                    seed_rings.append(
+                        (tuple(e["tile"]), int(e["epoch"]), decode_ring(e["ring"]))
+                    )
             self._owner_map = None  # tiles (re)deployed: publish cache is stale
+        if seed_rings and self.store is not None:
+            # A migrated tile arrives at its LIVE epoch; neighbors replaying
+            # older epochs ask US (the new owner) for rings we never
+            # computed.  The previous owner's retained ring history rode the
+            # certified payload (it may already be out of the wiring — or
+            # gone entirely, on a drain's final move — so a pull could never
+            # be addressed); seeding it here also answers any local pulls
+            # already queued on those epochs.
+            self.store.push_rings(seed_rings)
         for tid, arr, epoch in outbound:
             # Announce our boundary at the deployed epoch so neighbors can
             # assemble their halos (History seeding, CellActor.scala:34).
@@ -1371,6 +1446,117 @@ class BackendWorker:
             self.channel.send({"type": P.REDEPLOY_REQUEST, "tile": list(tid)})
         except OSError:
             pass
+
+    # -- live migration / drain (the elastic plane) --------------------------
+
+    def _migrate_payload(self, tid: TileId, arr: np.ndarray, epoch: int) -> dict:
+        """The MIGRATE_STATE body for one frozen tile: its bit-packed state
+        (the PR 4 wire codec — 8 cells/byte for binary rules), the
+        source-side digest lanes the frontend certifies the payload
+        against, and the tile's retained ring history.  The history rides
+        IN-BAND because the destination cannot reliably pull it later: a
+        drain's final move removes the source from the OWNERS wiring (and
+        the source may exit) before any pull could be addressed, yet
+        lagging neighbors still re-ask the NEW owner for rings the new
+        owner never computed.  Factored out so failure-path tests can
+        corrupt it."""
+        from akka_game_of_life_tpu.ops import digest as odigest
+
+        with self._lock:
+            origin = self.origins.get(tid, (0, 0))
+            width = (
+                self.layout.board_shape[1]
+                if self.layout is not None
+                else arr.shape[1]
+            )
+            store = self.store
+        lanes = odigest.digest_dense_np(arr, origin, width)
+        pack = self.ring_pack and self.rule is not None and self.rule.is_binary
+        rings = (
+            [
+                {"tile": list(tid), "epoch": e, "ring": encode_ring(ring, pack)}
+                for e, ring in store.rings_from(tid, 0)
+            ]
+            if store is not None
+            else []
+        )
+        return {
+            "type": P.MIGRATE_STATE,
+            "tile": list(tid),
+            "epoch": epoch,
+            "state": pack_tile(arr),
+            "digest": [int(lanes[0]), int(lanes[1])],
+            "rings": rings,
+        }
+
+    def _on_migrate_prepare(self, msg: dict) -> None:
+        """PREPARE: freeze the tile at its current chunk boundary and ship
+        its state.  Compute runs under the worker lock, so the (arr, epoch)
+        snapshot below is always a consistent chunk-boundary state; setting
+        ``frozen_until`` under the same lock guarantees no later chunk
+        starts.  A tile we no longer host is simply not answered — the
+        frontend's migration deadline aborts the move."""
+        tid: TileId = tuple(msg["tile"])
+        seq = int(msg["seq"])
+        deadline_s = float(msg.get("deadline_s", 10.0))
+        with self._lock:
+            tile = self.tiles.get(tid)
+            if tile is None:
+                return
+            # 2× the frontend deadline: the frontend always decides first
+            # (commit or abort); this is only the lost-message backstop.
+            tile.frozen_until = time.monotonic() + 2.0 * deadline_s
+            arr, epoch = tile.arr, tile.epoch
+        out = self._migrate_payload(tid, arr, epoch)
+        out["seq"] = seq
+        try:
+            self.channel.send(out)
+        except OSError:
+            pass
+        except ValueError as e:
+            # An oversize MIGRATE_STATE frame (tile state + ring history
+            # past MAX_FRAME) must not escape into run()'s wire-error
+            # handling and kill the whole worker — that would turn a
+            # graceful drain of a healthy worker into node loss.  The
+            # transfer can never happen, so unfreeze now instead of
+            # waiting out the 2x-deadline thaw; the frontend's deadline
+            # aborts the move on its side.
+            print(
+                f"tile {tid}: migration payload unsendable ({e}); "
+                f"resuming",
+                flush=True,
+            )
+            with self._lock:
+                tile = self.tiles.get(tid)
+                if tile is not None:
+                    tile.frozen_until = 0.0
+            self._drive(tid)
+
+    def _on_migrate_abort(self, tid: TileId) -> None:
+        """Rollback: unfreeze and resume stepping — the tile never left."""
+        with self._lock:
+            tile = self.tiles.get(tid)
+            if tile is None:
+                return
+            tile.frozen_until = 0.0
+        self._drive(tid)
+
+    def request_drain(self) -> bool:
+        """Ask the frontend to migrate every tile off this worker so it can
+        leave without tripping node-loss recovery.  Returns False when a
+        drain is pointless (no tiles, not connected, already stopping) —
+        callers then take the abrupt-leave path.  The caller keeps serving
+        the control channel; the frontend answers with MIGRATE_PREPAREs and
+        finally DRAIN_COMPLETE."""
+        with self._lock:
+            has_tiles = bool(self.tiles)
+        if not has_tiles or self.channel is None or self._stop.is_set():
+            return False
+        try:
+            self.channel.send({"type": P.DRAIN_REQUEST})
+        except OSError:
+            return False
+        return True
 
     # -- stepping plumbing ---------------------------------------------------
 
@@ -1400,6 +1586,10 @@ class BackendWorker:
                     or tile.awaiting_since is not None  # pull already in flight
                 ):
                     return
+                if tile.frozen_until:
+                    if time.monotonic() < tile.frozen_until:
+                        return  # migration in flight: state must not move
+                    tile.frozen_until = 0.0  # deadline passed: self-heal
                 # Chunked advance: one width-k halo exchange licenses the
                 # next c = min(k, final-epoch) epochs; the tile waits until
                 # the target covers the WHOLE chunk so every tile visits the
@@ -1469,6 +1659,12 @@ class BackendWorker:
                 or self.paused
                 or c <= 0
                 or self.target < epoch + c
+                # Frozen for migration: refuse the chunk; an abort/expiry
+                # re-drives and the halo reassembles from stored rings.
+                or (
+                    tile.frozen_until
+                    and time.monotonic() < tile.frozen_until
+                )
             ):
                 if tile is not None and epoch == tile.epoch:
                     tile.awaiting_since = None  # paused/short target: clear latch
@@ -1677,6 +1873,10 @@ class BackendWorker:
 _SPAN_FORWARD_INTERVAL_S = 1.0
 _SPAN_FORWARD_PENDING_CAP = 8192
 
+# How long a SIGTERM'd CLI worker keeps serving the migration protocol
+# waiting for its drain to complete before leaving abruptly anyway.
+_DRAIN_TIMEOUT_S = 30.0
+
 
 def _start_span_forwarding(worker: BackendWorker, tracer) -> None:
     """Batch this process's finished spans to the frontend (P.SPANS) so its
@@ -1793,14 +1993,45 @@ def run_backend(
     try:
         return worker.run()
     except KeyboardInterrupt:
-        # Graceful operator stop: GOODBYE tells the frontend this is a
-        # deliberate leave, so tiles redeploy immediately instead of after
-        # the heartbeat-timeout a kill -9 needs to be detected.  Masked so
-        # a second signal cannot abort the GOODBYE/close half-way.
-        from akka_game_of_life_tpu.runtime.signals import mask_interrupts
+        # Graceful operator stop, in two tiers.  First choice: DRAIN — ask
+        # the frontend to live-migrate every hosted tile off this worker
+        # (digest-certified, zero lost epochs, no node-loss redeploy), keep
+        # serving the migration protocol until DRAIN_COMPLETE releases us,
+        # and leave rc=0.  The wait is bounded (stop_after) and a second
+        # signal skips straight to the abrupt tier.  Fallback (no tiles,
+        # refused drain, or timeout): GOODBYE — a deliberate leave the
+        # frontend recovers with an immediate checkpoint redeploy instead
+        # of waiting out the heartbeat timeout.  Masked so a second signal
+        # cannot abort the GOODBYE/close half-way.
+        from akka_game_of_life_tpu.runtime.signals import (
+            mask_interrupts,
+            stop_after,
+        )
 
+        if worker.request_drain():
+            print(
+                f"backend {worker.name} draining: handing "
+                f"{len(worker.tiles)} tile(s) back",
+                flush=True,
+            )
+            try:
+                with stop_after(_DRAIN_TIMEOUT_S, worker.stop):
+                    worker.run()
+            except KeyboardInterrupt:
+                pass  # second signal: give up on the drain, leave now
         with mask_interrupts():
             worker.stop()
+        if worker.stopped_reason == "drained":
+            print(f"backend {worker.name} drained; leaving", flush=True)
+            return 0
+        if worker.stopped_reason == "shutdown":
+            # The run finished while we were draining: the frontend's
+            # clean cluster SHUTDOWN reached us before DRAIN_COMPLETE
+            # could (the planner stops once the run is done).  Nothing
+            # was lost and nothing redeployed — a clean exit, same as
+            # every other worker's.
+            print(f"backend {worker.name} shut down mid-drain; leaving", flush=True)
+            return 0
         return 130
     finally:
         if dumper is not None:

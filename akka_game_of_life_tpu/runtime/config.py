@@ -289,6 +289,35 @@ class SimulationConfig:
     # one probe through — success closes it, failure re-opens.
     breaker_failures: int = 3
     breaker_cooldown_s: float = 2.0
+    # -- elastic rebalancing (docs/OPERATIONS.md "Elastic rebalancing") --
+    # The frontend's live tile-migration plane: a tile freezes at a chunk
+    # boundary on its current owner, its packed state + digest lanes ship
+    # through the control plane, the frontend certifies the digest on
+    # arrival, and an atomic OWNERS rewiring commits the move (any failure
+    # — mismatch, deadline, member loss — rolls back to the source, which
+    # never dropped the tile).  Graceful drain (a worker handing its tiles
+    # back before leaving) always uses this machinery; rebalance_enabled
+    # additionally turns on AUTOMATIC load-driven planning in the frontend
+    # maintenance loop.  Every field maps to a --rebalance-* flag
+    # (tools/check_rebalance_config.py lint-enforces the bijection).
+    rebalance_enabled: bool = False
+    # How often the automatic planner looks for imbalance (drain-driven
+    # moves ignore this and plan every maintenance pass).
+    rebalance_interval_s: float = 1.0
+    # Plan a migration when the most- and least-loaded placeable members
+    # differ by at least this many tiles.  The planner floors this at 2
+    # regardless: a gap-1 move swaps which member is fuller without
+    # lowering the peak load, so honoring it would ping-pong one tile
+    # forever.  Raise it to tolerate more skew before reshaping.
+    rebalance_min_gap: int = 2
+    # Concurrent in-flight migrations; each freezes one tile, so a small
+    # bound keeps the epoch floor moving while the cluster reshapes.
+    rebalance_max_inflight: int = 1
+    # Per-migration deadline (PREPARE to certified state arrival); an
+    # overdue migration aborts and the source resumes stepping.  Failed
+    # migrations retry under the retry_s/retry_max_s decorrelated-jitter
+    # backoff policy below.
+    rebalance_deadline_s: float = 10.0
     # Optional deadline on cluster channel sends (seconds; 0 = block
     # forever, the classic TCP behavior).  With a deadline, a send into a
     # wedged peer's full socket buffer raises after this long instead of
@@ -424,6 +453,23 @@ class SimulationConfig:
             raise ValueError(
                 f"send_deadline_s={self.send_deadline_s} must be >= 0 (0 = off)"
             )
+        if self.rebalance_interval_s <= 0:
+            raise ValueError(
+                f"rebalance_interval_s={self.rebalance_interval_s} must be > 0"
+            )
+        if self.rebalance_min_gap < 1:
+            raise ValueError(
+                f"rebalance_min_gap={self.rebalance_min_gap} must be >= 1"
+            )
+        if self.rebalance_max_inflight < 1:
+            raise ValueError(
+                f"rebalance_max_inflight={self.rebalance_max_inflight} "
+                f"must be >= 1"
+            )
+        if self.rebalance_deadline_s <= 0:
+            raise ValueError(
+                f"rebalance_deadline_s={self.rebalance_deadline_s} must be > 0"
+            )
         if self.tiles_per_worker < 1:
             raise ValueError(
                 f"tiles_per_worker must be >= 1, got {self.tiles_per_worker}"
@@ -467,6 +513,8 @@ _DURATION_FIELDS = {
     "every_s",
     "retry_s",
     "retry_max_s",
+    "rebalance_interval_s",
+    "rebalance_deadline_s",
     "breaker_cooldown_s",
     "send_deadline_s",
     "delay_s",

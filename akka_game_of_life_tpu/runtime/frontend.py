@@ -48,6 +48,7 @@ from akka_game_of_life_tpu.runtime.netchaos import (
     NetworkChaos,
     wrap_channel,
 )
+from akka_game_of_life_tpu.runtime.rebalance import Migration, Rebalancer
 from akka_game_of_life_tpu.runtime.render import BoardObserver
 from akka_game_of_life_tpu.runtime.simulation import initial_board
 from akka_game_of_life_tpu.runtime.tiles import TileId, TileLayout, layout_for_workers
@@ -91,6 +92,8 @@ _MSG_REQUIRED = {
     P.TILE_STATE: ("tile", "epoch"),
     P.REDEPLOY_REQUEST: ("tile",),
     P.GATHER_FAILED: ("tile", "epoch"),
+    P.MIGRATE_STATE: ("tile", "epoch", "state", "digest", "seq"),
+    P.DRAIN_REQUEST: (),
 }
 # TILE_STATE carries per-reason payloads; each declared reason needs its key.
 _REASON_PAYLOAD = {
@@ -123,7 +126,11 @@ def _validate_msg(msg) -> None:
             )
     if "epoch" in required and not isinstance(msg["epoch"], int):
         raise MalformedMessage(f"{kind} epoch {msg['epoch']!r} is not an int")
-    if kind == P.PROGRESS and "digest" in msg:
+    if "seq" in required and not isinstance(msg["seq"], int):
+        raise MalformedMessage(f"{kind} seq {msg.get('seq')!r} is not an int")
+    if "state" in required and not isinstance(msg["state"], dict):
+        raise MalformedMessage(f"{kind} state is not a tile payload dict")
+    if kind in (P.PROGRESS, P.MIGRATE_STATE) and "digest" in msg:
         d = msg["digest"]
         if not (
             isinstance(d, (list, tuple))
@@ -131,7 +138,7 @@ def _validate_msg(msg) -> None:
             and all(isinstance(v, int) for v in d)
         ):
             raise MalformedMessage(
-                f"progress digest {d!r} is not an integer (lo, hi) pair"
+                f"{kind} digest {d!r} is not an integer (lo, hi) pair"
             )
     if kind == P.TILE_STATE:
         reasons = msg.get("reasons", [])
@@ -214,6 +221,23 @@ class Frontend:
             "gol_digest_mismatches_total"
         )
         self._m_digest_seconds = self.metrics.histogram("gol_digest_seconds")
+        # Elastic plane observability: per-member control-plane staleness
+        # (the operator's early-warning gauge before auto-down fires),
+        # migration outcomes, and drain progress.
+        self._m_hb_age = self.metrics.gauge(
+            "gol_member_heartbeat_age_seconds",
+            "Seconds since each member's last control-plane traffic",
+            ("member",),
+        )
+        self._m_draining = self.metrics.gauge("gol_members_draining")
+        self._m_migrations = self.metrics.counter("gol_migrations_total")
+        self._m_migration_aborts = self.metrics.counter(
+            "gol_migration_aborts_total"
+        )
+        self._m_migration_seconds = self.metrics.histogram(
+            "gol_migration_seconds"
+        )
+        self._m_drains = self.metrics.counter("gol_drains_total")
         self._metrics_server: Optional[MetricsServer] = None
         # Wire-fault policy (config/CLI --chaos-net-*): one seeded instance
         # per process; the in-process harness hands this same instance to
@@ -261,6 +285,13 @@ class Frontend:
                 "(first_after_s/every_s)"
             )
         self.membership = Membership(config.failure_timeout_s)
+        # The elastic plane (docs/OPERATIONS.md "Elastic rebalancing"):
+        # live tile migration, mid-run scale-out, graceful drain.  Always
+        # constructed — drains use it on every cluster; rebalance_enabled
+        # only gates the automatic load-driven planning.  Mutated strictly
+        # under self._lock.
+        self.rebalancer = Rebalancer(config)
+        self._drain_spans: Dict[str, object] = {}
         if config.checkpoint_dir and config.checkpoint_format != "npz":
             # The cluster frontend streams per-tile saves (save_tile /
             # finalize_epoch), which only the npz store implements; orbax is
@@ -362,12 +393,23 @@ class Frontend:
 
     def _health(self) -> dict:
         """The /healthz document: ok until the run has errored — plus the
-        live facts an operator checks first (members, epoch floor, done)."""
+        live facts an operator checks first (members, epoch floor, done).
+        Per-member heartbeat age surfaces control-plane staleness BEFORE
+        auto-down fires (it also lives in the
+        gol_member_heartbeat_age_seconds gauge)."""
+        now = time.monotonic()
         with self._lock:
+            alive = self.membership.alive_members()
             return {
                 "ok": self.error is None,
                 "error": self.error,
-                "members_alive": len(self.membership.alive_members()),
+                "members_alive": len(alive),
+                "heartbeat_age_s": {
+                    m.name: round(max(0.0, now - m.last_seen), 3)
+                    for m in alive
+                },
+                "draining": sorted(m.name for m in alive if m.draining),
+                "migrations_inflight": len(self.rebalancer.inflight),
                 "epoch_floor": min(self.tile_epochs.values(), default=0),
                 "target_epoch": self.target_epoch,
                 "done": self.done.is_set(),
@@ -412,7 +454,7 @@ class Frontend:
 
     def start_simulation(self) -> None:
         with self._lock:
-            members = self.membership.alive_members()
+            members = self.membership.placeable_members()
             if len(members) < self.min_backends:
                 raise RuntimeError(
                     f"only {len(members)} backends joined, need {self.min_backends}"
@@ -504,22 +546,26 @@ class Frontend:
             if m.tiles:
                 self._send_deploy(m, m.tiles)
 
-    def _broadcast_owners(self) -> None:
-        """NeighboursRefs (re-)wiring (BoardCreator.scala:86-88,149-151):
-        every worker learns every tile's owner and peer data-plane address.
-        The frontend brokers addresses only — ring bytes never touch it."""
+    def _owners_msg(self) -> dict:
+        """The current wiring as one OWNERS message.  Caller holds the lock."""
         rows = []
         for tile, owner in self.tile_owner.items():
             m = self.membership.get(owner)
             if m is None:
                 continue
             rows.append([list(tile), owner, m.peer_host, m.peer_port])
-        msg = {
+        return {
             "type": P.OWNERS,
             "tiles": rows,
             "grid": list(self.layout.grid),
             "shape": list(self.config.shape),
         }
+
+    def _broadcast_owners(self) -> None:
+        """NeighboursRefs (re-)wiring (BoardCreator.scala:86-88,149-151):
+        every worker learns every tile's owner and peer data-plane address.
+        The frontend brokers addresses only — ring bytes never touch it."""
+        msg = self._owners_msg()
         for m in self.membership.alive_members():
             self._safe_send(m, msg)
 
@@ -602,22 +648,52 @@ class Frontend:
                 f"corrupt recovery source"
             )
 
-    def _send_deploy(self, member: Member, tiles: List[TileId]) -> None:
+    def _send_deploy(
+        self,
+        member: Member,
+        tiles: List[TileId],
+        *,
+        state_epoch: Optional[int] = None,
+        payloads: Optional[Dict[TileId, dict]] = None,
+        ring_history: Optional[Dict[TileId, list]] = None,
+    ) -> None:
         """Ship tiles to a worker.  Callers must NOT hold the frontend lock:
         a DEPLOY is a multi-megabyte send, and the receiving worker may be
         deep in a multi-second compute step, not reading — a blocking send
         under the global lock would stall every reader thread behind it and
         auto-down live workers (the bulk-send liveness hazard).
 
-        The recovery (epoch, payload) pair is read HERE, under one lock
-        acquisition: a caller passing an epoch it read earlier races with a
-        checkpoint completing in between, shipping a newer board labeled
-        with the older epoch — the tile then replays from a wrong state and
-        silently corrupts the trajectory (caught by the width-k node-loss
-        test, where chunked stepping makes kill-during-checkpoint likely)."""
+        By default the recovery (epoch, payload) pair is read HERE, under
+        one lock acquisition: a caller passing an epoch it read earlier
+        races with a checkpoint completing in between, shipping a newer
+        board labeled with the older epoch — the tile then replays from a
+        wrong state and silently corrupts the trajectory (caught by the
+        width-k node-loss test, where chunked stepping makes
+        kill-during-checkpoint likely).
+
+        A live migration instead passes the certified ``payloads`` at their
+        frozen ``state_epoch`` (plus ``ring_history``, the source's retained
+        rings for the tile, forwarded in-band so the destination can serve
+        lagging neighbors even after the source has left the wiring) — the
+        tile resumes exactly where it froze, no checkpoint replay."""
         with self._lock:
             now = time.monotonic()
-            epoch, recovery = self._last_ckpt
+            if payloads is not None:
+                # A migration deploy races member loss: if the destination
+                # died (or a tile was re-placed by recovery) between COMMIT
+                # and this send, mutating the bookkeeping below would pin
+                # the tile's epoch at the frozen value while its real owner
+                # replays from a checkpoint — and the wrongly-high prune
+                # floor would drop ring history the replay still needs
+                # (PROGRESS is monotone-max, so it never self-corrects).
+                # The recovery path already owns the tile; drop this deploy.
+                if not member.alive or any(
+                    self.tile_owner.get(t) != member.name for t in tiles
+                ):
+                    return
+                epoch, recovery = state_epoch, payloads
+            else:
+                epoch, recovery = self._last_ckpt
             for t in tiles:
                 # A freshly deployed tile gets a full stuck_timeout_s of
                 # grace before GATHER_FAILED may count it as wedged.
@@ -625,17 +701,20 @@ class Frontend:
                 # Keep the lag/prune bookkeeping consistent with the epoch
                 # actually shipped (not one a caller read before the swap).
                 self.tile_epochs[t] = epoch
+            specs = []
+            for t in tiles:
+                spec = {
+                    "id": list(t),
+                    "epoch": epoch,
+                    "origin": list(self.layout.origin(t)),
+                    "state": recovery[t],  # bit-packed, straight to wire
+                }
+                if ring_history and ring_history.get(t):
+                    spec["rings"] = ring_history[t]
+                specs.append(spec)
             msg = {
                 "type": P.DEPLOY,
-                "tiles": [
-                    {
-                        "id": list(t),
-                        "epoch": epoch,
-                        "origin": list(self.layout.origin(t)),
-                        "state": recovery[t],  # bit-packed, straight to wire
-                    }
-                    for t in tiles
-                ],
+                "tiles": specs,
                 "rule": self.rule.rulestring(),
                 "target": self.target_epoch,
                 "final_epoch": self.config.max_epochs,
@@ -700,6 +779,15 @@ class Frontend:
             if self._degraded_span is not None:
                 self._degraded_span.set(healed=False).finish()
                 self._degraded_span = None
+            # Elastic-plane spans must not outlive the run: migrations and
+            # drains still open at stop() finish with outcome=shutdown.
+            for mig in list(self.rebalancer.inflight.values()):
+                if mig.span is not None:
+                    mig.span.set(outcome="shutdown").finish()
+                    mig.span = None
+            for span in self._drain_spans.values():
+                span.set(outcome="shutdown").finish()
+            self._drain_spans.clear()
             if self._epoch_span is not None:
                 self._epoch_span.set(done=self.done.is_set()).finish()
             if self._run_span is not None:
@@ -836,6 +924,22 @@ class Frontend:
             self.events.emit(
                 "member_joined", member=member.name, engine=str(engine)
             )
+            with self._lock:
+                late = self._started.is_set() and self.layout is not None
+                if late:
+                    # Late join (after start_simulation): the deterministic
+                    # path is admit-and-idle — the member gets the current
+                    # wiring immediately (it can dial peers, serve pulls,
+                    # and is a valid migration destination from this
+                    # moment) and hosts no tiles until the rebalancer
+                    # migrates load onto it.  Scale-out is exactly this
+                    # plus a migration.  Sent UNDER the lock, like every
+                    # _broadcast_owners call site: a migration committing
+                    # concurrently must not slot its OWNERS broadcast
+                    # between this snapshot and its send — the stale
+                    # snapshot arriving last would make the joiner drop a
+                    # tile just migrated onto it.
+                    self._safe_send(member, self._owners_msg())
             while True:
                 msg = channel.recv()
                 if msg is None:
@@ -913,6 +1017,10 @@ class Frontend:
             self._redeploy_tile(tile, preferred=member.name)
         elif kind == P.GATHER_FAILED:
             self._on_gather_failed(member, tuple(msg["tile"]), int(msg["epoch"]))
+        elif kind == P.MIGRATE_STATE:
+            self._on_migrate_state(member, msg)
+        elif kind == P.DRAIN_REQUEST:
+            self._on_drain_request(member)
         elif kind == P.GOODBYE:
             self._on_member_lost(member.name)
 
@@ -1091,12 +1199,303 @@ class Frontend:
                 ntile
                 for ntile in sorted(set(self.layout.neighbors(tile).values()))
                 if ntile != tile
+                and ntile not in self.rebalancer.inflight  # frozen on purpose
                 and self.tile_epochs.get(ntile, 0) < epoch
                 and now - self._last_ring_time.get(ntile, now)
                 > self.config.stuck_timeout_s
             ]
         for ntile in stuck:
             self._redeploy_tile(ntile, avoid=self.tile_owner.get(ntile))
+
+    # -- elastic plane: live migration, scale-out, drain ---------------------
+
+    def _rebalance_poll(self, now: float, drain_only: bool = False) -> None:
+        """One maintenance pass of the elastic plane: expire overdue
+        migrations, start newly planned ones, release finished drains.
+        Suspended while degraded — a stalled cluster must heal, not
+        reshape.  ``drain_only`` (the paused cluster) plans drain-driven
+        moves but no load balancing."""
+        if not self._started.is_set() or self.layout is None or self.degraded:
+            return
+        with self._lock:
+            overdue = self.rebalancer.expired(now)
+        for mig in overdue:
+            self._abort_migration(mig, "deadline")
+        started: List[Tuple[Migration, Member]] = []
+        with self._lock:
+            if self._stop.is_set() or self.done.is_set():
+                return
+            plans = self.rebalancer.plan(
+                self.membership.alive_members(),
+                self.tile_epochs,
+                self.config.max_epochs,
+                now,
+                drain_only=drain_only,
+            )
+            for tile, source, dest in plans:
+                pair = self._begin_migration_locked(tile, source, dest, now)
+                if pair is not None:
+                    started.append(pair)
+        # PREPARE frames outside the lock (send discipline).
+        for mig, src in started:
+            self._send_migrate_prepare(mig, src)
+        self._check_drains()
+
+    def migrate_tile(self, tile: TileId, dest: str) -> bool:
+        """Manually start a live migration of ``tile`` to member ``dest`` —
+        the operator/embedder entry to the same three-phase protocol the
+        automatic planner drives.  Returns False when the move is not
+        currently startable (unknown/departed members, tile already in
+        flight, dest draining, or dest already the owner)."""
+        now = time.monotonic()
+        with self._lock:
+            tile = tuple(tile)
+            source = self.tile_owner.get(tile)
+            if source is None or source == dest or self.layout is None:
+                return False
+            pair = self._begin_migration_locked(tile, source, dest, now)
+        if pair is None:
+            return False
+        self._send_migrate_prepare(*pair)
+        return True
+
+    def _begin_migration_locked(
+        self, tile: TileId, source: str, dest: str, now: float
+    ) -> Optional[Tuple[Migration, Member]]:
+        """Validate and record one migration (caller holds the lock);
+        returns (migration, source member) for the PREPARE send, or None."""
+        src = self.membership.get(source)
+        dst = self.membership.get(dest)
+        if (
+            src is None or not src.alive
+            or dst is None or not dst.alive or dst.draining
+            or self.tile_owner.get(tile) != source
+            or tile in self.rebalancer.inflight
+        ):
+            return None
+        mig = self.rebalancer.begin(tile, source, dest, now)
+        mig.span = self.tracer.start(
+            "migrate.tile", parent=self._epoch_span, node="frontend",
+            tile=str(tile), source=source, dest=dest,
+        )
+        self.events.emit(
+            "migration_started",
+            tile=list(tile),
+            source=source,
+            dest=dest,
+            seq=mig.seq,
+        )
+        return mig, src
+
+    def _send_migrate_prepare(self, mig: Migration, src: Member) -> None:
+        self._safe_send(
+            src,
+            {
+                "type": P.MIGRATE_PREPARE,
+                "tile": list(mig.tile),
+                "seq": mig.seq,
+                "deadline_s": self.rebalancer.deadline_s,
+            },
+        )
+
+    def _on_migrate_state(self, member: Member, msg: dict) -> None:
+        """TRANSFER → CERTIFY → COMMIT.  The payload is certified against
+        the source-computed digest lanes BEFORE any ownership change: a
+        corrupted transfer rolls back loudly (the source still owns the
+        canonical state), never forks the trajectory.  Commit is the atomic
+        OWNERS rewiring; the certified payload then deploys to the
+        destination at its frozen epoch."""
+        from akka_game_of_life_tpu.ops import digest as odigest
+
+        tile = tuple(msg["tile"])
+        epoch = int(msg["epoch"])
+        seq = int(msg["seq"])
+        with self._lock:
+            mig = self.rebalancer.get(tile, seq)
+            if (
+                mig is None
+                or mig.source != member.name
+                or self.tile_owner.get(tile) != member.name
+            ):
+                return  # stale state frame from an aborted/unknown attempt
+            origin = self.layout.origin(tile)
+        # Certification outside the lock: a tile-sized unpack+digest must
+        # not stall every reader thread behind the coordinator lock.
+        t0 = time.perf_counter()
+        lanes = odigest.digest_payload_np(
+            msg["state"], origin, self.config.width
+        )
+        self._m_digest_checks.inc()
+        self._m_digest_seconds.observe(time.perf_counter() - t0)
+        if [int(lanes[0]), int(lanes[1])] != [int(v) for v in msg["digest"]]:
+            self._m_digest_mismatches.inc()
+            self.events.emit(
+                "digest_mismatch",
+                tile=list(tile),
+                epoch=epoch,
+                source=member.name,
+            )
+            self._abort_migration(mig, "digest_mismatch")
+            return
+        with self._lock:
+            if self.rebalancer.get(tile, seq) is not mig:
+                return  # aborted (deadline/member loss) while certifying
+            dest = self.membership.get(mig.dest)
+            if dest is None or not dest.alive or dest.draining:
+                commit = False
+            else:
+                commit = True
+                now = time.monotonic()
+                self.rebalancer.complete(tile)
+                self.tile_owner[tile] = dest.name
+                if tile in member.tiles:
+                    member.tiles.remove(tile)
+                if tile not in dest.tiles:
+                    dest.tiles.append(tile)
+                self.tile_epochs[tile] = epoch
+                self._last_ring_time[tile] = now
+                self._m_migrations.inc()
+                self._m_migration_seconds.observe(now - mig.started)
+                if mig.span is not None:
+                    mig.span.set(outcome="commit", epoch=epoch).finish()
+                self.events.emit(
+                    "migration_committed",
+                    tile=list(tile),
+                    source=mig.source,
+                    dest=dest.name,
+                    epoch=epoch,
+                )
+                # Wiring before data, as everywhere: the OWNERS broadcast
+                # IS the commit point — the source drops the tile on
+                # receipt, every peer re-aims its ring pushes, and only
+                # then does the state land on the destination.
+                self._broadcast_owners()
+        if not commit:
+            self._abort_migration(mig, "dest_lost")
+            return
+        print(
+            f"tile {tile}: migrated {mig.source} -> {dest.name} at epoch "
+            f"{epoch}",
+            flush=True,
+        )
+        self._send_deploy(
+            dest,
+            [tile],
+            state_epoch=epoch,
+            payloads={tile: msg["state"]},
+            ring_history={tile: msg.get("rings") or []},
+        )
+        self._check_drains()
+
+    def _abort_migration(
+        self, mig: Migration, reason: str, *, notify_source: bool = True
+    ) -> None:
+        """Roll a migration back: the source (which never dropped the tile)
+        unfreezes and resumes; the tile cools down under the jittered
+        backoff before the planner may retry it.  Always loud: a counter, a
+        lifecycle event, and a flight dump — a rollback is a fault artifact
+        even though no state was lost."""
+        with self._lock:
+            if self.rebalancer.get(mig.tile, mig.seq) is not mig:
+                return  # already concluded
+            self.rebalancer.abort(mig.tile, time.monotonic())
+            self._m_migration_aborts.inc()
+            if mig.span is not None:
+                mig.span.set(outcome="abort", reason=reason).finish()
+            self.events.emit(
+                "migration_aborted",
+                tile=list(mig.tile),
+                source=mig.source,
+                dest=mig.dest,
+                reason=reason,
+            )
+        print(
+            f"tile {mig.tile}: migration {mig.source} -> {mig.dest} "
+            f"aborted ({reason})",
+            flush=True,
+        )
+        self.tracer.flight.dump("migration_abort", node="frontend")
+        if notify_source:
+            src = self.membership.get(mig.source)
+            if src is not None and src.alive:
+                self._safe_send(
+                    src, {"type": P.MIGRATE_ABORT, "tile": list(mig.tile)}
+                )
+
+    def _on_drain_request(self, member: Member) -> None:
+        """A worker asks to leave gracefully.  With another placeable
+        member present, mark it draining — the planner empties it and
+        ``_check_drains`` releases it; with nowhere to put its tiles the
+        drain is refused immediately (the worker falls back to the abrupt
+        GOODBYE path) rather than left hanging."""
+        with self._lock:
+            others = [
+                m
+                for m in self.membership.placeable_members()
+                if m.name != member.name
+            ]
+            if not self._started.is_set() or not others:
+                refused = True
+            else:
+                refused = False
+                if not member.draining:
+                    member.draining = True
+                    self._drain_spans[member.name] = self.tracer.start(
+                        "cluster.drain", parent=self._run_span,
+                        node="frontend", member=member.name,
+                        tiles=len(member.tiles),
+                    )
+                    self.events.emit(
+                        "drain_requested",
+                        member=member.name,
+                        tiles=len(member.tiles),
+                    )
+                    print(
+                        f"member {member.name} draining "
+                        f"({len(member.tiles)} tiles)",
+                        flush=True,
+                    )
+            self._m_draining.set(
+                sum(
+                    1
+                    for m in self.membership.alive_members()
+                    if m.draining
+                )
+            )
+        if refused:
+            self.events.emit("drain_refused", member=member.name)
+            self._safe_send(
+                member, {"type": P.DRAIN_COMPLETE, "drained": False}
+            )
+            return
+        # A tileless worker (e.g. a spare) drains in zero moves.
+        self._check_drains()
+
+    def _check_drains(self) -> None:
+        """Release every draining member that owns nothing and has no
+        in-flight migration — the DRAIN_COMPLETE that lets it exit rc=0
+        with the guarantee its departure redeploys nothing."""
+        released: List[Member] = []
+        with self._lock:
+            for m in self.membership.alive_members():
+                if not m.draining or m.drain_acked:
+                    continue
+                busy = any(
+                    m.name in (mig.source, mig.dest)
+                    for mig in self.rebalancer.inflight.values()
+                )
+                if m.tiles or busy:
+                    continue
+                m.drain_acked = True
+                self._m_drains.inc()
+                span = self._drain_spans.pop(m.name, None)
+                if span is not None:
+                    span.set(outcome="drained").finish()
+                self.events.emit("member_drained", member=m.name)
+                released.append(m)
+        for m in released:
+            print(f"member {m.name} drained", flush=True)
+            self._safe_send(m, {"type": P.DRAIN_COMPLETE, "drained": True})
 
     # -- failure handling / redeployment -------------------------------------
 
@@ -1113,6 +1512,29 @@ class Frontend:
             member.channel.close()
         except OSError:
             pass
+        # Elastic-plane hygiene: a departed member leaves no stale gauge
+        # series, no open drain span, and no in-flight migration.  A dead
+        # DESTINATION rolls its migrations back (the live source unfreezes
+        # and resumes — no epoch lost); a dead SOURCE just clears the
+        # record, and the normal checkpoint redeploy below recovers its
+        # tiles, the frozen one included.
+        self._m_hb_age.labels(member=name).set(0)
+        with self._lock:
+            span = self._drain_spans.pop(name, None)
+            if span is not None:
+                span.set(outcome="lost").finish()
+            self._m_draining.set(
+                sum(1 for m in self.membership.alive_members() if m.draining)
+            )
+            doomed = self.rebalancer.drop_member(name)
+        if not (self._stop.is_set() or self.done.is_set()):
+            # Mid-run only: at shutdown the in-flight records die with the
+            # run (stop() already finished their spans) — aborting them
+            # against departing workers would be teardown noise.
+            for mig in doomed:
+                self._abort_migration(
+                    mig, "member_lost", notify_source=(mig.source != name)
+                )
         if not self._started.is_set():
             return
         if self._stop.is_set() or self.done.is_set():
@@ -1183,8 +1605,14 @@ class Frontend:
             return None
         times.append(now)
         member = self.membership.get(preferred) if preferred else None
-        if member is None or not member.alive:
-            survivors = self.membership.alive_members()
+        if member is None or not member.alive or member.draining:
+            # Placeable members first — a draining worker must not be
+            # handed recovery work it would immediately hand back — but a
+            # draining survivor still beats failing the run.
+            survivors = (
+                self.membership.placeable_members()
+                or self.membership.alive_members()
+            )
             if not survivors:
                 self.error = "all backends lost"
                 self.done.set()
@@ -1255,6 +1683,13 @@ class Frontend:
             # cluster still needs the heal clock to tick).
             if self.netchaos is not None:
                 self.netchaos.poll(now)
+            # Per-member control-plane staleness, refreshed every pass so
+            # operators see heartbeat age climbing BEFORE auto-down fires
+            # (also surfaced in /healthz as heartbeat_age_s).
+            for m in self.membership.alive_members():
+                self._m_hb_age.labels(member=m.name).set(
+                    max(0.0, now - m.last_seen)
+                )
             # Degraded-mode detection BEFORE auto-down: a partition that
             # strands a quorum of tiles must flip the cluster into waiting,
             # not evict every silent-but-alive member.
@@ -1265,6 +1700,12 @@ class Frontend:
             if not self.degraded:
                 for m in self.membership.stale_members(now):
                     self._on_member_lost(m.name)
+            # The elastic plane: expire/plan migrations, release drains.
+            # A paused cluster still honors drains (a paused tile is not
+            # stepping, so moving it is safe; a SIGTERM'd worker must be
+            # able to leave gracefully mid-pause) but never reshapes for
+            # load.
+            self._rebalance_poll(now, drain_only=self.paused)
             # paced epoch announcements
             if (
                 self._started.is_set()

@@ -82,6 +82,16 @@ class ClusterHarness:
         self.threads.append(t)
         return w
 
+    def drain_worker(self, w, timeout: float = 30.0) -> str:
+        """Gracefully drain one in-process worker: request the drain and
+        wait for its serve thread to exit (the frontend live-migrates its
+        tiles off, then releases it).  Returns the worker's stopped_reason
+        — "drained" on success."""
+        assert w.request_drain(), "drain request not sendable"
+        t = self.threads[self.workers.index(w)]
+        t.join(timeout)
+        return w.stopped_reason
+
     def run_to_completion(self, timeout: float = DONE_TIMEOUT):
         assert self.frontend.wait_for_backends(timeout=5)
         self.frontend.start_simulation()

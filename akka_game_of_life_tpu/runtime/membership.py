@@ -31,6 +31,12 @@ class Member:
     # via OWNERS; the frontend itself never carries ring bytes.
     peer_host: str = ""
     peer_port: int = 0
+    # Graceful scale-in: a draining member still serves everything it owns
+    # but receives no NEW tiles (placement, recovery, or migration) while
+    # the elastic plane moves its tiles off; drain_acked marks the one
+    # DRAIN_COMPLETE release already sent.
+    draining: bool = False
+    drain_acked: bool = False
 
 
 class Membership:
@@ -78,6 +84,16 @@ class Membership:
     def alive_members(self) -> List[Member]:
         with self._lock:
             return [m for m in self._members.values() if m.alive]
+
+    def placeable_members(self) -> List[Member]:
+        """Members that may RECEIVE tiles: alive and not draining.  Every
+        placement decision (initial deal, node-loss reassignment, migration
+        destination) filters through this — a worker mid-drain must never
+        be handed new work it would immediately have to hand back."""
+        with self._lock:
+            return [
+                m for m in self._members.values() if m.alive and not m.draining
+            ]
 
     def mark_dead(self, name: str) -> Optional[Member]:
         """DeathWatch fired (EOF) or auto-down (stale heartbeat)."""

@@ -38,7 +38,22 @@ PAUSE / RESUME              PauseSimulation/ResumeSimulation — *dead code* in
                             the reference (BoardCreator.scala:109-112); reachable here
 SHUTDOWN                    (new) orderly termination
 GOODBYE                     graceful leave (cluster down)
+MIGRATE_PREPARE /           (new) the elastic plane: live tile migration —
+MIGRATE_STATE /             freeze a tile at its chunk boundary, ship its
+MIGRATE_ABORT               packed state + digest lanes to the frontend,
+                            certify on arrival, commit via an OWNERS
+                            rewiring (or roll back loudly); the reference
+                            can only *react* to failure, never move load
+DRAIN_REQUEST /             (new) graceful scale-in: a worker asks to leave,
+DRAIN_COMPLETE              its tiles migrate off live, and only then is it
+                            released — planned departure never trips the
+                            node-loss redeploy path
 ==========================  ====================================================
+
+Every message constant below must appear in docs/OPERATIONS.md's
+"Protocol messages" table — ``tools/check_protocol_msgs.py`` (tier-1, via
+``tests/test_rebalance.py``) lint-enforces it, so new messages cannot ship
+undocumented.
 
 Wire form: each message is a JSON object with a ``type`` field from the
 constants below; numpy arrays ride as base64 (see :mod:`wire`).
@@ -79,6 +94,15 @@ CRASH_TILE = "crash_tile"
 PAUSE = "pause"
 RESUME = "resume"
 SHUTDOWN = "shutdown"
+
+# elastic plane: live tile migration + graceful drain
+# frontend → backend
+MIGRATE_PREPARE = "migrate_prepare"
+MIGRATE_ABORT = "migrate_abort"
+DRAIN_COMPLETE = "drain_complete"
+# backend → frontend
+MIGRATE_STATE = "migrate_state"
+DRAIN_REQUEST = "drain_request"
 
 # worker ↔ worker (the peer-to-peer data plane)
 PEER_HELLO = "peer_hello"

@@ -1,0 +1,226 @@
+"""Elastic rebalancing: the frontend's live tile-migration planner.
+
+The reference cluster only *reacts* to failure (node-loss redeploy,
+supervision replay); production elasticity needs the proactive motions —
+scale-out (a late joiner receives load mid-run) and scale-in (a draining
+worker hands its tiles back before leaving).  Both ride ONE mechanism, the
+three-phase live migration the :class:`Rebalancer` plans and the frontend
+executes:
+
+  PREPARE   frontend → source: ``MIGRATE_PREPARE`` freezes the tile at its
+            next chunk boundary (the worker refuses to start new chunks;
+            in-flight compute completes normally under the worker lock).
+  TRANSFER  source → frontend: ``MIGRATE_STATE`` ships the tile bit-packed
+            (the PR 4 ``pack_tile`` codec, 8 cells/byte) at its live epoch,
+            plus the source-computed 64-bit digest lanes.  The frontend
+            re-derives the lanes from the payload (``digest_payload_np``)
+            and refuses a mismatch LOUDLY — a corrupted transfer must roll
+            back, never fork the trajectory.
+  COMMIT    frontend: atomically rewire ownership (one OWNERS broadcast),
+            then DEPLOY the certified payload to the destination at the
+            frozen epoch.  The source drops the tile on the OWNERS receipt
+            — until that moment it still owns the canonical state, so a
+            destination death, a digest mismatch, or a deadline all roll
+            back by simply unfreezing the source (``MIGRATE_ABORT``); no
+            epoch is ever lost.
+
+This module holds only the *policy* and the in-flight bookkeeping — pure
+data structures the frontend mutates under its own lock.  All wire traffic,
+membership, and metrics stay in :mod:`runtime.frontend`.
+
+Failure handling follows the PR 3 discipline: an aborted migration puts its
+tile on a decorrelated-jitter cooldown (``delay = min(retry_max_s,
+uniform(retry_s, 3·last))``, reset on success) so a flapping destination
+sees a handful of desynchronized attempts per window, not a retry storm;
+peer-plane traffic the migration induces (the destination's ring-history
+pull from the source) rides the existing per-peer circuit breakers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from akka_game_of_life_tpu.runtime.tiles import TileId
+
+
+@dataclasses.dataclass
+class Migration:
+    """One in-flight tile move, PREPARE through COMMIT/abort."""
+
+    tile: TileId
+    source: str
+    dest: str
+    seq: int
+    started: float  # monotonic, for gol_migration_seconds
+    deadline: float  # monotonic; overdue → abort + cooldown
+    span: object = None  # the frontend's migrate.tile span
+
+
+class Rebalancer:
+    """Plans tile moves and tracks the in-flight set.
+
+    NOT thread-safe on its own: the frontend mutates it strictly under the
+    frontend lock (the same discipline as ``tile_owner``/``tile_epochs``).
+    ``rebalance_enabled`` gates only the automatic imbalance planning;
+    drain-driven moves (a member marked draining) are always planned —
+    graceful scale-in must work on any cluster.
+    """
+
+    def __init__(self, config) -> None:
+        self.enabled = config.rebalance_enabled
+        self.interval_s = config.rebalance_interval_s
+        self.min_gap = config.rebalance_min_gap
+        self.max_inflight = config.rebalance_max_inflight
+        self.deadline_s = config.rebalance_deadline_s
+        # The PR 3 backoff policy, applied to migration retries.
+        self._retry_s = config.retry_s
+        self._retry_max_s = config.retry_max_s
+        self._rng = random.Random(f"rebalance:{config.seed}")
+        self.inflight: Dict[TileId, Migration] = {}
+        self._seq = 0
+        self._next_plan_at = 0.0
+        self._cooldown: Dict[TileId, float] = {}  # tile → not-before
+        self._delay: Dict[TileId, float] = {}  # tile → last chosen backoff
+
+    # -- in-flight bookkeeping ------------------------------------------------
+
+    def begin(
+        self, tile: TileId, source: str, dest: str, now: float
+    ) -> Migration:
+        self._seq += 1
+        mig = Migration(
+            tile=tile,
+            source=source,
+            dest=dest,
+            seq=self._seq,
+            started=now,
+            deadline=now + self.deadline_s,
+        )
+        self.inflight[tile] = mig
+        return mig
+
+    def get(self, tile: TileId, seq: int) -> Optional[Migration]:
+        """The in-flight migration a MIGRATE_STATE answers, or None for a
+        stale/unknown (tile, seq) — a state frame from an already-aborted
+        attempt must be ignored, never committed."""
+        mig = self.inflight.get(tuple(tile))
+        return mig if mig is not None and mig.seq == seq else None
+
+    def complete(self, tile: TileId) -> Optional[Migration]:
+        """Commit: drop the in-flight record and reset the tile's backoff."""
+        self._cooldown.pop(tile, None)
+        self._delay.pop(tile, None)
+        return self.inflight.pop(tile, None)
+
+    def abort(self, tile: TileId, now: float) -> Optional[Migration]:
+        """Rollback: drop the record and put the tile on a decorrelated-
+        jitter cooldown before the planner may try it again."""
+        last = self._delay.get(tile, self._retry_s)
+        delay = min(self._retry_max_s, self._rng.uniform(self._retry_s, 3 * last))
+        self._delay[tile] = delay
+        self._cooldown[tile] = now + delay
+        return self.inflight.pop(tile, None)
+
+    def expired(self, now: float) -> List[Migration]:
+        return [m for m in self.inflight.values() if now >= m.deadline]
+
+    def drop_member(self, name: str) -> List[Migration]:
+        """Migrations that must abort because ``name`` left the cluster
+        (either end of an in-flight move)."""
+        return [
+            m for m in self.inflight.values() if name in (m.source, m.dest)
+        ]
+
+    # -- planning -------------------------------------------------------------
+
+    def plan(
+        self,
+        members,
+        tile_epochs: Dict[TileId, int],
+        final_epoch: int,
+        now: float,
+        drain_only: bool = False,
+    ) -> List[Tuple[TileId, str, str]]:
+        """(tile, source, dest) moves to start this pass.
+
+        Drain-driven moves come first and are planned every pass; automatic
+        imbalance moves run only when enabled, at ``interval_s`` cadence,
+        and never when ``drain_only`` (a paused cluster still honors
+        drains — a paused tile is not stepping, so moving it is safe — but
+        must not reshape for load).
+        Loads are projected through the in-flight set so a slow migration
+        is not double-planned, and every destination filters to placeable
+        (alive, not draining) members.
+        """
+        moves: List[Tuple[TileId, str, str]] = []
+        budget = self.max_inflight - len(self.inflight)
+        if budget <= 0:
+            return moves
+        placeable = [m for m in members if m.alive and not m.draining]
+        if not placeable:
+            return moves
+        loads = {m.name: len(m.tiles) for m in placeable}
+        for mig in self.inflight.values():
+            if mig.dest in loads:
+                loads[mig.dest] += 1
+            if mig.source in loads:
+                loads[mig.source] = max(0, loads[mig.source] - 1)
+        planned = set()
+
+        def movable(m, require_unfinished: bool = False):
+            out = [
+                t
+                for t in m.tiles
+                if t not in self.inflight
+                and t not in planned
+                and self._cooldown.get(t, 0.0) <= now
+            ]
+            if require_unfinished and final_epoch:
+                # Load balancing skips tiles already at the final epoch
+                # (nothing left to speed up); drains still move them —
+                # the member cannot leave while it owns anything.
+                out = [t for t in out if tile_epochs.get(t, 0) < final_epoch]
+            # Most caught-up first: freezing the tile closest to the
+            # target blocks the fewest neighbor halo assemblies.
+            out.sort(key=lambda t: tile_epochs.get(t, 0), reverse=True)
+            return out
+
+        # 1. Drain-driven: empty draining members as fast as the in-flight
+        # budget allows.  A draining member is its own source only.
+        for m in members:
+            if not (m.alive and m.draining):
+                continue
+            for tile in movable(m):
+                if budget <= 0 or not loads:
+                    break
+                dest = min(loads, key=lambda n: loads[n])
+                moves.append((tile, m.name, dest))
+                planned.add(tile)
+                loads[dest] += 1
+                budget -= 1
+
+        # 2. Load-driven: most- → least-loaded while the gap holds.  The
+        # effective gap floor is 2 whatever min_gap says: moving a tile
+        # across a gap of 1 swaps which member is fuller without lowering
+        # the peak load — a planner honoring gap 1 ping-pongs the same
+        # tile forever once loads are as even as the tile count allows.
+        if self.enabled and not drain_only and budget > 0 and now >= self._next_plan_at:
+            self._next_plan_at = now + self.interval_s
+            gap = max(2, self.min_gap)
+            while budget > 0 and len(loads) >= 2:
+                src = max(placeable, key=lambda m: loads[m.name])
+                dest = min(loads, key=lambda n: loads[n])
+                if dest == src.name or loads[src.name] - loads[dest] < gap:
+                    break
+                cands = movable(src, require_unfinished=True)
+                if not cands:
+                    break
+                tile = cands[0]
+                moves.append((tile, src.name, dest))
+                planned.add(tile)
+                loads[src.name] -= 1
+                loads[dest] += 1
+                budget -= 1
+        return moves

@@ -74,6 +74,27 @@ def flight_dump_on_signals(recorder, *, reason: str = "sigterm", signals=None):
 
 
 @contextlib.contextmanager
+def stop_after(timeout_s: float, stop_fn):
+    """Bound a graceful wait: run ``stop_fn`` if the block outlives
+    ``timeout_s``.
+
+    Used by the backend's SIGTERM drain: the worker keeps serving the
+    migration protocol until the frontend releases it, but an unreachable
+    or wedged frontend must not turn an orchestrator stop into a hang —
+    past the deadline the watchdog forces the worker's own stop() and the
+    caller falls back to the abrupt-leave path.  The timer thread is a
+    daemon and is cancelled on every exit path, so a prompt release costs
+    nothing."""
+    timer = threading.Timer(timeout_s, stop_fn)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        timer.cancel()
+
+
+@contextlib.contextmanager
 def mask_interrupts():
     """Ignore SIGINT/SIGTERM for the duration of a graceful drain.
 

@@ -36,6 +36,7 @@ from __future__ import annotations
 import argparse
 import io
 import json
+import os
 import time
 
 import numpy as np
@@ -223,17 +224,228 @@ def bench_cluster_halo(
     return summary
 
 
+def bench_cluster_elastic(
+    size: int = 1024,
+    epochs: int = 96,
+    workers: int = 2,
+    grow_to: int = 4,
+    grow_at: int = None,
+    drain_at: int = None,
+    tiles_per_worker: int = 4,
+    exchange_width: int = 4,
+    engine: str = "numpy",
+    chaos: bool = False,
+    emit=print,
+) -> dict:
+    """Elastic-cluster drill (docs/OPERATIONS.md "Elastic rebalancing").
+
+    ``--grow-at E``: run a seeded ``workers``→``grow_to`` scale-out — once
+    the epoch floor crosses E, the extra workers join mid-run, the
+    rebalancer live-migrates tiles onto them, and the record reports
+    aggregate cell-updates/s BEFORE vs AFTER the grow (the after window
+    includes the migration cost — the honest number).  ``--drain-at E``:
+    gracefully drain one loaded worker mid-run (optionally under ``chaos``:
+    5% peer-plane drops plus one scheduled partition), asserting zero
+    node-loss redeploys.  Both certify the final state against the dense
+    oracle via the merged digest plane, like the halo A/B.
+
+    Interpretation: the scale-out raises aggregate throughput when the
+    machine has idle cores for the joiners (the record carries ``cores``);
+    on a host where the initial workers already saturate the CPU, the
+    after-window honestly reports the added wire+migration overhead
+    instead.  ``workers`` must be >= 2: a fully-local single worker steps
+    synchronously on its dispatch thread and starves the control plane."""
+    import threading
+
+    from akka_game_of_life_tpu.obs.catalog import install
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.ops import digest as odigest
+    from akka_game_of_life_tpu.runtime.config import (
+        NetworkChaosConfig,
+        SimulationConfig,
+    )
+    from akka_game_of_life_tpu.runtime.harness import cluster
+    from akka_game_of_life_tpu.runtime.render import BoardObserver
+
+    if workers < 2:
+        raise SystemExit("elastic drill needs --workers >= 2 (see docstring)")
+    config = f"cluster-elastic-{size}"
+    cfg = SimulationConfig(
+        height=size, width=size, seed=0, max_epochs=epochs,
+        exchange_width=exchange_width, tiles_per_worker=tiles_per_worker,
+        flight_dir="", obs_digest=True,
+        rebalance_enabled=True, rebalance_interval_s=0.05,
+        # Large CPU tiles hold the GIL long enough to starve heartbeat
+        # threads; the reference's aggressive 1 s auto-down is calibrated
+        # for 6x6 boards (same rationale as the scale recovery tests).
+        failure_timeout_s=10.0,
+        net_chaos=(
+            NetworkChaosConfig(
+                enabled=True, seed=7, drop_p=0.05, scope="peer",
+                partition_after_s=1.0, partition_every_s=120.0,
+                partition_heal_s=1.0, max_partitions=1,
+            )
+            if chaos
+            else NetworkChaosConfig()
+        ),
+    )
+    registry = install(MetricsRegistry())
+    marks = {}
+    drained = {}
+
+    def floor(h):
+        return min(h.frontend.tile_epochs.values(), default=0)
+
+    t0 = time.perf_counter()
+    with cluster(
+        cfg, workers, observer=BoardObserver(out=io.StringIO()),
+        engine=engine, registry=registry,
+    ) as h:
+        h.frontend.wait_for_backends(timeout=10)
+        h.frontend.start_simulation()
+
+        def driver():
+            # Any escape is recorded, not swallowed: a daemon thread dying
+            # silently would skip the drill and let the bench report a
+            # drain/grow it never performed.
+            try:
+                grew = drained_done = False
+                while not h.frontend.done.is_set():
+                    f = floor(h)
+                    if grow_at is not None and not grew and f >= grow_at:
+                        marks["grow_t"] = time.perf_counter()
+                        marks["grow_epoch"] = f
+                        for i in range(grow_to - workers):
+                            h.add_worker(f"grown-{i}")
+                        grew = True
+                    if drain_at is not None and not drained_done and f >= drain_at:
+                        loaded = [w for w in h.workers if w.tiles]
+                        if not loaded:
+                            raise AssertionError(
+                                "no worker holds tiles at the drain mark"
+                            )
+                        victim = loaded[0]
+                        drained[victim.name] = h.drain_worker(victim)
+                        drained_done = True
+                    time.sleep(0.005)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                marks["driver_error"] = e
+
+        t = threading.Thread(target=driver, daemon=True)
+        t.start()
+        assert h.frontend.done.wait(1200), "elastic drill did not finish"
+        assert h.frontend.error is None, h.frontend.error
+        if "driver_error" in marks:
+            raise AssertionError(
+                f"{config}: drill driver died: {marks['driver_error']!r}"
+            )
+        t_end = time.perf_counter()
+        final_digest = h.frontend.final_digest
+
+    snap = registry.snapshot()
+    oracle_digest = odigest.value(odigest.digest_dense_np(_oracle(cfg, epochs)))
+    digest_ok = final_digest == oracle_digest
+    summary = {
+        "config": config,
+        "cores": os.cpu_count(),
+        "metric": (
+            f"elastic drill, conway {size}x{size} TCP cluster "
+            f"({workers} workers x {tiles_per_worker} tiles, {engine} "
+            f"engine" + (", netchaos armed" if chaos else "") + ")"
+        ),
+        "unit": "cell-updates/sec",
+        "migrations": snap.get("gol_migrations_total", 0.0),
+        "migration_aborts": snap.get("gol_migration_aborts_total", 0.0),
+        "redeploys": snap.get("gol_redeploys_total", 0.0),
+        "digest_certified": digest_ok,
+        # Both digests on record: on divergence the post-mortem needs the
+        # OBSERVED value, not only the expected one.
+        "final_digest": (
+            odigest.format_digest(final_digest)
+            if final_digest is not None
+            else None
+        ),
+        "oracle_digest": odigest.format_digest(oracle_digest),
+    }
+    # A drill that never fired (the run outpaced its epoch mark, or the
+    # driver died before reaching it) must fail, not silently pass with
+    # its assertions skipped.
+    if grow_at is not None and "grow_t" not in marks:
+        raise AssertionError(
+            f"{config}: --grow-at {grow_at} never fired (run finished first)"
+        )
+    if drain_at is not None and not drained:
+        raise AssertionError(
+            f"{config}: --drain-at {drain_at} never fired (run finished first)"
+        )
+    if "grow_t" in marks:
+        ge = marks["grow_epoch"]
+        before = size * size * ge / (marks["grow_t"] - t0)
+        after = size * size * (epochs - ge) / (t_end - marks["grow_t"])
+        summary.update(
+            value=after,
+            vs_baseline=after / REFERENCE_CEILING,
+            grow_epoch=ge,
+            cells_per_sec_before=before,
+            cells_per_sec_after=after,
+            scale_out_speedup=after / before if before else None,
+            workers_after=grow_to,
+        )
+    else:
+        rate = size * size * epochs / (t_end - t0)
+        summary.update(value=rate, vs_baseline=rate / REFERENCE_CEILING)
+    if drained:
+        summary["drained"] = drained  # worker name -> stopped_reason
+        summary["drains_completed"] = snap.get("gol_drains_total", 0.0)
+    emit(json.dumps(summary), flush=True)
+    if not digest_ok:
+        raise AssertionError(
+            f"{config}: merged final digest diverged from the dense "
+            f"oracle's — the elastic plane corrupted the simulation"
+        )
+    if drained and any(r != "drained" for r in drained.values()):
+        raise AssertionError(f"{config}: drain did not complete: {drained}")
+    if drained and summary["redeploys"]:
+        raise AssertionError(
+            f"{config}: drain tripped {summary['redeploys']:.0f} node-loss "
+            f"redeploy(s) — the graceful-drain guarantee is broken"
+        )
+    return summary
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--size", type=int, default=1024)
-    parser.add_argument("--epochs", type=int, default=32)
+    # None = per-drill default (32 for the halo A/B, 96 for the elastic
+    # drill; 8 and 4 tiles/worker respectively) — a sentinel, so explicit
+    # values equal to a default are honored, not rewritten.
+    parser.add_argument("--epochs", type=int, default=None)
     parser.add_argument("--workers", type=int, default=2)
-    parser.add_argument("--tiles-per-worker", type=int, default=8)
+    parser.add_argument("--tiles-per-worker", type=int, default=None)
     parser.add_argument("--exchange-width", type=int, default=4)
     parser.add_argument(
         "--engine", choices=["numpy", "jax", "swar"], default="numpy",
         help="worker tile engine (numpy = portable default; the wire "
         "plane under test is engine-independent)",
+    )
+    parser.add_argument(
+        "--grow-at", type=int, default=None, metavar="E",
+        help="elastic drill: grow the cluster to --grow-to workers once "
+        "the epoch floor crosses E (reports cell-updates/s before/after)",
+    )
+    parser.add_argument(
+        "--grow-to", type=int, default=4, metavar="N",
+        help="worker count after the --grow-at scale-out (default 4)",
+    )
+    parser.add_argument(
+        "--drain-at", type=int, default=None, metavar="E",
+        help="elastic drill: gracefully drain one loaded worker once the "
+        "epoch floor crosses E (asserts zero redeploys, digest-certified)",
+    )
+    parser.add_argument(
+        "--drill-chaos", action="store_true",
+        help="arm the elastic drill with peer-plane netchaos (5%% drops + "
+        "one scheduled partition)",
     )
     parser.add_argument(
         "--platform", default=None, help="pin jax platform (e.g. cpu)"
@@ -243,11 +455,29 @@ def main() -> int:
     from akka_game_of_life_tpu.cli import _apply_platform
 
     _apply_platform(args.platform)
+    if args.grow_at is not None or args.drain_at is not None:
+        bench_cluster_elastic(
+            size=args.size,
+            epochs=args.epochs if args.epochs is not None else 96,
+            workers=args.workers,
+            grow_to=args.grow_to,
+            grow_at=args.grow_at,
+            drain_at=args.drain_at,
+            tiles_per_worker=(
+                args.tiles_per_worker if args.tiles_per_worker is not None else 4
+            ),
+            exchange_width=args.exchange_width,
+            engine=args.engine,
+            chaos=args.drill_chaos,
+        )
+        return 0
     bench_cluster_halo(
         size=args.size,
-        epochs=args.epochs,
+        epochs=args.epochs if args.epochs is not None else 32,
         workers=args.workers,
-        tiles_per_worker=args.tiles_per_worker,
+        tiles_per_worker=(
+            args.tiles_per_worker if args.tiles_per_worker is not None else 8
+        ),
         exchange_width=args.exchange_width,
         engine=args.engine,
     )

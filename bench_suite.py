@@ -641,7 +641,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", type=int, nargs="*",
-        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11],
     )
     parser.add_argument(
         "--scale", type=float, default=1.0,
@@ -698,6 +698,16 @@ def main() -> None:
         # Digest certification vs full-board fetch (PR 5): the
         # observation/validation data-path win, in bytes and seconds.
         bench_digest_certification(s(8192))
+    if 11 in args.config:
+        # Elastic scale-out drill (PR 6): a seeded 2→4 worker grow under
+        # load — late joiners admitted mid-run, tiles live-migrated onto
+        # them (digest-certified) — reporting aggregate cell-updates/s
+        # before vs after the grow.
+        from bench_cluster import bench_cluster_elastic
+
+        bench_cluster_elastic(
+            size=s(1024), epochs=96, workers=2, grow_to=4, grow_at=32
+        )
 
 
 if __name__ == "__main__":

@@ -171,6 +171,89 @@ def test_kill9_backend_process_redeploys_and_matches_oracle(tmp_path):
 
 
 @pytest.mark.slow
+def test_cli_scale_out_and_graceful_drain(tmp_path):
+    """The elastic plane on real OS processes: a third backend joins
+    MID-RUN and receives live-migrated tiles (scale-out), then a SIGTERM'd
+    backend drains — its tiles migrate off, it exits rc=0 ("drained"), the
+    drain triggers zero node-loss redeploys, and the finished run's final
+    checkpoint still equals the dense oracle."""
+    from akka_game_of_life_tpu.models import get_model
+    from akka_game_of_life_tpu.runtime.checkpoint import CheckpointStore
+    from akka_game_of_life_tpu.runtime.config import load_config
+    from akka_game_of_life_tpu.runtime.simulation import initial_board
+
+    import jax.numpy as jnp
+
+    max_epochs = 600
+    ckpt_dir = tmp_path / "ck"
+    sim_args = [
+        "--pattern", "gosper-glider-gun", "--height", "48", "--width", "48",
+        "--max-epochs", str(max_epochs), "--tick", "20ms",
+        "--checkpoint-dir", str(ckpt_dir), "--checkpoint-every", "20",
+        "--tiles-per-worker", "2", "--obs-digest",
+        "--rebalance", "--rebalance-interval-s", "100ms",
+    ]
+    env = _child_env()
+    with _cluster(
+        tmp_path, sim_args, backend_args=("--engine", "numpy")
+    ) as (fe, fe_log, backends):
+        _wait_for(
+            lambda: list(ckpt_dir.glob("ckpt_*.d/COMPLETE.json")),
+            "first checkpoint",
+        )
+        # Scale-out: gamma joins mid-run; the rebalancer migrates onto it.
+        gamma_log = tmp_path / "gamma.log"
+        port = _listening_port(fe_log)
+        with open(gamma_log, "w") as fh:
+            gamma = _spawn(
+                ["backend", "--port", str(port), "--name", "gamma",
+                 "--engine", "numpy"],
+                fh,
+                env,
+            )
+        try:
+            _wait_for(
+                lambda: "-> gamma at epoch" in fe_log.read_text(),
+                "a tile to migrate onto gamma",
+            )
+            # Scale-in: SIGTERM gamma — it must drain, not die.
+            gamma.send_signal(signal.SIGTERM)
+            _wait_for(lambda: gamma.poll() is not None, "gamma exit")
+            out = gamma_log.read_text()
+            assert gamma.returncode == 0, out
+            assert "draining: handing" in out
+            assert "drained; leaving" in out
+            assert "member gamma drained" in fe_log.read_text()
+        finally:
+            if gamma.poll() is None:
+                gamma.kill()
+            gamma.wait(timeout=10)
+
+        _wait_for(lambda: fe.poll() is not None, "frontend to finish")
+        out = fe_log.read_text()
+        assert fe.returncode == 0, out
+        assert f"simulation complete at epoch {max_epochs}" in out
+        # The drain redeployed nothing: no supervision-replay events for it.
+        assert "node_loss" not in out
+
+        cfg = load_config(
+            None,
+            {
+                "pattern": "gosper-glider-gun",
+                "height": 48,
+                "width": 48,
+                "max_epochs": max_epochs,
+            },
+        )
+        store = CheckpointStore(str(ckpt_dir))
+        assert store.latest_epoch() == max_epochs
+        oracle = np.asarray(
+            get_model("conway").run(max_epochs)(jnp.asarray(initial_board(cfg)))
+        )
+        np.testing.assert_array_equal(store.load().board, oracle)
+
+
+@pytest.mark.slow
 def test_sigterm_frontend_shuts_cluster_down_gracefully(tmp_path):
     """SIGTERM on the frontend (the orchestrator-stop path — exercises the
     CLI's SIGTERM→KeyboardInterrupt mapping, which a SIGINT test would not)

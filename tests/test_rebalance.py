@@ -1,0 +1,457 @@
+"""Elastic cluster tests: live tile migration, scale-out, graceful drain.
+
+The failure plane (test_cluster/test_netchaos) proves the cluster survives
+what it did not choose; this file proves the PROACTIVE motions — a late
+joiner receiving load mid-run, a worker handing its tiles back before
+leaving, and every failure path of the three-phase migration protocol
+rolling back to the source with zero lost epochs."""
+
+import io
+import json
+import time
+
+import numpy as np
+
+from akka_game_of_life_tpu.obs.catalog import install
+from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+from akka_game_of_life_tpu.runtime.config import (
+    NetworkChaosConfig,
+    SimulationConfig,
+)
+from akka_game_of_life_tpu.runtime.harness import cluster
+from akka_game_of_life_tpu.runtime.membership import Member
+from akka_game_of_life_tpu.runtime.render import BoardObserver
+from akka_game_of_life_tpu.runtime.simulation import initial_board
+
+from tests.test_cluster import DONE_TIMEOUT, dense_oracle
+
+
+def _registry():
+    return install(MetricsRegistry())
+
+
+def _quiet():
+    return BoardObserver(out=io.StringIO())
+
+
+def _wait(pred, what, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, f"timed out waiting for {what}"
+        time.sleep(0.01)
+
+
+def _wait_floor(h, epoch, timeout=20.0):
+    _wait(
+        lambda: min(h.frontend.tile_epochs.values(), default=0) >= epoch,
+        f"epoch floor >= {epoch}",
+        timeout,
+    )
+
+
+# -- lints (tier-1 doc/config drift guards) ----------------------------------
+
+
+def _tool(name):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_every_rebalance_flag_maps_to_config():
+    mod = _tool("check_rebalance_config")
+    flags = mod.flag_names()
+    # Sanity: the scan sees the real surface.
+    assert "--rebalance" in flags and "--rebalance-min-gap" in flags
+    fields = mod.config_fields()
+    assert "rebalance_enabled" in fields and "rebalance_min_gap" in fields
+    assert mod.problems() == []
+
+
+def test_every_protocol_msg_documented():
+    mod = _tool("check_protocol_msgs")
+    declared = mod.protocol_messages()
+    # Sanity: the scan sees old and new messages alike.
+    assert "tick" in declared and "migrate_prepare" in declared
+    assert "drain_request" in declared
+    assert mod.problems() == []
+
+
+# -- planner unit behavior ----------------------------------------------------
+
+
+def _member(name, tiles=(), draining=False):
+    m = Member(name=name, channel=None, last_seen=0.0)
+    m.tiles = list(tiles)
+    m.draining = draining
+    return m
+
+
+def _rebalancer(**kw):
+    from akka_game_of_life_tpu.runtime.rebalance import Rebalancer
+
+    cfg = SimulationConfig(max_epochs=100, **kw)
+    return Rebalancer(cfg)
+
+
+def test_planner_moves_from_loaded_to_idle():
+    r = _rebalancer(rebalance_enabled=True, rebalance_max_inflight=4)
+    members = [_member("a", [(0, 0), (0, 1)]), _member("b")]
+    moves = r.plan(members, {(0, 0): 5, (0, 1): 9}, 100, now=1.0)
+    # One move closes the gap to 1; the most caught-up tile goes first.
+    assert moves == [((0, 1), "a", "b")]
+
+
+def test_planner_never_honors_gap_one():
+    """A gap-1 move swaps which member is fuller without lowering the peak
+    load — the planner must floor min_gap at 2 or it ping-pongs forever."""
+    r = _rebalancer(rebalance_enabled=True, rebalance_min_gap=1)
+    members = [_member("a", [(0, 0), (0, 1)]), _member("b", [(1, 0)])]
+    assert r.plan(members, {}, 100, now=1.0) == []
+
+
+def test_planner_disabled_still_plans_drains():
+    r = _rebalancer()  # rebalance_enabled defaults False
+    members = [_member("a", [(0, 0)], draining=True), _member("b")]
+    assert r.plan(members, {}, 100, now=1.0) == [((0, 0), "a", "b")]
+    # ...but never plans load moves.
+    members = [_member("a", [(0, 0), (0, 1), (1, 0)]), _member("b")]
+    assert r.plan(members, {}, 100, now=1.0) == []
+
+
+def test_planner_excludes_draining_destinations_and_cooled_tiles():
+    r = _rebalancer(rebalance_enabled=True)
+    members = [
+        _member("a", [(0, 0), (0, 1), (1, 0)]),
+        _member("b", draining=True),
+        _member("c"),
+    ]
+    moves = r.plan(members, {}, 100, now=1.0)
+    assert moves and all(dest == "c" for _, _, dest in moves)
+    # An aborted migration cools the tile down (decorrelated-jitter delay).
+    tile = moves[0][0]
+    r.begin(tile, "a", "c", now=2.0)
+    r.abort(tile, now=2.0)
+    later = r.plan(members, {}, 100, now=2.0)
+    assert all(t != tile for t, _, _ in later)
+
+
+def test_planner_respects_inflight_budget():
+    r = _rebalancer(rebalance_enabled=True, rebalance_max_inflight=1)
+    members = [_member("a", [(0, 0), (0, 1), (1, 0), (1, 1)]), _member("b")]
+    assert len(r.plan(members, {}, 100, now=1.0)) == 1
+    r.begin((0, 0), "a", "b", now=1.0)
+    assert r.plan(members, {}, 100, now=2.0) == []
+
+
+# -- late join / scale-out ----------------------------------------------------
+
+
+def test_late_joiner_admitted_with_wiring_and_idles():
+    """Satellite: a worker registering after start_simulation has a
+    deterministic path — admitted, wired (it receives the current OWNERS
+    immediately), and idle until rebalanced."""
+    cfg = SimulationConfig(height=16, width=16, seed=7, max_epochs=80, tick_s=0.01,
+        start_delay_s=0.05)
+    with cluster(cfg, 2, observer=_quiet()) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        late = h.add_worker("late")
+        # Wired without owning anything: the OWNERS broadcast reached it.
+        _wait(lambda: late.layout is not None, "late joiner wiring")
+        assert set(late.owners) == set(h.frontend.layout.tile_ids)
+        assert not late.tiles
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        final = h.frontend.final_board
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 80))
+
+
+def test_scale_out_migrates_tiles_to_late_joiner():
+    """The scale-out motion: with rebalancing on, a late joiner receives
+    live-migrated tiles (digest-certified, no restart, no lost epoch) and
+    the run stays bit-identical to the dense oracle."""
+    reg = _registry()
+    cfg = SimulationConfig(
+        height=64, width=64, seed=7, max_epochs=80, tick_s=0.005,
+        start_delay_s=0.05, tiles_per_worker=2, obs_digest=True,
+        rebalance_enabled=True, rebalance_interval_s=0.05,
+    )
+    with cluster(cfg, 2, observer=_quiet(), registry=reg) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        _wait_floor(h, 5)
+        late = h.add_worker("late")
+        _wait(
+            lambda: any(
+                o == late.name for o in h.frontend.tile_owner.values()
+            ),
+            "a tile to migrate onto the late joiner",
+        )
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+        final_digest = h.frontend.final_digest
+    snap = reg.snapshot()
+    assert snap.get("gol_migrations_total", 0) >= 1
+    assert not snap.get("gol_digest_mismatches_total")
+    oracle = dense_oracle(initial_board(cfg), "conway", 80)
+    assert np.array_equal(final, oracle)
+    from akka_game_of_life_tpu.ops import digest as odigest
+
+    assert final_digest == odigest.value(odigest.digest_dense_np(oracle))
+
+
+# -- graceful drain -----------------------------------------------------------
+
+
+def test_drain_hands_tiles_back_and_worker_exits_cleanly():
+    """Scale-in: a drained worker's tiles live-migrate to the survivor,
+    the worker is released rc-clean ("drained"), and — the whole point —
+    zero node-loss redeploys: planned departure is not failure.  Works
+    with rebalance_enabled OFF (drain moves are always planned)."""
+    reg = _registry()
+    cfg = SimulationConfig(
+        height=32, width=32, seed=5, max_epochs=80, tick_s=0.005,
+        start_delay_s=0.05, tiles_per_worker=2,
+    )
+    with cluster(cfg, 2, observer=_quiet(), registry=reg) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        _wait_floor(h, 5)
+        victim = h.workers[0]
+        assert h.drain_worker(victim) == "drained"
+        survivor = h.workers[1].name
+        assert all(o == survivor for o in h.frontend.tile_owner.values())
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+    snap = reg.snapshot()
+    assert not snap.get("gol_redeploys_total")  # nothing was "lost"
+    assert snap.get("gol_drains_total") == 1
+    assert snap.get("gol_migrations_total", 0) >= 2
+    assert not snap.get("gol_members_draining")  # gauge back to 0
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 80))
+
+
+def test_drain_completes_while_cluster_paused():
+    """SIGTERM during a SIGUSR1 pause must still drain gracefully: a
+    paused tile is not stepping, so moving it is safe, and the worker
+    must not be stranded for the drain timeout and then trip node-loss
+    redeploy.  Resume afterwards and the run completes on the oracle."""
+    reg = _registry()
+    cfg = SimulationConfig(
+        height=32, width=32, seed=5, max_epochs=80, tick_s=0.005,
+        start_delay_s=0.05, tiles_per_worker=2,
+    )
+    with cluster(cfg, 2, observer=_quiet(), registry=reg) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        _wait_floor(h, 5)
+        h.frontend.pause()
+        assert h.drain_worker(h.workers[0]) == "drained"
+        survivor = h.workers[1].name
+        assert all(o == survivor for o in h.frontend.tile_owner.values())
+        h.frontend.resume()
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+    snap = reg.snapshot()
+    assert not snap.get("gol_redeploys_total")
+    assert snap.get("gol_drains_total") == 1
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 80))
+
+
+def test_drain_refused_without_destination():
+    """A drain with nowhere to put the tiles is refused immediately (the
+    worker falls back to the abrupt-leave path) instead of hanging."""
+    cfg = SimulationConfig(height=16, width=16, seed=3, max_epochs=500, tick_s=0.01)
+    with cluster(cfg, 1, observer=_quiet()) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        _wait_floor(h, 2)
+        assert h.drain_worker(h.workers[0]) == "drain_refused"
+
+
+def test_drain_under_netchaos_loses_nothing(tmp_path):
+    """The scale-in acceptance drill: drain a worker while the peer plane
+    is lossy AND a scheduled partition fires mid-run.  The drained worker
+    exits cleanly, the drain triggers zero node-loss redeploys, and the
+    final board is bit-identical to the fault-free oracle."""
+    reg = _registry()
+    cfg = SimulationConfig(
+        height=48, width=48, seed=23, max_epochs=120, tick_s=0.005,
+        start_delay_s=0.05, tiles_per_worker=2, obs_digest=True, flight_dir="",
+        net_chaos=NetworkChaosConfig(
+            enabled=True, seed=5, drop_p=0.1, scope="peer",
+            partition_after_s=0.3, partition_every_s=60.0,
+            partition_heal_s=0.5, max_partitions=1,
+        ),
+    )
+    with cluster(cfg, 2, observer=_quiet(), registry=reg) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        _wait_floor(h, 20)
+        assert h.drain_worker(h.workers[0]) == "drained"
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+    snap = reg.snapshot()
+    assert not snap.get("gol_redeploys_total")
+    assert snap.get("gol_drains_total") == 1
+    assert snap.get("gol_net_chaos_dropped_total", 0) > 0  # chaos was real
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 120))
+
+
+# -- migration failure paths --------------------------------------------------
+
+
+def test_migration_digest_mismatch_rolls_back(tmp_path):
+    """A corrupted transfer: the frontend's certification catches it,
+    counts gol_digest_mismatches_total, dumps the flight ring, aborts —
+    and the source (which never dropped the tile) resumes, so the run
+    still matches the oracle exactly."""
+    reg = _registry()
+    flight_dir = tmp_path / "flight"
+    cfg = SimulationConfig(
+        height=32, width=32, seed=9, max_epochs=80, tick_s=0.005,
+        start_delay_s=0.05, flight_dir=str(flight_dir),
+    )
+    with cluster(cfg, 2, observer=_quiet(), registry=reg) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        _wait_floor(h, 5)
+        source = h.workers[0]
+        orig = source._migrate_payload
+
+        def corrupt(tid, arr, epoch):
+            out = orig(tid, arr, epoch)
+            out["digest"] = [out["digest"][0] ^ 1, out["digest"][1]]
+            return out
+
+        source._migrate_payload = corrupt
+        tile = next(
+            t for t, o in h.frontend.tile_owner.items() if o == source.name
+        )
+        assert h.frontend.migrate_tile(tile, h.workers[1].name)
+        _wait(
+            lambda: reg.snapshot().get("gol_migration_aborts_total"),
+            "the mismatch rollback",
+        )
+        assert h.frontend.tile_owner[tile] == source.name  # rolled back
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+    snap = reg.snapshot()
+    assert snap.get("gol_digest_mismatches_total") == 1
+    assert not snap.get("gol_migrations_total")
+    dumps = [
+        json.loads(p.read_text()) for p in flight_dir.glob("flightrec-*.json")
+    ]
+    assert any(d.get("reason") == "migration_abort" for d in dumps)
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 80))
+
+
+def test_migration_dest_death_aborts_and_source_keeps_ownership():
+    """Destination dies mid-transfer: the migration aborts, the source
+    keeps ownership (it never dropped the tile), and no epoch is lost —
+    the run completes bit-identical to the oracle."""
+    reg = _registry()
+    cfg = SimulationConfig(
+        height=32, width=32, seed=13, max_epochs=80, tick_s=0.005,
+        start_delay_s=0.05,
+    )
+    with cluster(cfg, 2, observer=_quiet(), registry=reg) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        _wait_floor(h, 5)
+        late = h.add_worker("doomed")
+        _wait(lambda: late.layout is not None, "late joiner wiring")
+        source = h.workers[0]
+        # Hold the transfer so the death deterministically lands mid-flight.
+        source._on_migrate_prepare = lambda msg: None
+        tile = next(
+            t for t, o in h.frontend.tile_owner.items() if o == source.name
+        )
+        assert h.frontend.migrate_tile(tile, "doomed")
+        late.stop()  # the destination dies before any state arrived
+        _wait(
+            lambda: reg.snapshot().get("gol_migration_aborts_total"),
+            "the dest-loss rollback",
+        )
+        assert h.frontend.tile_owner[tile] == source.name
+        assert not h.frontend.rebalancer.inflight
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 80))
+
+
+def test_migration_deadline_aborts_and_run_completes():
+    """A source that never answers PREPARE: the frontend's deadline fires,
+    the move rolls back, and the cooled-down tile keeps stepping on the
+    source — self-healing, not a stall."""
+    reg = _registry()
+    cfg = SimulationConfig(
+        height=32, width=32, seed=17, max_epochs=80, tick_s=0.005,
+        start_delay_s=0.05, rebalance_deadline_s=0.3,
+    )
+    with cluster(cfg, 2, observer=_quiet(), registry=reg) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        _wait_floor(h, 5)
+        source = h.workers[0]
+        source._on_migrate_prepare = lambda msg: None  # PREPARE vanishes
+        tile = next(
+            t for t, o in h.frontend.tile_owner.items() if o == source.name
+        )
+        assert h.frontend.migrate_tile(tile, h.workers[1].name)
+        _wait(
+            lambda: reg.snapshot().get("gol_migration_aborts_total"),
+            "the deadline rollback",
+        )
+        assert h.frontend.tile_owner[tile] == source.name
+        assert h.frontend.done.wait(DONE_TIMEOUT)
+        assert h.frontend.error is None
+        final = h.frontend.final_board
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 80))
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_healthz_reports_heartbeat_age_and_gauge_tracks_members():
+    """Satellite: per-member heartbeat age in /healthz and the
+    gol_member_heartbeat_age_seconds gauge, refreshed by the maintenance
+    loop, so staleness is visible BEFORE auto-down fires."""
+    reg = _registry()
+    cfg = SimulationConfig(height=16, width=16, seed=2, max_epochs=60, tick_s=0.01,
+        start_delay_s=0.05)
+    with cluster(cfg, 2, observer=_quiet(), registry=reg) as h:
+        assert h.frontend.wait_for_backends(timeout=5)
+        h.frontend.start_simulation()
+        _wait_floor(h, 2)
+
+        def gauge_has(worker):
+            return any(
+                k.startswith("gol_member_heartbeat_age_seconds")
+                and f'member="{worker.name}"' in k
+                for k in reg.snapshot()
+            )
+
+        # The maintenance loop refreshes the series every pass.
+        _wait(
+            lambda: all(gauge_has(w) for w in h.workers),
+            "heartbeat-age gauge series for every member",
+        )
+        health = h.frontend._health()
+        ages = health["heartbeat_age_s"]
+        assert set(ages) == {w.name for w in h.workers}
+        assert all(0 <= a < 5 for a in ages.values())
+        assert health["draining"] == []
+        assert health["migrations_inflight"] == 0
+        assert h.frontend.done.wait(DONE_TIMEOUT)
