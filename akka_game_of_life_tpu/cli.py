@@ -290,6 +290,73 @@ def _rebalance_overrides(args: argparse.Namespace) -> dict:
     }
 
 
+def _add_serve(p: argparse.ArgumentParser) -> None:
+    """The serving plane's knobs (``serve/``).  Every ``--serve-X`` flag
+    maps 1:1 onto ``SimulationConfig.serve_X`` (dashes to underscores) —
+    ``tools/check_serve_config.py`` lint-enforces the bijection."""
+    g = p.add_argument_group(
+        "serving plane",
+        "admission control and batched-engine knobs for the multi-tenant "
+        "/boards API (see docs/OPERATIONS.md \"Serving plane\")",
+    )
+    g.add_argument(
+        "--serve-max-sessions", type=int, default=None, metavar="N",
+        help="session-count cap; creates beyond it answer 429",
+    )
+    g.add_argument(
+        "--serve-max-cells", type=int, default=None, metavar="N",
+        help="aggregate live-cell budget across all sessions; creates "
+        "that would exceed it answer 429",
+    )
+    g.add_argument(
+        "--serve-queue-depth", type=int, default=None, metavar="N",
+        help="pending step-job bound; a full queue answers 429 to NEW "
+        "jobs (queued ones always complete)",
+    )
+    g.add_argument(
+        "--serve-max-steps", type=int, default=None, metavar="N",
+        help="most generations one step request may ask for",
+    )
+    g.add_argument(
+        "--serve-tick-s", default=None, metavar="DUR",
+        help="engine pacing floor: at most one batched device program "
+        "per this interval (e.g. 10ms; 0 = free-running)",
+    )
+    g.add_argument(
+        "--serve-ttl-s", default=None, metavar="DUR",
+        help="idle-session TTL; untouched sessions are evicted after "
+        "this long (e.g. 5m; 0 = never)",
+    )
+    g.add_argument(
+        "--serve-size-classes", default=None, metavar="C1,C2,...",
+        help="padded board size classes (square sides, ascending): mixed "
+        "shapes bucket into a few compiled programs; boards beyond the "
+        "largest class are refused (default 32,64,128,256)",
+    )
+
+
+def _serve_overrides(args: argparse.Namespace) -> dict:
+    """``--serve-*`` flags → SimulationConfig override kwargs (empty
+    entries are dropped by load_config's None filtering)."""
+    return {
+        "serve_max_sessions": args.serve_max_sessions,
+        "serve_max_cells": args.serve_max_cells,
+        "serve_queue_depth": args.serve_queue_depth,
+        "serve_max_steps": args.serve_max_steps,
+        "serve_tick_s": (
+            parse_duration(args.serve_tick_s)
+            if args.serve_tick_s is not None
+            else None
+        ),
+        "serve_ttl_s": (
+            parse_duration(args.serve_ttl_s)
+            if args.serve_ttl_s is not None
+            else None
+        ),
+        "serve_size_classes": args.serve_size_classes,
+    }
+
+
 def _add_chaos_net(p: argparse.ArgumentParser) -> None:
     """The network chaos plane's knobs (``runtime/netchaos.py``).  Every
     ``--chaos-net-X`` flag maps 1:1 onto ``NetworkChaosConfig.X`` (dashes to
@@ -526,6 +593,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     _add_rebalance(fe_p)
     _add_chaos_net(fe_p)
 
+    sv_p = sub.add_parser(
+        "serve",
+        help="multi-tenant board service: vmapped batched boards behind "
+        "a /boards HTTP API with admission control (mounted on the obs "
+        "endpoint alongside /metrics, /healthz, /trace)",
+    )
+    sv_p.add_argument("--config", help="TOML or JSON config file")
+    _add_platform(sv_p)
+    sv_p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="HTTP port for /boards + /metrics + /healthz + /trace "
+        "(default 0 = ephemeral, printed at startup)",
+    )
+    _add_serve(sv_p)
+
     st_p = sub.add_parser(
         "selftest",
         help="verify this machine end-to-end: gun phase, oracle equivalence, "
@@ -723,6 +807,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             except KeyboardInterrupt:
                 # run_frontend handles interrupts inside its serve loop; this
                 # covers startup (bind/quorum/deploy) windows.
+                return 130
+
+    if args.command == "serve":
+        cfg = load_config(
+            args.config,
+            {
+                "role": "serve",
+                "metrics_port": args.metrics_port,
+                **_serve_overrides(args),
+            },
+        )
+        from akka_game_of_life_tpu.obs import get_tracer
+        from akka_game_of_life_tpu.runtime.signals import flight_dump_on_signals
+        from akka_game_of_life_tpu.serve.api import run_serve
+
+        with _sigterm_as_interrupt(), flight_dump_on_signals(
+            get_tracer().flight
+        ):
+            try:
+                return run_serve(cfg)
+            except KeyboardInterrupt:
+                # run_serve handles interrupts in its wait loop; this
+                # covers the bind/startup window.
                 return 130
 
     return _other_commands(args)

@@ -97,6 +97,32 @@ CATALOG = (
     ("gol_drains_total", "counter",
      "Graceful worker drains completed (every tile migrated off before "
      "the member left)", ()),
+    # -- multi-tenant serving plane (serve/) ----------------------------------
+    ("gol_serve_sessions", "gauge",
+     "Live board sessions, per tenant", ("tenant",)),
+    ("gol_serve_cells", "gauge",
+     "Aggregate live-session cells (the serve_max_cells admission "
+     "resource)", ()),
+    ("gol_serve_session_creates_total", "counter",
+     "Board sessions admitted, per tenant", ("tenant",)),
+    ("gol_serve_session_evictions_total", "counter",
+     "Sessions evicted by the idle TTL sweep", ()),
+    ("gol_serve_steps_total", "counter",
+     "Board generations served, per tenant", ("tenant",)),
+    ("gol_serve_rejects_total", "counter",
+     "Requests refused by admission control (HTTP 429), by reason",
+     ("reason",)),
+    ("gol_serve_queue_depth", "gauge",
+     "Step jobs pending in the engine queue", ()),
+    ("gol_serve_batch_boards", "histogram",
+     "Boards advanced per batched device program (count = programs run)",
+     (), RING_BATCH_BUCKETS),
+    ("gol_serve_tick_seconds", "histogram",
+     "Wall seconds per engine tick (batch assembly + device programs + "
+     "scatter-back)", ()),
+    ("gol_serve_step_seconds", "histogram",
+     "Wall seconds per step request, enqueue to result (queue wait + "
+     "batch run)", ()),
     # -- network chaos plane / hardened comms (PR 3) ---------------------------
     ("gol_net_chaos_dropped_total", "counter",
      "Messages dropped by the network chaos policy (random drops + "

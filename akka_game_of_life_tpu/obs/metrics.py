@@ -202,6 +202,16 @@ class _Instrument:
                 child = self._children[key] = self._new_child()
         return child
 
+    def remove(self, **labels: str) -> None:
+        """Drop one labeled child from the exposition.  A departed label
+        set (an evicted tenant, a drained peer) must not export forever —
+        unbounded label cardinality is a memory leak.  Removing a counter
+        child forfeits its monotonic history (rate() handles the reset);
+        callers own that trade.  No-op when the child never existed."""
+        key = tuple(str(labels[n]) for n in self.labelnames)
+        with self._lock:
+            self._children.pop(key, None)
+
     # Unlabeled convenience passthroughs -------------------------------------
 
     def _default(self):

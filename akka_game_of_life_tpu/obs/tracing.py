@@ -74,6 +74,9 @@ SPAN_CATALOG = (
     ("net.partition", "one injected partition, open to heal"),
     ("breaker.open", "one circuit-breaker open interval, open to re-close"),
     ("cluster.degraded", "frontend degraded mode, quorum-stranded to heal"),
+    # -- multi-tenant serving plane -------------------------------------------
+    ("serve.tick", "one serving-plane engine tick (batched device programs "
+     "over this tick's step jobs)"),
     # -- durability -----------------------------------------------------------
     ("checkpoint.save", "one checkpoint save made durable"),
     ("checkpoint.restore", "one checkpoint load"),

@@ -97,7 +97,9 @@ def digest_dense(board: jax.Array, row0=0, col0=0, width: Optional[int] = None):
         width = w
     rows = jax.lax.broadcasted_iota(_U, (h, w), 0) + jnp.asarray(row0, _U)
     cols = jax.lax.broadcasted_iota(_U, (h, w), 1) + jnp.asarray(col0, _U)
-    idx = rows * _U(width) + cols
+    # asarray, not _U(...): ``width`` may be a traced per-board scalar under
+    # the serving plane's vmapped fold (digest_dense_batch).
+    idx = rows * jnp.asarray(width, _U) + cols
     state = board.astype(_U)
     lanes = [
         jnp.sum(state * _fmix32(idx ^ _U(seed)), dtype=_U)
@@ -145,6 +147,23 @@ def digest_planes(planes: jax.Array, width: int, row0=0, wordcol0=0):
             digest_packed(planes[k], width, row0, wordcol0) << _U(k)
         )
     return total
+
+
+def digest_dense_batch(boards: jax.Array, widths) -> jax.Array:
+    """Per-board digest lanes of a batched ``[B, H, W]`` uint8 stack —
+    the serving plane's certification fold, one ``vmap`` lane per tenant
+    board.  Returns ``[B, 2]`` uint32 lanes, board b's row bit-identical
+    to ``digest_dense`` of that board alone with global width
+    ``widths[b]``.
+
+    Boards of mixed logical shapes ride one stack zero-padded to the
+    size-class shape: a padding cell holds state 0 and contributes
+    ``0 · key = 0`` to every lane, so padding is invisible to the digest
+    and each row certifies exactly the ``[h_b, w_b]`` live region (the
+    index stream ``r · widths[b] + c`` over that region is the same one
+    the single-board definition walks)."""
+    widths = jnp.asarray(widths, _U)
+    return jax.vmap(lambda b, w: digest_dense(b, 0, 0, w))(boards, widths)
 
 
 # -- host (np) twins -----------------------------------------------------------

@@ -36,6 +36,26 @@ _UNIT_SECONDS = {
 }
 
 
+def parse_size_classes(spec) -> Tuple[int, ...]:
+    """``"32,64,256"`` → (32, 64, 256): the serving plane's padded board
+    size classes, strictly ascending positive square sides (see
+    docs/OPERATIONS.md "Serving plane").  Lives here (not serve/) so
+    config validation stays import-light — :mod:`serve.batch` re-exports
+    it."""
+    try:
+        classes = tuple(int(v) for v in str(spec).split(","))
+    except ValueError:
+        raise ValueError(f"unparseable serve size classes: {spec!r}") from None
+    if not classes or any(c <= 0 for c in classes) or any(
+        b <= a for a, b in zip(classes, classes[1:])
+    ):
+        raise ValueError(
+            f"serve size classes must be strictly ascending positive "
+            f"ints, got {spec!r}"
+        )
+    return classes
+
+
 def parse_duration(value) -> float:
     """Parse a duration into seconds: 5, 5.0, "5s", "3000ms", "1 second"."""
     if isinstance(value, (int, float)):
@@ -318,6 +338,35 @@ class SimulationConfig:
     # migrations retry under the retry_s/retry_max_s decorrelated-jitter
     # backoff policy below.
     rebalance_deadline_s: float = 10.0
+    # -- multi-tenant serving plane (docs/OPERATIONS.md "Serving plane") --
+    # The serve role's admission-control and batched-engine knobs.  Every
+    # field maps to a --serve-X flag (tools/check_serve_config.py
+    # lint-enforces the bijection).  Session-count cap per process:
+    serve_max_sessions: int = 1024
+    # Aggregate live-cell budget across every session — the batch-memory
+    # resource a count cap alone cannot bound (1024 sessions of 256² is
+    # 64 MiB of boards; of 32² it is 1 MiB).
+    serve_max_cells: int = 16_777_216
+    # Pending step-job bound; a full queue REJECTS new jobs (HTTP 429)
+    # instead of dropping queued ones — a queued job's client is already
+    # blocked on it.
+    serve_queue_depth: int = 4096
+    # Per-request epoch bound (one POST /boards/<id>/step may ask at most
+    # this many generations; the scan length buckets to powers of two up
+    # to it).
+    serve_max_steps: int = 1024
+    # Engine pacing floor: at most one batched device program per tick_s
+    # (0 = run as fast as jobs arrive — the free-running default, like
+    # tick_s for the simulation loop).
+    serve_tick_s: float = 0.0
+    # Idle-session TTL: a session untouched (no step/get) this long is
+    # evicted by the ticker's sweep (0 = never evict).
+    serve_ttl_s: float = 300.0
+    # Padded size classes (square sides, strictly ascending): a (h, w)
+    # board occupies the smallest class ≥ max(h, w), so mixed shapes
+    # bucket into a handful of compiled programs; boards beyond the
+    # largest class are refused with 400.
+    serve_size_classes: str = "32,64,128,256"
     # Optional deadline on cluster channel sends (seconds; 0 = block
     # forever, the classic TCP behavior).  With a deadline, a send into a
     # wedged peer's full socket buffer raises after this long instead of
@@ -423,7 +472,7 @@ class SimulationConfig:
                     f"probe_window {self.probe_window} out of bounds for "
                     f"{self.height}x{self.width}"
                 )
-        if self.role not in ("standalone", "frontend", "backend"):
+        if self.role not in ("standalone", "frontend", "backend", "serve"):
             raise ValueError(f"unknown role {self.role!r}")
         if not (0 <= self.metrics_port < 65536):
             raise ValueError(
@@ -478,6 +527,27 @@ class SimulationConfig:
             raise ValueError(
                 f"ring_queue_depth must be >= 1, got {self.ring_queue_depth}"
             )
+        for name in (
+            "serve_max_sessions",
+            "serve_max_cells",
+            "serve_queue_depth",
+            "serve_max_steps",
+        ):
+            if getattr(self, name) < 1:
+                raise ValueError(
+                    f"{name}={getattr(self, name)} must be >= 1"
+                )
+        if self.serve_tick_s < 0:
+            raise ValueError(
+                f"serve_tick_s={self.serve_tick_s} must be >= 0 (0 = "
+                f"free-running)"
+            )
+        if self.serve_ttl_s < 0:
+            raise ValueError(
+                f"serve_ttl_s={self.serve_ttl_s} must be >= 0 (0 = never "
+                f"evict)"
+            )
+        parse_size_classes(self.serve_size_classes)
         if self.exchange_width < 1:
             raise ValueError(f"exchange_width must be >= 1, got {self.exchange_width}")
         if self.exchange_width > 1:
@@ -515,6 +585,8 @@ _DURATION_FIELDS = {
     "retry_max_s",
     "rebalance_interval_s",
     "rebalance_deadline_s",
+    "serve_tick_s",
+    "serve_ttl_s",
     "breaker_cooldown_s",
     "send_deadline_s",
     "delay_s",

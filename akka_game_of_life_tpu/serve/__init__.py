@@ -1,0 +1,33 @@
+"""Multi-tenant serving plane: batched boards + session router + HTTP API.
+
+The rest of the runtime simulates ONE board per process; this subsystem
+turns it into a *service* — thousands of small per-user boards advancing
+in one device program (:mod:`.batch`, the CAX ``vmap``-batched shape with
+per-board rule masks as traced data, the CAT "rule as operand" move), a
+session table + job queue feeding the engine in ticks with admission
+control (:mod:`.sessions`), and ``/boards`` HTTP routes mounted on the
+existing obs endpoint (:mod:`.api`).
+"""
+
+from akka_game_of_life_tpu.serve.api import board_routes, run_serve
+from akka_game_of_life_tpu.serve.batch import (
+    DEFAULT_SIZE_CLASSES,
+    batch_step_fn,
+    size_class,
+)
+from akka_game_of_life_tpu.serve.sessions import (
+    AdmissionError,
+    Session,
+    SessionRouter,
+)
+
+__all__ = [
+    "AdmissionError",
+    "DEFAULT_SIZE_CLASSES",
+    "Session",
+    "SessionRouter",
+    "batch_step_fn",
+    "board_routes",
+    "run_serve",
+    "size_class",
+]

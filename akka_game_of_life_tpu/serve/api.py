@@ -1,0 +1,207 @@
+"""The ``/boards`` HTTP API: the serving plane's tenant-facing surface.
+
+Mounted on the existing obs endpoint through its registered-routes table
+(:meth:`akka_game_of_life_tpu.obs.httpd.MetricsServer.add_route`) — one
+port serves ``/metrics``, ``/healthz``, ``/trace``, AND the board API.
+
+| Method & path            | Body (JSON)                               | Returns |
+|--------------------------|-------------------------------------------|---------|
+| POST /boards             | {tenant?, rule?, height?, width?, seed?, density?} | 201 session doc |
+| GET /boards              | —                                         | 200 {boards: [...]} (no cells) |
+| GET /boards/<id>         | —                                         | 200 session doc (+ board cells) |
+| POST /boards/<id>/step   | {steps?}                                  | 200 {epoch, digest, steps} |
+| DELETE /boards/<id>      | —                                         | 200 {deleted} |
+
+Error mapping — admission control answers, it never wedges: a capacity
+refusal (session cap, cell budget, full step queue, shutdown drain) is
+**429** with the machine-readable ``reason`` (the same string on
+``gol_serve_rejects_total{reason}``) and a ``Retry-After`` hint in the
+body; a step that timed out is **503** (the body says whether it was
+cancelled in-queue — board provably not advanced, retry safe); malformed
+requests are 400; unknown ids 404; everything else 500 with the error
+repr.  Board cells travel as base64 of the raw row-major
+uint8 bytes (``board_b64`` + the height/width already in the doc) — JSON-
+safe at any state alphabet without a 4-byte-per-cell integer list.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from akka_game_of_life_tpu.obs.httpd import JSON_TYPE, json_response
+from akka_game_of_life_tpu.serve.sessions import AdmissionError, SessionRouter
+
+
+def _doc(snapshot: dict, *, with_board: bool = True) -> dict:
+    doc = dict(snapshot)
+    board = doc.pop("board", None)
+    if with_board and board is not None:
+        doc["board_b64"] = base64.b64encode(
+            np.ascontiguousarray(board).tobytes()
+        ).decode("ascii")
+    return doc
+
+
+def decode_board_b64(doc: dict) -> np.ndarray:
+    """Client-side twin of the ``board_b64`` encoding (bench/tests)."""
+    raw = base64.b64decode(doc["board_b64"])
+    return np.frombuffer(raw, dtype=np.uint8).reshape(
+        doc["height"], doc["width"]
+    )
+
+
+class BoardsRoute:
+    """The ``/boards`` route handler (callable with the httpd route
+    contract: ``(method, path, body) -> (status, ctype, bytes)``)."""
+
+    def __init__(self, router: SessionRouter) -> None:
+        self.router = router
+
+    def __call__(self, method: str, path: str, body: bytes):
+        try:
+            return self._dispatch(method, path, body)
+        except AdmissionError as e:
+            return json_response(
+                429,
+                {"error": str(e), "reason": e.reason, "retry_after_s": 0.1},
+            )
+        except KeyError as e:
+            return json_response(404, {"error": f"no board {e.args[0]!r}"})
+        except (ValueError, TypeError) as e:
+            return json_response(400, {"error": str(e)})
+        except TimeoutError as e:
+            # The router's distinguished outcomes ("cancelled; board not
+            # advanced" = a safe retry) ride str(e) — a generic 500 would
+            # read as a route bug and lose the retry signal.
+            return json_response(
+                503, {"error": str(e), "retry_after_s": 1.0}
+            )
+
+    def _dispatch(self, method: str, path: str, body: bytes):
+        sid, action = self._parse_path(path)
+        if sid is None:
+            if method == "POST":
+                return self._create(body)
+            if method == "GET":
+                return json_response(200, {"boards": self.router.list()})
+            return json_response(405, {"error": f"{method} /boards"})
+        if action == "step":
+            if method != "POST":
+                return json_response(405, {"error": f"{method} {path}"})
+            return self._step(sid, body)
+        if action is not None:
+            raise KeyError(action)
+        if method == "GET":
+            return json_response(200, _doc(self.router.get(sid)))
+        if method == "DELETE":
+            self.router.delete(sid)
+            return json_response(200, {"deleted": sid})
+        return json_response(405, {"error": f"{method} {path}"})
+
+    @staticmethod
+    def _parse_path(path: str) -> Tuple[Optional[str], Optional[str]]:
+        """"/boards" → (None, None); "/boards/<id>" → (id, None);
+        "/boards/<id>/step" → (id, "step")."""
+        parts = [p for p in path.split("/") if p]
+        if parts[:1] != ["boards"] or len(parts) > 3:
+            raise KeyError(path)
+        sid = parts[1] if len(parts) > 1 else None
+        action = parts[2] if len(parts) > 2 else None
+        return sid, action
+
+    @staticmethod
+    def _payload(body: bytes) -> dict:
+        if not body:
+            return {}
+        doc = json.loads(body.decode("utf-8"))
+        if not isinstance(doc, dict):
+            raise ValueError("request body must be a JSON object")
+        return doc
+
+    def _create(self, body: bytes):
+        doc = self._payload(body)
+        allowed = {"tenant", "rule", "height", "width", "seed", "density"}
+        unknown = set(doc) - allowed
+        if unknown:
+            raise ValueError(f"unknown fields: {sorted(unknown)}")
+        snap = self.router.create(
+            tenant=str(doc.get("tenant", "default")),
+            rule=doc.get("rule", "conway"),
+            height=int(doc.get("height", 64)),
+            width=int(doc.get("width", 64)),
+            seed=int(doc.get("seed", 0)),
+            density=float(doc.get("density", 0.5)),
+            # The 201 deliberately carries no cells; skip the O(h·w) copy.
+            with_board=False,
+        )
+        return json_response(201, _doc(snap, with_board=False))
+
+    def _step(self, sid: str, body: bytes):
+        doc = self._payload(body)
+        steps = int(doc.get("steps", 1))
+        epoch, digest = self.router.step(sid, steps)
+        from akka_game_of_life_tpu.ops.digest import format_digest
+
+        return json_response(
+            200,
+            {"id": sid, "epoch": epoch, "steps": steps,
+             "digest": format_digest(digest)},
+        )
+
+
+def board_routes(router: SessionRouter) -> dict:
+    """The route table to mount on a MetricsServer (``routes=`` kwarg or
+    ``add_route`` per entry)."""
+    return {"/boards": BoardsRoute(router)}
+
+
+def run_serve(config, *, registry=None, tracer=None) -> int:
+    """The ``serve`` CLI role body: a SessionRouter + one obs endpoint
+    carrying /metrics, /healthz, /trace, and /boards, until interrupted."""
+    from akka_game_of_life_tpu.obs import MetricsServer, get_registry
+    from akka_game_of_life_tpu.obs.tracing import get_tracer
+
+    registry = registry if registry is not None else get_registry()
+    tracer = tracer if tracer is not None else get_tracer()
+    router = SessionRouter(config, registry=registry, tracer=tracer)
+
+    def health() -> dict:
+        return {"ok": True, "role": "serve", **router.stats()}
+
+    server = MetricsServer(
+        registry,
+        port=config.metrics_port,
+        health=health,
+        tracer=tracer,
+        routes=board_routes(router),
+    )
+    print(
+        f"serving /boards (+/metrics,/healthz,/trace) on :{server.port} — "
+        f"max {router.max_sessions} sessions, {router.max_cells} cells, "
+        f"size classes {list(router.size_classes)}",
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        # A real drain, not just the word: refuse NEW work (429 reason
+        # "draining") and run the admitted queue dry before closing — an
+        # accepted job is never failed with "router closed" because the
+        # operator sent SIGTERM.
+        print("serve: interrupted; draining", flush=True)
+        drained = router.drain()
+        print(
+            "serve: drained" if drained
+            else "serve: drain timed out; aborting pending jobs",
+            flush=True,
+        )
+        return 130
+    finally:
+        server.close()
+        router.close()

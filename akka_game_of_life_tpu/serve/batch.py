@@ -1,0 +1,186 @@
+"""Batched multi-tenant stepping: many small boards in one device program.
+
+Everywhere else in the codebase the rule is a *compile-time constant* the
+kernel closes over (``ops/stencil.py``: XLA constant-folds the two bitmask
+ints into the stencil fusion).  That is the right trade for one huge board
+— and exactly the wrong one for serving millions of users, where thousands
+of small boards with *heterogeneous* rules must advance together: one
+compiled program per (rule, shape, steps) would thrash the compile cache
+and serialize the device.
+
+This module flips the trade, the CAX/CAT shape (PAPERS.md): ``vmap`` the
+step over a batched ``[B, C, C]`` leading dimension and lift the rule
+masks from closure constants to **traced per-board operands** —
+``(birth_mask, survive_mask, states)`` uint32/int32 arrays ride the batch
+like the boards do, so one compiled program serves every outer-totalistic
+rule (binary life-likes AND multi-state Generations decay) at once.
+
+Mixed shapes bucket into a few padded **size classes**: a board of logical
+shape ``(h, w)`` occupies the top-left corner of a ``C×C`` slot (zeros
+beyond it) and steps toroidally *on its own h×w region* via modular index
+gathers — ``(r+dy) mod h`` never reads padding, and the output mask keeps
+padding dead — so the batched step is bit-identical to the single-board
+toroidal step at every shape ≤ the class side.  Per-board step counts are
+a traced operand too (a scan-step applies only while ``i < n[b]``), with
+the scan length and batch size rounded up to powers of two so the whole
+traffic mix compiles into O(classes · log(steps) · log(B)) programs.
+
+The per-board digest lanes (``ops.digest.digest_dense_batch``) come back
+from the SAME jitted call: certification rides the step program, ~8 bytes
+per board.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from akka_game_of_life_tpu.ops import digest as odigest
+from akka_game_of_life_tpu.ops.rules import Rule
+from akka_game_of_life_tpu.runtime.config import parse_size_classes
+
+__all__ = [
+    "DEFAULT_SIZE_CLASSES",
+    "batch_step_fn",
+    "next_pow2",
+    "parse_size_classes",  # canonical home: runtime.config (validation)
+    "rule_operands",
+    "size_class",
+]
+
+STATE_DTYPE = jnp.uint8
+_I = jnp.int32
+_U = jnp.uint32
+
+# Default padded size classes (square sides).  Small powers of two: the
+# serving plane targets many small per-user boards, not the 65536² headline
+# board — that one stays on the single-board kernels.
+DEFAULT_SIZE_CLASSES: Tuple[int, ...] = (32, 64, 128, 256)
+
+
+def size_class(
+    height: int, width: int, classes: Sequence[int] = DEFAULT_SIZE_CLASSES
+) -> Optional[int]:
+    """The smallest class side that fits an (height, width) board, or None
+    when the board exceeds every class (the caller's 400, not a crash)."""
+    side = max(height, width)
+    for c in classes:
+        if side <= c:
+            return c
+    return None
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1) — the batch/length quantizer
+    that bounds how many programs the traffic mix can compile."""
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+def rule_operands(rule: Rule) -> Tuple[int, int, int]:
+    """A rule as traced-operand data: (birth_mask, survive_mask, states).
+    Only outer-totalistic families serve batched — wireworld's transition
+    is not mask-encodable and LtL needs radius-R geometry."""
+    if rule.kind != "totalistic":
+        raise ValueError(
+            f"the serving plane steps outer-totalistic rules only "
+            f"(life-like and Generations); {rule} is kind={rule.kind!r}"
+        )
+    return rule.birth_mask, rule.survive_mask, rule.states
+
+
+def _mod_idx(n_static: int, d: int, m) -> jax.Array:
+    """Index vector ``(i + d) mod m`` over a static range — the toroidal
+    shift on a traced live extent ``m`` ≤ ``n_static`` (jnp ``%`` is
+    Python-signed: -1 % m == m-1; indices land in [0, m), so gathers
+    through these never read padding)."""
+    return (jnp.arange(n_static, dtype=_I) + d) % m
+
+
+def _neighbor_counts(alive: jax.Array, h, w) -> jax.Array:
+    """Moore-8 live-neighbor counts, toroidal on the [:h, :w] live region
+    of a padded slot.  Separable: three column gathers then three row
+    gathers (6 gathers per step), minus the center.  Rows/cols ≥ the live
+    extent compute garbage that the caller's region mask discards."""
+    ch, cw = alive.shape
+    s1 = jnp.zeros_like(alive)
+    for d in (-1, 0, 1):
+        s1 = s1 + jnp.take(alive, _mod_idx(cw, d, w), axis=1)
+    acc = jnp.zeros_like(alive)
+    for d in (-1, 0, 1):
+        acc = acc + jnp.take(s1, _mod_idx(ch, d, h), axis=0)
+    return acc - alive
+
+
+def _step_once(board, birth_mask, survive_mask, states, h, w):
+    """One toroidal step of ONE padded board slot; the rule is four traced
+    scalars.  Bit-identical to ``ops.stencil.step`` of the ``[:h, :w]``
+    region for every outer-totalistic rule, including Generations decay
+    (live cell failing survival enters state 2 and decays to 0; refractory
+    cells block birth and never count as neighbors)."""
+    ch, cw = board.shape
+    counts = _neighbor_counts((board == 1).astype(STATE_DTYPE), h, w)
+    c = counts.astype(_U)
+    birth = ((jnp.asarray(birth_mask, _U) >> c) & _U(1)).astype(STATE_DTYPE)
+    survive = ((jnp.asarray(survive_mask, _U) >> c) & _U(1)).astype(STATE_DTYPE)
+    one = jnp.asarray(1, STATE_DTYPE)
+    two = jnp.asarray(2, STATE_DTYPE)
+    zero = jnp.asarray(0, STATE_DTYPE)
+    states = jnp.asarray(states, _I)
+    # Binary rules (states == 2) fall out of the Generations form: the
+    # first refractory state only exists when states > 2, and the decay
+    # branch never sees a state ≥ 2 cell.
+    live_next = jnp.where(
+        survive == 1, one, jnp.where(states > 2, two, zero)
+    )
+    bumped = board.astype(_I) + 1
+    decayed = jnp.where(bumped < states, bumped, 0).astype(STATE_DTYPE)
+    out = jnp.where(
+        board == 0, birth, jnp.where(board == 1, live_next, decayed)
+    )
+    # Padding stays dead: birth in the garbage region (or from a B0-style
+    # mask) must not leak live cells outside [:h, :w].
+    rows = jnp.arange(ch, dtype=_I)[:, None]
+    cols = jnp.arange(cw, dtype=_I)[None, :]
+    return jnp.where((rows < h) & (cols < w), out, zero)
+
+
+@functools.lru_cache(maxsize=None)
+def batch_step_fn(class_side: int, length: int):
+    """The jitted batched advance for one size class (cached per
+    ``(class_side, length)``; the caller also quantizes the batch dim to
+    powers of two, so the program count stays O(classes · log steps ·
+    log B) whatever the traffic mix).
+
+    Signature of the returned callable::
+
+        boards' [B,C,C]u8, lanes [B,2]u32 = run(
+            boards [B,C,C]u8,   # zero-padded beyond each [:h,:w] region
+            birth   [B]u32,     # per-board Rule.birth_mask
+            survive [B]u32,     # per-board Rule.survive_mask
+            states  [B]i32,     # per-board state count (2 = binary)
+            h, w    [B]i32,     # per-board live extents (1..C)
+            n       [B]i32,     # per-board step counts (0..length)
+        )
+
+    Board b advances exactly ``n[b]`` toroidal epochs (scan iterations
+    past its count are identity), then its digest lanes are folded in the
+    same program — certification ships with the step."""
+
+    def one(board, birth, survive, states, h, w, n):
+        def body(s, i):
+            stepped = _step_once(s, birth, survive, states, h, w)
+            return jnp.where(i < n, stepped, s), None
+
+        out, _ = jax.lax.scan(body, board, jnp.arange(length, dtype=_I))
+        return out
+
+    @jax.jit
+    def run(boards, birth, survive, states, h, w, n):
+        stepped = jax.vmap(one)(boards, birth, survive, states, h, w, n)
+        lanes = odigest.digest_dense_batch(stepped, w)
+        return stepped, lanes
+
+    return run

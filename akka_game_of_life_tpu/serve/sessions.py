@@ -1,0 +1,617 @@
+"""Session table + job queue: the router that feeds the batched engine.
+
+One :class:`SessionRouter` owns every tenant board in the process: a
+session table (tenant id, rule, seed, epoch, idle-TTL eviction), a bounded
+job queue, and a ticker thread that drains the queue in **ticks** — each
+tick groups pending step jobs by size class, pads them into one
+``[B, C, C]`` stack, and advances the whole group in ONE device program
+(:mod:`akka_game_of_life_tpu.serve.batch`), scattering boards, epochs, and
+per-board digest lanes back into the table.
+
+Admission control is enforced at the table edge, never inside the engine,
+and always answers instead of wedging:
+
+- ``serve_max_sessions`` — session-count cap (per process);
+- ``serve_max_cells``    — aggregate live-cell budget across sessions (the
+  batch-memory resource a count cap alone cannot bound);
+- ``serve_queue_depth``  — pending-job bound; a full queue REJECTS the new
+  job (the caller's 429 + retry) rather than dropping a queued one —
+  dropping would lose a request whose client is already blocked on it.
+
+Rejections raise :class:`AdmissionError` with a machine-readable
+``reason`` (the HTTP layer maps it to 429 and the reason rides the
+``gol_serve_rejects_total{reason}`` counter).  Boards live host-side as
+plain uint8 arrays between ticks — sessions are small by design (the size
+classes top out well below the single-board kernels' territory), and the
+host copy is what GET returns without touching the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from akka_game_of_life_tpu.obs import get_registry
+from akka_game_of_life_tpu.obs.tracing import get_tracer
+from akka_game_of_life_tpu.ops import digest as odigest
+from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
+from akka_game_of_life_tpu.serve import batch as sbatch
+from akka_game_of_life_tpu.utils.patterns import random_grid
+
+# A step request abandoned by the engine (ticker died, close() raced) must
+# never block its client thread forever; this is the server-side bound on
+# one job's queue wait + batch run.
+JOB_TIMEOUT_S = 120.0
+# After JOB_TIMEOUT_S, a job still IN the queue is cancelled (removed —
+# guaranteed never applied, the client's retry is safe); a job already
+# riding a launched batch gets this much extra grace to land, because its
+# write-back cannot be recalled.
+JOB_GRACE_S = 60.0
+
+# Tenant ids label metrics (gol_serve_*{tenant=...}); they must be short
+# and tame or a client could mint unbounded exposition series from junk.
+_TENANT_MAX = 64
+_TENANT_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._:-"
+)
+
+
+class AdmissionError(Exception):
+    """A request refused by admission control (HTTP 429).  ``reason`` is
+    machine-readable: ``max_sessions`` | ``max_cells`` | ``queue_full`` |
+    ``draining``."""
+
+    def __init__(self, reason: str, detail: str) -> None:
+        super().__init__(detail)
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class Session:
+    """One tenant board and its serving state."""
+
+    sid: str
+    tenant: str
+    rule: Rule
+    height: int
+    width: int
+    seed: int
+    density: float
+    board: np.ndarray  # (height, width) uint8, host-side
+    lanes: np.ndarray  # (2,) uint32 digest lanes of `board`
+    population: int = 0  # live (state 1) cells of `board`, kept in lockstep
+    epoch: int = 0
+    created: float = 0.0
+    last_used: float = 0.0
+
+    @property
+    def digest(self) -> int:
+        return odigest.value(self.lanes)
+
+    def snapshot(self, *, with_board: bool = True) -> dict:
+        """The GET document (board copied so a caller can't mutate the
+        table's array).  ``with_board=False`` skips the O(h·w) copy for
+        summary paths — list() runs under the router lock, and touching
+        every board there would stall the ticker for all tenants
+        (``population`` is cached at create/write-back for the same
+        reason, never scanned here)."""
+        doc = {
+            "id": self.sid,
+            "tenant": self.tenant,
+            "rule": self.rule.rulestring(),
+            "height": self.height,
+            "width": self.width,
+            "seed": self.seed,
+            "epoch": self.epoch,
+            "population": self.population,
+            "digest": odigest.format_digest(self.digest),
+        }
+        if with_board:
+            doc["board"] = self.board.copy()
+        return doc
+
+
+@dataclasses.dataclass
+class _Job:
+    sid: str
+    steps: int
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    result: Optional[Tuple[int, int]] = None  # (epoch, digest)
+    error: Optional[BaseException] = None
+
+
+class SessionRouter:
+    """The multi-tenant serving engine: session table + job queue + ticker.
+
+    Thread-safe; constructed from a :class:`SimulationConfig`'s ``serve_*``
+    knobs (every knob has a ``--serve-*`` flag —
+    ``tools/check_serve_config.py`` lint-enforces the bijection).  The
+    ``clock`` injection point exists for TTL tests; ``pause()``/``resume()``
+    hold the ticker between batches — the deterministic way to fill the
+    queue in backpressure drills (bench_serve's 429 drill)."""
+
+    def __init__(
+        self,
+        config=None,
+        *,
+        registry=None,
+        tracer=None,
+        clock=time.monotonic,
+    ) -> None:
+        if config is None:
+            from akka_game_of_life_tpu.runtime.config import SimulationConfig
+
+            config = SimulationConfig()
+        self.config = config
+        self.max_sessions = config.serve_max_sessions
+        self.max_cells = config.serve_max_cells
+        self.queue_depth = config.serve_queue_depth
+        self.max_steps = config.serve_max_steps
+        self.tick_s = config.serve_tick_s
+        self.ttl_s = config.serve_ttl_s
+        self.size_classes = sbatch.parse_size_classes(
+            config.serve_size_classes
+        )
+        self.metrics = registry if registry is not None else get_registry()
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self._clock = clock
+        # Hot-path instruments resolved once (lookup takes the registry
+        # lock); per-tenant children minted on demand.
+        self._m_sessions = self.metrics.gauge(
+            "gol_serve_sessions", labelnames=("tenant",)
+        )
+        self._m_cells = self.metrics.gauge("gol_serve_cells")
+        self._m_creates = self.metrics.counter(
+            "gol_serve_session_creates_total", labelnames=("tenant",)
+        )
+        self._m_evictions = self.metrics.counter(
+            "gol_serve_session_evictions_total"
+        )
+        self._m_steps = self.metrics.counter(
+            "gol_serve_steps_total", labelnames=("tenant",)
+        )
+        self._m_rejects = self.metrics.counter(
+            "gol_serve_rejects_total", labelnames=("reason",)
+        )
+        self._m_queue = self.metrics.gauge("gol_serve_queue_depth")
+        # Buckets passed explicitly (count-scale, not latency-scale): the
+        # registry may be a plain MetricsRegistry without the catalog
+        # installed, and _get_or_create would not flag the mismatch.
+        from akka_game_of_life_tpu.obs.catalog import RING_BATCH_BUCKETS
+
+        self._m_batch = self.metrics.histogram(
+            "gol_serve_batch_boards", buckets=RING_BATCH_BUCKETS
+        )
+        self._m_tick = self.metrics.histogram("gol_serve_tick_seconds")
+        self._m_req = self.metrics.histogram("gol_serve_step_seconds")
+
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._sessions: Dict[str, Session] = {}
+        self._cells = 0
+        self._queue: deque = deque()
+        self._ids = itertools.count(1)
+        self._paused = False
+        self._draining = False
+        self._stopped = False
+        self._ticker = threading.Thread(
+            target=self._tick_loop, daemon=True, name="serve-ticker"
+        )
+        self._ticker.start()
+
+    # -- session lifecycle ---------------------------------------------------
+
+    def create(
+        self,
+        tenant: str = "default",
+        rule="conway",
+        height: int = 64,
+        width: int = 64,
+        seed: int = 0,
+        density: float = 0.5,
+        with_board: bool = True,
+    ) -> dict:
+        """Admit a new session and seed its board.  Raises ValueError for a
+        malformed request (the HTTP 400), AdmissionError when a capacity
+        cap refuses it (the HTTP 429).  ``with_board=False`` skips the
+        returned doc's O(h·w) board copy — the HTTP 201 deliberately
+        carries no cells."""
+        tenant = str(tenant)
+        if not tenant or len(tenant) > _TENANT_MAX or not (
+            set(tenant) <= _TENANT_OK
+        ):
+            raise ValueError(
+                f"tenant must be 1..{_TENANT_MAX} chars of [A-Za-z0-9._:-] "
+                f"(it labels metrics), got {tenant!r}"
+            )
+        rule = resolve_rule(rule)
+        sbatch.rule_operands(rule)  # totalistic-only; raises ValueError
+        if height < 1 or width < 1:
+            raise ValueError(f"board must be positive, got {height}x{width}")
+        if not (0.0 <= density <= 1.0):
+            raise ValueError(f"density {density} must be in [0, 1]")
+        if sbatch.size_class(height, width, self.size_classes) is None:
+            raise ValueError(
+                f"board {height}x{width} exceeds the largest size class "
+                f"({self.size_classes[-1]}); this plane serves small "
+                f"boards — run big ones standalone"
+            )
+        # Admission is checked BEFORE the O(h·w) board generation so a
+        # saturated plane sheds rejected creates cheaply (429 is the
+        # overload path), then re-checked at insert — the lock is released
+        # in between and a racing create may have taken the last slot.
+        with self._lock:
+            self._admit_locked(height, width)
+        board = random_grid((height, width), density=density, seed=seed)
+        lanes = odigest.digest_dense_np(board)
+        population = int((board == 1).sum())
+        with self._lock:
+            self._admit_locked(height, width)
+            now = self._clock()
+            sess = Session(
+                sid=f"b{next(self._ids):08x}",
+                tenant=tenant,
+                rule=rule,
+                height=height,
+                width=width,
+                seed=seed,
+                density=density,
+                board=board,
+                lanes=lanes,
+                population=population,
+                created=now,
+                last_used=now,
+            )
+            self._sessions[sess.sid] = sess
+            self._cells += height * width
+            self._m_cells.set(self._cells)
+            self._m_sessions.labels(tenant=sess.tenant).inc()
+            self._m_creates.labels(tenant=sess.tenant).inc()
+        # Snapshot OUTSIDE the lock: nobody can step this session before
+        # its id is returned, and the O(h·w) board copy must not stall
+        # the ticker or concurrent requests.
+        return sess.snapshot(with_board=with_board)
+
+    def get(self, sid: str) -> dict:
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                raise KeyError(sid)
+            sess.last_used = self._clock()
+            return sess.snapshot()
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return [
+                sess.snapshot(with_board=False)
+                for sess in self._sessions.values()
+            ]
+
+    def delete(self, sid: str) -> None:
+        with self._lock:
+            self._drop(sid, evicted=False)
+
+    def _drop(self, sid: str, *, evicted: bool) -> None:
+        """Remove a session (lock held).  An in-flight step job for it
+        completes against the ticker's snapshot and its write-back is
+        skipped — the client still gets the stepped result."""
+        sess = self._sessions.pop(sid, None)
+        if sess is None:
+            raise KeyError(sid)
+        self._cells -= sess.height * sess.width
+        self._m_cells.set(self._cells)
+        self._m_sessions.labels(tenant=sess.tenant).dec()
+        if not any(
+            s.tenant == sess.tenant for s in self._sessions.values()
+        ):
+            # Last session of this tenant: reclaim its metric children, or
+            # a create/delete loop over fresh tenant strings would grow
+            # the exposition without bound.
+            for inst in (self._m_sessions, self._m_creates, self._m_steps):
+                inst.remove(tenant=sess.tenant)
+        if evicted:
+            self._m_evictions.inc()
+
+    def _reject(self, reason: str, detail: str) -> None:
+        self._m_rejects.labels(reason=reason).inc()
+        raise AdmissionError(reason, detail)
+
+    def _admit_locked(self, height: int, width: int) -> None:
+        """The create-side admission gate (lock held): closed router,
+        drain, session cap, cell budget — raising instead of wedging."""
+        if self._stopped:
+            raise RuntimeError("router is closed")
+        if self._draining:
+            self._reject("draining", "router is draining for shutdown")
+        if len(self._sessions) >= self.max_sessions:
+            self._reject(
+                "max_sessions",
+                f"session cap {self.max_sessions} reached",
+            )
+        if self._cells + height * width > self.max_cells:
+            self._reject(
+                "max_cells",
+                f"cell budget {self.max_cells} would be exceeded "
+                f"({self._cells} in use, {height * width} asked)",
+            )
+
+    # -- stepping ------------------------------------------------------------
+
+    def step(self, sid: str, steps: int = 1) -> Tuple[int, int]:
+        """Advance a session by ``steps`` epochs; blocks until the batch
+        that carried the job lands.  Returns (epoch, digest).  Raises
+        KeyError (404), ValueError (400), AdmissionError (429)."""
+        if not (1 <= steps <= self.max_steps):
+            raise ValueError(
+                f"steps {steps} out of range 1..{self.max_steps}"
+            )
+        t0 = time.perf_counter()
+        job = _Job(sid=sid, steps=steps)
+        with self._lock:
+            if self._stopped:
+                # The ticker is gone: enqueueing would strand the caller
+                # on JOB_TIMEOUT_S; fail now like create() does.
+                raise RuntimeError("router is closed")
+            sess = self._sessions.get(sid)
+            if sess is None:
+                # Looked up BEFORE the drain gate: an unknown id is a
+                # terminal 404, not a retryable 429.
+                raise KeyError(sid)
+            if self._draining:
+                self._reject("draining", "router is draining for shutdown")
+            if len(self._queue) >= self.queue_depth:
+                self._reject(
+                    "queue_full",
+                    f"step queue depth {self.queue_depth} reached",
+                )
+            sess.last_used = self._clock()
+            self._queue.append(job)
+            self._m_queue.set(len(self._queue))
+            self._wake.notify_all()
+        if not job.done.wait(JOB_TIMEOUT_S):
+            with self._lock:
+                try:
+                    self._queue.remove(job)
+                    cancelled = True
+                    self._m_queue.set(len(self._queue))
+                except ValueError:
+                    cancelled = False
+            if cancelled:
+                # Still queued → removed before any batch saw it: the
+                # board did NOT advance, a client retry is safe.
+                raise TimeoutError(
+                    f"step job for {sid} timed out in queue (cancelled; "
+                    f"board not advanced)"
+                )
+            # Already riding a launched batch: its write-back cannot be
+            # recalled, so give it bounded grace to land rather than
+            # reporting failure for epochs that WILL apply.
+            if not job.done.wait(JOB_GRACE_S):
+                raise TimeoutError(f"step job for {sid} timed out mid-batch")
+        if job.error is not None:
+            raise job.error
+        self._m_req.observe(time.perf_counter() - t0)
+        return job.result
+
+    # -- drill hooks ---------------------------------------------------------
+
+    def pause(self) -> None:
+        """Hold the ticker between batches (jobs queue up; admission still
+        answers).  The backpressure-drill hook — bench_serve and the tests
+        use it to fill the queue deterministically."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+            self._wake.notify_all()
+
+    # -- the tick loop -------------------------------------------------------
+
+    def _tick_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._stopped and (
+                    self._paused or not self._queue
+                ):
+                    # Bounded wait so idle routers still sweep TTLs.
+                    self._wake.wait(timeout=0.25)
+                    if not self._paused:
+                        self._evict_idle_locked()
+                if self._stopped:
+                    self._fail_pending_locked(RuntimeError("router closed"))
+                    return
+                # Sweep here too: a router under sustained load never
+                # sits in the idle wait above.
+                self._evict_idle_locked()
+                taken = self._take_jobs_locked()
+            if taken:
+                t0 = time.perf_counter()
+                with self.tracer.span("serve.tick", jobs=len(taken)):
+                    self._run_tick(taken)
+                dt = time.perf_counter() - t0
+                self._m_tick.observe(dt)
+                if self.tick_s > 0 and dt < self.tick_s:
+                    # Pacing floor: at most one batch launch per tick_s.
+                    time.sleep(self.tick_s - dt)
+
+    def _take_jobs_locked(self) -> List[_Job]:
+        """Drain this tick's jobs: at most ONE job per session (a second
+        pending step for the same board serializes into the next tick so
+        each job's result is the state after exactly its own steps);
+        dead-session jobs fail out here."""
+        taken: List[_Job] = []
+        rest: deque = deque()
+        seen = set()
+        while self._queue:
+            job = self._queue.popleft()
+            if job.sid not in self._sessions:
+                job.error = KeyError(job.sid)
+                job.done.set()
+                continue
+            if job.sid in seen:
+                rest.append(job)
+                continue
+            seen.add(job.sid)
+            taken.append(job)
+        self._queue = rest
+        self._m_queue.set(len(self._queue))
+        return taken
+
+    def _fail_pending_locked(self, err: BaseException) -> None:
+        while self._queue:
+            job = self._queue.popleft()
+            job.error = err
+            job.done.set()
+        self._m_queue.set(0)
+
+    def _evict_idle_locked(self) -> None:
+        if self.ttl_s <= 0:
+            return
+        now = self._clock()
+        # A session with an ADMITTED queued job is never idle — evicting
+        # it would 404 a client already blocked on that job, breaking the
+        # "a queued job always completes" admission contract.
+        busy = {job.sid for job in self._queue}
+        for sid in [
+            s.sid
+            for s in self._sessions.values()
+            if s.sid not in busy and now - s.last_used > self.ttl_s
+        ]:
+            self._drop(sid, evicted=True)
+
+    def _run_tick(self, jobs: List[_Job]) -> None:
+        """Group this tick's jobs by size class, advance each group in one
+        device program, scatter results back.  A failed batch fails its
+        jobs, never the ticker."""
+        groups: Dict[int, List[Tuple[_Job, Session, np.ndarray]]] = {}
+        with self._lock:
+            for job in jobs:
+                sess = self._sessions.get(job.sid)
+                if sess is None:
+                    job.error = KeyError(job.sid)
+                    job.done.set()
+                    continue
+                cls = sbatch.size_class(
+                    sess.height, sess.width, self.size_classes
+                )
+                # Snapshot the board reference: the ticker only ever
+                # REPLACES session boards, so the reference is stable
+                # outside the lock.
+                groups.setdefault(cls, []).append((job, sess, sess.board))
+        for cls, entries in sorted(groups.items()):
+            try:
+                self._run_class_batch(cls, entries)
+            except Exception as e:  # noqa: BLE001 — jobs fail, ticker lives
+                for job, _, _ in entries:
+                    job.error = e
+                    job.done.set()
+
+    def _run_class_batch(
+        self, cls: int, entries: List[Tuple[_Job, Session, np.ndarray]]
+    ) -> None:
+        b_real = len(entries)
+        length = sbatch.next_pow2(max(job.steps for job, _, _ in entries))
+        b_pad = sbatch.next_pow2(b_real)
+        boards = np.zeros((b_pad, cls, cls), dtype=np.uint8)
+        birth = np.zeros(b_pad, dtype=np.uint32)
+        survive = np.zeros(b_pad, dtype=np.uint32)
+        states = np.full(b_pad, 2, dtype=np.int32)
+        hs = np.ones(b_pad, dtype=np.int32)
+        ws = np.ones(b_pad, dtype=np.int32)
+        ns = np.zeros(b_pad, dtype=np.int32)
+        for i, (job, sess, board) in enumerate(entries):
+            boards[i, : sess.height, : sess.width] = board
+            birth[i], survive[i], states[i] = sbatch.rule_operands(sess.rule)
+            hs[i], ws[i] = sess.height, sess.width
+            ns[i] = job.steps
+        out, lanes = sbatch.batch_step_fn(cls, length)(
+            boards, birth, survive, states, hs, ws, ns
+        )
+        out = np.asarray(out)
+        lanes = np.asarray(lanes, dtype=np.uint32)
+        self._m_batch.observe(b_real)
+        # Slice-copies and popcounts are O(Σ h·w) host work — done OUTSIDE
+        # the lock so scatter-back never stalls concurrent create/step/get.
+        results = [
+            (
+                out[i, : sess.height, : sess.width].copy(),
+                lanes[i],
+            )
+            for i, (_, sess, _) in enumerate(entries)
+        ]
+        pops = [int((board == 1).sum()) for board, _ in results]
+        with self._lock:
+            for (job, sess, _), (new_board, new_lanes), pop in zip(
+                entries, results, pops
+            ):
+                if self._sessions.get(job.sid) is sess:
+                    sess.board = new_board
+                    sess.lanes = new_lanes
+                    sess.population = pop
+                    sess.epoch += job.steps
+                    sess.last_used = self._clock()
+                    epoch = sess.epoch
+                    self._m_steps.labels(tenant=sess.tenant).inc(job.steps)
+                else:
+                    # Deleted mid-batch: the client still gets its result;
+                    # the table write-back is skipped, and so is the
+                    # per-tenant counter — _drop may just have reclaimed
+                    # this tenant's metric children, and incrementing here
+                    # would re-mint a leaked child for a gone tenant.
+                    epoch = sess.epoch + job.steps
+                job.result = (epoch, odigest.value(new_lanes))
+                job.done.set()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Refuse NEW work and run the already-admitted queue dry (bounded)
+        — the graceful half of shutdown: an admitted job completes, it is
+        never failed with 'router closed' just because the operator sent
+        SIGTERM.  Returns True when the queue emptied in time."""
+        with self._lock:
+            self._draining = True
+            self._wake.notify_all()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._queue:
+                    return True
+            time.sleep(0.05)
+        return False
+
+    # -- stats / lifecycle ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """The /healthz contribution: live table + queue facts."""
+        with self._lock:
+            return {
+                "sessions": len(self._sessions),
+                "cells": self._cells,
+                "queue_depth": len(self._queue),
+                "max_sessions": self.max_sessions,
+                "max_cells": self.max_cells,
+                "size_classes": list(self.size_classes),
+            }
+
+    def close(self) -> None:
+        """Stop the ticker and fail any still-pending jobs loudly."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._wake.notify_all()
+        self._ticker.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,365 @@
+"""Serving-plane benchmark: synthetic heavy multi-tenant traffic.
+
+``bench.py`` measures one huge board; this bench measures the opposite
+regime the ROADMAP north-star actually describes — **many small boards for
+many users**: N concurrent sessions with mixed rules (life-likes AND
+Generations) and mixed sizes, driven through the real ``/boards`` HTTP API
+(``akka_game_of_life_tpu/serve/``) by a pool of client threads, all
+advancing through vmapped batched device programs.
+
+Reported in BENCH record format (one JSON line each):
+
+- **boards/sec** — step requests sustained end-to-end (HTTP + queue +
+  batch), vs the reference's ceiling of one board per 3 s tick;
+- **cell-updates/s aggregate** — Σ cells·steps over the wall clock;
+- **p50 / p99 step latency** — client-observed, vs the reference's 3 s.
+
+Then two acceptance gates, asserted loudly:
+
+1. **digest-vs-oracle**: a sample of sessions is re-run single-board
+   (``ops.stencil.multi_step_fn`` on the same seeded init) and each
+   session's served digest must equal its oracle's — a batching plane that
+   changes the simulation is not a serving plane;
+2. **admission control answers, never wedges**: one create past the
+   session cap and one step past the queue bound must return HTTP 429
+   (machine-readable reason), while every job already admitted completes
+   with no state lost (epochs land exactly where the request count says).
+
+Usage:
+  python bench_serve.py                         # 256 sessions (CPU-friendly)
+  python bench_serve.py --sessions 1024 --threads 32
+
+Also wired into ``bench_suite.py`` as config 12.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+# The reference's throughput ceiling (BASELINE.md): ONE board, 49 cells,
+# one epoch per 3 s tick.  Its serving analogs: 1/3 board-steps/sec and
+# 49/3 cell-updates/sec, and 3 s of latency floor per step.
+REFERENCE_BOARDS_PER_SEC = 1 / 3.0
+REFERENCE_CEILING = 49 / 3.0
+REFERENCE_TICK_S = 3.0
+
+DEFAULT_RULES = (
+    "conway", "highlife", "seeds", "day-and-night",
+    "brians-brain", "star-wars",
+)
+DEFAULT_SIZES = (16, 24, 32, 48, 64)
+
+
+def _request(base: str, method: str, path: str, doc=None, timeout=60):
+    data = json.dumps(doc).encode("utf-8") if doc is not None else None
+    req = urllib.request.Request(base + path, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def bench_serve(
+    sessions: int = 256,
+    steps: int = 8,
+    rounds: int = 4,
+    threads: int = 16,
+    tenants: int = 8,
+    sample: int = 16,
+    rules=DEFAULT_RULES,
+    sizes=DEFAULT_SIZES,
+    queue_drill_depth: int = 32,
+    emit=print,
+) -> dict:
+    """Run the traffic + drills; emit BENCH lines; return the summary
+    record (the last line emitted)."""
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.obs import MetricsServer
+    from akka_game_of_life_tpu.obs.catalog import install
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.ops import digest as odigest, stencil
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.serve import SessionRouter, board_routes
+    from akka_game_of_life_tpu.utils.patterns import random_grid
+
+    config = f"serve-{sessions}"
+    cfg = SimulationConfig(
+        role="serve",
+        serve_max_sessions=sessions,
+        # The queue bound is sized to be DRILLABLE (pause the engine, fill
+        # it with queue_drill_depth jobs, overflow once) while staying
+        # comfortably above the client pool's in-flight ceiling so steady
+        # traffic never trips it.
+        serve_queue_depth=max(queue_drill_depth, 2 * threads),
+        serve_max_steps=max(64, steps),
+        flight_dir="",
+    )
+    registry = install(MetricsRegistry())
+    router = SessionRouter(cfg, registry=registry)
+    server = MetricsServer(
+        registry, port=0, host="127.0.0.1", routes=board_routes(router)
+    )
+    base = f"http://127.0.0.1:{server.port}"
+
+    # -- create the tenant mix ------------------------------------------------
+    specs = []  # (sid, rule, (h, w), seed)
+    for i in range(sessions):
+        rule = rules[i % len(rules)]
+        side = sizes[i % len(sizes)]
+        h, w = side, max(1, side - (i % 7))  # non-square mix
+        status, doc = _request(
+            base, "POST", "/boards",
+            {"tenant": f"t{i % tenants}", "rule": rule,
+             "height": h, "width": w, "seed": i},
+        )
+        assert status == 201, f"create {i} failed: {status} {doc}"
+        specs.append((doc["id"], rule, (h, w), i))
+
+    # One create past the cap must answer 429 without disturbing anything.
+    status, doc = _request(
+        base, "POST", "/boards", {"height": 8, "width": 8}
+    )
+    assert status == 429 and doc.get("reason") == "max_sessions", (
+        f"expected 429 max_sessions past the cap, got {status} {doc}"
+    )
+
+    # -- sustained traffic: rounds × sessions step requests -------------------
+    latencies: list = []
+    lat_lock = threading.Lock()
+    issued = {sid: 0 for sid, _, _, _ in specs}
+
+    def run_traffic(round_count: int, record: bool) -> float:
+        """Drive round_count × sessions step requests through `threads`
+        concurrent clients; returns the wall time."""
+        work = [
+            spec for _ in range(round_count) for spec in specs
+        ]  # round-major: every session stays concurrently live throughout
+        cursor = {"i": 0}
+        cursor_lock = threading.Lock()
+        errors: list = []
+
+        def client():
+            while True:
+                with cursor_lock:
+                    i = cursor["i"]
+                    if i >= len(work):
+                        return
+                    cursor["i"] = i + 1
+                sid = work[i][0]
+                t0 = time.perf_counter()
+                status, doc = _request(
+                    base, "POST", f"/boards/{sid}/step", {"steps": steps}
+                )
+                dt = time.perf_counter() - t0
+                if status != 200:
+                    errors.append((sid, status, doc))
+                    return
+                with lat_lock:
+                    issued[sid] += steps
+                    if record:
+                        latencies.append(dt)
+
+        t0 = time.perf_counter()
+        pool = [threading.Thread(target=client) for _ in range(threads)]
+        for t in pool:
+            t.start()
+        for t in pool:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errors, f"step traffic failed: {errors[:3]}"
+        return wall
+
+    # Warmup round (uncounted): the first ticks pay the jit compiles for
+    # this traffic mix's (class, length, batch) buckets — steady-state
+    # latency is what the report is about.  The warmed epochs still count
+    # toward each session's oracle total via `issued`.
+    run_traffic(1, record=False)
+    wall = run_traffic(rounds, record=True)
+    n_requests = sessions * rounds
+    assert len(latencies) == n_requests
+
+    # Timed phase only: every session served exactly `rounds` requests of
+    # `steps` epochs inside `wall` (the warmup round is excluded).
+    cells_stepped = sum(
+        h * w * steps * rounds for _, _, (h, w), _ in specs
+    )
+    boards_per_sec = n_requests / wall
+    cells_per_sec = cells_stepped / wall
+    lat = sorted(latencies)
+    p50, p99 = _percentile(lat, 0.50), _percentile(lat, 0.99)
+
+    emit(json.dumps({
+        "config": config,
+        "metric": (
+            f"step requests/sec sustained, {sessions} sessions x "
+            f"{rounds} rounds x {steps} steps, {len(rules)} rules x "
+            f"{len(sizes)} sizes, {threads} HTTP client threads"
+        ),
+        "value": boards_per_sec,
+        "unit": "boards/sec",
+        "vs_baseline": boards_per_sec / REFERENCE_BOARDS_PER_SEC,
+    }))
+    emit(json.dumps({
+        "config": config,
+        "metric": "cell-updates/sec aggregate across all tenant boards",
+        "value": cells_per_sec,
+        "unit": "cell-updates/sec",
+        "vs_baseline": cells_per_sec / REFERENCE_CEILING,
+    }))
+    for name, value in (("p50", p50), ("p99", p99)):
+        emit(json.dumps({
+            "config": config,
+            "metric": f"{name} step-request latency, client-observed "
+            f"(HTTP + queue + batched device program)",
+            "value": value,
+            "unit": "seconds",
+            "vs_baseline": value / REFERENCE_TICK_S,
+        }))
+
+    # -- queue backpressure drill --------------------------------------------
+    # Freeze the engine, fill the queue exactly to its bound, overflow once
+    # (the deterministic 429), thaw, and require every admitted job to land
+    # — backpressure sheds NEW load, it never drops admitted state.
+    router.pause()
+    depth = router.queue_depth
+    # Cycle over sessions so the drill fills the queue even when the bound
+    # exceeds the session count (same-session jobs queue fine — the engine
+    # serializes them one per tick).
+    drilled = [specs[i % len(specs)] for i in range(depth)]
+    drill_results: list = []
+
+    def drill_step(sid):
+        drill_results.append(
+            _request(base, "POST", f"/boards/{sid}/step", {"steps": 1})
+        )
+
+    drill_pool = [
+        threading.Thread(target=drill_step, args=(sid,))
+        for sid, _, _, _ in drilled
+    ]
+    for t in drill_pool:
+        t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if router.stats()["queue_depth"] >= depth:
+            break
+        time.sleep(0.01)
+    assert router.stats()["queue_depth"] >= depth, "drill queue never filled"
+    status, doc = _request(
+        base, "POST", f"/boards/{specs[0][0]}/step", {"steps": 1}
+    )
+    assert status == 429 and doc.get("reason") == "queue_full", (
+        f"expected 429 queue_full past the bound, got {status} {doc}"
+    )
+    router.resume()
+    for t in drill_pool:
+        t.join()
+    assert all(s == 200 for s, _ in drill_results), (
+        f"admitted jobs must complete through backpressure: "
+        f"{[r for r in drill_results if r[0] != 200][:3]}"
+    )
+    for sid, _, _, _ in drilled:
+        issued[sid] += 1
+
+    # -- digest-vs-oracle certification ---------------------------------------
+    stride = max(1, len(specs) // max(1, sample))
+    sampled = specs[::stride][:sample]
+    mismatches = []
+    for sid, rule, (h, w), seed in sampled:
+        status, doc = _request(base, "GET", f"/boards/{sid}")
+        assert status == 200, (sid, status)
+        assert doc["epoch"] == issued[sid], (
+            f"{sid}: epoch {doc['epoch']} != issued {issued[sid]} — "
+            f"state lost"
+        )
+        board0 = random_grid((h, w), density=0.5, seed=seed)
+        oracle = np.asarray(
+            stencil.multi_step_fn(rule, issued[sid])(jnp.asarray(board0))
+        )
+        want = odigest.format_digest(
+            odigest.value(odigest.digest_dense_np(oracle))
+        )
+        if doc["digest"] != want:
+            mismatches.append((sid, rule, doc["digest"], want))
+    assert not mismatches, f"digest mismatches vs oracle: {mismatches[:3]}"
+
+    snap = registry.snapshot()
+    record = {
+        "config": config,
+        "metric": "serving-plane summary",
+        "value": boards_per_sec,
+        "unit": "boards/sec",
+        "vs_baseline": boards_per_sec / REFERENCE_BOARDS_PER_SEC,
+        "sessions": sessions,
+        "rounds": rounds,
+        "steps_per_request": steps,
+        "threads": threads,
+        "tenants": tenants,
+        "boards_per_sec": boards_per_sec,
+        "cells_per_sec": cells_per_sec,
+        "p50_s": p50,
+        "p99_s": p99,
+        "rejected_create_429": 1,
+        "rejected_step_429": 1,
+        "digest_ok": True,
+        "sampled": len(sampled),
+        "metrics": {
+            k: v for k, v in snap.items() if k.startswith("gol_serve")
+        },
+    }
+    emit(json.dumps(record))
+    server.close()
+    router.close()
+    return record
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sessions", type=int, default=256)
+    parser.add_argument("--steps", type=int, default=8,
+                        help="generations per step request")
+    parser.add_argument("--rounds", type=int, default=4,
+                        help="step requests per session")
+    parser.add_argument("--threads", type=int, default=16)
+    parser.add_argument("--tenants", type=int, default=8)
+    parser.add_argument("--sample", type=int, default=16,
+                        help="sessions digest-certified against the oracle")
+    parser.add_argument("--sizes", default=",".join(map(str, DEFAULT_SIZES)))
+    parser.add_argument("--rules", default=",".join(DEFAULT_RULES))
+    parser.add_argument("--platform", default=None)
+    args = parser.parse_args()
+
+    from akka_game_of_life_tpu.cli import _apply_platform
+
+    _apply_platform(args.platform)
+    bench_serve(
+        sessions=args.sessions,
+        steps=args.steps,
+        rounds=args.rounds,
+        threads=args.threads,
+        tenants=args.tenants,
+        sample=args.sample,
+        rules=tuple(args.rules.split(",")),
+        sizes=tuple(int(v) for v in args.sizes.split(",")),
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

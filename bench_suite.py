@@ -35,6 +35,16 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
                          fetching the whole board and digesting on host —
                          the observation/validation data-path win, plus the
                          digest's share of a 64-step chunk's wall-clock.
+ 11. cluster-elastic     mid-run scale-out drill (bench_cluster.py --grow-at):
+                         a 2-worker loopback cluster grows to 4, tiles
+                         migrate live, before/after aggregate throughput,
+                         digest-certified against the dense oracle.
+ 12. serve               the multi-tenant serving plane (bench_serve.py) at
+                         a small size: N sessions of mixed rules/sizes
+                         stepped through the /boards HTTP API by concurrent
+                         clients — boards/sec, aggregate cell-updates/s,
+                         p50/p99 step latency, digest-vs-oracle sampling,
+                         and the 429 admission drills.
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -641,7 +651,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", type=int, nargs="*",
-        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11],
+        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
     )
     parser.add_argument(
         "--scale", type=float, default=1.0,
@@ -707,6 +717,19 @@ def main() -> None:
 
         bench_cluster_elastic(
             size=s(1024), epochs=96, workers=2, grow_to=4, grow_at=32
+        )
+    if 12 in args.config:
+        # The multi-tenant serving plane (PR 7): vmapped batched boards
+        # behind the /boards API under synthetic concurrent traffic, with
+        # digest-vs-oracle sampling and the 429 admission drills.
+        from bench_serve import bench_serve
+
+        bench_serve(
+            sessions=max(16, int(64 * args.scale)),
+            steps=4,
+            rounds=2,
+            threads=8,
+            sample=8,
         )
 
 

@@ -407,7 +407,9 @@ def test_cluster_digest_under_chaos_and_redeploy(tmp_path):
             assert time.monotonic() < deadline
             time.sleep(0.01)
         h.frontend._redeploy_tile(next(iter(h.frontend.tile_owner)))
-        assert h.frontend.done.wait(60), "cluster did not finish"
+        # Generous: chaos crashes + an explicit redeploy on a loaded
+        # 2-core CI host can stretch recovery well past the usual 60 s.
+        assert h.frontend.done.wait(180), "cluster did not finish"
         assert h.frontend.error is None, h.frontend.error
         fd = h.frontend.final_digest
         assert h.frontend.crash_events, "chaos never fired"

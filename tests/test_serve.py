@@ -1,0 +1,847 @@
+"""Multi-tenant serving plane tests: batched engine, session router, API.
+
+Three layers, matching the subsystem:
+
+- **engine** (`serve/batch.py` + `ops.digest.digest_dense_batch`): every
+  board in a mixed-rule, mixed-shape ``[B, C, C]`` batch must step
+  bit-identical to its own single-board run — including Generations decay
+  states — and its digest row must equal the single-board definition's;
+- **router** (`serve/sessions.py`): lifecycle, admission control (session
+  cap, cell budget, queue bound → AdmissionError, with admitted jobs
+  always completing), idle-TTL eviction on an injected clock;
+- **surface** (`serve/api.py` on the `obs/httpd.py` registered-routes
+  table): the /boards HTTP contract next to /metrics, /healthz, /trace on
+  one port, the 400/404/405/413/429/500 mappings, and the config/CLI
+  bijection lint.
+"""
+
+import io
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.obs.catalog import install
+from akka_game_of_life_tpu.obs.httpd import MetricsServer, json_response
+from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+from akka_game_of_life_tpu.ops import digest as odigest
+from akka_game_of_life_tpu.ops import stencil
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.runtime.config import (
+    SimulationConfig,
+    parse_size_classes,
+)
+from akka_game_of_life_tpu.serve import (
+    AdmissionError,
+    SessionRouter,
+    batch_step_fn,
+    board_routes,
+    size_class,
+)
+from akka_game_of_life_tpu.serve import batch as sbatch
+from akka_game_of_life_tpu.utils.patterns import random_grid
+
+# The heterogeneous traffic mix every engine test rides: binary life-likes
+# AND multi-state Generations, square and ragged shapes, zero steps too.
+MIX = (
+    # (rule, h, w, seed, steps)
+    ("conway", 16, 16, 1, 5),
+    ("highlife", 12, 30, 2, 7),
+    ("seeds", 8, 8, 3, 4),
+    ("day-and-night", 32, 17, 4, 3),
+    ("brians-brain", 24, 24, 5, 6),  # Generations, 3 states
+    ("star-wars", 20, 9, 6, 8),  # Generations, 4 states
+    ("conway", 3, 32, 7, 2),
+    ("highlife", 32, 32, 8, 0),  # n=0: scan padding must be identity
+)
+
+
+def _registry():
+    return install(MetricsRegistry())
+
+
+def _cfg(**kw):
+    kw.setdefault("role", "serve")
+    kw.setdefault("flight_dir", "")
+    return SimulationConfig(**kw)
+
+
+def _oracle(rule, board0, steps):
+    """The single-board reference: ops.stencil on the exact same init."""
+    if steps == 0:
+        return np.asarray(board0, dtype=np.uint8)
+    return np.asarray(
+        stencil.multi_step_fn(resolve_rule(rule), steps)(jnp.asarray(board0))
+    )
+
+
+def _batch_run(specs, cls):
+    """Pad `specs` rows [(rule, board, steps)] into one class-`cls` batch,
+    run the jitted engine, return (outputs [B,cls,cls], lanes [B,2])."""
+    b_pad = sbatch.next_pow2(len(specs))
+    length = sbatch.next_pow2(max(max(s[2] for s in specs), 1))
+    boards = np.zeros((b_pad, cls, cls), dtype=np.uint8)
+    birth = np.zeros(b_pad, dtype=np.uint32)
+    survive = np.zeros(b_pad, dtype=np.uint32)
+    states = np.full(b_pad, 2, dtype=np.int32)
+    hs = np.ones(b_pad, dtype=np.int32)
+    ws = np.ones(b_pad, dtype=np.int32)
+    ns = np.zeros(b_pad, dtype=np.int32)
+    for i, (rule, board, steps) in enumerate(specs):
+        h, w = board.shape
+        boards[i, :h, :w] = board
+        birth[i], survive[i], states[i] = sbatch.rule_operands(
+            resolve_rule(rule)
+        )
+        hs[i], ws[i] = h, w
+        ns[i] = steps
+    out, lanes = batch_step_fn(cls, length)(
+        boards, birth, survive, states, hs, ws, ns
+    )
+    return np.asarray(out), np.asarray(lanes, dtype=np.uint32)
+
+
+# -- lint (tier-1 config/CLI drift guard) -------------------------------------
+
+
+def _tool(name):
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_every_serve_flag_maps_to_config():
+    mod = _tool("check_serve_config")
+    flags = mod.flag_names()
+    # Sanity: the scan sees the real surface.
+    assert "--serve-max-sessions" in flags and "--serve-size-classes" in flags
+    fields = mod.config_fields()
+    assert "serve_max_sessions" in fields and "serve_size_classes" in fields
+    assert mod.problems() == []
+
+
+# -- config -------------------------------------------------------------------
+
+
+def test_parse_size_classes():
+    assert parse_size_classes("32,64,256") == (32, 64, 256)
+    assert parse_size_classes("8") == (8,)
+    for bad in ("", "0", "-4", "64,32", "32,32", "a,b", "32,"):
+        with pytest.raises(ValueError):
+            parse_size_classes(bad)
+
+
+def test_serve_config_validation():
+    _cfg()  # defaults valid, role accepted
+    for field in (
+        "serve_max_sessions",
+        "serve_max_cells",
+        "serve_queue_depth",
+        "serve_max_steps",
+    ):
+        with pytest.raises(ValueError):
+            _cfg(**{field: 0})
+    with pytest.raises(ValueError):
+        _cfg(serve_tick_s=-0.1)
+    with pytest.raises(ValueError):
+        _cfg(serve_ttl_s=-1)
+    with pytest.raises(ValueError):
+        _cfg(serve_size_classes="64,32")
+
+
+def test_size_class_bucketing():
+    classes = (32, 64, 256)
+    assert size_class(1, 1, classes) == 32
+    assert size_class(32, 32, classes) == 32
+    assert size_class(33, 8, classes) == 64  # max(h, w) picks the class
+    assert size_class(8, 200, classes) == 256
+    assert size_class(257, 1, classes) is None  # caller's 400, not a crash
+    assert sbatch.next_pow2(1) == 1
+    assert sbatch.next_pow2(5) == 8
+    assert sbatch.next_pow2(8) == 8
+
+
+def test_rule_operands_totalistic_only():
+    with pytest.raises(ValueError):
+        sbatch.rule_operands(resolve_rule("wireworld"))
+
+
+# -- batched engine vs single-board oracle ------------------------------------
+
+
+def test_batched_mixed_rules_match_single_board_oracles():
+    """Every board in one mixed-rule [B, C, C] batch (binary AND
+    Generations, ragged shapes, heterogeneous step counts) steps
+    bit-identical to its own single-board run, and padding stays dead."""
+    cls = 32
+    specs = [
+        (rule, random_grid((h, w), density=0.5, seed=seed), steps)
+        for rule, h, w, seed, steps in MIX
+    ]
+    out, lanes = _batch_run(specs, cls)
+    for i, (rule, board0, steps) in enumerate(specs):
+        h, w = board0.shape
+        want = _oracle(rule, board0, steps)
+        np.testing.assert_array_equal(
+            out[i, :h, :w], want, err_msg=f"board {i} ({rule}, {h}x{w})"
+        )
+        # Padding beyond the live region never holds a live cell.
+        assert not out[i, h:].any() and not out[i, :, w:].any()
+        # The batched digest row == the single-board definition.
+        np.testing.assert_array_equal(
+            lanes[i], odigest.digest_dense_np(want), err_msg=f"lanes {i}"
+        )
+
+
+def test_batched_step_matches_simulation_run(monkeypatch):
+    """The satellite's exact shape: vs a real single-board `Simulation`
+    (same seed/density contract the router's create uses), Generations
+    decay included."""
+    import jax
+
+    from akka_game_of_life_tpu.runtime.render import BoardObserver
+    from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+    one = jax.devices()[:1]
+    monkeypatch.setattr(jax, "devices", lambda *a: one)
+    cls, steps = 32, 6
+    mix = (("conway", 24, 24, 11), ("star-wars", 16, 28, 12))
+    specs = [
+        (rule, random_grid((h, w), density=0.5, seed=seed), steps)
+        for rule, h, w, seed in mix
+    ]
+    seeds = [seed for _, _, _, seed in mix]
+    out, _ = _batch_run(specs, cls)
+    for i, ((rule, board0, _), seed) in enumerate(zip(specs, seeds)):
+        h, w = board0.shape
+        sim = Simulation(
+            SimulationConfig(
+                rule=rule,
+                height=h,
+                width=w,
+                seed=seed,
+                density=0.5,
+                kernel="dense",
+                max_epochs=steps,
+                flight_dir="",
+            ),
+            observer=BoardObserver(out=io.StringIO()),
+            registry=_registry(),
+        )
+        sim.advance(steps)
+        np.testing.assert_array_equal(
+            out[i, :h, :w], sim.board_host(), err_msg=rule
+        )
+        sim.close()
+
+
+def test_digest_dense_batch_property():
+    """digest_dense_batch rows are bit-identical to the single-board
+    definition across batch sizes, shapes, and state alphabets — and
+    padding is invisible to the fold."""
+    rng = np.random.default_rng(7)
+    for b in (1, 3, 8):
+        cls = 16
+        boards = np.zeros((b, cls, cls), dtype=np.uint8)
+        widths = np.zeros(b, dtype=np.int32)
+        singles = []
+        for i in range(b):
+            h = int(rng.integers(1, cls + 1))
+            w = int(rng.integers(1, cls + 1))
+            states = int(rng.choice((2, 3, 4)))
+            board = rng.integers(0, states, size=(h, w), dtype=np.uint8)
+            boards[i, :h, :w] = board
+            widths[i] = w
+            singles.append(board)
+        lanes = np.asarray(
+            odigest.digest_dense_batch(jnp.asarray(boards), widths),
+            dtype=np.uint32,
+        )
+        for i, board in enumerate(singles):
+            np.testing.assert_array_equal(
+                lanes[i], odigest.digest_dense_np(board), err_msg=f"b={b} i={i}"
+            )
+
+
+def test_batch_step_fn_program_cache():
+    """(class, length) keys one compiled program: the quantizers bound the
+    program count however the traffic mix varies."""
+    assert batch_step_fn(32, 8) is batch_step_fn(32, 8)
+    assert batch_step_fn(32, 8) is not batch_step_fn(32, 16)
+
+
+# -- session router -----------------------------------------------------------
+
+
+def test_router_lifecycle_and_oracle_digest():
+    with SessionRouter(_cfg(), registry=_registry()) as router:
+        doc = router.create(
+            tenant="alice", rule="highlife", height=20, width=12, seed=42
+        )
+        sid = doc["id"]
+        assert doc["epoch"] == 0 and doc["tenant"] == "alice"
+        board0 = random_grid((20, 12), density=0.5, seed=42)
+        np.testing.assert_array_equal(doc["board"], board0)
+
+        epoch, digest = router.step(sid, steps=5)
+        assert epoch == 5
+        want = _oracle("highlife", board0, 5)
+        assert digest == odigest.value(odigest.digest_dense_np(want))
+        got = router.get(sid)
+        assert got["epoch"] == 5
+        np.testing.assert_array_equal(got["board"], want)
+
+        assert [d["id"] for d in router.list()] == [sid]
+        assert "board" not in router.list()[0]
+        router.delete(sid)
+        with pytest.raises(KeyError):
+            router.get(sid)
+        with pytest.raises(KeyError):
+            router.step(sid)
+
+
+def test_router_one_tick_batches_many_sessions():
+    """Concurrent step requests land in few batched device programs, and
+    every session's result is its own oracle's."""
+    registry = _registry()
+    with SessionRouter(_cfg(), registry=registry) as router:
+        specs = []
+        for i, (rule, h, w, seed, _) in enumerate(MIX):
+            doc = router.create(
+                tenant=f"t{i % 3}", rule=rule, height=h, width=w, seed=seed
+            )
+            specs.append((doc["id"], rule, (h, w), seed))
+        router.pause()
+        results = {}
+
+        def step_one(sid):
+            results[sid] = router.step(sid, steps=3)
+
+        pool = [
+            threading.Thread(target=step_one, args=(sid,))
+            for sid, _, _, _ in specs
+        ]
+        for t in pool:
+            t.start()
+        _wait_for(lambda: router.stats()["queue_depth"] == len(specs))
+        router.resume()
+        for t in pool:
+            t.join()
+        for sid, rule, (h, w), seed in specs:
+            want = _oracle(
+                rule, random_grid((h, w), density=0.5, seed=seed), 3
+            )
+            assert results[sid] == (
+                3, odigest.value(odigest.digest_dense_np(want))
+            ), (sid, rule)
+        snap = registry.snapshot()
+        # All 8 sessions bucket into ONE 32-class program run this tick.
+        assert snap["gol_serve_batch_boards"]["count"] == 1
+        assert snap["gol_serve_batch_boards"]["sum"] == len(specs)
+
+
+def _wait_for(pred, timeout=10.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, "timed out"
+        time.sleep(0.005)
+
+
+def test_router_rejects_malformed_creates():
+    with SessionRouter(_cfg(), registry=_registry()) as router:
+        with pytest.raises(ValueError):
+            router.create(rule="wireworld")  # not mask-encodable
+        with pytest.raises(ValueError):
+            router.create(height=0)
+        with pytest.raises(ValueError):
+            router.create(density=1.5)
+        with pytest.raises(ValueError):
+            router.create(height=10_000)  # beyond the largest class
+        with pytest.raises(ValueError):
+            router.create(height=257, width=1)  # max(h, w) picks the class
+        # Tenant ids label metrics: junk and oversize are refused (400).
+        for bad in ("", "a b", 'x"y', "t\n", "q" * 65):
+            with pytest.raises(ValueError):
+                router.create(tenant=bad, height=8, width=8)
+
+
+def test_tenant_metric_children_reclaimed_on_last_delete():
+    """A create/delete loop over fresh tenant strings must not grow the
+    exposition without bound: the last session of a tenant reclaims its
+    per-tenant gauge/counter children."""
+    registry = _registry()
+    with SessionRouter(_cfg(), registry=registry) as router:
+        for i in range(20):
+            sid = router.create(
+                tenant=f"burst{i}", height=8, width=8, seed=i
+            )["id"]
+            router.delete(sid)
+        keep = router.create(tenant="keeper", height=8, width=8)["id"]
+        text = registry.render()
+        assert "burst" not in text
+        assert 'gol_serve_sessions{tenant="keeper"} 1' in text
+        # Deleting the keeper reclaims it too.
+        router.delete(keep)
+        assert "keeper" not in registry.render()
+
+
+def test_admission_session_cap_and_cell_budget():
+    registry = _registry()
+    cfg = _cfg(serve_max_sessions=2, serve_max_cells=3000)
+    with SessionRouter(cfg, registry=registry) as router:
+        router.create(height=32, width=32, seed=1)  # 1024 cells
+        with pytest.raises(AdmissionError) as e:
+            router.create(height=45, width=45, seed=2)  # 2025 > budget left
+        assert e.value.reason == "max_cells"
+        router.create(height=32, width=32, seed=2)
+        with pytest.raises(AdmissionError) as e:
+            router.create(height=8, width=8, seed=3)
+        assert e.value.reason == "max_sessions"
+        snap = registry.snapshot()
+        assert snap['gol_serve_rejects_total{reason="max_cells"}'] == 1.0
+        assert snap['gol_serve_rejects_total{reason="max_sessions"}'] == 1.0
+        assert snap["gol_serve_cells"] == 2048.0
+        # Deleting releases both resources.
+        sid = router.list()[0]["id"]
+        router.delete(sid)
+        router.create(height=40, width=40, seed=4)
+
+
+def test_admission_queue_backpressure_never_drops_admitted():
+    cfg = _cfg(serve_queue_depth=4)
+    registry = _registry()
+    with SessionRouter(cfg, registry=registry) as router:
+        sids = [
+            router.create(height=8, width=8, seed=i)["id"] for i in range(4)
+        ]
+        router.pause()
+        results = []
+        pool = [
+            threading.Thread(
+                target=lambda s=s: results.append(router.step(s, steps=1))
+            )
+            for s in sids
+        ]
+        for t in pool:
+            t.start()
+        _wait_for(lambda: router.stats()["queue_depth"] == 4)
+        with pytest.raises(AdmissionError) as e:
+            router.step(sids[0], steps=1)  # the bound: 429, not a wedge
+        assert e.value.reason == "queue_full"
+        router.resume()
+        for t in pool:
+            t.join()
+        # Every ADMITTED job completed with exactly its own epochs.
+        assert sorted(r[0] for r in results) == [1, 1, 1, 1]
+        assert registry.snapshot()["gol_serve_queue_depth"] == 0.0
+
+
+def test_idle_ttl_eviction_injected_clock():
+    clock = {"now": 1000.0}
+    registry = _registry()
+    cfg = _cfg(serve_ttl_s=60.0)
+    with SessionRouter(
+        cfg, registry=registry, clock=lambda: clock["now"]
+    ) as router:
+        a = router.create(height=8, width=8, seed=1)["id"]
+        b = router.create(height=8, width=8, seed=2)["id"]
+        clock["now"] += 50
+        router.get(a)  # touches a, not b
+        clock["now"] += 20  # b now 70s idle, a only 20s
+        _wait_for(lambda: len(router.list()) == 1)
+        assert router.list()[0]["id"] == a
+        with pytest.raises(KeyError):
+            router.get(b)
+        assert (
+            registry.snapshot()["gol_serve_session_evictions_total"] == 1.0
+        )
+        # cells released by the sweep
+        assert registry.snapshot()["gol_serve_cells"] == 64.0
+
+
+def test_ttl_sweep_spares_sessions_with_queued_jobs():
+    """An ADMITTED queued step job always completes: the idle sweep must
+    not evict its session mid-wait, however stale last_used looks."""
+    clock = {"now": 1000.0}
+    with SessionRouter(
+        _cfg(serve_ttl_s=5.0),
+        registry=_registry(),
+        clock=lambda: clock["now"],
+    ) as router:
+        sid = router.create(height=8, width=8, seed=1)["id"]
+        router.pause()
+        result = []
+        t = threading.Thread(
+            target=lambda: result.append(router.step(sid, steps=2))
+        )
+        t.start()
+        _wait_for(lambda: router.stats()["queue_depth"] == 1)
+        clock["now"] += 60  # far past the TTL while the job is queued
+        import time as _time
+
+        _time.sleep(0.6)  # give the idle sweep cycles to (wrongly) fire
+        router.resume()
+        t.join()
+        assert result and result[0][0] == 2  # completed, not 404'd
+
+
+def test_drain_completes_admitted_jobs_and_rejects_new_work():
+    """The shutdown contract: drain() answers new work with 429
+    reason=draining while every ADMITTED queued job still completes."""
+    with SessionRouter(_cfg(), registry=_registry()) as router:
+        sids = [
+            router.create(height=8, width=8, seed=i)["id"] for i in range(3)
+        ]
+        router.pause()
+        results = []
+        pool = [
+            threading.Thread(
+                target=lambda s=s: results.append(router.step(s, steps=1))
+            )
+            for s in sids
+        ]
+        for t in pool:
+            t.start()
+        _wait_for(lambda: router.stats()["queue_depth"] == 3)
+        done = {"v": None}
+        drainer = threading.Thread(
+            target=lambda: done.update(v=router.drain(timeout=30))
+        )
+        drainer.start()
+        # Draining: new work is refused with the machine-readable reason…
+        with pytest.raises(AdmissionError) as e:
+            router.step(sids[0], steps=1)
+        assert e.value.reason == "draining"
+        with pytest.raises(AdmissionError):
+            router.create(height=8, width=8, seed=99)
+        # …while the admitted queue runs dry and every job lands.
+        router.resume()
+        for t in pool:
+            t.join()
+        drainer.join()
+        assert done["v"] is True
+        assert sorted(r[0] for r in results) == [1, 1, 1]
+
+
+def test_step_bounds_and_closed_router():
+    cfg = _cfg(serve_max_steps=16)
+    router = SessionRouter(cfg, registry=_registry())
+    sid = router.create(height=8, width=8)["id"]
+    with pytest.raises(ValueError):
+        router.step(sid, steps=0)
+    with pytest.raises(ValueError):
+        router.step(sid, steps=17)
+    router.close()
+    with pytest.raises(RuntimeError):
+        router.create(height=8, width=8)
+    with pytest.raises(RuntimeError):
+        # Fail NOW, not after JOB_TIMEOUT_S: the ticker is gone, an
+        # enqueued job would never drain.
+        router.step(sid, steps=1)
+
+
+# -- HTTP surface on the registered-routes table ------------------------------
+
+
+def _http(base, method, path, doc=None):
+    data = json.dumps(doc).encode() if doc is not None else None
+    req = urllib.request.Request(base + path, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _serve_stack(cfg=None, registry=None):
+    registry = registry if registry is not None else _registry()
+    router = SessionRouter(cfg or _cfg(), registry=registry)
+    server = MetricsServer(
+        registry, port=0, host="127.0.0.1", routes=board_routes(router)
+    )
+    return router, server, f"http://127.0.0.1:{server.port}"
+
+
+def test_http_boards_api_contract():
+    from akka_game_of_life_tpu.serve.api import decode_board_b64
+
+    router, server, base = _serve_stack()
+    try:
+        status, doc = _http(
+            base, "POST", "/boards",
+            {"tenant": "bob", "rule": "brians-brain", "height": 10,
+             "width": 14, "seed": 9},
+        )
+        assert status == 201 and "board_b64" not in doc
+        sid = doc["id"]
+        assert doc["rule"] == resolve_rule("brians-brain").rulestring()
+
+        status, doc = _http(base, "GET", f"/boards/{sid}")
+        assert status == 200 and doc["epoch"] == 0
+        board0 = random_grid((10, 14), density=0.5, seed=9)
+        np.testing.assert_array_equal(decode_board_b64(doc), board0)
+
+        status, doc = _http(base, "POST", f"/boards/{sid}/step", {"steps": 4})
+        assert status == 200 and doc["epoch"] == 4 and doc["steps"] == 4
+        want = _oracle("brians-brain", board0, 4)
+        assert doc["digest"] == odigest.format_digest(
+            odigest.value(odigest.digest_dense_np(want))
+        )
+        # GET returns the stepped cells (Generations: refractory states
+        # survive the base64 round-trip too).
+        status, doc = _http(base, "GET", f"/boards/{sid}")
+        np.testing.assert_array_equal(decode_board_b64(doc), want)
+
+        status, doc = _http(base, "GET", "/boards")
+        assert status == 200 and [b["id"] for b in doc["boards"]] == [sid]
+
+        status, doc = _http(base, "DELETE", f"/boards/{sid}")
+        assert status == 200 and doc["deleted"] == sid
+        assert _http(base, "GET", f"/boards/{sid}")[0] == 404
+    finally:
+        server.close()
+        router.close()
+
+
+def test_http_error_mapping():
+    router, server, base = _serve_stack(_cfg(serve_max_sessions=1))
+    try:
+        # 400: unknown field, bad rule family, oversize, malformed body
+        assert _http(base, "POST", "/boards", {"bogus": 1})[0] == 400
+        assert _http(base, "POST", "/boards", {"rule": "wireworld"})[0] == 400
+        assert _http(base, "POST", "/boards", {"height": 9999})[0] == 400
+        status, doc = _http(base, "POST", "/boards", {"height": 8, "width": 8})
+        assert status == 201
+        # 429 with machine-readable reason on the cap
+        status, doc = _http(base, "POST", "/boards", {"height": 8, "width": 8})
+        assert status == 429 and doc["reason"] == "max_sessions"
+        assert "retry_after_s" in doc
+        # 404 unknown id / unknown action; 405 wrong method
+        assert _http(base, "GET", "/boards/nope")[0] == 404
+        sid = router.list()[0]["id"]
+        assert _http(base, "GET", f"/boards/{sid}/bogus")[0] == 404
+        assert _http(base, "DELETE", "/boards")[0] == 405
+        assert _http(base, "GET", f"/boards/{sid}/step")[0] == 405
+        # bad steps value → 400 (range) / 400 (type)
+        assert _http(
+            base, "POST", f"/boards/{sid}/step", {"steps": 0}
+        )[0] == 400
+        assert _http(
+            base, "POST", f"/boards/{sid}/step", {"steps": "lots"}
+        )[0] == 400
+    finally:
+        server.close()
+        router.close()
+
+
+def test_http_shares_port_with_metrics_and_healthz():
+    """The satellite's point: /boards rides the SAME server and _respond
+    discipline as the scrape endpoint — one port, a routes table, no
+    if/elif chain."""
+    registry = _registry()
+    router = SessionRouter(_cfg(), registry=registry)
+    server = MetricsServer(
+        registry,
+        port=0,
+        host="127.0.0.1",
+        health=lambda: {"ok": True, **router.stats()},
+        routes=board_routes(router),
+    )
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        _http(base, "POST", "/boards", {"tenant": "t9", "height": 8,
+                                        "width": 8})
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        assert 'gol_serve_sessions{tenant="t9"} 1' in text
+        status, doc = _http(base, "GET", "/healthz")
+        assert status == 200 and doc["sessions"] == 1
+        assert _http(base, "GET", "/nothing-here")[0] == 404
+        # The built-in routes honor the method contract too.
+        assert _http(base, "POST", "/metrics", {})[0] == 405
+        assert _http(base, "DELETE", "/healthz")[0] == 405
+    finally:
+        server.close()
+        router.close()
+
+
+def test_route_table_dispatch_rules():
+    registry = _registry()
+    calls = []
+
+    def route_a(method, path, body):
+        calls.append(("a", method, path, body))
+        return json_response(200, {"route": "a"})
+
+    def route_ab(method, path, body):
+        return json_response(200, {"route": "ab"})
+
+    def route_boom(method, path, body):
+        raise RuntimeError("route bug")
+
+    server = MetricsServer(registry, port=0, host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with pytest.raises(ValueError):
+            server.add_route("no-slash", route_a)
+        with pytest.raises(ValueError):
+            server.add_route("/trailing/", route_a)
+        server.add_route("/a", route_a)
+        server.add_route("/a/b", route_ab)
+        server.add_route("/boom", route_boom)
+        # Longest prefix wins; subtree paths dispatch to their root.
+        assert _http(base, "GET", "/a")[1]["route"] == "a"
+        assert _http(base, "GET", "/a/b")[1]["route"] == "ab"
+        assert _http(base, "GET", "/a/b/c")[1]["route"] == "ab"
+        assert _http(base, "GET", "/a/x?q=1")[1]["route"] == "a"
+        # POST bodies reach the handler.
+        _http(base, "POST", "/a/x", {"k": 1})
+        assert calls[-1][1] == "POST" and json.loads(calls[-1][3]) == {"k": 1}
+        # A raising handler maps to 500, never a dead connection.
+        status, doc = _http(base, "GET", "/boom")
+        assert status == 500 and "route bug" in doc["error"]
+        # Oversize bodies are refused before being read.
+        status, _ = _http_raw(server.port, b"999999999")
+        assert status == 413
+        # A NEGATIVE declared length must answer (an empty-body dispatch),
+        # not turn into a read-until-EOF that pins the connection thread.
+        status, _ = _http_raw(server.port, b"-1")
+        assert status == 200
+        # A chunked body would be silently read as empty — refuse it loud.
+        with socket.create_connection(
+            ("127.0.0.1", server.port), timeout=10
+        ) as s:
+            s.sendall(
+                b"POST /a HTTP/1.1\r\nHost: x\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+            )
+            assert b" 411 " in s.recv(65536).split(b"\r\n", 1)[0]
+    finally:
+        server.close()
+
+
+def _http_raw(port, content_length: bytes):
+    """A request with a hand-forged Content-Length header — urllib would
+    send a real body, so speak raw HTTP and lie instead."""
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        s.sendall(
+            b"POST /a HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: " + content_length + b"\r\n\r\n"
+        )
+        data = s.recv(65536).decode()
+    status = int(data.split(" ", 2)[1])
+    return status, data
+
+
+def test_trace_route_still_mounts_with_tracer():
+    from akka_game_of_life_tpu.obs.tracing import Tracer
+
+    registry = _registry()
+    tracer = Tracer(node="t")
+    with tracer.span("serve.tick", jobs=1):
+        pass
+    server = MetricsServer(registry, port=0, host="127.0.0.1", tracer=tracer)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with urllib.request.urlopen(base + "/trace", timeout=30) as resp:
+            doc = json.loads(resp.read())
+        assert any(
+            ev.get("name") == "serve.tick" for ev in doc["traceEvents"]
+        )
+    finally:
+        server.close()
+
+
+# -- bench + CLI end-to-end ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_serve_small_end_to_end():
+    """bench_serve's whole contract at a tiny size: BENCH lines, the two
+    429 drills, and digest-vs-oracle sampling all pass in-process."""
+    from bench_serve import bench_serve
+
+    lines = []
+    record = bench_serve(
+        sessions=12, steps=3, rounds=2, threads=4, sample=6,
+        queue_drill_depth=8, emit=lines.append,
+    )
+    assert record["digest_ok"] is True
+    assert record["rejected_create_429"] == 1
+    assert record["rejected_step_429"] == 1
+    assert record["boards_per_sec"] > 0 and record["p99_s"] > 0
+    parsed = [json.loads(l) for l in lines]
+    assert all("config" in r and "value" in r and "unit" in r for r in parsed)
+
+
+@pytest.mark.slow
+def test_cli_serve_role_real_process(tmp_path):
+    """The `serve` CLI role on a real process: boots, prints its port,
+    serves a create/step/get round-trip with an oracle-checked digest, and
+    exits cleanly on SIGINT."""
+    import os
+    import re
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "akka_game_of_life_tpu", "serve",
+            "--metrics-port", "0", "--serve-max-sessions", "4",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+        cwd=repo,
+    )
+    try:
+        m = None
+        deadline = time.monotonic() + 120
+        while m is None and time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            assert line, "serve process exited before printing its banner"
+            m = re.search(r"on :(\d+)", line)
+        assert m, "no port banner within the deadline"
+        base = f"http://127.0.0.1:{m.group(1)}"
+        status, doc = _http(
+            base, "POST", "/boards",
+            {"rule": "conway", "height": 12, "width": 12, "seed": 5},
+        )
+        assert status == 201
+        sid = doc["id"]
+        status, doc = _http(base, "POST", f"/boards/{sid}/step", {"steps": 7})
+        assert status == 200
+        want = _oracle(
+            "conway", random_grid((12, 12), density=0.5, seed=5), 7
+        )
+        assert doc["digest"] == odigest.format_digest(
+            odigest.value(odigest.digest_dense_np(want))
+        )
+        status, doc = _http(base, "GET", "/healthz")
+        assert status == 200 and doc["role"] == "serve"
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            rc = proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+    assert rc == 130
