@@ -159,6 +159,43 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "frontend role, workers digest tiles locally and the frontend "
         "merges them (see docs/OPERATIONS.md \"Digest certification\")",
     )
+    g = p.add_argument_group(
+        "activity-gated sparse stepping",
+        "skip the dead parts of the board: O(activity) throughput on "
+        "dilute universes (see docs/OPERATIONS.md \"Activity-gated sparse "
+        "stepping\"); every --sparse-X flag maps 1:1 onto "
+        "SimulationConfig.sparse_X (tools/check_sparse_config.py "
+        "lint-enforces the bijection)",
+    )
+    g.add_argument(
+        "--sparse-cluster",
+        choices=["on", "off"],
+        default=None,
+        help="cluster tier (frontend role, shipped to workers in WELCOME): "
+        "a tile whose state and halo repeat across a chunk (period 1 or 2) "
+        "skips its step, publishes an O(1)-byte same-ring marker, and "
+        "suppresses per-chunk PROGRESS pings; a changed neighboring ring "
+        "wakes it with zero wrong-state epochs (default off)",
+    )
+    g.add_argument(
+        "--sparse-kernel",
+        choices=["on", "off"],
+        default=None,
+        help="intra-tile tier (run role): a per-block activity bitmap "
+        "gates which blocks the stepper advances — a block steps only if "
+        "it or a neighbor changed last chunk (default off)",
+    )
+    g.add_argument(
+        "--sparse-block", type=int, default=None, metavar="B",
+        help="gating block side in cells (default 128; clamped to the "
+        "largest common divisor of the board sides)",
+    )
+    g.add_argument(
+        "--sparse-threshold", type=float, default=None, metavar="F",
+        help="dense escape hatch: above this active-block fraction the "
+        "chunk runs the plain dense kernel and only the change bitmap is "
+        "recomputed (default 0.5)",
+    )
     p.add_argument("--log-file")
     p.add_argument("--inject-faults", action="store_true", default=None)
     p.add_argument(
@@ -511,6 +548,14 @@ def _overrides(args: argparse.Namespace) -> dict:
         "flight_dir": args.flight_dir,
         "obs_defer": args.obs_defer,
         "obs_digest": args.obs_digest,
+        "sparse_cluster": {"on": True, "off": False, None: None}[
+            args.sparse_cluster
+        ],
+        "sparse_kernel": {"on": True, "off": False, None: None}[
+            args.sparse_kernel
+        ],
+        "sparse_block": args.sparse_block,
+        "sparse_threshold": args.sparse_threshold,
         "log_file": args.log_file,
         "distributed": args.distributed,
         "coordinator_address": args.coordinator,

@@ -123,6 +123,29 @@ CATALOG = (
     ("gol_serve_step_seconds", "histogram",
      "Wall seconds per step request, enqueue to result (queue wait + "
      "batch run)", ()),
+    # -- activity-gated sparse stepping --------------------------------------
+    ("gol_tiles_skipped_total", "counter",
+     "Tile chunks skipped by quiescent cluster tiles (frontend-merged "
+     "worker deltas — the cluster tier's O(activity) win)", ()),
+    ("gol_tiles_quiescent", "gauge",
+     "Tiles currently self-reporting quiescent (period 1 or 2)", ()),
+    ("gol_tile_chunks_skipped_total", "counter",
+     "Tile chunks this worker skipped as provably quiescent", ()),
+    ("gol_ring_same_markers_total", "counter",
+     "O(1)-byte same-ring markers published in place of ring payloads", ()),
+    ("gol_ring_same_miss_total", "counter",
+     "Same-ring markers whose referenced epoch was not in the local store "
+     "(recovered by the dependent pull's re-ask — latency, never "
+     "corruption)", ()),
+    ("gol_sparse_active_blocks", "gauge",
+     "Blocks the intra-tile activity gate considers live this chunk", ()),
+    ("gol_sparse_blocks_stepped_total", "counter",
+     "Block-chunks actually advanced by the gated kernel", ()),
+    ("gol_sparse_blocks_skipped_total", "counter",
+     "Block-chunks skipped as provably unchanged by the activity gate", ()),
+    ("gol_sparse_dense_chunks_total", "counter",
+     "Chunks the gate handed to the dense kernel (active fraction over "
+     "sparse_threshold, or a board of unknown provenance)", ()),
     # -- network chaos plane / hardened comms (PR 3) ---------------------------
     ("gol_net_chaos_dropped_total", "counter",
      "Messages dropped by the network chaos policy (random drops + "

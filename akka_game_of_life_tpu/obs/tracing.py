@@ -70,6 +70,8 @@ SPAN_CATALOG = (
     ("halo.retry", "stale-halo retry round (re-asks to missing rings' owners)"),
     ("gather.escalate", "GATHER_FAILED escalation after the retry budget"),
     ("backend.crash", "CRASH/CRASH_TILE handled on the worker"),
+    ("tile.quiesce", "a tile entering quiescence (sparse_cluster: chunks "
+     "skipped until a neighboring ring changes)"),
     # -- network chaos plane / hardened comms ---------------------------------
     ("net.partition", "one injected partition, open to heal"),
     ("breaker.open", "one circuit-breaker open interval, open to re-close"),

@@ -1,0 +1,329 @@
+"""Activity-gated sparse stepping: O(activity) work on dilute boards.
+
+Every dense kernel in ``ops/`` does O(area) work per epoch — a handful of
+gliders on an otherwise-dead torus costs the same as a fully boiling one.
+Casper (PAPERS.md) frames the stencil bottleneck as memory traffic; the
+cheapest byte is the one never touched, so this engine tracks WHICH parts
+of the board changed and steps only those.
+
+The unit of gating is a coarse **block** (``block`` cells square, one bit
+per block).  The invariant that makes skipping exact, not approximate:
+
+    A cell whose entire radius-``k`` neighborhood is identical at two
+    consecutive chunk boundaries computes the identical next state — so a
+    cell can change during chunk ``t+1`` only if some cell within ``k``
+    of it changed during chunk ``t`` (``k`` = steps per chunk, radius-1
+    rules).  With ``k <= block``, that influence front stays within one
+    block ring: ``active(t+1) ⊆ dilate3x3(active(t))``.
+
+Per chunk the stepper therefore (1) dilates last chunk's changed-block
+bitmap by one block ring (toroidal 3×3 OR), (2) gathers the active blocks
+with a ``k``-cell halo into a ``[n, B+2k, B+2k]`` batch, (3) advances the
+batch ``k`` toroidal steps under one vmapped jit (the cut-edge garbage
+front moves one cell per step, so the ``B×B`` interiors are exact — the
+same slab argument as the cluster's chunk engine), (4) scatters the
+interiors back and records which blocks actually changed.  Batch sizes
+quantize to powers of two so the traffic mix compiles O(log blocks)
+programs, not one per activity level (the serve-plane discipline).
+
+Dense escape hatch: once the dilated active fraction crosses
+``threshold`` the whole board steps through the ordinary dense chunk and
+only the changed-block bitmap is recomputed (one vectorized compare) —
+on a boiling board the gating costs one O(area) memcmp per chunk, a few
+percent, never a per-block Python loop.
+
+The first chunk after construction — and after any board the stepper did
+not itself produce (checkpoint restore, crash replay) — runs dense with
+every block considered active, so no change can ever be missed.
+
+Host-orchestrated on purpose: the gather/scatter runs in numpy on the
+host board while only the active slabs visit the accelerator.  That is
+the right trade on dilute boards (the win this engine exists for);
+``threshold`` hands boiling boards back to the dense device path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+
+def pick_block(height: int, width: int, requested: int) -> int:
+    """The effective gating block: the largest common divisor of the board
+    sides that is <= ``requested`` (so blocks always tile the torus
+    exactly).  Deterministic; 1 in the worst (coprime-sides) case."""
+    g = math.gcd(height, width)
+    best = 1
+    for d in range(1, int(math.isqrt(g)) + 1):
+        if g % d == 0:
+            for c in (d, g // d):
+                if c <= requested and c > best:
+                    best = c
+    return best
+
+
+def dilate3x3(active: np.ndarray) -> np.ndarray:
+    """Toroidal 3×3 OR-dilation of a bool block bitmap."""
+    out = active.copy()
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            if (dy, dx) != (0, 0):
+                out |= np.roll(active, (dy, dx), axis=(0, 1))
+    return out
+
+
+def changed_blocks(prev: np.ndarray, new: np.ndarray, block: int) -> np.ndarray:
+    """Bool (H//block, W//block) bitmap of blocks whose cells differ."""
+    h, w = prev.shape
+    nbh, nbw = h // block, w // block
+    diff = prev != new
+    return diff.reshape(nbh, block, nbw, block).any(axis=(1, 3))
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1) — the batch/length quantizer
+    that bounds how many programs a varying traffic mix can compile.  The
+    canonical copy; :mod:`serve.batch` re-exports it for the serving
+    plane's size classes."""
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+class SparseStepper:
+    """Stateful activity-gated chunk engine for one board.
+
+    ``step(board, k)`` advances a host uint8 board ``k`` generations.
+    State: the changed-block bitmap of the last chunk, keyed to the array
+    object the stepper produced — a board it has never seen resets the
+    gate to all-active, which is what makes checkpoint restore / crash
+    replay correct without any explicit hook.
+
+    **Ownership contract**: a board the stepper itself produced is updated
+    IN PLACE on the sparse path (every active slab is gathered — copied —
+    before any block is written back, so the Jacobi semantics are exact);
+    a foreign board is never mutated — its first chunk runs dense, which
+    allocates the owned output.  Skipping the O(area) copy is the point:
+    at 16384² the copy alone rivals the in-cache packed kernel, and the
+    sparse path must cost O(activity), not O(area).  Callers that retain
+    a reference across chunks (checkpoint writers, deferred observation)
+    must copy — :class:`runtime.simulation.Simulation` does exactly that
+    at its escape points."""
+
+    def __init__(
+        self,
+        rule,
+        shape,
+        *,
+        block: int = 128,
+        threshold: float = 0.5,
+    ) -> None:
+        self.rule = resolve_rule(rule)
+        if self.rule.radius != 1:
+            raise ValueError(
+                f"sparse stepping gates radius-1 rules; {self.rule} "
+                f"(radius {self.rule.radius}) runs on the dense kernels"
+            )
+        self.shape = tuple(shape)
+        self.block = pick_block(self.shape[0], self.shape[1], block)
+        self.threshold = threshold
+        self.grid = (self.shape[0] // self.block, self.shape[1] // self.block)
+        self._changed: Optional[np.ndarray] = None
+        self._last_out: Optional[np.ndarray] = None
+        # Consecutive dense-fallback chunks: on a boiling board the bitmap
+        # is recomputed only every other dense chunk (skipping it means
+        # "assume everything active" — an over-approximation, so still
+        # exact), halving the gate's dense-path tax.
+        self._dense_streak = 0
+        # Compiled cores, cached per (kind, steps) ON THE INSTANCE — an
+        # lru_cache on the methods would key on `self` and pin every
+        # stepper (and its retained full board) in a class-level cache for
+        # the life of the process (the Simulation._steppers discipline).
+        self._fns = {}
+        # Gating observability, read by the embedder after each chunk.
+        self.last_active_blocks = 0
+        self.last_stepped_blocks = 0
+        self.dense_chunks = 0
+        self.sparse_chunks = 0
+
+    @property
+    def total_blocks(self) -> int:
+        return self.grid[0] * self.grid[1]
+
+    # -- jitted cores (cached per (steps, batch/board shape)) ----------------
+
+    def _block_fn(self, steps: int):
+        if ("block", steps) in self._fns:
+            return self._fns[("block", steps)]
+        import jax
+        import jax.numpy as jnp
+
+        from akka_game_of_life_tpu.ops.stencil import step as stencil_step
+
+        rule = self.rule
+        b = self.block
+
+        def chunk(slab):
+            # Toroidal scan on the (B+2k, B+2k) slab: the wrap only ever
+            # corrupts the outermost halo cells (cut edges), whose garbage
+            # front moves one cell per step — with steps <= k the B×B
+            # interior slice is exact.  The per-block changed flag rides
+            # the same fused pass, so the host never compares cells.
+            out, _ = jax.lax.scan(
+                lambda s, _: (stencil_step(s, rule), None),
+                slab, None, length=steps,
+            )
+            interior = out[steps : steps + b, steps : steps + b]
+            changed = jnp.any(interior != slab[steps : steps + b, steps : steps + b])
+            return interior, changed
+
+        fn = self._fns[("block", steps)] = jax.jit(jax.vmap(chunk))
+        return fn
+
+    def _dense_fn(self, steps: int):
+        if ("dense", steps) in self._fns:
+            return self._fns[("dense", steps)]
+        import jax
+
+        from akka_game_of_life_tpu.ops.stencil import multi_step
+
+        rule = self.rule
+        b = self.block
+        nbh, nbw = self.grid
+
+        @jax.jit
+        def run(board):
+            out = multi_step(board, rule, steps)
+            # The changed-block bitmap in the SAME fused device pass as the
+            # step — a host-side O(area) compare per chunk would cost ~12%
+            # of a boiling chunk (measured at 8192²); fused, the gate's
+            # dense-path overhead stays within the <=5% budget.
+            diff = out != board
+            bitmap = diff.reshape(nbh, b, nbw, b).any(axis=(1, 3))
+            return out, bitmap
+
+        self._fns[("dense", steps)] = run
+        return run
+
+    def _dense_plain_fn(self, steps: int):
+        if ("plain", steps) not in self._fns:
+            from akka_game_of_life_tpu.ops.stencil import multi_step_fn
+
+            self._fns[("plain", steps)] = multi_step_fn(self.rule, steps)
+        return self._fns[("plain", steps)]
+
+    # -- the chunk ------------------------------------------------------------
+
+    def step(self, board: np.ndarray, steps: int) -> np.ndarray:
+        if steps < 1:
+            return board
+        if steps > self.block:
+            raise ValueError(
+                f"chunk of {steps} steps exceeds the {self.block}-cell "
+                f"gating block: the one-ring dilation would miss influence "
+                f"(use steps_per_call <= sparse_block)"
+            )
+        board = np.asarray(board, dtype=np.uint8)
+        if board.shape != self.shape:
+            raise ValueError(f"board {board.shape} != stepper {self.shape}")
+        owned = self._last_out is not None and board is self._last_out
+        if not owned:
+            # Unknown provenance (first chunk, restore, replay): everything
+            # is presumed active — the gate can only ever skip work it has
+            # proven dead — and the board is not ours to mutate.
+            self._dense_streak = 0
+            active = np.ones(self.grid, dtype=bool)
+        elif self._changed is None:
+            # The previous dense chunk skipped its bitmap (hysteresis):
+            # assume everything active.
+            active = np.ones(self.grid, dtype=bool)
+        else:
+            active = dilate3x3(self._changed)
+        n_active = int(active.sum())
+        self.last_active_blocks = n_active
+        if n_active > self.threshold * self.total_blocks:
+            self._dense_streak += 1
+            # Odd streaks (the first dense chunk included) compute the
+            # bitmap, so a dilute board transitions to the sparse path
+            # immediately; even streaks skip it — a boiling board pays the
+            # fused diff every OTHER chunk, not every chunk.
+            out = self._dense_step(
+                board, steps, with_bitmap=self._dense_streak % 2 == 1
+            )
+        else:
+            self._dense_streak = 0
+            # In place only when the owned board is also writable: a dense
+            # fallback chunk hands back a read-only zero-copy view of the
+            # device result (copying every boiling chunk would be pure
+            # overhead), so the first sparse chunk after one pays a single
+            # transition copy and owns writable memory from then on.
+            out = self._sparse_step(
+                board, steps, active,
+                inplace=owned and bool(board.flags.writeable),
+            )
+        self._last_out = out
+        return out
+
+    def _dense_step(
+        self, board: np.ndarray, steps: int, with_bitmap: bool = True
+    ) -> np.ndarray:
+        # asarray on purpose: the jit result comes back as a read-only
+        # zero-copy view, and copying it every boiling chunk would be the
+        # exact O(area) tax the threshold exists to avoid — the sparse
+        # path checks writability and pays one transition copy instead.
+        if with_bitmap:
+            out, bitmap = self._dense_fn(steps)(board)
+            self._changed = np.asarray(bitmap)
+        else:
+            out = self._dense_plain_fn(steps)(board)
+            self._changed = None
+        out = np.asarray(out, dtype=np.uint8)
+        self.last_stepped_blocks = self.total_blocks
+        self.dense_chunks += 1
+        return out
+
+    def _sparse_step(
+        self, board: np.ndarray, steps: int, active: np.ndarray,
+        inplace: bool = False,
+    ) -> np.ndarray:
+        b, k = self.block, steps
+        h, w = self.shape
+        idx = np.argwhere(active)
+        self.last_stepped_blocks = len(idx)
+        self.sparse_chunks += 1
+        if len(idx) == 0:
+            # Provably a fixed point: nothing changed last chunk anywhere.
+            self._changed = active
+            return board
+        # Gather each active block with its k-cell toroidal halo.  Two
+        # mod-indexed takes per block keep the copies O(active area) — a
+        # wrap-pad of the whole board would be O(area) and defeat the point.
+        # Every slab is a COPY made before any write below, so the in-place
+        # scatter cannot feed one block's new state into another's input.
+        rows = (idx[:, 0, None] * b + np.arange(-k, b + k)[None, :]) % h
+        cols = (idx[:, 1, None] * b + np.arange(-k, b + k)[None, :]) % w
+        slabs = board[rows[:, :, None], cols[:, None, :]]
+        # Quantize the batch dim to a power of two so activity churn reuses
+        # O(log blocks) compiled programs; the padding rows recompute block
+        # 0 and are dropped on scatter.
+        n = len(idx)
+        pad = next_pow2(n) - n
+        if pad:
+            slabs = np.concatenate([slabs, slabs[:1].repeat(pad, axis=0)])
+        outs, flags = self._block_fn(k)(slabs)
+        outs = np.asarray(outs, dtype=np.uint8)[:n]
+        flags = np.asarray(flags)[:n]
+        # In place when we own the board (see the class docstring) — the
+        # O(area) defensive copy would otherwise dominate dilute chunks.
+        out = board if inplace else board.copy()
+        changed = np.zeros(self.grid, dtype=bool)
+        for i, (by, bx) in enumerate(idx):
+            if not flags[i]:
+                continue  # device-computed: this block did not change
+            y0, x0 = by * b, bx * b
+            out[y0 : y0 + b, x0 : x0 + b] = outs[i]
+            changed[by, bx] = True
+        self._changed = changed
+        return out

@@ -57,7 +57,11 @@ from akka_game_of_life_tpu.obs.tracing import get_tracer
 from akka_game_of_life_tpu.ops.npkernel import step_padded_np
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 from akka_game_of_life_tpu.runtime import protocol as P
-from akka_game_of_life_tpu.runtime.boundary import BoundaryStore, Halo
+from akka_game_of_life_tpu.runtime.boundary import (
+    BoundaryStore,
+    Halo,
+    halos_equal,
+)
 from akka_game_of_life_tpu.runtime.netchaos import (
     ChaosChannel,
     CircuitBreaker,
@@ -95,6 +99,25 @@ class _Tile:
         # (an OWNERS rewiring that drops the tile) or MIGRATE_ABORT never
         # arrives, the retry loop unfreezes and resumes at expiry.
         self.frozen_until = 0.0
+        # Quiescence tier (sparse_cluster): the last up-to-two chunk inputs
+        # as (state, halo, chunk_len) — references, never copies (compute
+        # always allocates a new array, so old ones stay valid).  A chunk
+        # whose (state, halo, len) matches inputs[0] is a fixed point
+        # (period 1); matching inputs[1] is period 2 — either way its
+        # output is already known and the compute is skipped.
+        self.inputs: Deque[Tuple[np.ndarray, object, int]] = deque(maxlen=2)
+        # The last two published (Ring, epoch) pairs, for the O(1)-byte
+        # "same-ring" markers a skipped chunk publishes instead of payload.
+        self.last_ring: Optional[Tuple[object, int]] = None
+        self.prev_ring: Optional[Tuple[object, int]] = None
+        self.q_period = 0  # 0 = active; 1/2 = quiescent at that period
+        self.q_skipped = 0  # chunks skipped since the last PROGRESS ping
+        # Adaptive backoff for the O(tile) quiescence probes: an interior-
+        # active tile behind a static halo doubles its wait (capped) after
+        # each failed state compare, so the gate's detection cost amortizes
+        # toward zero on tiles that refuse to quiesce.
+        self.q_probe_wait = 0
+        self.q_probe_backoff = 0
 
 
 # VMEM row block for the cluster's Mosaic chunk sweeps (the measured-best
@@ -625,6 +648,11 @@ class BackendWorker:
         # fingerprint lanes ride the PROGRESS ping — O(tiles) bytes for the
         # frontend to certify cluster state, no board assembly anywhere.
         self.obs_digest = False
+        # Quiescence tier (cluster config, shipped in WELCOME): skip the
+        # step compute / ring payload / per-chunk PROGRESS ping of tiles
+        # whose chunk input (state + halo) repeats (period 1 or 2).  Actor
+        # engines are stateful and never skip regardless.
+        self.sparse_cluster = False
         # Decorrelated-jitter draws; reseeded per worker name in connect()
         # so a seeded cluster run's retry timing is reproducible per node.
         self._retry_rng = random.Random(f"retry:{name}")
@@ -671,6 +699,14 @@ class BackendWorker:
             ("peer",),
         )
         self._m_queue_drops = reg.counter("gol_peer_send_queue_drops_total")
+        # Quiescence-tier accounting: chunks this worker skipped outright,
+        # O(1)-byte same-ring markers published in place of ring payloads,
+        # and markers a receiver could not resolve (pruned ref — the
+        # dependent pull re-asks and the real ring is served, so a miss is
+        # latency, never corruption).
+        self._m_skipped_chunks = reg.counter("gol_tile_chunks_skipped_total")
+        self._m_same_markers = reg.counter("gol_ring_same_markers_total")
+        self._m_same_misses = reg.counter("gol_ring_same_miss_total")
         self.breaker = CircuitBreaker(
             failures=breaker_failures,
             cooldown_s=breaker_cooldown_s,
@@ -789,6 +825,8 @@ class BackendWorker:
             self.ring_queue_depth = max(1, int(welcome["ring_queue_depth"]))
         if "obs_digest" in welcome:
             self.obs_digest = bool(welcome["obs_digest"])
+        if "sparse_cluster" in welcome:
+            self.sparse_cluster = bool(welcome["sparse_cluster"])
         self._retry_rng = random.Random(f"retry:{self.name}")
         self.breaker.node = self.name or "backend"
         if isinstance(self.channel, ChaosChannel):
@@ -942,11 +980,22 @@ class BackendWorker:
         elif kind == P.PEER_RING:
             self._m_receives.inc()
             if self.store is not None:
-                ring = (
-                    decode_ring(msg["ring"])
-                    if "ring" in msg
-                    else _ring_of_msg(msg)
-                )
+                if "same_as" in msg:
+                    # Quiescent peer: the ring repeats the one it published
+                    # at same_as — resolve from the local store, zero
+                    # payload bytes.  A miss (ref pruned here) is dropped;
+                    # the dependent pull's retry re-asks and the owner
+                    # serves the real ring from its own store.
+                    ring = self.store.ring_at(
+                        tuple(msg["tile"]), int(msg["same_as"])
+                    )
+                    if ring is None:
+                        self._m_same_misses.inc()
+                        return
+                elif "ring" in msg:
+                    ring = decode_ring(msg["ring"])
+                else:
+                    ring = _ring_of_msg(msg)
                 # push_ring fires queued local pull callbacks (_apply_halo),
                 # so the span also covers any tile chunks this ring unblocks.
                 with self.tracer.span(
@@ -966,11 +1015,24 @@ class BackendWorker:
             # steps (push_rings fires callbacks after the last store), so
             # dependent tiles step back-to-back and their outbound rings
             # coalesce in turn.  A malformed entry raises ValueError —
-            # the serve loop drops the peer connection, loudly.
-            items = [
-                (tuple(e["tile"]), int(e["epoch"]), decode_ring(e["ring"]))
-                for e in entries
-            ]
+            # the serve loop drops the peer connection, loudly.  Quiescence
+            # markers ("same_as") resolve against the local store; an
+            # unresolvable one is dropped (miss counted) and recovered by
+            # the dependent pull's re-ask.
+            items = []
+            for e in entries:
+                if "same_as" in e:
+                    ring = self.store.ring_at(
+                        tuple(e["tile"]), int(e["same_as"])
+                    )
+                    if ring is None:
+                        self._m_same_misses.inc()
+                        continue
+                else:
+                    ring = decode_ring(e["ring"])
+                items.append((tuple(e["tile"]), int(e["epoch"]), ring))
+            if not items:
+                return
             with self.tracer.span(
                 "halo.recv", parent=self._trace_ctx,
                 node=self.name or "backend", rings=len(items),
@@ -1645,11 +1707,78 @@ class BackendWorker:
         k = self.exchange_width
         return min(k, self.final_epoch - epoch) if self.final_epoch else k
 
+    def _quiescent_period_locked(self, tile: _Tile, halo: Halo, c: int) -> int:
+        """0 (active) or the period (1/2) at which the chunk about to run
+        repeats a recorded input.  Determinism is the whole proof: the
+        chunk output is a pure function of (state, halo, chunk length), so
+        an input seen before has an output already in hand.  Halo equality
+        is checked FIRST (O(perimeter)) so active tiles — whose boundary
+        almost surely moved — never pay the O(tile) state compare.  Caller
+        holds the lock."""
+        if not self.sparse_cluster or self.engine in ("actor", "actor-native"):
+            # Actor engines are stateful (per-cell histories advance with
+            # every step); skipping their drive would desynchronize them.
+            return 0
+        ins = tile.inputs
+        p1 = (
+            len(ins) >= 1
+            and tile.last_ring is not None
+            and c == ins[0][2]
+            and halos_equal(halo, ins[0][1])
+        )
+        p2 = (
+            len(ins) >= 2
+            and tile.prev_ring is not None
+            and c == ins[1][2]
+            and halos_equal(halo, ins[1][1])
+        )
+        if not (p1 or p2):
+            # The boundary moved: the common active case, and free — no
+            # state compare, and any probe backoff is moot.
+            tile.q_probe_wait = 0
+            return 0
+        # Identity fast paths: a tile already quiescent holds the SAME
+        # array object its matching input recorded, so steady-state skips
+        # cost O(perimeter) only.
+        if p1 and tile.arr is ins[0][0]:
+            return 1
+        if p2 and tile.arr is ins[1][0]:
+            return 2
+        # The O(tile) probes, under adaptive backoff: a tile whose halo is
+        # static but whose INTERIOR is active would otherwise pay up to two
+        # full memcmps per chunk — exactly the dilute pattern this tier
+        # targets.  A failed probe doubles the wait (capped); the only cost
+        # of waiting is entering quiescence a few chunks late.
+        if tile.q_probe_wait > 0:
+            tile.q_probe_wait -= 1
+            return 0
+        if p1 and np.array_equal(tile.arr, ins[0][0]):
+            tile.q_probe_backoff = 0
+            return 1
+        if p2 and np.array_equal(tile.arr, ins[1][0]):
+            tile.q_probe_backoff = 0
+            return 2
+        tile.q_probe_backoff = min(8, max(1, 2 * tile.q_probe_backoff))
+        tile.q_probe_wait = tile.q_probe_backoff
+        return 0
+
     def _step_tile(self, tid: TileId, epoch: int, halo: Halo) -> bool:
         """One chunk (1..exchange_width epochs) of one tile.  Compute happens
         under the lock; ring and state sends happen after releasing it so two
         workers never hold their locks while writing into each other's
-        sockets."""
+        sockets.
+
+        Quiescence tier (sparse_cluster): a chunk whose (state, halo, len)
+        input matches the previous chunk's is a fixed point — its output IS
+        the current state; one matching the chunk before that is period-2 —
+        its output IS the previous state.  Either way the compute is
+        skipped, the ring publish collapses to an O(1)-byte same-ring
+        marker, and the PROGRESS ping is suppressed except at cadence/
+        digest-due epochs and on the quiesce transition itself.  Epochs
+        still advance through the normal epoch-tagged protocol, so a
+        changed neighboring ring simply fails the halo-equality test on
+        the next chunk and the tile computes again — the wake needs no
+        message of its own and can never run a wrong-state epoch."""
         with self._lock:
             tile = self.tiles.get(tid)
             c = self._chunk_for(epoch)
@@ -1669,29 +1798,88 @@ class BackendWorker:
                 if tile is not None and epoch == tile.epoch:
                     tile.awaiting_since = None  # paused/short target: clear latch
                 return False
-            padded = halo.pad(tile.arr)
-            with self.tracer.span(
-                "backend.step", parent=self._trace_ctx,
-                node=self.name or "backend", tile=str(tid), epoch=epoch, chunk=c,
-            ):
-                if self.engine in ("actor", "actor-native"):
-                    # Actor engines exchange per-epoch (the frontend rejects
-                    # them when exchange_width > 1), so c == 1 here.
-                    tile.arr = self._actor_engines[tid].step(padded)
-                else:
-                    tile.arr = self._step_chunk(padded, c, self.exchange_width)
+            period = self._quiescent_period_locked(tile, halo, c)
+            if period:
+                prev_state = tile.arr
+                new_arr = tile.arr if period == 1 else tile.inputs[0][0]
+                reuse = tile.last_ring if period == 1 else tile.prev_ring
+                ring, same_as = reuse
+                entered = tile.q_period == 0
+                tile.q_period = period
+                tile.q_skipped += 1
+                tile.arr = new_arr
+            else:
+                prev_state = tile.arr
+                padded = halo.pad(prev_state)
+                with self.tracer.span(
+                    "backend.step", parent=self._trace_ctx,
+                    node=self.name or "backend", tile=str(tid), epoch=epoch,
+                    chunk=c,
+                ):
+                    if self.engine in ("actor", "actor-native"):
+                        # Actor engines exchange per-epoch (the frontend
+                        # rejects them when exchange_width > 1), so c == 1.
+                        tile.arr = self._actor_engines[tid].step(padded)
+                    else:
+                        tile.arr = self._step_chunk(
+                            padded, c, self.exchange_width
+                        )
+                tile.q_period = 0
+                ring, same_as = Ring.of(tile.arr, self.exchange_width), None
+            if self.sparse_cluster:
+                # Record the chunk input for the next quiescence test —
+                # references only; compute allocated a fresh array, so the
+                # old ones stay valid.  Off, nothing is retained (holding
+                # two extra boards per tile is the feature's cost, not a
+                # default tax).
+                tile.inputs.appendleft((prev_state, halo, c))
             tile.epoch += c
             tile.awaiting_since = None
             tile.retries = 0
             tile.retry_delay = self.retry_s  # backoff resets on success
+            # Ring history rotation HERE, under the same lock that orders
+            # chunk completion: rotating in _publish_ring (outside the
+            # lock) would let two threads publishing consecutive chunks
+            # swap last/prev — and a later period-2 skip would then marker
+            # the wrong phase's ring.
+            tile.prev_ring = tile.last_ring
+            tile.last_ring = (ring, tile.epoch)
             # Snapshot (arr, epoch) while still holding the lock: the sends
             # below run unlocked, and a concurrent kick may step the tile
             # again in between — publishing from the live tile there would
             # pair one chunk's data with another's epoch label.
             arr, epoch_now = tile.arr, tile.epoch
-        self._publish_ring(tid, arr, epoch_now)
+        if period:
+            self._m_skipped_chunks.inc()
+            if entered:
+                with self.tracer.span(
+                    "tile.quiesce", parent=self._trace_ctx,
+                    node=self.name or "backend", tile=str(tid),
+                    epoch=epoch_now, period=period,
+                ):
+                    pass
+            self._publish_ring(
+                tid, arr, epoch_now, ring=ring, same_as=same_as,
+                ping=entered or self._quiescent_ping_due(epoch_now),
+            )
+        else:
+            self._publish_ring(tid, arr, epoch_now, ring=ring)
         self._report_state(tid, arr, epoch_now)
         return True
+
+    def _quiescent_ping_due(self, epoch: int) -> bool:
+        """Epochs at which even a quiescent tile must ping: every cadence
+        the frontend keys bookkeeping to (checkpoint completion gates,
+        prune floor advance, render/metrics lag accounting, the final
+        epoch) plus digest-due certificates."""
+        if epoch == self.final_epoch:
+            return True
+        for every in (
+            self.checkpoint_every, self.metrics_every, self.render_every
+        ):
+            if every and epoch % every == 0:
+                return True
+        return self._digest_due(epoch)
 
     def _owner_rings_locked(self, tid: TileId) -> Tuple[List[str], Dict[str, set]]:
         """For one publishing tile: the distinct remote owners of its 8
@@ -1719,7 +1907,16 @@ class BackendWorker:
         by_tile, expect = self._owner_map
         return by_tile.get(tid, []), expect
 
-    def _publish_ring(self, tid: TileId, arr: np.ndarray, epoch: int) -> None:
+    def _publish_ring(
+        self,
+        tid: TileId,
+        arr: np.ndarray,
+        epoch: int,
+        *,
+        ring: Optional[Ring] = None,
+        same_as: Optional[int] = None,
+        ping: bool = True,
+    ) -> None:
         """Store our ring locally (answers our own and co-located pulls) and
         queue it for each distinct remote owner among the tile's 8 neighbors
         — the direct neighbor-to-neighbor data plane.  Takes an (arr, epoch)
@@ -1729,14 +1926,37 @@ class BackendWorker:
         rules when ring_pack is on), the owner set and payload accounting
         are computed once per publish, and the per-owner loop only enqueues
         onto async sender lanes — no socket work, no re-encoding, no
-        blocking on a slow peer."""
-        ring = Ring.of(arr, self.exchange_width)
+        blocking on a slow peer.
+
+        Quiescent publish (``same_as`` set): ``ring`` is the reused ring
+        object published at epoch ``same_as``, re-stored locally (a shared
+        reference, no copy) while remote owners receive an O(1)-byte
+        ``same_as`` marker instead of payload — the receiver resolves it
+        against its own store.  ``ping=False`` additionally suppresses the
+        per-chunk PROGRESS ping (cadence/digest epochs keep it).
+
+        ``ring=None`` is the deploy-time announce: the tile is not yet
+        being driven (single-threaded for it), so the ring is computed —
+        and the last/prev ring history rotated — right here.  Step-loop
+        publishes instead pass the ring rotated inside ``_step_tile``'s
+        locked section, where chunk completion order is serialized; a
+        rotation here would race a concurrent publish of the next chunk
+        and could invert last/prev under a later period-2 skip."""
+        marker = same_as is not None
+        if ring is None:
+            ring = Ring.of(arr, self.exchange_width)
+            with self._lock:
+                tile = self.tiles.get(tid)
+                if tile is not None:
+                    tile.prev_ring = tile.last_ring
+                    tile.last_ring = (ring, epoch)
         if self.store is not None:
             self.store.push_ring(tid, epoch, ring)
         with self._lock:
             remote_owners, expect = self._owner_rings_locked(tid)
         if not remote_owners:
-            self._progress_ping(tid, epoch, arr)
+            if ping:
+                self._progress_ping(tid, epoch, arr)
             return
         pack = self.ring_pack and self.rule is not None and self.rule.is_binary
         # Wire-cost accounting (the Casper data-movement signal at the
@@ -1745,7 +1965,12 @@ class BackendWorker:
         # unbatched baseline ships the legacy per-field message, so its
         # wire bytes ARE the dense bytes and nothing needs encoding — the
         # A/B baseline must not pay a concatenate+copy it never sends.
-        if pack or self.ring_batch:
+        # A quiescence marker ships no payload at all: dense bytes still
+        # count (the logical exchange happened), wire bytes count zero.
+        if marker:
+            enc, wire = None, 0
+            self._m_same_markers.inc(len(remote_owners))
+        elif pack or self.ring_batch:
             enc = encode_ring(ring, pack)
             wire = ring_entry_nbytes(enc)
         else:
@@ -1758,7 +1983,11 @@ class BackendWorker:
             peers=len(remote_owners), bytes=wire * len(remote_owners),
         ):
             if self.ring_batch:
-                entry = {"tile": list(tid), "epoch": epoch, "ring": enc}
+                entry = (
+                    {"tile": list(tid), "epoch": epoch, "same_as": same_as}
+                    if marker
+                    else {"tile": list(tid), "epoch": epoch, "ring": enc}
+                )
                 for owner in remote_owners:
                     s = self._sender(owner)
                     if s is not None:  # departed between snapshot and here
@@ -1766,15 +1995,22 @@ class BackendWorker:
             else:
                 # Frame-per-ring mode (the reference's wire shape, kept for
                 # A/B measurement): still async, still encoded at most once.
-                msg = (
-                    {"type": P.PEER_RING, "tile": list(tid), "epoch": epoch,
-                     "ring": enc}
-                    if pack
-                    else _ring_msg(tid, epoch, ring)
-                )
+                if marker:
+                    msg = {
+                        "type": P.PEER_RING, "tile": list(tid),
+                        "epoch": epoch, "same_as": same_as,
+                    }
+                elif pack:
+                    msg = {
+                        "type": P.PEER_RING, "tile": list(tid),
+                        "epoch": epoch, "ring": enc,
+                    }
+                else:
+                    msg = _ring_msg(tid, epoch, ring)
                 for owner in remote_owners:
                     self._send_peer(owner, msg)
-        self._progress_ping(tid, epoch, arr)
+        if ping:
+            self._progress_ping(tid, epoch, arr)
 
     def _digest_due(self, epoch: int) -> bool:
         """Epochs whose PROGRESS ping carries the tile's digest lanes:
@@ -1795,8 +2031,22 @@ class BackendWorker:
         prune floor, stuck detection, and lag accounting.  At digest-due
         epochs it additionally carries the tile's 64-bit fingerprint lanes
         (~8 bytes — the mergeable per-tile form of the digest plane), so
-        the frontend certifies whole-cluster state in O(tiles) bytes."""
+        the frontend certifies whole-cluster state in O(tiles) bytes.
+
+        Quiescence tier: the ping additionally reports the tile's live
+        period (``q``) and the chunks skipped since the last ping
+        (``skipped``) — the frontend folds the deltas into
+        ``gol_tiles_skipped_total`` and tracks the quiescent set for
+        ``/healthz``."""
         msg = {"type": P.PROGRESS, "tile": list(tid), "epoch": epoch}
+        if self.sparse_cluster:
+            with self._lock:
+                tile = self.tiles.get(tid)
+                if tile is not None:
+                    msg["q"] = tile.q_period
+                    if tile.q_skipped:
+                        msg["skipped"] = tile.q_skipped
+                        tile.q_skipped = 0
         if arr is not None and self._digest_due(epoch):
             from akka_game_of_life_tpu.ops import digest as odigest
 

@@ -59,6 +59,21 @@ class Halo:
         return out
 
 
+def halos_equal(a: Optional[Halo], b: Optional[Halo]) -> bool:
+    """Exact equality of two assembled halos — the quiescence tier's
+    neighborhood-unchanged test (O(perimeter); cheap enough to run every
+    chunk, and the first thing checked so active tiles never pay an
+    O(tile) state compare)."""
+    if a is None or b is None:
+        return False
+    return (
+        np.array_equal(a.top, b.top)
+        and np.array_equal(a.bottom, b.bottom)
+        and np.array_equal(a.left, b.left)
+        and np.array_equal(a.right, b.right)
+    )
+
+
 class BoundaryStore:
     """Thread-safe ring store + halo assembler + pending-pull queue."""
 
@@ -148,6 +163,14 @@ class BoundaryStore:
                     if (ntile, epoch) not in self._rings
                 }
             )
+
+    def ring_at(self, tile: TileId, epoch: int):
+        """The stored ring of ``tile`` at exactly ``epoch``, or None.  The
+        resolution target of a quiescent peer's "same-ring" marker: the
+        marker names the epoch whose ring bytes it repeats, and this lookup
+        turns it back into the Ring without any wire payload."""
+        with self._lock:
+            return self._rings.get((tile, epoch))
 
     def ring_count(self) -> int:
         with self._lock:

@@ -367,6 +367,35 @@ class SimulationConfig:
     # bucket into a handful of compiled programs; boards beyond the
     # largest class are refused with 400.
     serve_size_classes: str = "32,64,128,256"
+    # -- activity-gated sparse stepping (docs/OPERATIONS.md "Activity-gated
+    # sparse stepping").  Two independent tiers that convert throughput from
+    # O(area) toward O(activity) on dilute boards; every field maps to a
+    # --sparse-* flag (tools/check_sparse_config.py lint-enforces the
+    # bijection).
+    # sparse_cluster: cluster tier — a tile whose state AND assembled halo
+    # are unchanged across a chunk (or match the chunk two back: cheap
+    # period-2 detection) is provably quiescent; its worker skips the step
+    # compute, publishes an O(1)-byte "same-ring" marker instead of ring
+    # payloads, and suppresses per-chunk PROGRESS pings (cadence pings and
+    # digest-due certificates still flow).  A changed neighboring ring wakes
+    # the tile before its next chunk — zero wrong-state epochs, because the
+    # epoch-tagged halo protocol itself is the wake signal.  Frontend-owned
+    # policy, shipped to every worker in WELCOME like the ring policy.
+    sparse_cluster: bool = False
+    # sparse_kernel: intra-tile tier (standalone runs) — a coarse activity
+    # bitmap (one bit per sparse_block² cell block, recomputed from each
+    # chunk's output) gates which blocks the stepper actually advances: a
+    # block steps only if it or a block-ring neighbor changed last chunk
+    # (exact for radius-1 rules with steps_per_call <= sparse_block).
+    sparse_kernel: bool = False
+    # Gating block side in cells (clamped to the largest common divisor of
+    # the board sides <= this, so blocks always tile the torus exactly).
+    sparse_block: int = 128
+    # Dense escape hatch: once the dilated active fraction exceeds this,
+    # the chunk steps the whole board through the ordinary dense kernel and
+    # only the changed-block bitmap is recomputed — boiling boards pay one
+    # O(area) compare per chunk, never a per-block host loop.
+    sparse_threshold: float = 0.5
     # Optional deadline on cluster channel sends (seconds; 0 = block
     # forever, the classic TCP behavior).  With a deadline, a send into a
     # wedged peer's full socket buffer raises after this long instead of
@@ -548,6 +577,14 @@ class SimulationConfig:
                 f"evict)"
             )
         parse_size_classes(self.serve_size_classes)
+        if self.sparse_block < 1:
+            raise ValueError(
+                f"sparse_block={self.sparse_block} must be >= 1"
+            )
+        if not 0.0 <= self.sparse_threshold <= 1.0:
+            raise ValueError(
+                f"sparse_threshold={self.sparse_threshold} must be in [0, 1]"
+            )
         if self.exchange_width < 1:
             raise ValueError(f"exchange_width must be >= 1, got {self.exchange_width}")
         if self.exchange_width > 1:

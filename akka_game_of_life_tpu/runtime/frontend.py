@@ -130,6 +130,12 @@ def _validate_msg(msg) -> None:
         raise MalformedMessage(f"{kind} seq {msg.get('seq')!r} is not an int")
     if "state" in required and not isinstance(msg["state"], dict):
         raise MalformedMessage(f"{kind} state is not a tile payload dict")
+    if kind == P.PROGRESS:
+        for field in ("q", "skipped"):
+            if field in msg and not isinstance(msg[field], int):
+                raise MalformedMessage(
+                    f"progress {field} {msg[field]!r} is not an int"
+                )
     if kind in (P.PROGRESS, P.MIGRATE_STATE) and "digest" in msg:
         d = msg["digest"]
         if not (
@@ -216,6 +222,10 @@ class Frontend:
         self._m_degraded_entries = self.metrics.counter(
             "gol_degraded_entries_total"
         )
+        self._m_tiles_skipped = self.metrics.counter(
+            "gol_tiles_skipped_total"
+        )
+        self._m_tiles_quiescent = self.metrics.gauge("gol_tiles_quiescent")
         self._m_digest_checks = self.metrics.counter("gol_digest_checks_total")
         self._m_digest_mismatches = self.metrics.counter(
             "gol_digest_mismatches_total"
@@ -328,6 +338,13 @@ class Frontend:
         # Per-tile progress clock (last RING received) — the evidence a
         # GATHER_FAILED escalation is judged against.
         self._last_ring_time: Dict[TileId, float] = {}
+        # Quiescence tier (sparse_cluster): tiles currently reporting
+        # themselves quiescent (tile -> period).  Exempted — while their
+        # pings stay fresh — from the stuck-neighbor redeploy and the
+        # degraded-mode stranded count (silence at cadence granularity is
+        # the feature, not a fault), and surfaced in /healthz.  Cleared on
+        # any ownership change; the new owner re-detects from scratch.
+        self.quiescent: Dict[TileId, int] = {}
         # Checkpoint cadence workers report at; falls back to an in-memory
         # cadence so ring pruning and recovery work without a durable store.
         self._ckpt_cadence = config.checkpoint_every or _MEMORY_CKPT_EVERY
@@ -410,6 +427,7 @@ class Frontend:
                 },
                 "draining": sorted(m.name for m in alive if m.draining),
                 "migrations_inflight": len(self.rebalancer.inflight),
+                "tiles_quiescent": len(self.quiescent),
                 "epoch_floor": min(self.tile_epochs.values(), default=0),
                 "target_epoch": self.target_epoch,
                 "done": self.done.is_set(),
@@ -910,6 +928,10 @@ class Frontend:
                     # Digest plane: workers attach per-tile fingerprint
                     # lanes to PROGRESS at digest-due epochs when on.
                     "obs_digest": self.config.obs_digest,
+                    # Quiescence tier (activity-gated sparse stepping):
+                    # workers skip provably-repeating chunks and publish
+                    # O(1)-byte same-ring markers when on.
+                    "sparse_cluster": self.config.sparse_cluster,
                 }
             )
             engine = hello.get("engine", "?")
@@ -1008,6 +1030,18 @@ class Frontend:
                     return  # stale ping from an evicted owner
                 self.tile_epochs[tile] = max(self.tile_epochs.get(tile, 0), epoch)
                 self._last_ring_time[tile] = time.monotonic()
+                q = msg.get("q")
+                if isinstance(q, int):
+                    if q > 0:
+                        self.quiescent[tile] = q
+                    else:
+                        self.quiescent.pop(tile, None)
+                    self._m_tiles_quiescent.set(len(self.quiescent))
+                skipped = msg.get("skipped")
+                if isinstance(skipped, int) and skipped > 0:
+                    # Worker-reported delta of chunks it skipped outright —
+                    # the cluster tier's headline counter.
+                    self._m_tiles_skipped.inc(skipped)
                 if "digest" in msg:
                     self._note_tile_digest(tile, epoch, msg["digest"])
         elif kind == P.TILE_STATE:
@@ -1200,6 +1234,7 @@ class Frontend:
                 for ntile in sorted(set(self.layout.neighbors(tile).values()))
                 if ntile != tile
                 and ntile not in self.rebalancer.inflight  # frozen on purpose
+                and not self._quiescent_fresh(ntile, now)  # silent on purpose
                 and self.tile_epochs.get(ntile, 0) < epoch
                 and now - self._last_ring_time.get(ntile, now)
                 > self.config.stuck_timeout_s
@@ -1354,6 +1389,8 @@ class Frontend:
                     dest.tiles.append(tile)
                 self.tile_epochs[tile] = epoch
                 self._last_ring_time[tile] = now
+                if self.quiescent.pop(tile, None) is not None:
+                    self._m_tiles_quiescent.set(len(self.quiescent))
                 self._m_migrations.inc()
                 self._m_migration_seconds.observe(now - mig.started)
                 if mig.span is not None:
@@ -1579,6 +1616,19 @@ class Frontend:
                 if m is not None and m.alive:
                     self._send_deploy(m, batch)
 
+    def _quiescent_fresh(self, tile: TileId, now: float) -> bool:
+        """Is ``tile`` self-reported quiescent AND recently heard from?
+        Quiescent tiles ping only at cadence epochs, so they look silent to
+        the stuck/degraded detectors — but the exemption is freshness-bound
+        (2x stuck_timeout_s): a worker that wedges after marking its tiles
+        quiescent loses the exemption and normal recovery takes over.
+        Caller holds the lock."""
+        return (
+            tile in self.quiescent
+            and now - self._last_ring_time.get(tile, 0.0)
+            <= 2.0 * self.config.stuck_timeout_s
+        )
+
     def _assign_tile(
         self,
         tile: TileId,
@@ -1640,6 +1690,10 @@ class Frontend:
                 epoch=self._last_ckpt[0],
             )
         self.tile_owner[tile] = member.name
+        # A re-placed tile starts with no quiescence history; the marking
+        # (and its stuck-exemption) must not survive the move.
+        if self.quiescent.pop(tile, None) is not None:
+            self._m_tiles_quiescent.set(len(self.quiescent))
         # The tile restarts at the recovery epoch: record that so the
         # ring-prune floor protects every epoch its replay will pull.
         self.tile_epochs[tile] = self._last_ckpt[0]
@@ -1768,7 +1822,8 @@ class Frontend:
             stranded = sum(
                 1
                 for t in tiles
-                if now - self._last_ring_time.get(t, now)
+                if not self._quiescent_fresh(t, now)
+                and now - self._last_ring_time.get(t, now)
                 > self.config.stuck_timeout_s
             )
             quorum = 2 * stranded >= len(tiles)

@@ -243,7 +243,13 @@ class Simulation:
 
         self._actor_board = None
         self._actor_board_cls = None
+        self._sparse = None
         if config.backend in ("actor", "actor-native"):
+            if config.sparse_kernel:
+                raise ValueError(
+                    "sparse_kernel gates the stencil kernels; the per-cell "
+                    "actor backends have no block structure to gate"
+                )
             # The per-cell actor backend (BASELINE config 1): same Simulation
             # surface, reference-architecture engine underneath — interpreted
             # ("actor") or compiled C++ ("actor-native").
@@ -276,6 +282,62 @@ class Simulation:
 
         n_dev = len(jax.devices())
         self._n_dev = n_dev
+        # Activity-gated sparse stepping (intra-tile tier, docs/OPERATIONS.md
+        # "Activity-gated sparse stepping"): a host-orchestrated block engine
+        # that advances only blocks whose neighborhood changed last chunk.
+        # Built below after kernel resolution; validated here so a bad combo
+        # fails at __init__ with the knob's name, never mid-advance.
+        if config.sparse_kernel:
+            from akka_game_of_life_tpu.ops.sparse import SparseStepper, pick_block
+
+            if self.rule.radius != 1:
+                raise ValueError(
+                    f"sparse_kernel gates radius-1 rules; {self.rule} "
+                    f"(radius {self.rule.radius}) runs dense"
+                )
+            if config.mesh_shape is not None or config.distributed:
+                raise ValueError(
+                    "sparse_kernel is a single-host engine (the gather/"
+                    "scatter runs on the host board); unset mesh_shape/"
+                    "distributed or disable sparse_kernel"
+                )
+            if config.obs_defer:
+                raise ValueError(
+                    "sparse_kernel updates its host board in place between "
+                    "chunks, so a deferred observation's handles could "
+                    "alias mutated memory; obs_defer is a device-fetch "
+                    "optimization the host engine does not need — disable "
+                    "one of the two"
+                )
+            eff_block = pick_block(
+                config.height, config.width, config.sparse_block
+            )
+            if config.steps_per_call > eff_block:
+                raise ValueError(
+                    f"steps_per_call={config.steps_per_call} exceeds the "
+                    f"effective sparse block ({eff_block} cells for "
+                    f"{config.height}x{config.width} with sparse_block="
+                    f"{config.sparse_block}): the one-ring block dilation "
+                    f"would miss influence"
+                )
+            self._sparse = SparseStepper(
+                self.rule,
+                config.shape,
+                block=config.sparse_block,
+                threshold=config.sparse_threshold,
+            )
+            self._m_sparse_active = self.metrics.gauge(
+                "gol_sparse_active_blocks"
+            )
+            self._m_sparse_stepped = self.metrics.counter(
+                "gol_sparse_blocks_stepped_total"
+            )
+            self._m_sparse_skipped = self.metrics.counter(
+                "gol_sparse_blocks_skipped_total"
+            )
+            self._m_sparse_dense = self.metrics.counter(
+                "gol_sparse_dense_chunks_total"
+            )
         # Binary-totalistic AND plane-rule pallas shard via the Mosaic
         # sweeps inside shard_map (parallel/pallas_halo.py); the LtL pallas
         # kernel has no sharded form, so explicit pallas for it pins to one
@@ -288,7 +350,19 @@ class Simulation:
             n_dev > 1 and not unsharded_pallas
         )
         self._kernel_auto = config.kernel == "auto"
-        self.kernel = self._resolve_kernel()
+        if self._sparse is not None:
+            # The gated engine owns the layout: dense uint8 on the host,
+            # active slabs jitted per chunk.  auto resolves to it; an
+            # explicit packed/pallas kernel contradicts the request.
+            if config.kernel not in ("auto", "dense"):
+                raise ValueError(
+                    f"sparse_kernel steps the dense-layout gated engine; "
+                    f"kernel={config.kernel!r} conflicts (use auto or dense)"
+                )
+            self._use_mesh = False
+            self.kernel = "dense"
+        else:
+            self.kernel = self._resolve_kernel()
         # Auto-selected pallas sizes its row block to the grid; explicit
         # pallas honors the config knob (validated in _resolve_kernel).
         self._pallas_block_rows = (
@@ -607,6 +681,12 @@ class Simulation:
     def _to_device(self, board: np.ndarray):
         if self._actor_board is not None:
             return board
+        if self._sparse is not None:
+            # The gated engine's board lives on the host (gather/scatter in
+            # numpy; only active slabs visit the device).  A board arriving
+            # here (initial, restore, replay) is one the stepper has never
+            # produced, so its gate resets to all-active automatically.
+            return np.asarray(board, dtype=np.uint8)
         if self._gen:
             return self._words_to_device(
                 bitpack_gen.pack_gen_np(np.asarray(board), self.rule.states)
@@ -657,6 +737,27 @@ class Simulation:
                 return self._actor_board.board_at_current()
 
             return _actor_advance
+        if self._sparse is not None:
+            if k not in self._steppers:
+                sp = self._sparse
+
+                def _sparse_advance(board, _k=k):
+                    dense_before = sp.dense_chunks
+                    out = sp.step(board, _k)
+                    # Gating observability after every chunk: live active
+                    # fraction plus cumulative stepped/skipped block-chunks
+                    # (the skip counter is the intra-tile win itself).
+                    self._m_sparse_active.set(sp.last_active_blocks)
+                    self._m_sparse_stepped.inc(sp.last_stepped_blocks)
+                    self._m_sparse_skipped.inc(
+                        sp.total_blocks - sp.last_stepped_blocks
+                    )
+                    if sp.dense_chunks > dense_before:
+                        self._m_sparse_dense.inc()
+                    return out
+
+                self._steppers[k] = _sparse_advance
+            return self._steppers[k]
         if k not in self._steppers:
             if self._gen:
                 if self.mesh is None:
@@ -813,14 +914,31 @@ class Simulation:
                 with self.tracer.span(
                     "sim.chunk", node=self._node, epoch=prev, chunk=chunk
                 ):
-                    with profiling.annotate_epochs("advance_chunk", self.epoch):
-                        new_board = self._stepper(chunk)(self.board)
-                    with _shield_sigint():
-                        # Atomic wrt ^C: an interrupt-checkpoint must never
-                        # see a stepped board still labeled with the
-                        # previous epoch.
-                        self.board = new_board
-                        self.epoch += chunk
+                    if self._sparse is not None:
+                        # The gated engine mutates self.board IN PLACE, so
+                        # the swap-only shield below would not be enough: an
+                        # interrupt mid-scatter would leave a half-stepped
+                        # board still labeled with the previous epoch, and
+                        # the interrupt-checkpoint would durably save that
+                        # lie.  Shield the WHOLE chunk (host-side and
+                        # milliseconds on the gated path).
+                        with _shield_sigint():
+                            with profiling.annotate_epochs(
+                                "advance_chunk", self.epoch
+                            ):
+                                self.board = self._stepper(chunk)(self.board)
+                            self.epoch += chunk
+                    else:
+                        with profiling.annotate_epochs(
+                            "advance_chunk", self.epoch
+                        ):
+                            new_board = self._stepper(chunk)(self.board)
+                        with _shield_sigint():
+                            # Atomic wrt ^C: an interrupt-checkpoint must
+                            # never see a stepped board still labeled with
+                            # the previous epoch.
+                            self.board = new_board
+                            self.epoch += chunk
                 # Host-side chunk cost (dispatch → board swap): on a
                 # synchronous backend this is the device time; under async
                 # dispatch it is the host's share of the critical path.
@@ -1244,10 +1362,17 @@ class Simulation:
                 # Replay: recompute the lost epochs (deterministic rule ⇒
                 # the trajectory is bit-identical to the pre-crash one).
                 # Reuses the steps_per_call stepper so no extra compilation
-                # beyond at most one partial chunk.
+                # beyond at most one partial chunk.  The gated engine's
+                # in-place chunks get the same interrupt shield as the main
+                # loop (a torn board must never be checkpointable).
                 chunk = min(self.config.steps_per_call, target - self.epoch)
-                self.board = self._stepper(chunk)(self.board)
-                self.epoch += chunk
+                if self._sparse is not None:
+                    with _shield_sigint():
+                        self.board = self._stepper(chunk)(self.board)
+                        self.epoch += chunk
+                else:
+                    self.board = self._stepper(chunk)(self.board)
+                    self.epoch += chunk
         finally:
             if restored_epoch is not None:
                 recover_span.set(
@@ -1299,7 +1424,12 @@ class Simulation:
         # replaces self.board/self.epoch, and jax arrays are immutable, so
         # capturing the references (not self) is what makes the overlap
         # correct — the checkpoint is of this epoch, whatever runs next.
+        # The sparse engine's host board is the one MUTABLE layout (updated
+        # in place between chunks): snapshot it by copy, or the async
+        # writer would serialize a live-mutating buffer.
         epoch, board = self.epoch, self.board
+        if self._sparse is not None:
+            board = np.array(board, copy=True)
         rulestr = self.rule.rulestring()
         self.events.emit(
             "checkpoint_requested",
@@ -1474,6 +1604,10 @@ class Simulation:
             return bitpack.unpack_np(
                 np.asarray(dist.fetch(self.board), dtype=np.uint32)
             )
+        if self._sparse is not None:
+            # The gated engine mutates its board in place between chunks;
+            # hand callers their own copy, never a live view.
+            return np.array(self.board, copy=True)
         return dist.fetch(self.board)
 
     def close(self) -> None:

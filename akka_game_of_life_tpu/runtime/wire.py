@@ -310,7 +310,8 @@ RING_BATCH_MAX_BYTES = MAX_FRAME // 4
 def split_ring_batches(
     entries: List[Dict[str, Any]], max_bytes: int = RING_BATCH_MAX_BYTES
 ) -> List[List[Dict[str, Any]]]:
-    """Split a list of batch entries (``{"tile", "epoch", "ring"}`` dicts)
+    """Split a list of batch entries (``{"tile", "epoch", "ring"}`` dicts,
+    or payload-free ``{"tile", "epoch", "same_as"}`` quiescence markers)
     into sub-lists whose payload bytes each stay under ``max_bytes`` — one
     PEER_RING_BATCH frame per sub-list.  Order is preserved; an oversize
     single entry still gets its own frame (the Channel's MAX_FRAME check is
@@ -319,7 +320,9 @@ def split_ring_batches(
     cur: List[Dict[str, Any]] = []
     cur_bytes = 0
     for entry in entries:
-        nbytes = ring_entry_nbytes(entry["ring"]) + _ENTRY_JSON_OVERHEAD
+        nbytes = (
+            ring_entry_nbytes(entry["ring"]) if "ring" in entry else 0
+        ) + _ENTRY_JSON_OVERHEAD
         if cur and cur_bytes + nbytes > max_bytes:
             frames.append(cur)
             cur, cur_bytes = [], 0

@@ -73,10 +73,11 @@ def size_class(
     return None
 
 
-def next_pow2(n: int) -> int:
-    """Smallest power of two >= n (n >= 1) — the batch/length quantizer
-    that bounds how many programs the traffic mix can compile."""
-    return 1 << max(0, int(n - 1).bit_length())
+# The canonical quantizer lives with the other gating math in ops/sparse
+# (ops must not import serve); re-exported here because it is part of this
+# module's public surface (__all__) and the sessions/tests call it as
+# sbatch.next_pow2.
+from akka_game_of_life_tpu.ops.sparse import next_pow2  # noqa: E402,F401
 
 
 def rule_operands(rule: Rule) -> Tuple[int, int, int]:

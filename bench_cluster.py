@@ -59,7 +59,7 @@ def _oracle(cfg, epochs):
 
 def _run_variant(
     *, size, epochs, workers, tiles_per_worker, exchange_width, engine,
-    ring_pack, ring_batch,
+    ring_pack, ring_batch, pattern=None, sparse_cluster=False,
 ):
     from akka_game_of_life_tpu.obs.catalog import install
     from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
@@ -71,7 +71,7 @@ def _run_variant(
         height=size, width=size, seed=0, max_epochs=epochs,
         exchange_width=exchange_width, tiles_per_worker=tiles_per_worker,
         ring_pack=ring_pack, ring_batch=ring_batch, flight_dir="",
-        obs_digest=True,
+        obs_digest=True, pattern=pattern, sparse_cluster=sparse_cluster,
     )
     registry = install(MetricsRegistry())
     t0 = time.perf_counter()
@@ -86,6 +86,8 @@ def _run_variant(
     return cfg, final, final_digest, dt, {
         # Peer data-plane frames (ring/batch frames + pull asks + hellos)
         # and the bytes that actually hit the wire, per simulated epoch.
+        "tiles_skipped": snap.get("gol_tiles_skipped_total", 0.0),
+        "same_markers": snap.get("gol_ring_same_markers_total", 0.0),
         "frames_per_epoch": snap.get("gol_peer_sends_total", 0.0) / epochs,
         "wire_bytes_per_epoch": (
             snap.get("gol_ring_packed_bytes_total", 0.0) / epochs
@@ -220,6 +222,189 @@ def bench_cluster_halo(
         raise AssertionError(
             f"{config}: digests matched but the boards differ — the digest "
             f"plane itself is broken (collision or layout bug)"
+        )
+    return summary
+
+
+def bench_cluster_sparse(
+    size: int = 1024,
+    epochs: int = 64,
+    workers: int = 2,
+    tiles_per_worker: int = 4,
+    exchange_width: int = 4,
+    engine: str = "numpy",
+    pattern: str = "glider",
+    emit=print,
+) -> dict:
+    """Dilute-universe A/B (docs/OPERATIONS.md "Activity-gated sparse
+    stepping"): the SAME seeded pattern board (a glider on an otherwise
+    dead ``size``² torus) run with ``sparse_cluster`` off then on.
+
+    Off, every tile does O(area) work per chunk; on, tiles whose state and
+    halo repeat skip their compute, publish O(1)-byte same-ring markers,
+    and suppress per-chunk pings — throughput goes from O(area) toward
+    O(activity).  Both runs certify their merged final digest against the
+    dense oracle (a gating plane that changes the simulation is not an
+    optimization), and the sparse run must actually have skipped
+    (``gol_tiles_skipped_total`` > 0) or the record raises."""
+    config = f"cluster-sparse-{size}"
+    stats = {}
+    digests = {}
+    for label, sparse in (("sparse-off", False), ("sparse-on", True)):
+        cfg, final, final_digest, dt, s = _run_variant(
+            size=size, epochs=epochs, workers=workers,
+            tiles_per_worker=tiles_per_worker,
+            exchange_width=exchange_width, engine=engine,
+            ring_pack=True, ring_batch=True,
+            pattern=pattern, sparse_cluster=sparse,
+        )
+        stats[label] = s
+        digests[label] = final_digest
+        emit(
+            json.dumps(
+                {
+                    "config": config,
+                    "metric": (
+                        f"wall-clock epochs/sec, conway {size}x{size} dilute "
+                        f"({pattern}) TCP cluster ({workers} workers x "
+                        f"{tiles_per_worker} tiles, {engine} engine, "
+                        f"exchange_width={exchange_width}, {label})"
+                    ),
+                    "value": s["cells_per_sec"] / (size * size),
+                    "unit": "epochs/sec",
+                    "vs_baseline": s["cells_per_sec"] / REFERENCE_CEILING,
+                    "cells_per_sec": s["cells_per_sec"],
+                    "tiles_skipped": s["tiles_skipped"],
+                    "same_markers": s["same_markers"],
+                    "wire_bytes_per_epoch": s["wire_bytes_per_epoch"],
+                },
+            ),
+            flush=True,
+        )
+
+    from akka_game_of_life_tpu.ops import digest as odigest
+
+    oracle = _oracle(cfg, epochs)
+    oracle_digest = odigest.value(odigest.digest_dense_np(oracle))
+    digest_ok = all(d == oracle_digest for d in digests.values())
+    speedup = (
+        stats["sparse-on"]["cells_per_sec"]
+        / stats["sparse-off"]["cells_per_sec"]
+    )
+    summary = {
+        "config": config,
+        "metric": "dilute-board sparse-on / sparse-off epochs/s speedup",
+        "value": speedup,
+        "unit": "x",
+        "vs_baseline": speedup,
+        "tiles_skipped": stats["sparse-on"]["tiles_skipped"],
+        "wire_bytes_reduction": (
+            stats["sparse-off"]["wire_bytes_per_epoch"]
+            / stats["sparse-on"]["wire_bytes_per_epoch"]
+            if stats["sparse-on"]["wire_bytes_per_epoch"]
+            else None
+        ),
+        "digest_certified": digest_ok,
+        "final_digest": odigest.format_digest(oracle_digest),
+    }
+    emit(json.dumps(summary), flush=True)
+    if not digest_ok:
+        got = {
+            k: odigest.format_digest(v) if v is not None else None
+            for k, v in digests.items()
+        }
+        raise AssertionError(
+            f"{config}: a variant's merged final digest diverged from the "
+            f"dense oracle's ({got} vs "
+            f"{odigest.format_digest(oracle_digest)}) — the quiescence "
+            f"plane is corrupting the simulation"
+        )
+    if not stats["sparse-on"]["tiles_skipped"]:
+        raise AssertionError(
+            f"{config}: sparse-on run skipped zero tile chunks — the "
+            f"quiescence tier never engaged on a dilute board"
+        )
+    return summary
+
+
+def bench_cluster_tsweep(
+    size: int = 1024,
+    epochs: int = 64,
+    workers: int = 2,
+    widths=(1, 2, 4, 8),
+    tiles_per_worker: int = 4,
+    engine: str = "numpy",
+    emit=print,
+) -> dict:
+    """Temporal-blocking T-sweep (ROADMAP item 3's standing record): the
+    same seeded cluster run at each ``exchange_width`` T — one peer
+    exchange buys T local epochs — reporting aggregate cell-updates/s per
+    T and certifying every T's merged final digest against T=1's AND the
+    dense oracle's (the Linear Acceleration Theorem legality check, made
+    executable)."""
+    from akka_game_of_life_tpu.ops import digest as odigest
+
+    config = f"cluster-tsweep-{size}"
+    rates = {}
+    digests = {}
+    cfg = None
+    for t in widths:
+        cfg, final, final_digest, dt, s = _run_variant(
+            size=size, epochs=epochs, workers=workers,
+            tiles_per_worker=tiles_per_worker, exchange_width=t,
+            engine=engine, ring_pack=True, ring_batch=True,
+        )
+        rates[t] = s["cells_per_sec"]
+        digests[t] = final_digest
+        emit(
+            json.dumps(
+                {
+                    "config": config,
+                    "metric": (
+                        f"cell-updates/sec aggregate, conway {size}x{size} "
+                        f"TCP cluster ({workers} workers x "
+                        f"{tiles_per_worker} tiles, {engine} engine, "
+                        f"exchange_width={t})"
+                    ),
+                    "value": s["cells_per_sec"],
+                    "unit": "cell-updates/sec",
+                    "vs_baseline": s["cells_per_sec"] / REFERENCE_CEILING,
+                    "exchange_width": t,
+                    "frames_per_epoch": s["frames_per_epoch"],
+                    "wire_bytes_per_epoch": s["wire_bytes_per_epoch"],
+                },
+            ),
+            flush=True,
+        )
+    oracle_digest = odigest.value(odigest.digest_dense_np(_oracle(cfg, epochs)))
+    digest_ok = all(d == oracle_digest for d in digests.values())
+    base = widths[0]
+    best = max(rates, key=rates.get)
+    summary = {
+        "config": config,
+        "metric": (
+            f"exchange-width sweep T={list(widths)}: best-T / T={base} "
+            f"throughput ratio"
+        ),
+        "value": rates[best] / rates[base],
+        "unit": "x",
+        "vs_baseline": rates[best] / rates[base],
+        "best_width": best,
+        "rates": {str(t): r for t, r in rates.items()},
+        "digest_certified": digest_ok,
+        "final_digest": odigest.format_digest(oracle_digest),
+    }
+    emit(json.dumps(summary), flush=True)
+    if not digest_ok:
+        got = {
+            str(t): odigest.format_digest(v) if v is not None else None
+            for t, v in digests.items()
+        }
+        raise AssertionError(
+            f"{config}: a width's merged final digest diverged from the "
+            f"dense oracle's ({got} vs "
+            f"{odigest.format_digest(oracle_digest)}) — temporal blocking "
+            f"is corrupting the simulation"
         )
     return summary
 
@@ -448,6 +633,22 @@ def main() -> int:
         "one scheduled partition)",
     )
     parser.add_argument(
+        "--sweep-exchange-width", default=None, metavar="T1,T2,...",
+        help="temporal-blocking T-sweep: run the same seeded cluster at "
+        "each exchange width (e.g. 1,2,4,8), digest-certified against the "
+        "dense oracle, reporting throughput per T",
+    )
+    parser.add_argument(
+        "--sparse", action="store_true",
+        help="dilute-universe drill: the same glider board with "
+        "sparse_cluster off vs on (quiescent tiles skip their chunks), "
+        "digest-certified, reporting the epochs/s speedup",
+    )
+    parser.add_argument(
+        "--pattern", default="glider",
+        help="seed pattern for the --sparse dilute board (default glider)",
+    )
+    parser.add_argument(
         "--platform", default=None, help="pin jax platform (e.g. cpu)"
     )
     args = parser.parse_args()
@@ -455,6 +656,40 @@ def main() -> int:
     from akka_game_of_life_tpu.cli import _apply_platform
 
     _apply_platform(args.platform)
+    if args.sweep_exchange_width is not None:
+        try:
+            widths = tuple(
+                int(v) for v in args.sweep_exchange_width.split(",")
+            )
+        except ValueError:
+            raise SystemExit(
+                f"bad --sweep-exchange-width "
+                f"{args.sweep_exchange_width!r}; expected e.g. 1,2,4,8"
+            )
+        bench_cluster_tsweep(
+            size=args.size,
+            epochs=args.epochs if args.epochs is not None else 64,
+            workers=args.workers,
+            widths=widths,
+            tiles_per_worker=(
+                args.tiles_per_worker if args.tiles_per_worker is not None else 4
+            ),
+            engine=args.engine,
+        )
+        return 0
+    if args.sparse:
+        bench_cluster_sparse(
+            size=args.size,
+            epochs=args.epochs if args.epochs is not None else 64,
+            workers=args.workers,
+            tiles_per_worker=(
+                args.tiles_per_worker if args.tiles_per_worker is not None else 4
+            ),
+            exchange_width=args.exchange_width,
+            engine=args.engine,
+            pattern=args.pattern,
+        )
+        return 0
     if args.grow_at is not None or args.drain_at is not None:
         bench_cluster_elastic(
             size=args.size,

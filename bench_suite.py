@@ -45,6 +45,17 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
                          clients — boards/sec, aggregate cell-updates/s,
                          p50/p99 step latency, digest-vs-oracle sampling,
                          and the 429 admission drills.
+ 13. sparse-dilute       the dilute-universe headline: a glider on an
+                         otherwise-dead torus, activity-gated sparse
+                         stepping off vs on — standalone (intra-tile block
+                         gating, sparse_kernel) AND cluster (quiescent-tile
+                         skipping, sparse_cluster via bench_cluster.py
+                         --sparse) — epochs/s speedups, digest-certified
+                         against the dense oracle.
+ 14. cluster-tsweep      temporal-blocking T-sweep (bench_cluster.py
+                         --sweep-exchange-width): the same seeded cluster
+                         at exchange_width 1/2/4/8, throughput per T,
+                         every T digest-certified against the dense oracle.
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -589,6 +600,84 @@ def bench_digest_certification(size: int, steps: int = 64) -> None:
     print(json.dumps(line), flush=True)
 
 
+def bench_sparse_dilute(size: int, epochs: int = 128, steps: int = 8) -> None:
+    """Config 13 (standalone half): a glider on an otherwise-dead torus —
+    the dilute universe every dense kernel prices at O(area) — advanced
+    with the intra-tile activity gate off vs on.  Off is the ordinary
+    auto kernel; on, only blocks whose neighborhood changed last chunk
+    step (O(activity)).  Both finals must carry the same digest as the
+    dense oracle; the gated run must actually have skipped blocks."""
+    from akka_game_of_life_tpu.obs.catalog import install
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.ops import digest as odigest
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+    config = f"sparse-dilute-{size}"
+    rates = {}
+    digests = {}
+    skipped = 0.0
+    for label, sparse in (("sparse-off", False), ("sparse-on", True)):
+        cfg = SimulationConfig(
+            height=size, width=size, pattern="glider", max_epochs=epochs,
+            steps_per_call=steps, sparse_kernel=sparse, flight_dir="",
+        )
+        import jax
+
+        registry = install(MetricsRegistry())
+        sim = Simulation(cfg, registry=registry)
+
+        def sync():
+            # One-element fetch forcing the dispatched chain to complete:
+            # jit dispatch is async, so without a sync the dense arm's
+            # clock would stop at enqueue time.  (The sparse host engine
+            # is synchronous already; the probe costs nothing there.)
+            board = sim.board
+            np.asarray(jax.device_get(board[(0,) * board.ndim]))
+
+        # Warm TWO chunks out of the timed window: the compile, the gated
+        # engine's all-active reset chunk, and its one dense→sparse
+        # transition copy — the steady state is what the A/B prices.
+        sim.advance(2 * steps)
+        sync()
+        t0 = time.perf_counter()
+        sim.advance(epochs)
+        sync()
+        dt = time.perf_counter() - t0
+        rates[label] = epochs / dt
+        digests[label] = sim.board_digest()
+        if sparse:
+            skipped = registry.snapshot().get(
+                "gol_sparse_blocks_skipped_total", 0.0
+            )
+        sim.close()
+        _emit(
+            config,
+            f"wall-clock epochs/sec, conway {size}x{size} dilute (glider), "
+            f"standalone {label} ({steps} steps/call)",
+            rates[label],
+            "epochs/sec",
+            REFERENCE_CEILING / (size * size),
+        )
+    assert digests["sparse-on"] == digests["sparse-off"], (
+        f"{config}: gated final digest {digests['sparse-on']:016x} != "
+        f"ungated {digests['sparse-off']:016x} — the activity gate is "
+        f"corrupting the simulation"
+    )
+    assert skipped > 0, f"{config}: the activity gate never skipped a block"
+    line = {
+        "config": config,
+        "metric": "dilute-board sparse-on / sparse-off epochs/s speedup "
+                  "(standalone intra-tile gating)",
+        "value": rates["sparse-on"] / rates["sparse-off"],
+        "unit": "x",
+        "vs_baseline": rates["sparse-on"] / rates["sparse-off"],
+        "blocks_skipped": skipped,
+        "digest": odigest.format_digest(digests["sparse-on"]),
+    }
+    print(json.dumps(line), flush=True)
+
+
 def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
     """Config 6: the TCP cluster's width-k communication-avoiding exchange —
     an in-process frontend + 2 workers (jax engines) stepping a size² board
@@ -651,7 +740,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", type=int, nargs="*",
-        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
+        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14],
     )
     parser.add_argument(
         "--scale", type=float, default=1.0,
@@ -731,6 +820,21 @@ def main() -> None:
             threads=8,
             sample=8,
         )
+    if 13 in args.config:
+        # Activity-gated sparse stepping (dilute universe): the standalone
+        # intra-tile block gate, then the cluster quiescence tier — both
+        # digest-certified A/Bs of the same glider board.
+        from bench_cluster import bench_cluster_sparse
+
+        bench_sparse_dilute(s(16384, 32 * 8), epochs=64)
+        bench_cluster_sparse(size=s(1024), epochs=64)
+    if 14 in args.config:
+        # Temporal-blocking T-sweep (ROADMAP item 3's standing record):
+        # exchange_width 1/2/4/8 over the same seeded cluster, every T's
+        # merged digest certified against the dense oracle.
+        from bench_cluster import bench_cluster_tsweep
+
+        bench_cluster_tsweep(size=s(1024), epochs=64, widths=(1, 2, 4, 8))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,107 @@
+"""tools/bench_trend.py: the per-config perf-trajectory aggregator.
+
+The perf history lives in driver records (``BENCH_r*.json``, whose
+``tail`` interleaves BENCH-format JSON lines with log noise) and in fresh
+bench output (plain JSONL); the tool folds both into one config × round
+table with last-wins per (config, round).  Tier-1 smoke: parsing both
+shapes, noise tolerance, the supersede rule, and the CLI surface."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+sys.path.insert(0, str(REPO / "tools"))
+import bench_trend  # noqa: E402
+
+sys.path.remove(str(REPO / "tools"))
+
+
+def _driver_record(n, lines, noise="probe attempt 1\nTraceback (most recent)"):
+    tail = noise + "\n" + "\n".join(json.dumps(l) for l in lines)
+    return json.dumps({"n": n, "cmd": "python bench.py", "rc": 0, "tail": tail})
+
+
+def test_trend_aggregates_records_and_fresh_output(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        _driver_record(
+            1,
+            [
+                {"metric": "headline", "value": 1e9, "unit": "cell-updates/sec"},
+                # same config twice in one round: the later line supersedes
+                {"config": "conway-8192", "metric": "m", "value": 2.0, "unit": "x"},
+                {"config": "conway-8192", "metric": "m", "value": 3.0, "unit": "x"},
+            ],
+        )
+    )
+    (tmp_path / "BENCH_r02.json").write_text(
+        _driver_record(
+            2, [{"config": "conway-8192", "metric": "m", "value": 4.0, "unit": "x"}]
+        )
+    )
+    fresh = tmp_path / "suite_out.jsonl"
+    fresh.write_text(
+        "some log noise\n"
+        + json.dumps(
+            {"config": "sparse-dilute-4096", "metric": "speedup", "value": 7.5,
+             "unit": "x"}
+        )
+        + "\n"
+    )
+    pairs = []
+    for p in sorted(tmp_path.glob("BENCH_r*.json")):
+        pairs.extend(bench_trend.scan_record_file(p))
+    for rnd, rec in bench_trend.scan_record_file(fresh):
+        pairs.append((9, rec))
+    trend = bench_trend.build_trend(pairs)
+    assert trend["headline"]["rounds"][1] == 1e9
+    assert trend["conway-8192"]["rounds"] == {1: 3.0, 2: 4.0}  # last wins
+    assert trend["sparse-dilute-4096"]["rounds"][9] == 7.5
+    table = bench_trend.render_table(trend)
+    assert "conway-8192" in table and "r1" in table and "r2" in table and "r9" in table
+
+
+def test_trend_cli_smoke(tmp_path):
+    (tmp_path / "BENCH_r03.json").write_text(
+        _driver_record(
+            3, [{"config": "c", "metric": "m", "value": 1.5, "unit": "x"}]
+        )
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "tools" / "bench_trend.py"),
+            "--dir", str(tmp_path), "--json",
+        ],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["c"]["rounds"]["r3"] == 1.5
+
+
+def test_trend_empty_dir_fails_loud(tmp_path):
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "tools" / "bench_trend.py"),
+            "--dir", str(tmp_path),
+        ],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "no BENCH-format lines" in proc.stderr
+
+
+def test_trend_on_real_repo_records():
+    """The actual BENCH_r*/MULTICHIP_r* records at the repo root parse
+    (they exist on this tree; their tails mix tracebacks with records)."""
+    if not list(REPO.glob("BENCH_r*.json")):
+        pytest.skip("no driver records on this tree")
+    pairs = []
+    for p in sorted(REPO.glob("BENCH_r*.json")):
+        pairs.extend(bench_trend.scan_record_file(p))
+    trend = bench_trend.build_trend(pairs)
+    assert trend  # at least one config parsed out of the real tails

@@ -1,0 +1,192 @@
+#!/usr/bin/env python3
+"""Aggregate the repo's scattered bench records into ONE per-config
+trajectory table.
+
+The perf history lives in two shapes with no single view:
+
+- ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` at the repo root: driver
+  records ``{"n": round, "cmd", "rc", "tail"}`` whose ``tail`` holds the
+  bench's stdout — BENCH-format JSON lines (``{"config", "metric",
+  "value", "unit", ...}``) interleaved with log noise;
+- fresh ``bench_suite.py`` / ``bench_cluster.py`` output: the same JSON
+  lines, one per line, in a file or on stdout.
+
+This tool parses both, keeps the LAST value per (config, round) — benches
+emit per-variant lines and then a summary; later lines supersede, the same
+convention bench.py documents for its retry lines — and prints a
+config × round table so a regression (or a win) is one glance, not an
+archaeology session.
+
+Usage:
+    python tools/bench_trend.py                       # repo-root records
+    python tools/bench_trend.py --dir path/to/records
+    python tools/bench_trend.py suite_out.jsonl       # + fresh output
+    python tools/bench_trend.py --round 9 new.jsonl   # label fresh rounds
+    python tools/bench_trend.py --json                # machine-readable
+
+Lines without a ``config`` key (bench.py's single-headline records) group
+under ``headline``.  Driven by ``tests/test_bench_trend.py`` (tier-1).
+No third-party imports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+_RECORD_GLOBS = ("BENCH_r*.json", "MULTICHIP_r*.json")
+_ROUND_RE = re.compile(r"_r(\d+)\b")
+
+
+def _bench_lines(text: str):
+    """Every parseable BENCH-format JSON object found in ``text``, one per
+    line.  Noise lines (tracebacks, probe logs) are skipped silently."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "value" in rec and "metric" in rec:
+            yield rec
+
+
+def scan_record_file(path: Path):
+    """(round, bench-line) pairs from one driver record or JSONL file."""
+    text = path.read_text(encoding="utf-8", errors="replace")
+    rnd = None
+    tail = text
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict) and "tail" in doc:
+        rnd = doc.get("n")
+        tail = str(doc.get("tail") or "")
+    if rnd is None:
+        m = _ROUND_RE.search(path.name)
+        rnd = int(m.group(1)) if m else None
+    for rec in _bench_lines(tail):
+        yield rnd, rec
+
+
+def build_trend(pairs):
+    """{config: {"unit": u, "rounds": {round: value}}} with last-wins per
+    (config, round)."""
+    trend = {}
+    for rnd, rec in pairs:
+        config = rec.get("config") or "headline"
+        entry = trend.setdefault(config, {"unit": rec.get("unit"), "rounds": {}})
+        value = rec.get("value")
+        entry["rounds"][rnd] = value
+        if rec.get("unit"):
+            entry["unit"] = rec["unit"]
+    return trend
+
+
+def render_table(trend) -> str:
+    rounds = sorted(
+        {r for e in trend.values() for r in e["rounds"]},
+        key=lambda r: (r is None, r),
+    )
+
+    def label(r):
+        return "r?" if r is None else f"r{r}"
+
+    def fmt(v):
+        if v is None:
+            return "—"
+        if isinstance(v, (int, float)):
+            return f"{v:.3g}"
+        return str(v)
+
+    header = ["config", "unit"] + [label(r) for r in rounds]
+    rows = [header]
+    for config in sorted(trend):
+        entry = trend[config]
+        rows.append(
+            [config, entry["unit"] or "?"]
+            + [fmt(entry["rounds"].get(r, None)) for r in rounds]
+        )
+    widths = [max(len(row[i]) for row in rows) for i in range(len(header))]
+    lines = []
+    for i, row in enumerate(rows):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "extra", nargs="*",
+        help="additional bench output files (JSONL from bench_suite.py / "
+        "bench_cluster.py / bench.py)",
+    )
+    parser.add_argument(
+        "--dir", default=None,
+        help="directory holding the BENCH_r*/MULTICHIP_r* records "
+        "(default: the repo root above this tool)",
+    )
+    parser.add_argument(
+        "--round", type=int, default=None,
+        help="round label for the extra files (default: parsed from the "
+        "filename's _rN, else unlabeled)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the aggregated trend as one JSON object instead of a "
+        "table",
+    )
+    args = parser.parse_args(argv)
+
+    root = Path(args.dir) if args.dir else Path(__file__).resolve().parent.parent
+    paths = []
+    for pattern in _RECORD_GLOBS:
+        paths.extend(sorted(root.glob(pattern)))
+    pairs = []
+    for path in paths:
+        pairs.extend(scan_record_file(path))
+    for name in args.extra:
+        path = Path(name)
+        if not path.exists():
+            print(f"bench_trend: no such file: {name}", file=sys.stderr)
+            return 2
+        for rnd, rec in scan_record_file(path):
+            pairs.append((args.round if args.round is not None else rnd, rec))
+    if not pairs:
+        print(
+            "bench_trend: no BENCH-format lines found "
+            f"(scanned {len(paths)} record file(s) under {root} and "
+            f"{len(args.extra)} extra file(s))",
+            file=sys.stderr,
+        )
+        return 1
+    trend = build_trend(pairs)
+    if args.json:
+        out = {
+            config: {
+                "unit": e["unit"],
+                "rounds": {
+                    ("r?" if r is None else f"r{r}"): v
+                    for r, v in sorted(
+                        e["rounds"].items(), key=lambda kv: (kv[0] is None, kv[0])
+                    )
+                },
+            }
+            for config, e in sorted(trend.items())
+        }
+        print(json.dumps(out, indent=2))
+    else:
+        print(render_table(trend))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
