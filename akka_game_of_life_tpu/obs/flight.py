@@ -54,8 +54,8 @@ class FlightRecorder:
         clock=time.monotonic,
         wallclock=time.time,
     ) -> None:
-        self.node = node
-        self.directory = directory
+        self.node = node  # graftlint: guarded-by _lock
+        self.directory = directory  # graftlint: guarded-by _lock
         self.max_dumps = max_dumps
         self.min_interval_s = min_interval_s
         self._clock = clock
@@ -65,11 +65,12 @@ class FlightRecorder:
         # the hot loop takes this lock) at the moment the signal lands — a
         # plain lock would deadlock the shutdown it decorates.
         self._lock = threading.RLock()
-        self._ring: deque = deque(maxlen=capacity)
-        self._seq = 0
-        self._dumps = 0
-        self._last_dump: dict = {}  # reason -> monotonic time of last dump
-        self.dump_paths: List[str] = []
+        self._ring: deque = deque(maxlen=capacity)  # graftlint: guarded-by _lock
+        self._seq = 0  # graftlint: guarded-by _lock
+        self._dumps = 0  # graftlint: guarded-by _lock
+        # reason -> monotonic time of last dump
+        self._last_dump: dict = {}  # graftlint: guarded-by _lock
+        self.dump_paths: List[str] = []  # graftlint: guarded-by _lock
 
     def configure(
         self, *, directory: Optional[str] = None, node: Optional[str] = None
@@ -85,7 +86,8 @@ class FlightRecorder:
 
     @property
     def enabled(self) -> bool:
-        return bool(self.directory)
+        with self._lock:
+            return bool(self.directory)
 
     # -- recording -----------------------------------------------------------
 

@@ -87,7 +87,7 @@ class _Child:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # graftlint: guarded-by _lock
         # Ever mutated?  snapshot() filters on this, not the value — a gauge
         # that was set and legitimately returned to 0 is still reported.
         self.touched = False
@@ -130,12 +130,13 @@ class _HistogramChild:
     def __init__(self, buckets: Tuple[float, ...]) -> None:
         self._lock = threading.Lock()
         self.buckets = buckets
-        self.counts = [0] * (len(buckets) + 1)  # +1 for the +Inf bucket
-        self.sum = 0.0
-        self.count = 0
+        self.counts = [0] * (len(buckets) + 1)  # graftlint: guarded-by _lock
+        self.sum = 0.0  # graftlint: guarded-by _lock
+        self.count = 0  # graftlint: guarded-by _lock
 
     @property
     def touched(self) -> bool:
+        # graftlint: waive GL-LOCK01 -- GIL-atomic read of a monotonic int used only as the exposition filter; a stale read under-reports one scrape and the next corrects it
         return self.count > 0
 
     def observe(self, value: float) -> None:
@@ -180,7 +181,7 @@ class _Instrument:
         self.labelnames = labelnames
         self.buckets = buckets
         self._lock = threading.Lock()
-        self._children: Dict[Tuple[str, ...], object] = {}
+        self._children: Dict[Tuple[str, ...], object] = {}  # graftlint: guarded-by _lock
         if not labelnames:
             self._children[()] = self._new_child()
 
@@ -219,6 +220,7 @@ class _Instrument:
             raise ValueError(
                 f"{self.name} is labeled {self.labelnames}; use .labels(...)"
             )
+        # graftlint: waive GL-LOCK01 -- the () child is created in __init__ and never replaced; a GIL-atomic dict read of an immortal key needs no lock on the hot inc() path
         return self._children[()]
 
     def inc(self, amount: float = 1.0) -> None:
@@ -253,7 +255,7 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._instruments: Dict[str, _Instrument] = {}
+        self._instruments: Dict[str, _Instrument] = {}  # graftlint: guarded-by _lock
 
     def _get_or_create(
         self,

@@ -373,6 +373,19 @@ class _PeerSender:
     a compute thread), and a send deadline surfaces as the same ``OSError``
     drop-and-redial path."""
 
+    # Lock discipline (tools/graftlint): every batch/queue field belongs to
+    # the condition; the writer and every producer agree on one monitor.
+    _GRAFTLINT_GUARDED = {
+        "_items": "_cond",
+        "_pending": "_cond",
+        "_pending_tiles": "_cond",
+        "_expect": "_cond",
+        "_pending_epoch": "_cond",
+        "_pending_since": "_cond",
+        "_depth": "_cond",
+        "_closed": "_cond",
+    }
+
     def __init__(self, worker: "BackendWorker", owner: str) -> None:
         self.worker = worker
         self.owner = owner
@@ -588,6 +601,26 @@ def _ring_of_msg(msg: dict) -> Ring:
 class BackendWorker:
     """One worker process/thread: joins, hosts tiles, steps them, and serves
     its boundary rings to peer workers directly."""
+
+    # Lock discipline (tools/graftlint, pass GL-LOCK01): the mutable shared
+    # state each lock actually orders.  The worker RLock serializes the tile
+    # table, wiring, and pause/target; the peer/sender/pre-stop locks own
+    # their maps.  Set-once run config (rule, layout, store, cadences) is
+    # deliberately undeclared: replaced atomically at (re)wiring, and
+    # BoundaryStore is internally thread-safe.
+    _GRAFTLINT_GUARDED = {
+        "tiles": "_lock",
+        "owners": "_lock",
+        "_owner_map": "_lock",
+        "paused": "_lock",
+        "target": "_lock",
+        "origins": "_lock",
+        "_actor_engines": "_lock",
+        "_peers": "_peer_lock",
+        "_senders": "_sender_lock",
+        "_pre_stop_hooks": "_pre_stop_lock",
+        "_pre_stop_done": "_pre_stop_lock",
+    }
 
     def __init__(
         self,
@@ -2091,7 +2124,8 @@ class BackendWorker:
             msg["state"] = pack_tile(arr)
         if "render" in reasons:
             sy, sx = self.render_strides
-            oy, ox = self.origins.get(tid, (0, 0))
+            with self._lock:
+                oy, ox = self.origins.get(tid, (0, 0))
             # Phase-align to the tile origin so the union over tiles is the
             # canonical full-board strided probe (cell (0,0) always shown).
             msg["sample"] = arr[(-oy) % sy :: sy, (-ox) % sx :: sx]

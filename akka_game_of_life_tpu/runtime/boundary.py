@@ -85,8 +85,8 @@ class BoundaryStore:
             )
         self.layout = layout
         self.width = width
-        self._rings: Dict[Tuple[TileId, int], Ring] = {}
-        self._pending: Dict[Tuple[TileId, int], List[Callable[[Halo], None]]] = {}
+        self._rings: Dict[Tuple[TileId, int], Ring] = {}  # graftlint: guarded-by _lock
+        self._pending: Dict[Tuple[TileId, int], List[Callable[[Halo], None]]] = {}  # graftlint: guarded-by _lock
         self._lock = threading.Lock()
 
     def push_ring(self, tile: TileId, epoch: int, ring: Ring) -> None:

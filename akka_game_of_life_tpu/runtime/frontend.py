@@ -169,6 +169,36 @@ class Frontend:
     thread per worker connection, one maintenance thread (ticks, heartbeat
     eviction, fault injection)."""
 
+    # Lock discipline (tools/graftlint, pass GL-LOCK01): the coordinator
+    # RLock orders every piece of cluster bookkeeping the reader threads
+    # and the maintenance thread both touch.  Helpers documented "caller
+    # holds the lock" carry the *_locked suffix.  Set-once references
+    # (config, rule, store, observer, membership — internally consistent
+    # or single-writer) are deliberately undeclared.
+    _GRAFTLINT_GUARDED = {
+        "tile_owner": "_lock",
+        "tile_epochs": "_lock",
+        "target_epoch": "_lock",
+        "paused": "_lock",
+        "layout": "_lock",
+        "quiescent": "_lock",
+        "_last_ring_time": "_lock",
+        "_redeploy_times": "_lock",
+        "_last_ckpt": "_lock",
+        "_ckpt_pending": "_lock",
+        "_final_tiles": "_lock",
+        "final_board": "_lock",
+        "_digest_partial": "_lock",
+        "_digest_floor": "_lock",
+        "epoch_digests": "_lock",
+        "final_digest": "_lock",
+        "error": "_lock",
+        "_next_tick": "_lock",
+        "_drain_spans": "_lock",
+        "_degraded_span": "_lock",
+        "degraded": "_lock",
+    }
+
     def __init__(
         self,
         config: SimulationConfig,
@@ -498,7 +528,7 @@ class Frontend:
                     f"exchange_width={self.config.exchange_width} exceeds the "
                     f"{th}x{tw} tile — a ring cannot be wider than its tile"
                 )
-            epoch0, tiles0 = self._load_recovery_tiles()
+            epoch0, tiles0 = self._load_recovery_tiles_locked()
             self._last_ckpt = (epoch0, tiles0)
             self.start_epoch = epoch0
             self.observer.set_cluster_layout(
@@ -555,7 +585,7 @@ class Frontend:
                 self.tile_epochs[tile] = epoch0
             # Wiring before data: workers must know every tile's peer
             # address before the first DEPLOY makes them publish rings.
-            self._broadcast_owners()
+            self._broadcast_owners_locked()
             for m in members:
                 m.tiles = assignments[m.name]
             self._started.set()
@@ -564,7 +594,7 @@ class Frontend:
             if m.tiles:
                 self._send_deploy(m, m.tiles)
 
-    def _owners_msg(self) -> dict:
+    def _owners_msg_locked(self) -> dict:
         """The current wiring as one OWNERS message.  Caller holds the lock."""
         rows = []
         for tile, owner in self.tile_owner.items():
@@ -579,15 +609,15 @@ class Frontend:
             "shape": list(self.config.shape),
         }
 
-    def _broadcast_owners(self) -> None:
+    def _broadcast_owners_locked(self) -> None:
         """NeighboursRefs (re-)wiring (BoardCreator.scala:86-88,149-151):
         every worker learns every tile's owner and peer data-plane address.
         The frontend brokers addresses only — ring bytes never touch it."""
-        msg = self._owners_msg()
+        msg = self._owners_msg_locked()
         for m in self.membership.alive_members():
             self._safe_send(m, msg)
 
-    def _load_recovery_tiles(self) -> Tuple[int, Dict[TileId, dict]]:
+    def _load_recovery_tiles_locked(self) -> Tuple[int, Dict[TileId, dict]]:
         """The (epoch, packed tile dict) the run starts/recovers from.
 
         A durable per-tile checkpoint whose grid matches the current layout
@@ -608,7 +638,7 @@ class Frontend:
                             t: self.store.load_tile_payload(epoch0, t)
                             for t in layout.tile_ids
                         }
-                        self._certify_recovery_tiles(epoch_meta, tiles)
+                        self._certify_recovery_tiles_locked(epoch_meta, tiles)
                         # One restore per recovery-source load: this path
                         # bypasses store.load(), so count it here (the
                         # full-board fallback below counts inside load()).
@@ -625,7 +655,7 @@ class Frontend:
             t: pack_tile(layout.extract(board, t)) for t in layout.tile_ids
         }
 
-    def _certify_recovery_tiles(
+    def _certify_recovery_tiles_locked(
         self, epoch_meta: dict, tiles: Dict[TileId, dict]
     ) -> None:
         """Certify a per-tile recovery source against the 64-bit digest its
@@ -820,9 +850,11 @@ class Frontend:
         if self._metrics_server is not None:
             self._metrics_server.close()
             self._metrics_server = None
+        with self._lock:
+            err = self.error
         self.events.emit(
             "frontend_stopped",
-            error=self.error,
+            error=err,
             done=self.done.is_set(),
         )
         self.events.close()
@@ -956,12 +988,12 @@ class Frontend:
                     # moment) and hosts no tiles until the rebalancer
                     # migrates load onto it.  Scale-out is exactly this
                     # plus a migration.  Sent UNDER the lock, like every
-                    # _broadcast_owners call site: a migration committing
+                    # _broadcast_owners_locked call site: a migration committing
                     # concurrently must not slot its OWNERS broadcast
                     # between this snapshot and its send — the stale
                     # snapshot arriving last would make the joiner drop a
                     # tile just migrated onto it.
-                    self._safe_send(member, self._owners_msg())
+                    self._safe_send(member, self._owners_msg_locked())
             while True:
                 msg = channel.recv()
                 if msg is None:
@@ -1043,7 +1075,7 @@ class Frontend:
                     # the cluster tier's headline counter.
                     self._m_tiles_skipped.inc(skipped)
                 if "digest" in msg:
-                    self._note_tile_digest(tile, epoch, msg["digest"])
+                    self._note_tile_digest_locked(tile, epoch, msg["digest"])
         elif kind == P.TILE_STATE:
             self._on_tile_state(member, msg)
         elif kind == P.REDEPLOY_REQUEST:
@@ -1084,13 +1116,13 @@ class Frontend:
                                     self.rule.rulestring(),
                                     self.layout.grid,
                                     self.config.shape,
-                                    self._digest_meta(epoch),
+                                    self._digest_meta_locked(epoch),
                                 ),
                             )
                         )
                     h, w = self.config.shape
                     if h * w <= _ASSEMBLE_LIMIT:
-                        self.final_board = self._assemble(self._final_tiles)
+                        self.final_board = self._assemble_locked(self._final_tiles)
                     self.done.set()
             if (
                 "checkpoint" in reasons
@@ -1115,7 +1147,7 @@ class Frontend:
                                     self.rule.rulestring(),
                                     self.layout.grid,
                                     self.config.shape,
-                                    self._digest_meta(epoch),
+                                    self._digest_meta_locked(epoch),
                                 ),
                             )
                         )
@@ -1147,7 +1179,7 @@ class Frontend:
             if "metrics" in reasons:
                 self.observer.add_population(epoch, tile, int(msg["population"]))
 
-    def _digest_meta(self, epoch: int) -> Optional[dict]:
+    def _digest_meta_locked(self, epoch: int) -> Optional[dict]:
         """Checkpoint metadata carrying the epoch's merged digest, or None.
         The merge always completes before the finalize enqueue: each
         tile's PROGRESS (with lanes) precedes its TILE_STATE on the same
@@ -1159,7 +1191,7 @@ class Frontend:
             return None
         return {"digest": odigest.format_digest(self.epoch_digests[epoch])}
 
-    def _note_tile_digest(self, tile: TileId, epoch: int, lanes) -> None:
+    def _note_tile_digest_locked(self, tile: TileId, epoch: int, lanes) -> None:
         """One tile's digest lanes from a PROGRESS ping; when every tile of
         the epoch has reported, fold them (lane-wise uint32 sum — the same
         merge rule as the mesh ``psum``) into the epoch's 64-bit value.
@@ -1198,7 +1230,7 @@ class Frontend:
             self.events.emit("digest", epoch=epoch, digest=hexd)
         print(f"epoch {epoch}: digest={hexd}", file=self.observer.out, flush=True)
 
-    def _assemble(self, tiles: Dict[TileId, dict]) -> np.ndarray:
+    def _assemble_locked(self, tiles: Dict[TileId, dict]) -> np.ndarray:
         from akka_game_of_life_tpu.runtime.tiles import stitch
 
         return stitch(
@@ -1230,17 +1262,20 @@ class Frontend:
                 return
             now = time.monotonic()
             stuck = [
-                ntile
+                (ntile, self.tile_owner.get(ntile))
                 for ntile in sorted(set(self.layout.neighbors(tile).values()))
                 if ntile != tile
                 and ntile not in self.rebalancer.inflight  # frozen on purpose
-                and not self._quiescent_fresh(ntile, now)  # silent on purpose
+                and not self._quiescent_fresh_locked(ntile, now)  # silent on purpose
                 and self.tile_epochs.get(ntile, 0) < epoch
                 and now - self._last_ring_time.get(ntile, now)
                 > self.config.stuck_timeout_s
             ]
-        for ntile in stuck:
-            self._redeploy_tile(ntile, avoid=self.tile_owner.get(ntile))
+        # (tile, owner) snapshotted under the lock above: reading the owner
+        # here would race a migration commit and aim `avoid` at the NEW
+        # owner, letting the redeploy land back on the wedged member.
+        for ntile, owner in stuck:
+            self._redeploy_tile(ntile, avoid=owner)
 
     # -- elastic plane: live migration, scale-out, drain ---------------------
 
@@ -1250,9 +1285,13 @@ class Frontend:
         Suspended while degraded — a stalled cluster must heal, not
         reshape.  ``drain_only`` (the paused cluster) plans drain-driven
         moves but no load balancing."""
-        if not self._started.is_set() or self.layout is None or self.degraded:
-            return
         with self._lock:
+            if (
+                not self._started.is_set()
+                or self.layout is None
+                or self.degraded
+            ):
+                return
             overdue = self.rebalancer.expired(now)
         for mig in overdue:
             self._abort_migration(mig, "deadline")
@@ -1406,7 +1445,7 @@ class Frontend:
                 # IS the commit point — the source drops the tile on
                 # receipt, every peer re-aims its ring pushes, and only
                 # then does the state land on the destination.
-                self._broadcast_owners()
+                self._broadcast_owners_locked()
         if not commit:
             self._abort_migration(mig, "dest_lost")
             return
@@ -1603,20 +1642,20 @@ class Frontend:
                 # the dead member for not-yet-reassigned tiles.
                 assigned: Dict[str, List[TileId]] = {}
                 for idx, tile in enumerate(tiles):
-                    m = self._assign_tile(
+                    m = self._assign_tile_locked(
                         tile, preferred=survivors[idx % len(survivors)].name
                     )
                     if m is None:
                         return  # budget/survivor escalation already set error
                     assigned.setdefault(m.name, []).append(tile)
-                self._broadcast_owners()
+                self._broadcast_owners_locked()
             # Bulk sends outside the lock (see _send_deploy).
             for owner, batch in assigned.items():
                 m = self.membership.get(owner)
                 if m is not None and m.alive:
                     self._send_deploy(m, batch)
 
-    def _quiescent_fresh(self, tile: TileId, now: float) -> bool:
+    def _quiescent_fresh_locked(self, tile: TileId, now: float) -> bool:
         """Is ``tile`` self-reported quiescent AND recently heard from?
         Quiescent tiles ping only at cadence epochs, so they look silent to
         the stuck/degraded detectors — but the exemption is freshness-bound
@@ -1629,7 +1668,7 @@ class Frontend:
             <= 2.0 * self.config.stuck_timeout_s
         )
 
-    def _assign_tile(
+    def _assign_tile_locked(
         self,
         tile: TileId,
         preferred: Optional[str] = None,
@@ -1712,12 +1751,12 @@ class Frontend:
         # the spans/events that led to this tile needing a restart.
         self.tracer.flight.dump("tile_redeploy", node="frontend")
         with self._lock:
-            member = self._assign_tile(tile, preferred=preferred, avoid=avoid)
+            member = self._assign_tile_locked(tile, preferred=preferred, avoid=avoid)
             if member is None:
                 return
             # Re-wire everyone first (NeighboursRefs re-send to the whole
             # neighborhood, BoardCreator.scala:149-151), then deploy.
-            self._broadcast_owners()
+            self._broadcast_owners_locked()
         self._send_deploy(member, [tile])
 
     # -- maintenance: ticks, auto-down, fault injection ----------------------
@@ -1751,7 +1790,10 @@ class Frontend:
             # auto-down stale members (application.conf:23 analog) —
             # suppressed while degraded: silence during a partition is the
             # partition's fault, not the members'
-            if not self.degraded:
+            with self._lock:
+                degraded = self.degraded
+                drain_only = self.paused
+            if not degraded:
                 for m in self.membership.stale_members(now):
                     self._on_member_lost(m.name)
             # The elastic plane: expire/plan migrations, release drains.
@@ -1759,17 +1801,17 @@ class Frontend:
             # stepping, so moving it is safe; a SIGTERM'd worker must be
             # able to leave gracefully mid-pause) but never reshapes for
             # load.
-            self._rebalance_poll(now, drain_only=self.paused)
+            self._rebalance_poll(now, drain_only=drain_only)
             # paced epoch announcements
-            if (
-                self._started.is_set()
-                and not self.paused
-                and self.config.tick_s > 0
-                and self._next_tick is not None
-                and now >= self._next_tick
-                and self.target_epoch < self.config.max_epochs
-            ):
-                with self._lock:
+            with self._lock:
+                if (
+                    self._started.is_set()
+                    and not self.paused
+                    and self.config.tick_s > 0
+                    and self._next_tick is not None
+                    and now >= self._next_tick
+                    and self.target_epoch < self.config.max_epochs
+                ):
                     if self._stop.is_set() or self.done.is_set():
                         # stop() is concurrently finishing the run's spans
                         # (under this lock): rotating now would mint an
@@ -1822,7 +1864,7 @@ class Frontend:
             stranded = sum(
                 1
                 for t in tiles
-                if not self._quiescent_fresh(t, now)
+                if not self._quiescent_fresh_locked(t, now)
                 and now - self._last_ring_time.get(t, now)
                 > self.config.stuck_timeout_s
             )
@@ -1858,7 +1900,7 @@ class Frontend:
                                 self.rule.rulestring(),
                                 self.layout.grid,
                                 self.config.shape,
-                                self._digest_meta(epoch),
+                                self._digest_meta_locked(epoch),
                             ),
                         )
                     )

@@ -193,13 +193,13 @@ class SessionRouter:
 
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
-        self._sessions: Dict[str, Session] = {}
-        self._cells = 0
-        self._queue: deque = deque()
+        self._sessions: Dict[str, Session] = {}  # graftlint: guarded-by _lock
+        self._cells = 0  # graftlint: guarded-by _lock
+        self._queue: deque = deque()  # graftlint: guarded-by _lock
         self._ids = itertools.count(1)
-        self._paused = False
-        self._draining = False
-        self._stopped = False
+        self._paused = False  # graftlint: guarded-by _lock
+        self._draining = False  # graftlint: guarded-by _lock
+        self._stopped = False  # graftlint: guarded-by _lock
         self._ticker = threading.Thread(
             target=self._tick_loop, daemon=True, name="serve-ticker"
         )
@@ -295,9 +295,9 @@ class SessionRouter:
 
     def delete(self, sid: str) -> None:
         with self._lock:
-            self._drop(sid, evicted=False)
+            self._drop_locked(sid, evicted=False)
 
-    def _drop(self, sid: str, *, evicted: bool) -> None:
+    def _drop_locked(self, sid: str, *, evicted: bool) -> None:
         """Remove a session (lock held).  An in-flight step job for it
         completes against the ticker's snapshot and its write-back is
         skipped — the client still gets the stepped result."""
@@ -485,7 +485,7 @@ class SessionRouter:
             for s in self._sessions.values()
             if s.sid not in busy and now - s.last_used > self.ttl_s
         ]:
-            self._drop(sid, evicted=True)
+            self._drop_locked(sid, evicted=True)
 
     def _run_tick(self, jobs: List[_Job]) -> None:
         """Group this tick's jobs by size class, advance each group in one
@@ -563,7 +563,7 @@ class SessionRouter:
                 else:
                     # Deleted mid-batch: the client still gets its result;
                     # the table write-back is skipped, and so is the
-                    # per-tenant counter — _drop may just have reclaimed
+                    # per-tenant counter — _drop_locked may just have reclaimed
                     # this tenant's metric children, and incrementing here
                     # would re-mint a leaked child for a gone tenant.
                     epoch = sess.epoch + job.steps
@@ -578,7 +578,11 @@ class SessionRouter:
         with self._lock:
             self._draining = True
             self._wake.notify_all()
-        deadline = time.monotonic() + timeout
+        # Bounded by REAL time on purpose: the loop paces with time.sleep,
+        # so the deadline must tick with it — on the injected clock a
+        # frozen TTL-test clock would turn this bounded shutdown wait into
+        # an infinite hang.
+        deadline = time.monotonic() + timeout  # graftlint: waive GL-HAZ04 -- the real-time bound pairs with the real time.sleep pacing below; a frozen injected test clock must not unbound shutdown
         while time.monotonic() < deadline:
             with self._lock:
                 if not self._queue:

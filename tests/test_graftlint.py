@@ -1,0 +1,585 @@
+"""tools/graftlint: the AST analyzer + bijection engine (tier-1).
+
+Three layers:
+
+- fixture snippets proving each pass catches its historical bug class —
+  including the PR 9 unlocked ring-rotation pattern and the
+  ``SparseStepper`` method-level ``lru_cache`` pin, both of which shipped
+  (or nearly shipped) before a human caught them;
+- the repo-wide clean-run gate: ``python -m tools.graftlint`` exits 0 with
+  zero unwaived findings — the standing lint surface;
+- regression tests for the lock-discipline fixes the pass forced in
+  ``serve/sessions.py`` / ``runtime/backend.py`` / ``runtime/frontend.py``,
+  proving behavior is unchanged.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.graftlint import bijection, hazards, locks, specs  # noqa: E402
+from tools.graftlint.core import (  # noqa: E402
+    PASS_CATALOG,
+    PASS_IDS,
+    SourceFile,
+    run,
+)
+
+
+def _check(text: str, rel: str = "akka_game_of_life_tpu/runtime/_fx.py"):
+    """Run the AST passes over a fixture snippet; returns findings."""
+    src = SourceFile(REPO / rel, text=text)
+    return src.meta_findings() + locks.check(src) + hazards.check(src)
+
+
+def _ids(findings, *, waived=False):
+    return [f.pass_id for f in findings if f.waived == waived]
+
+
+# -- lock discipline (GL-LOCK01) ----------------------------------------------
+
+# The PR 9 bug, minimized: ring history rotation OUTSIDE the locked section
+# that orders chunk completion — two threads publishing consecutive chunks
+# can swap last/prev, and a later period-2 skip markers the wrong phase's
+# ring.  It took a second manual review pass to catch; the pass makes it
+# one deterministic finding.
+_PR9_UNLOCKED_ROTATION = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.tiles = {}  # graftlint: guarded-by _lock
+
+    def _step_tile(self, tid):
+        with self._lock:
+            tile = self.tiles[tid]
+            tile.epoch += 1
+        # BUG: rotation outside the lock that serializes chunk completion.
+        tile = self.tiles[tid]
+        tile.prev_ring = tile.last_ring
+        tile.last_ring = (object(), tile.epoch)
+"""
+
+_PR9_FIXED_ROTATION = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.tiles = {}  # graftlint: guarded-by _lock
+
+    def _step_tile(self, tid):
+        with self._lock:
+            tile = self.tiles[tid]
+            tile.epoch += 1
+            tile.prev_ring = tile.last_ring
+            tile.last_ring = (object(), tile.epoch)
+"""
+
+
+def test_pr9_unlocked_ring_rotation_is_flagged():
+    findings = _check(_PR9_UNLOCKED_ROTATION)
+    assert _ids(findings) == ["GL-LOCK01"]
+    assert "self.tiles" in findings[0].message
+    # The corrected shape (rotation under the same lock) runs clean.
+    assert _ids(_check(_PR9_FIXED_ROTATION)) == []
+
+
+def test_locked_method_convention_and_registry():
+    clean = _check("""
+import threading
+
+class Store:
+    _GRAFTLINT_GUARDED = {"_rings": "_lock", "_pending": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rings = {}
+        self._pending = {}
+
+    def push(self, k, v):
+        with self._lock:
+            self._rings[k] = v
+            self._assemble_locked(k)
+
+    def _assemble_locked(self, k):
+        return self._rings.get(k), len(self._pending)
+""")
+    assert _ids(clean) == []
+    # The same reads outside both the with and the convention flag.
+    dirty = _check("""
+import threading
+
+class Store:
+    _GRAFTLINT_GUARDED = {"_rings": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rings = {}
+
+    def peek(self, k):
+        return self._rings.get(k)
+""")
+    assert _ids(dirty) == ["GL-LOCK01"]
+
+
+def test_init_exemption_excludes_closures():
+    """A thread target defined inside __init__ runs after publication on
+    another thread — it gets no construction exemption."""
+    out = _check("""
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = []  # graftlint: guarded-by _lock
+
+        def loop():
+            self._q.append(1)
+
+        threading.Thread(target=loop, daemon=True).start()
+""")
+    assert _ids(out) == ["GL-LOCK01"]
+
+
+def test_closure_under_held_lock_not_exempt():
+    """A callback defined inside ``with self._lock:`` runs later, unlocked
+    — lexical containment in the with-block earns it no exemption."""
+    out = _check("""
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # graftlint: guarded-by _lock
+        self._cbs = []
+
+    def register(self):
+        with self._lock:
+            self._cbs.append(lambda x: self._items.append(x))
+""")
+    assert _ids(out) == ["GL-LOCK01"]
+
+
+def test_locked_convention_covers_primary_lock_only():
+    """``*_locked`` names no lock, so it vouches only for the class's
+    primary ``_lock`` — secondary-lock state must be held explicitly.  A
+    single-lock class (Condition-monitor style) keeps the convention."""
+    out = _check("""
+import threading
+
+class W:
+    _GRAFTLINT_GUARDED = {"tiles": "_lock", "_senders": "_sender_lock"}
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._sender_lock = threading.Lock()
+        self.tiles = {}
+        self._senders = {}
+
+    def _step_locked(self):
+        return len(self.tiles), len(self._senders)
+
+class Sender:
+    _GRAFTLINT_GUARDED = {"_items": "_cond"}
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+
+    def _seal_locked(self):
+        return len(self._items)
+""")
+    assert len(_ids(out)) == 1 and "_senders" in out[0].message
+
+
+def test_guard_map_inherits_within_module():
+    """A subclass of an annotated base is held to the base's declarations."""
+    out = _check("""
+import threading
+
+class Child:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0  # graftlint: guarded-by _lock
+
+class CounterChild(Child):
+    def inc(self, amount=1.0):
+        self._value += amount
+
+class LockedChild(Child):
+    def inc(self, amount=1.0):
+        with self._lock:
+            self._value += amount
+""")
+    assert _ids(out) == ["GL-LOCK01"]
+
+
+def test_waiver_needs_reason_and_covers_site():
+    waived = _check("""
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  # graftlint: guarded-by _lock
+
+    def peek(self):
+        # graftlint: waive GL-LOCK01 -- GIL-atomic int read, test-only surface
+        return self.n
+""")
+    assert _ids(waived) == []
+    assert _ids(waived, waived=True) == ["GL-LOCK01"]
+    # No reason: the access stays flagged AND the waiver itself is flagged.
+    reasonless = _check("""
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  # graftlint: guarded-by _lock
+
+    def peek(self):
+        return self.n  # graftlint: waive GL-LOCK01
+""")
+    assert sorted(_ids(reasonless)) == ["GL-LOCK01", "GL-META01"]
+
+
+def test_malformed_guard_declaration_is_flagged():
+    out = _check("""
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # graftlint: guarded-by _lock
+        pass
+""")
+    assert _ids(out) == ["GL-LOCK02"]
+
+
+# -- hazards (GL-HAZ01..04) ---------------------------------------------------
+
+# The SparseStepper pin, minimized: an lru_cache on a method keys on self,
+# so the class-level cache retains every stepper — and the full board each
+# one holds — for the life of the process.
+_METHOD_LRU_CACHE = """
+import functools
+
+class SparseStepper:
+    def __init__(self, board):
+        self.board = board
+
+    @functools.lru_cache(maxsize=None)
+    def _block_fn(self, steps):
+        return steps
+"""
+
+
+def test_method_level_lru_cache_is_flagged():
+    findings = _check(_METHOD_LRU_CACHE)
+    assert _ids(findings) == ["GL-HAZ01"]
+    assert "pins every instance" in findings[0].message
+    # Module-level functions (the repo's actual idiom) stay clean.
+    assert _ids(_check("""
+import functools
+
+@functools.lru_cache(maxsize=None)
+def compiled(rule, steps):
+    return rule, steps
+""")) == []
+
+
+def test_x64_dtype_flagged_only_in_kernel_dirs():
+    snippet = """
+import jax.numpy as jnp
+import numpy as np
+
+def digest(x):
+    a = jnp.zeros((4,), dtype=jnp.uint64)
+    b = jnp.asarray(x, dtype="int64")
+    c = np.uint64(7)  # host-side: fine
+    return a, b, c
+"""
+    in_ops = _check(snippet, rel="akka_game_of_life_tpu/ops/_fx.py")
+    assert _ids(in_ops) == ["GL-HAZ02", "GL-HAZ02"]
+    # The same code outside ops//parallel/ is host-side policy, not flagged.
+    assert _ids(_check(snippet)) == []
+    # The unaliased import spelling is caught too.
+    assert _ids(_check("""
+import jax.numpy
+
+def f():
+    return jax.numpy.uint64(1)
+""", rel="akka_game_of_life_tpu/parallel/_fx.py")) == ["GL-HAZ02"]
+
+
+def test_device_compute_under_lock_is_flagged():
+    out = _check("""
+import threading
+import jax.numpy as jnp
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad(self, x):
+        with self._lock:
+            y = jnp.sum(x)
+            y.block_until_ready()
+        return y
+
+    def good(self, x):
+        with self._lock:
+            arr = x
+        return jnp.sum(arr)
+""")
+    assert _ids(out) == ["GL-HAZ03", "GL-HAZ03"]
+
+
+def test_bare_clock_in_injectable_clock_class_is_flagged():
+    out = _check("""
+import time
+
+class Router:
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+
+    def drain(self, timeout):
+        deadline = time.monotonic() + timeout
+        return deadline
+
+class NoInjection:
+    def stamp(self):
+        return time.time()
+""")
+    assert _ids(out) == ["GL-HAZ04"]
+
+
+# -- bijection engine ---------------------------------------------------------
+
+def test_flag_to_field_mappings():
+    assert specs.CHAOS_CONFIG.flag_to_field("--chaos-net") == "enabled"
+    assert specs.CHAOS_CONFIG.flag_to_field("--chaos-net-drop-p") == "drop_p"
+    assert specs.RING_CONFIG.flag_to_field("--ring-queue-depth") == (
+        "ring_queue_depth"
+    )
+    assert specs.REBALANCE_CONFIG.flag_to_field("--rebalance") == (
+        "rebalance_enabled"
+    )
+    assert specs.REBALANCE_CONFIG.flag_to_field("--rebalance-min-gap") == (
+        "rebalance_min_gap"
+    )
+    assert specs.SERVE_CONFIG.flag_to_field("--serve-max-cells") == (
+        "serve_max_cells"
+    )
+    assert specs.SPARSE_CONFIG.flag_to_field("--sparse-block") == (
+        "sparse_block"
+    )
+
+
+def test_engine_findings_carry_real_anchors():
+    """Every spec's sides resolve to real files with 1-based lines."""
+    for spec in specs.SPECS:
+        if isinstance(spec, bijection.FlagConfigSpec):
+            names = {**spec.flags(REPO), **spec.fields(REPO)}
+        else:
+            names = {
+                k: v
+                for key, side in spec.sides.items()
+                if side.kind != "text"
+                for k, v in side.names(REPO).items()
+            }
+        assert names, spec.name
+        for name, (path, line) in names.items():
+            text = (REPO / path).read_text(encoding="utf-8")
+            assert name in text.splitlines()[line - 1], (
+                f"{spec.name}: {name} not on {path}:{line}"
+            )
+
+
+def test_pass_catalog_matches_spec_ids():
+    spec_ids = {s.pass_id for s in specs.SPECS}
+    assert spec_ids <= PASS_IDS
+    assert len({s.pass_id for s in specs.SPECS}) == len(specs.SPECS)
+    assert len(dict(PASS_CATALOG)) == len(PASS_CATALOG)
+
+
+# -- the standing gate: the repo itself runs clean ----------------------------
+
+def test_repo_clean_in_process():
+    findings = run()
+    unwaived = [f for f in findings if not f.waived]
+    assert not unwaived, "\n".join(f.render() for f in unwaived)
+    # Waivers exist and every one carries a reason (GL-META01 would have
+    # fired above otherwise) — the waiver surface is intentional, not off.
+    assert all(f.waive_reason for f in findings if f.waived)
+
+
+def test_graftlint_cli_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_all_repo_clean():
+    """The aggregate runner: graftlint + all 8 shim CLIs, one command."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_all.py"), "--json"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True
+    assert doc["graftlint"]["unwaived"] == 0
+    assert set(doc["shims"]) == {
+        "check_chaos_config", "check_ring_config", "check_rebalance_config",
+        "check_serve_config", "check_sparse_config", "check_metrics_doc",
+        "check_trace_names", "check_protocol_msgs",
+    }
+    assert all(rc == 0 for rc in doc["shims"].values())
+
+
+def test_finding_output_format_is_uniform():
+    """Satellite: every finding renders as ``path:line: PASS-ID message``."""
+    import re
+
+    from tools.graftlint.core import Finding
+
+    line = Finding("a/b.py", 12, "GL-LOCK01", "msg").render()
+    assert re.fullmatch(r"\S+:\d+: GL-[A-Z0-9]+ .+", line)
+
+
+# -- regression: the lock-discipline fixes changed no behavior ----------------
+
+def test_session_router_drop_and_drain_behavior_unchanged():
+    """serve/sessions: ``_drop`` → ``_drop_locked`` rename + drain() on the
+    injected clock.  delete/evict/drain semantics are identical."""
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.serve.sessions import SessionRouter
+
+    now = [0.0]
+    cfg = SimulationConfig(serve_ttl_s=5.0)
+    with SessionRouter(
+        cfg, registry=MetricsRegistry(), clock=lambda: now[0]
+    ) as router:
+        doc = router.create(tenant="t1", height=8, width=8, seed=1)
+        sid = doc["id"]
+        assert router.get(sid)["id"] == sid
+        # delete() still drops the session and frees the cell budget.
+        router.delete(sid)
+        with pytest.raises(KeyError):
+            router.get(sid)
+        assert router.stats()["cells"] == 0
+        # TTL eviction still rides the injected clock.
+        sid2 = router.create(tenant="t1", height=8, width=8, seed=2)["id"]
+        now[0] += 100.0
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if router.stats()["sessions"] == 0:
+                break
+            time.sleep(0.01)
+        assert router.stats()["sessions"] == 0
+        with pytest.raises(KeyError):
+            router.get(sid2)
+        # drain()'s bound stays REAL time (paired with its real sleep): an
+        # empty queue drains instantly, and with the injected clock frozen
+        # a stuck queue still times out to False instead of hanging.
+        assert router.drain(timeout=1.0) is True
+        from akka_game_of_life_tpu.serve.sessions import _Job
+
+        router.pause()
+        with router._lock:
+            router._draining = False
+            router._queue.append(_Job(sid="ghost", steps=1))
+        t0 = time.monotonic()
+        assert router.drain(timeout=0.3) is False
+        assert time.monotonic() - t0 < 5.0
+        with router._lock:
+            router._queue.clear()
+
+
+def test_backend_report_state_render_sample_unchanged():
+    """runtime/backend: ``_report_state`` now snapshots ``origins`` under
+    the worker lock; the render sample/origin it ships is bit-identical."""
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.runtime.backend import BackendWorker
+
+    w = BackendWorker(
+        "127.0.0.1", 1, name="w0", engine="numpy",
+        registry=MetricsRegistry(),
+    )
+    try:
+        sent = []
+        w.channel = type("Ch", (), {"send": lambda self, m: sent.append(m)})()
+        w.render_every = 2
+        w.render_strides = (2, 2)
+        with w._lock:
+            w.origins[(0, 0)] = (3, 5)
+        arr = np.arange(64, dtype=np.uint8).reshape(8, 8) % 2
+        w._report_state((0, 0), arr, 2)
+        (msg,) = sent
+        assert msg["reasons"] == ["render"]
+        oy, ox, sy, sx = 3, 5, 2, 2
+        np.testing.assert_array_equal(
+            msg["sample"], arr[(-oy) % sy :: sy, (-ox) % sx :: sx]
+        )
+        assert msg["scaled_origin"] == [
+            (oy + sy - 1) // sy, (ox + sx - 1) // sx,
+        ]
+    finally:
+        w._peer_listener.close()
+
+
+def test_frontend_gather_failed_avoid_owner_snapshot():
+    """runtime/frontend: ``_on_gather_failed`` snapshots each stuck
+    neighbor's owner inside the locked section; the redeploy still avoids
+    the owner that was current at decision time."""
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.runtime.frontend import Frontend
+    from akka_game_of_life_tpu.runtime.tiles import TileLayout
+
+    cfg = SimulationConfig(
+        height=8, width=8, max_epochs=4, port=0, stuck_timeout_s=0.01
+    )
+    fe = Frontend(cfg, registry=MetricsRegistry())
+    try:
+        fe.layout = TileLayout((8, 8), (2, 1))
+        member = fe.membership.register(None, "w0", peer_host="h", peer_port=1)
+        fe.membership.register(None, "w1", peer_host="h", peer_port=2)
+        long_ago = time.monotonic() - 10.0
+        with fe._lock:
+            fe.tile_owner = {(0, 0): "w0", (1, 0): "w1"}
+            fe.tile_epochs = {(0, 0): 3, (1, 0): 0}
+            fe._last_ring_time = {(0, 0): long_ago, (1, 0): long_ago}
+        calls = []
+        fe._redeploy_tile = lambda tile, preferred=None, avoid=None: (
+            calls.append((tile, avoid))
+        )
+        fe._on_gather_failed(member, (0, 0), 3)
+        # The stuck neighbor (1, 0) redeploys away from its owner-at-
+        # decision-time, exactly as before the locking fix.
+        assert calls == [((1, 0), "w1")]
+    finally:
+        fe._listener.close()

@@ -1,105 +1,46 @@
 #!/usr/bin/env python3
-"""Lint: the ``--chaos-net-*`` CLI surface and ``NetworkChaosConfig`` cannot
-drift apart.
+"""Lint shim: the ``--chaos-net-*`` CLI surface ↔ ``NetworkChaosConfig``
+fields (graftlint pass ``GL-CFG01``).
+Engine spec: ``tools/graftlint/specs.CHAOS_CONFIG``.  Driven by
+``tests/test_netchaos.py::test_every_chaos_net_flag_maps_to_config``
+(tier-1), and runnable standalone::
 
-Two-way check, the config analog of ``check_metrics_doc.py`` /
-``check_trace_names.py``:
-
-1. every ``--chaos-net-X`` flag declared in ``cli.py`` must map to a
-   ``NetworkChaosConfig`` field named ``X`` (dashes to underscores; the bare
-   ``--chaos-net`` arming flag maps to ``enabled``) — a flag that sets
-   nothing is a lie in the --help text;
-2. every ``NetworkChaosConfig`` field must be reachable from some
-   ``--chaos-net-*`` flag — a knob the CLI cannot set silently rots.
-
-Driven by ``tests/test_netchaos.py::test_every_chaos_net_flag_maps_to_config``
-(tier-1), and runnable standalone:
-
-    python tools/check_chaos_config.py      # exit 1 + list when stale
-
-No third-party imports, and both sides are parsed textually (not imported)
-so the lint works before the environment is set up.
+    python tools/check_chaos_config.py      # exit 1 + findings when stale
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-CLI = REPO / "akka_game_of_life_tpu" / "cli.py"
-CONFIG = REPO / "akka_game_of_life_tpu" / "runtime" / "config.py"
+sys.path.insert(0, str(REPO))
 
-# A --chaos-net flag literal inside an add_argument call.
-_FLAG = re.compile(r"""["'](--chaos-net(?:-[a-z0-9-]+)?)["']""")
-
-# A dataclass field line: four-space indent, name, annotation.
-_FIELD = re.compile(r"^    (\w+)\s*:", re.M)
+from tools.graftlint import bijection  # noqa: E402
+from tools.graftlint.shim import shim_main  # noqa: E402
+from tools.graftlint.specs import CHAOS_CONFIG as SPEC  # noqa: E402
 
 
 def flag_names() -> set:
-    return set(_FLAG.findall(CLI.read_text(encoding="utf-8")))
+    return set(SPEC.flags(REPO))
 
 
 def config_fields() -> set:
-    text = CONFIG.read_text(encoding="utf-8")
-    try:
-        block = text.split("class NetworkChaosConfig", 1)[1]
-    except IndexError:
-        return set()
-    # Fields end where the first method begins.
-    block = block.split("    def ", 1)[0]
-    return set(_FIELD.findall(block))
-
-
-def flag_to_field(flag: str) -> str:
-    rest = flag[len("--chaos-net"):].lstrip("-")
-    return rest.replace("-", "_") if rest else "enabled"
+    return set(SPEC.fields(REPO))
 
 
 def problems() -> list:
-    out = []
-    flags = flag_names()
-    fields = config_fields()
-    if not fields:
-        return ["NetworkChaosConfig not found in runtime/config.py"]
-    mapped = set()
-    for flag in sorted(flags):
-        field = flag_to_field(flag)
-        mapped.add(field)
-        if field not in fields:
-            out.append(
-                f"flag {flag!r} maps to no NetworkChaosConfig field "
-                f"({field!r} missing)"
-            )
-    for field in sorted(fields - mapped):
-        out.append(
-            f"NetworkChaosConfig.{field} has no --chaos-net-* flag"
-        )
-    return out
+    return [f.render() for f in bijection.problems(SPEC, REPO)]
 
 
 def main() -> int:
-    flags = flag_names()
-    if not flags:
-        print(
-            "check_chaos_config: found NO --chaos-net flags in cli.py — the "
-            "scan is broken, not the config",
-            file=sys.stderr,
-        )
-        return 2
-    bad = problems()
-    if bad:
-        print(f"{len(bad)} chaos-config problem(s):", file=sys.stderr)
-        for line in bad:
-            print(f"  - {line}", file=sys.stderr)
-        return 1
-    print(
-        f"check_chaos_config: {len(flags)} --chaos-net flags all map onto "
-        f"{len(config_fields())} NetworkChaosConfig fields"
+    return shim_main(
+        SPEC,
+        prog="check_chaos_config",
+        scan=flag_names,
+        ok=lambda: f"{len(flag_names())} --chaos-net flags all map onto "
+        f"{len(config_fields())} NetworkChaosConfig fields",
     )
-    return 0
 
 
 if __name__ == "__main__":

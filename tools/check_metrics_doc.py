@@ -1,59 +1,37 @@
 #!/usr/bin/env python3
-"""Lint: every metric name registered in code is documented AND cataloged.
+"""Lint shim: every ``gol_*`` metric literal in code is documented in
+``docs/OPERATIONS.md`` AND pre-registered in ``obs/catalog.py``
+(graftlint pass ``GL-DOC01``).
+Engine spec: ``tools/graftlint/specs.METRICS_DOC``.  Driven by
+``tests/test_metrics.py::test_every_metric_in_code_is_documented``
+(tier-1), and runnable standalone::
 
-Scans ``akka_game_of_life_tpu/**/*.py`` for ``gol_*`` metric-name string
-literals (which covers the catalog AND any ad-hoc registration that bypasses
-it) and asserts each appears in
-
-1. ``docs/OPERATIONS.md``'s "Metrics & events" catalog — so the
-   operator-facing doc cannot silently rot as instrumentation grows;
-2. ``obs/catalog.py``'s ``CATALOG`` tuple — so every name is pre-registered
-   and a scrape always shows the full metric surface, zeros included (an
-   ad-hoc registration that skips the catalog would only appear after its
-   path first fired).
-
-Driven by ``tests/test_metrics.py::test_every_metric_in_code_is_
-documented`` (tier-1), and runnable standalone:
-
-    python tools/check_metrics_doc.py       # exit 1 + list when stale
-
-No third-party imports, and the catalog is parsed textually (not imported):
-usable before the environment is set up.
+    python tools/check_metrics_doc.py       # exit 1 + findings when stale
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-DOC = REPO / "docs" / "OPERATIONS.md"
-PACKAGE = REPO / "akka_game_of_life_tpu"
-CATALOG = PACKAGE / "obs" / "catalog.py"
+sys.path.insert(0, str(REPO))
 
-# A metric-name literal: the gol_ prefix is the package's namespace, so any
-# quoted gol_* identifier in the source IS a metric name (nothing else in
-# the codebase uses the prefix).
-_METRIC_LITERAL = re.compile(r"""["'](gol_[a-z0-9_]+)["']""")
+from tools.graftlint.shim import shim_main  # noqa: E402
+from tools.graftlint.specs import METRICS_DOC as SPEC  # noqa: E402
 
 
 def metric_names_in_code() -> set:
-    names = set()
-    for path in sorted(PACKAGE.rglob("*.py")):
-        names.update(_METRIC_LITERAL.findall(path.read_text(encoding="utf-8")))
-    return names
+    return set(SPEC.sides["code"].names(REPO))
 
 
 def catalog_names() -> set:
-    text = CATALOG.read_text(encoding="utf-8")
-    block = text.split("CATALOG = (", 1)[1].split("\n)\n", 1)[0]
-    return set(_METRIC_LITERAL.findall(block))
+    return set(SPEC.sides["catalog"].names(REPO))
 
 
 def undocumented() -> set:
-    doc = DOC.read_text(encoding="utf-8")
-    return {name for name in metric_names_in_code() if name not in doc}
+    doc = (REPO / "docs/OPERATIONS.md").read_text(encoding="utf-8")
+    return {n for n in metric_names_in_code() if n not in doc}
 
 
 def uncataloged() -> set:
@@ -61,31 +39,13 @@ def uncataloged() -> set:
 
 
 def main() -> int:
-    names = metric_names_in_code()
-    if not names:
-        print("check_metrics_doc: found NO gol_* metric literals — the scan "
-              "is broken, not the doc", file=sys.stderr)
-        return 2
-    rc = 0
-    missing = sorted(undocumented())
-    if missing:
-        print(f"{len(missing)} metric(s) registered in code but missing "
-              f"from {DOC.relative_to(REPO)}:", file=sys.stderr)
-        for name in missing:
-            print(f"  - {name}", file=sys.stderr)
-        rc = 1
-    stray = sorted(uncataloged())
-    if stray:
-        print(f"{len(stray)} metric(s) registered in code but missing from "
-              f"obs/catalog.py CATALOG (add them so scrapes pre-register "
-              f"the full surface):", file=sys.stderr)
-        for name in stray:
-            print(f"  - {name}", file=sys.stderr)
-        rc = 1
-    if rc == 0:
-        print(f"check_metrics_doc: {len(names)} metric names all documented "
-              f"and cataloged")
-    return rc
+    return shim_main(
+        SPEC,
+        prog="check_metrics_doc",
+        scan=metric_names_in_code,
+        ok=lambda: f"{len(metric_names_in_code())} metric names all documented "
+        f"and cataloged",
+    )
 
 
 if __name__ == "__main__":

@@ -1,106 +1,47 @@
 #!/usr/bin/env python3
-"""Lint: every wire-protocol message is documented, and the doc names only
-real messages.
+"""Lint shim: every wire-protocol message constant ↔ the OPERATIONS.md
+"Protocol messages" table, both directions (graftlint pass ``GL-DOC03``).
 
-Two-way check, the protocol analog of ``check_metrics_doc.py`` /
-``check_trace_names.py``:
+Engine spec: ``tools/graftlint/specs.PROTOCOL_MSGS``.  Driven by
+``tests/test_rebalance.py::test_every_protocol_msg_documented`` (tier-1),
+and runnable standalone::
 
-1. every ``NAME = "value"`` message constant declared at module level in
-   ``runtime/protocol.py`` must appear (as `` `value` `` in backticks) in
-   ``docs/OPERATIONS.md``'s "Protocol messages" table — a message the
-   operator docs don't name is invisible exactly when a wire capture needs
-   decoding (this is what catches a new MIGRATE/DRAIN message shipped
-   without its doc row);
-2. every message named in that table must be a declared constant — a doc
-   row for a message the code no longer speaks is worse than none.
-
-Driven by ``tests/test_rebalance.py::test_every_protocol_msg_documented``
-(tier-1), and runnable standalone:
-
-    python tools/check_protocol_msgs.py     # exit 1 + list when stale
-
-No third-party imports, and both sides are parsed textually (not imported)
-so the lint works before the environment is set up.
+    python tools/check_protocol_msgs.py     # exit 1 + findings when stale
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-PROTOCOL = REPO / "akka_game_of_life_tpu" / "runtime" / "protocol.py"
-DOC = REPO / "docs" / "OPERATIONS.md"
+sys.path.insert(0, str(REPO))
 
-# A module-level message constant: NAME = "wire_value" at column 0.
-_CONST = re.compile(r'^([A-Z][A-Z0-9_]*)\s*=\s*"([a-z][a-z0-9_]*)"\s*$', re.M)
-
-# A "Protocol messages" table row: | `value` | ... (scoped to the table so
-# message values mentioned in prose elsewhere don't satisfy/poison check 2).
-_DOC_ROW = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`\s*\|", re.M)
+from tools.graftlint import bijection  # noqa: E402
+from tools.graftlint.shim import shim_main  # noqa: E402
+from tools.graftlint.specs import PROTOCOL_MSGS as SPEC  # noqa: E402
 
 
-def protocol_messages() -> dict:
-    """{wire value: CONSTANT_NAME} declared in protocol.py."""
-    text = PROTOCOL.read_text(encoding="utf-8")
-    # Constants live after the docstring; _CONST's column-0 anchor already
-    # excludes the docstring's indented table rows.
-    return {value: name for name, value in _CONST.findall(text)}
+def protocol_messages() -> set:
+    return set(SPEC.sides["decl"].names(REPO))
 
 
 def documented_messages() -> set:
-    text = DOC.read_text(encoding="utf-8")
-    try:
-        section = text.split("### Protocol messages", 1)[1]
-    except IndexError:
-        return set()
-    # The table ends at the next heading.
-    section = section.split("\n#", 1)[0]
-    return set(_DOC_ROW.findall(section))
+    return set(SPEC.sides["doc"].names(REPO))
 
 
 def problems() -> list:
-    out = []
-    declared = protocol_messages()
-    documented = documented_messages()
-    if not documented:
-        return [
-            'no "### Protocol messages" table found in docs/OPERATIONS.md'
-        ]
-    for value in sorted(set(declared) - documented):
-        out.append(
-            f"protocol message {declared[value]} = {value!r} has no row in "
-            f"the OPERATIONS.md protocol table"
-        )
-    for value in sorted(documented - set(declared)):
-        out.append(
-            f"OPERATIONS.md documents protocol message {value!r} which "
-            f"protocol.py does not declare"
-        )
-    return out
+    return [f.render() for f in bijection.problems(SPEC, REPO)]
 
 
 def main() -> int:
-    declared = protocol_messages()
-    if not declared:
-        print(
-            "check_protocol_msgs: found NO message constants in "
-            "runtime/protocol.py — the scan is broken, not the doc",
-            file=sys.stderr,
-        )
-        return 2
-    bad = problems()
-    if bad:
-        print(f"{len(bad)} protocol-doc problem(s):", file=sys.stderr)
-        for line in bad:
-            print(f"  - {line}", file=sys.stderr)
-        return 1
-    print(
-        f"check_protocol_msgs: {len(declared)} protocol messages all "
-        f"documented in OPERATIONS.md"
+    return shim_main(
+        SPEC,
+        prog="check_protocol_msgs",
+        scan=protocol_messages,
+        ok=lambda: f"{len(protocol_messages())} protocol messages all documented "
+        f"in OPERATIONS.md",
     )
-    return 0
 
 
 if __name__ == "__main__":

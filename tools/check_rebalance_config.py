@@ -1,103 +1,46 @@
 #!/usr/bin/env python3
-"""Lint: the ``--rebalance-*`` CLI surface and ``SimulationConfig``'s
-``rebalance_*`` fields cannot drift apart.
+"""Lint shim: the ``--rebalance-*`` CLI surface ↔ ``SimulationConfig rebalance_*`` fields
+(graftlint pass ``GL-CFG03``).
+Engine spec: ``tools/graftlint/specs.REBALANCE_CONFIG``.  Driven by
+``tests/test_rebalance.py::test_every_rebalance_flag_maps_to_config``
+(tier-1), and runnable standalone::
 
-Two-way check, the elastic-plane analog of ``check_chaos_config.py`` /
-``check_ring_config.py``:
-
-1. every ``--rebalance-X`` flag declared in ``cli.py`` must map to a
-   ``SimulationConfig`` field named ``rebalance_X`` (dashes to underscores;
-   the bare ``--rebalance`` arming flag maps to ``rebalance_enabled``) — a
-   flag that sets nothing is a lie in the --help text;
-2. every ``SimulationConfig.rebalance_*`` field must be reachable from some
-   ``--rebalance*`` flag — a knob the CLI cannot set silently rots.
-
-Driven by ``tests/test_rebalance.py::test_every_rebalance_flag_maps_to_config``
-(tier-1), and runnable standalone:
-
-    python tools/check_rebalance_config.py  # exit 1 + list when stale
-
-No third-party imports, and both sides are parsed textually (not imported)
-so the lint works before the environment is set up.
+    python tools/check_rebalance_config.py      # exit 1 + findings when stale
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-CLI = REPO / "akka_game_of_life_tpu" / "cli.py"
-CONFIG = REPO / "akka_game_of_life_tpu" / "runtime" / "config.py"
+sys.path.insert(0, str(REPO))
 
-# A --rebalance flag literal inside an add_argument call.
-_FLAG = re.compile(r"""["'](--rebalance(?:-[a-z0-9-]+)?)["']""")
-
-# A rebalance_* dataclass field line: four-space indent, name, annotation.
-_FIELD = re.compile(r"^    (rebalance_\w+)\s*:", re.M)
+from tools.graftlint import bijection  # noqa: E402
+from tools.graftlint.shim import shim_main  # noqa: E402
+from tools.graftlint.specs import REBALANCE_CONFIG as SPEC  # noqa: E402
 
 
 def flag_names() -> set:
-    return set(_FLAG.findall(CLI.read_text(encoding="utf-8")))
+    return set(SPEC.flags(REPO))
 
 
 def config_fields() -> set:
-    text = CONFIG.read_text(encoding="utf-8")
-    try:
-        block = text.split("class SimulationConfig", 1)[1]
-    except IndexError:
-        return set()
-    # Fields end where the first method begins.
-    block = block.split("    def ", 1)[0]
-    return set(_FIELD.findall(block))
-
-
-def flag_to_field(flag: str) -> str:
-    rest = flag[len("--rebalance"):].lstrip("-")
-    return f"rebalance_{rest.replace('-', '_')}" if rest else "rebalance_enabled"
+    return set(SPEC.fields(REPO))
 
 
 def problems() -> list:
-    out = []
-    flags = flag_names()
-    fields = config_fields()
-    if not fields:
-        return ["no rebalance_* fields found in SimulationConfig"]
-    mapped = set()
-    for flag in sorted(flags):
-        field = flag_to_field(flag)
-        mapped.add(field)
-        if field not in fields:
-            out.append(
-                f"flag {flag!r} maps to no SimulationConfig field "
-                f"({field!r} missing)"
-            )
-    for field in sorted(fields - mapped):
-        out.append(f"SimulationConfig.{field} has no --rebalance-* flag")
-    return out
+    return [f.render() for f in bijection.problems(SPEC, REPO)]
 
 
 def main() -> int:
-    flags = flag_names()
-    if not flags:
-        print(
-            "check_rebalance_config: found NO --rebalance flags in cli.py — "
-            "the scan is broken, not the config",
-            file=sys.stderr,
-        )
-        return 2
-    bad = problems()
-    if bad:
-        print(f"{len(bad)} rebalance-config problem(s):", file=sys.stderr)
-        for line in bad:
-            print(f"  - {line}", file=sys.stderr)
-        return 1
-    print(
-        f"check_rebalance_config: {len(flags)} --rebalance flags all map "
-        f"onto {len(config_fields())} SimulationConfig fields"
+    return shim_main(
+        SPEC,
+        prog="check_rebalance_config",
+        scan=flag_names,
+        ok=lambda: f"{len(flag_names())} --rebalance-* flags all map onto "
+        f"{len(config_fields())} SimulationConfig rebalance_* fields",
     )
-    return 0
 
 
 if __name__ == "__main__":

@@ -1,94 +1,47 @@
 #!/usr/bin/env python3
-"""Lint: every trace span name the runtime emits is documented.
+"""Lint shim: every span name the runtime emits is in ``SPAN_CATALOG``,
+and every catalog name is documented in ``docs/OPERATIONS.md``
+(graftlint pass ``GL-DOC02``).
+Engine spec: ``tools/graftlint/specs.TRACE_NAMES``.  Driven by
+``tests/test_tracing.py::test_every_span_name_is_documented`` (tier-1),
+and runnable standalone::
 
-Two-way check, the span analog of ``check_metrics_doc.py``:
-
-1. every span-name literal passed to ``.span("...")`` / ``.start("...")``
-   in ``akka_game_of_life_tpu/**/*.py`` must be declared in
-   ``obs/tracing.SPAN_CATALOG`` (no ad-hoc names sneaking past the catalog);
-2. every catalog name must appear in ``docs/OPERATIONS.md``'s "Tracing &
-   flight recorder" table (the operator-facing doc cannot rot).
-
-Driven by ``tests/test_tracing.py::test_every_span_name_is_documented``
-(tier-1), and runnable standalone:
-
-    python tools/check_trace_names.py       # exit 1 + list when stale
-
-No third-party imports, and the catalog is parsed textually (not imported)
-so the lint works before the environment is set up.
+    python tools/check_trace_names.py       # exit 1 + findings when stale
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-DOC = REPO / "docs" / "OPERATIONS.md"
-PACKAGE = REPO / "akka_game_of_life_tpu"
-TRACING = PACKAGE / "obs" / "tracing.py"
+sys.path.insert(0, str(REPO))
 
-# A span-creation call with a literal name: tracer.span("epoch", ...) /
-# tracer.start("backend.step", ...) / the checkpoint stores'
-# self._span("checkpoint.save") wrapper.  Dynamic names (profiling.timed's
-# labels) intentionally do not match — they are documented as a family.
-_SPAN_CALL = re.compile(
-    r"""\.(?:span|start|_span)\(\s*\n?\s*["']([a-z][a-z0-9_.]*)["']"""
-)
-
-# SPAN_CATALOG entries: ("name", "meaning"),
-_CATALOG_ENTRY = re.compile(r"""^\s*\(\s*["']([a-z][a-z0-9_.]*)["']\s*,""", re.M)
-
-
-def catalog_names() -> set:
-    text = TRACING.read_text(encoding="utf-8")
-    block = text.split("SPAN_CATALOG = (", 1)[1].split("\n)\n", 1)[0]
-    return set(_CATALOG_ENTRY.findall(block))
+from tools.graftlint import bijection  # noqa: E402
+from tools.graftlint.shim import shim_main  # noqa: E402
+from tools.graftlint.specs import TRACE_NAMES as SPEC  # noqa: E402
 
 
 def span_names_in_code() -> set:
-    names = set()
-    for path in sorted(PACKAGE.rglob("*.py")):
-        names.update(_SPAN_CALL.findall(path.read_text(encoding="utf-8")))
-    return names
+    return set(SPEC.sides["code"].names(REPO))
+
+
+def catalog_names() -> set:
+    return set(SPEC.sides["catalog"].names(REPO))
 
 
 def problems() -> list:
-    out = []
-    catalog = catalog_names()
-    doc = DOC.read_text(encoding="utf-8")
-    for name in sorted(span_names_in_code() - catalog):
-        out.append(f"span {name!r} emitted in code but not in SPAN_CATALOG")
-    for name in sorted(catalog):
-        if f"`{name}`" not in doc:
-            out.append(
-                f"span {name!r} in SPAN_CATALOG but missing from "
-                f"{DOC.relative_to(REPO)}"
-            )
-    return out
+    return [f.render() for f in bijection.problems(SPEC, REPO)]
 
 
 def main() -> int:
-    emitted = span_names_in_code()
-    if not emitted:
-        print(
-            "check_trace_names: found NO .span()/.start() literals — the "
-            "scan is broken, not the doc",
-            file=sys.stderr,
-        )
-        return 2
-    bad = problems()
-    if bad:
-        print(f"{len(bad)} trace-name problem(s):", file=sys.stderr)
-        for line in bad:
-            print(f"  - {line}", file=sys.stderr)
-        return 1
-    print(
-        f"check_trace_names: {len(emitted)} emitted span names all "
-        f"cataloged and documented"
+    return shim_main(
+        SPEC,
+        prog="check_trace_names",
+        scan=span_names_in_code,
+        ok=lambda: f"{len(span_names_in_code())} emitted span names all cataloged "
+        f"and documented",
     )
-    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,25 @@
+"""graftlint — the repo's AST-based static analysis subsystem.
+
+One entry point (``python -m tools.graftlint [--json] [paths...]``) runs
+three pass families over the package:
+
+- **lock discipline** (:mod:`tools.graftlint.locks`): attributes declared
+  ``guarded-by`` a lock must only be touched under that lock, inside a
+  ``*_locked`` method, or under an explicit waiver — the pass that makes
+  the PR 9 unlocked ring-rotation bug class unwritable;
+- **JAX/threading hazards** (:mod:`tools.graftlint.hazards`): method-level
+  ``lru_cache`` (the SparseStepper 256 MB pin), 64-bit jnp dtypes in
+  x64-disabled kernel code, device compute under a lock, and bare wall
+  clocks inside injectable-clock classes;
+- **declarative bijections** (:mod:`tools.graftlint.bijection` +
+  :mod:`tools.graftlint.specs`): the data-driven engine behind every
+  ``tools/check_*.py`` drift lint (CLI flag ↔ config field, code literal ↔
+  catalog ↔ doc table).
+
+Every finding prints as ``path:line: PASS-ID message``; waivers are
+``# graftlint: waive PASS-ID -- reason`` and must carry a reason.  The
+pass table lives in ``docs/OPERATIONS.md`` ("Static analysis") and is
+itself bijection-enforced (GL-DOC04).
+"""
+
+from tools.graftlint.core import Finding, PASS_CATALOG, run  # noqa: F401

@@ -1,0 +1,20 @@
+"""``python -m tools.graftlint [--json] [paths...]`` — run every pass.
+
+Exit codes: 0 clean (waived findings allowed), 1 unwaived findings,
+2 a scan itself broke.  Paths (files or directories) restrict the AST
+passes; the bijection specs always run repo-wide.
+"""
+
+import sys
+from pathlib import Path
+
+# Runnable as a script too (``python tools/graftlint/__main__.py``): the
+# package imports below need the repo root on sys.path.
+_REPO = Path(__file__).resolve().parent.parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from tools.graftlint.core import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

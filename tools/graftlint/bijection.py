@@ -1,0 +1,235 @@
+"""Declarative bijection engine — the data plane behind every drift lint.
+
+The eight ``tools/check_*.py`` scripts were 813 lines of near-identical
+copy-paste.  This module keeps their *textual* property (every side is
+parsed with regexes, never imported, so the lints run before the
+environment is set up) and moves everything that varied into data
+(:mod:`tools.graftlint.specs`): :class:`FlagConfigSpec` (a CLI flag
+family ↔ a config class's fields) and :class:`CatalogSpec` (named *sides*
+— code literals, a catalog block, a doc table — plus subset *relations*
+between them).  Findings carry real file:line anchors in the repo-wide
+``path:line: PASS-ID message`` shape; each legacy script survives as a
+thin shim exposing its historical API on top of this engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from tools.graftlint.core import Finding
+
+Names = Dict[str, Tuple[str, int]]  # name -> (repo-relative path, line)
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def _scan(text: str, path: str, regex: re.Pattern, offset: int = 0) -> Names:
+    out: Names = {}
+    for m in regex.finditer(text):
+        name = m.group(m.lastindex or 0)
+        out.setdefault(name, (path, _line_of(text, m.start()) + offset))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Side:
+    """Where one set of names comes from.  ``kind``:
+
+    - ``files``: ``regex`` over every file matching ``glob`` under the repo;
+    - ``block``: ``regex`` over the slice of ``path`` between ``start`` and
+      ``end`` markers (a catalog tuple, a dataclass body);
+    - ``section``: ``regex`` over the slice of ``path`` from the ``start``
+      heading to the next line beginning with ``end`` (a doc table);
+    - ``text``: membership-only — a name is present iff ``member_fmt``
+      formatted with it appears anywhere in ``path`` (cannot enumerate, so
+      only valid on the right of a relation).
+    """
+
+    kind: str
+    regex: Optional[str] = None
+    glob: Optional[str] = None
+    path: Optional[str] = None
+    start: Optional[str] = None
+    end: Optional[str] = None
+    member_fmt: str = "{name}"
+
+    def names(self, root: Path) -> Names:
+        rx = re.compile(self.regex, re.M) if self.regex else None
+        if self.kind == "files":
+            out: Names = {}
+            for f in sorted(root.glob(self.glob)):
+                found = _scan(
+                    f.read_text(encoding="utf-8"),
+                    f.relative_to(root).as_posix(), rx,
+                )
+                for name, where in found.items():
+                    out.setdefault(name, where)
+            return out
+        text = (root / self.path).read_text(encoding="utf-8")
+        if self.kind == "block":
+            try:
+                pre, rest = text.split(self.start, 1)
+            except ValueError:
+                return {}
+            block = rest.split(self.end, 1)[0] if self.end else rest
+            return _scan(block, self.path, rx, offset=_line_of(text, len(pre)) - 1)
+        if self.kind == "section":
+            try:
+                pre, rest = text.split(self.start, 1)
+            except ValueError:
+                return {}
+            kept = []
+            for line in rest.splitlines():
+                if self.end and line.startswith(self.end):
+                    break
+                kept.append(line)
+            return _scan(
+                "\n".join(kept), self.path, rx,
+                offset=_line_of(text, len(pre)) - 1,
+            )
+        raise ValueError(f"side kind {self.kind!r} cannot enumerate")
+
+    def contains(self, root: Path, name: str) -> bool:
+        if self.kind == "text":
+            text = (root / self.path).read_text(encoding="utf-8")
+            return self.member_fmt.format(name=name) in text
+        return name in self.names(root)
+
+    def anchor(self, root: Path) -> Tuple[str, int]:
+        """Fallback file:line for findings about names *absent* from an
+        enumerable location: the start marker's line, else line 1."""
+        if self.path:
+            if self.start:
+                text = (root / self.path).read_text(encoding="utf-8")
+                pos = text.find(self.start)
+                if pos >= 0:
+                    return self.path, _line_of(text, pos)
+            return self.path, 1
+        return self.glob or "<repo>", 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Relation:
+    """Every name on ``left`` must be present on ``right``."""
+
+    left: str
+    right: str
+    message: str
+
+
+@dataclasses.dataclass(frozen=True)
+class CatalogSpec:
+    """A literal↔catalog↔doc lint: sides + subset relations."""
+
+    name: str
+    pass_id: str
+    sides: Dict[str, Side]
+    relations: Tuple[Relation, ...]
+    # (side key, message): an empty scan here means the SCAN broke.
+    scan_guard: Tuple[str, str] = ("", "")
+
+
+@dataclasses.dataclass(frozen=True)
+class FlagConfigSpec:
+    """A CLI flag family ↔ config-class field family bijection."""
+
+    name: str
+    pass_id: str
+    flag_regex: str  # one capture group: the full --flag literal
+    config_class: str
+    field_regex: str  # one capture group: the field name
+    flag_strip: str  # prefix removed before mapping to a field
+    field_prefix: str = ""
+    bare_field: Optional[str] = None  # field for the bare ``flag_strip`` flag
+    cli_path: str = "akka_game_of_life_tpu/cli.py"
+    config_path: str = "akka_game_of_life_tpu/runtime/config.py"
+
+    def flag_to_field(self, flag: str) -> str:
+        rest = flag[len(self.flag_strip):].lstrip("-").replace("-", "_")
+        if not rest:
+            return self.bare_field or rest
+        return self.field_prefix + rest
+
+    def flags(self, root: Path) -> Names:
+        text = (root / self.cli_path).read_text(encoding="utf-8")
+        return _scan(text, self.cli_path, re.compile(self.flag_regex))
+
+    def fields(self, root: Path) -> Names:
+        text = (root / self.config_path).read_text(encoding="utf-8")
+        marker = f"class {self.config_class}"
+        try:
+            pre, rest = text.split(marker, 1)
+        except ValueError:
+            return {}
+        block = rest.split("    def ", 1)[0]  # fields end at first method
+        return _scan(
+            block, self.config_path, re.compile(self.field_regex, re.M),
+            offset=_line_of(text, len(pre)) - 1,
+        )
+
+
+def problems(spec, root: Path) -> List[Finding]:
+    if isinstance(spec, FlagConfigSpec):
+        return _flag_config_problems(spec, root)
+    return _catalog_problems(spec, root)
+
+
+def _flag_config_problems(spec: FlagConfigSpec, root: Path) -> List[Finding]:
+    flags, fields = spec.flags(root), spec.fields(root)
+    if not flags:
+        return [Finding(spec.cli_path, 1, spec.pass_id, f"scan broken: "
+                        f"found NO {spec.flag_strip}* flags in cli.py")]
+    if not fields:
+        return [Finding(spec.config_path, 1, spec.pass_id, f"scan broken: "
+                        f"{spec.config_class} fields not found")]
+    out: List[Finding] = []
+    mapped = set()
+    for flag, (path, line) in sorted(flags.items()):
+        field = spec.flag_to_field(flag)
+        mapped.add(field)
+        if field not in fields:
+            out.append(Finding(
+                path, line, spec.pass_id,
+                f"flag {flag!r} maps to no {spec.config_class} field "
+                f"({field!r} missing) — a flag that sets nothing is a "
+                f"lie in the --help text"))
+    for field in sorted(set(fields) - mapped):
+        path, line = fields[field]
+        out.append(Finding(
+            path, line, spec.pass_id,
+            f"{spec.config_class}.{field} has no {spec.flag_strip}* "
+            f"flag — a knob the CLI cannot set silently rots"))
+    return out
+
+
+def _catalog_problems(spec: CatalogSpec, root: Path) -> List[Finding]:
+    guard_key, guard_msg = spec.scan_guard
+    if guard_key:
+        side = spec.sides[guard_key]
+        if not side.names(root):
+            path, line = side.anchor(root)
+            return [Finding(path, line, spec.pass_id, guard_msg)]
+    out: List[Finding] = []
+    left_cache: Dict[str, Names] = {}
+    for rel in spec.relations:
+        left, right = spec.sides[rel.left], spec.sides[rel.right]
+        if right.kind == "text":
+            # One read per relation, not one per name.
+            text = (root / right.path).read_text(encoding="utf-8")
+            fmt = right.member_fmt
+            right_has = lambda n, t=text: fmt.format(name=n) in t  # noqa: E731
+        else:
+            rnames = right.names(root)
+            right_has = lambda n, r=rnames: n in r  # noqa: E731
+        if rel.left not in left_cache:
+            left_cache[rel.left] = left.names(root)
+        for name, (path, line) in sorted(left_cache[rel.left].items()):
+            if not right_has(name):
+                out.append(Finding(path, line, spec.pass_id,
+                                   rel.message.format(name=name)))
+    return out

@@ -1,0 +1,207 @@
+"""Shared graftlint machinery: findings, waivers, source model, runner.
+
+Output contract (every lint in the repo, shims included, speaks it):
+
+    path:line: PASS-ID message
+
+Waiver contract: a finding is waived by a comment on its own line or the
+line directly above —
+
+    # graftlint: waive GL-LOCK01 -- reason the operator will still believe
+    # graftlint: waive GL-LOCK01,GL-HAZ03 -- one reason may cover several
+
+A waiver **must** carry a ``-- reason``; a reasonless waiver is itself a
+finding (GL-META01) and cannot be waived.  Waived findings still appear in
+``--json`` (``"waived": true``) so the waiver surface stays auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO = Path(__file__).resolve().parent.parent.parent
+PACKAGE = REPO / "akka_game_of_life_tpu"
+
+# The pass surface.  docs/OPERATIONS.md's "Static analysis" table must name
+# every id here and nothing else — spec GL-DOC04 enforces the bijection, so
+# this tuple cannot drift from the operator doc.
+PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
+    ("GL-LOCK01", "guarded attribute touched outside its declared lock"),
+    ("GL-LOCK02", "malformed guarded-by declaration"),
+    ("GL-HAZ01", "functools.lru_cache/cache on an instance method"),
+    ("GL-HAZ02", "64-bit jnp dtype in x64-disabled kernel code"),
+    ("GL-HAZ03", "device compute / block_until_ready under a lock"),
+    ("GL-HAZ04", "bare wall clock inside an injectable-clock class"),
+    ("GL-META01", "waiver without a reason"),
+    ("GL-CFG01", "--chaos-net-* flags ↔ NetworkChaosConfig fields"),
+    ("GL-CFG02", "--ring-* flags ↔ SimulationConfig ring_* fields"),
+    ("GL-CFG03", "--rebalance-* flags ↔ SimulationConfig rebalance_* fields"),
+    ("GL-CFG04", "--serve-* flags ↔ SimulationConfig serve_* fields"),
+    ("GL-CFG05", "--sparse-* flags ↔ SimulationConfig sparse_* fields"),
+    ("GL-DOC01", "gol_* metric literals ↔ obs catalog ↔ OPERATIONS.md"),
+    ("GL-DOC02", "span names ↔ SPAN_CATALOG ↔ OPERATIONS.md"),
+    ("GL-DOC03", "protocol messages ↔ OPERATIONS.md table"),
+    ("GL-DOC04", "graftlint pass ids ↔ OPERATIONS.md static-analysis table"),
+)
+PASS_IDS = frozenset(pid for pid, _ in PASS_CATALOG)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint result, pinned to a file:line."""
+
+    path: str  # repo-relative, forward slashes
+    line: int
+    pass_id: str
+    message: str
+    waived: bool = False
+    waive_reason: Optional[str] = None
+
+    def render(self) -> str:
+        tag = " (waived)" if self.waived else ""
+        return f"{self.path}:{self.line}: {self.pass_id} {self.message}{tag}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_WAIVE = re.compile(
+    r"#\s*graftlint:\s*waive\s+([A-Z0-9,\- ]+?)\s*(?:--\s*(.*))?$"
+)
+
+
+class SourceFile:
+    """One parsed python source: text, AST, and the waiver map."""
+
+    def __init__(self, path: Path, text: Optional[str] = None) -> None:
+        self.path = path
+        self.text = path.read_text(encoding="utf-8") if text is None else text
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(path))
+        # line -> (frozenset of waived pass ids, reason or None)
+        self.waivers: Dict[int, Tuple[frozenset, Optional[str]]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _WAIVE.search(line)
+            if m:
+                ids = frozenset(
+                    p.strip() for p in m.group(1).split(",") if p.strip()
+                )
+                reason = (m.group(2) or "").strip() or None
+                self.waivers[i] = (ids, reason)
+
+    @property
+    def rel(self) -> str:
+        try:
+            return self.path.resolve().relative_to(REPO).as_posix()
+        except ValueError:
+            return str(self.path)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def waiver_for(self, lineno: int, pass_id: str):
+        """The (ids, reason) waiver covering ``lineno`` for ``pass_id`` —
+        same line or the line directly above — or None."""
+        for ln in (lineno, lineno - 1):
+            entry = self.waivers.get(ln)
+            if entry and pass_id in entry[0]:
+                return entry
+        return None
+
+    def finding(self, lineno: int, pass_id: str, message: str) -> Finding:
+        """Build a finding, applying any covering waiver."""
+        f = Finding(self.rel, lineno, pass_id, message)
+        entry = self.waiver_for(lineno, pass_id)
+        if entry is not None and entry[1]:
+            f.waived, f.waive_reason = True, entry[1]
+        return f
+
+    def meta_findings(self) -> List[Finding]:
+        """GL-META01: every waiver comment must carry a ``-- reason``."""
+        out = []
+        for ln, (ids, reason) in sorted(self.waivers.items()):
+            if not reason:
+                out.append(
+                    Finding(
+                        self.rel, ln, "GL-META01",
+                        f"waiver for {', '.join(sorted(ids))} has no "
+                        f"'-- reason'; every waiver must say why",
+                    )
+                )
+        return out
+
+
+def iter_sources(paths: Sequence[Path]) -> Iterable[SourceFile]:
+    """Yield parsed sources for every .py under ``paths`` (files or dirs).
+    Unparseable files become GL-META findings downstream, not crashes."""
+    seen = set()
+    for p in paths:
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            key = f.resolve()
+            if key in seen:
+                continue
+            seen.add(key)
+            yield SourceFile(f)
+
+
+def run(
+    paths: Optional[Sequence[str]] = None,
+    *,
+    ast_passes: bool = True,
+    bijections: bool = True,
+) -> List[Finding]:
+    """Run every pass family; returns all findings (waived included)."""
+    from tools.graftlint import bijection, hazards, locks, specs
+
+    findings: List[Finding] = []
+    if ast_passes:
+        roots = [Path(p) for p in paths] if paths else [PACKAGE]
+        for src in iter_sources(roots):
+            findings.extend(src.meta_findings())
+            findings.extend(locks.check(src))
+            findings.extend(hazards.check(src))
+    if bijections:
+        for spec in specs.SPECS:
+            findings.extend(bijection.problems(spec, REPO))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    paths = [a for a in argv if a != "--json"]
+    try:
+        findings = run(paths or None)
+    except (OSError, SyntaxError) as e:
+        print(f"graftlint: scan failed: {e}", file=sys.stderr)
+        return 2
+    live = [f for f in findings if not f.waived]
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "unwaived": len(live),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render(), file=sys.stderr if not f.waived else sys.stdout)
+        waived = len(findings) - len(live)
+        print(
+            f"graftlint: {len(live)} finding(s), {waived} waived",
+            file=sys.stderr if live else sys.stdout,
+        )
+    return 1 if live else 0

@@ -1,0 +1,235 @@
+"""GL-HAZ: JAX / threading hazard pass.
+
+Four checks, each a mechanical version of a bug this repo actually shipped
+or reviewed out by luck:
+
+- **GL-HAZ01** — ``functools.lru_cache``/``cache`` decorating an instance
+  method.  The cache keys on ``self`` and lives on the class, so every
+  instance (and everything it retains — for a ``SparseStepper``, a 256 MB
+  board) is pinned for the life of the process.  Cache per instance
+  (``self._fns``) or on a module-level function instead.
+- **GL-HAZ02** — ``jnp.int64``/``jnp.uint64`` (or a ``dtype="int64"``
+  string handed to a jnp call) inside ``ops/`` / ``parallel/``.  x64 is
+  disabled by default, so these silently become 32-bit: the op computes
+  wrong widths without an error.  Use two 32-bit lanes (``ops/digest.py``)
+  or host-side numpy.
+- **GL-HAZ03** — device compute (``jnp.*`` / ``jax.*`` calls) or
+  ``.block_until_ready()`` lexically under a ``with ...lock:`` block.
+  Device work can take milliseconds-to-seconds; holding a lock across it
+  starves every peer thread (the serve ticker's discipline: snapshot under
+  the lock, compute outside).
+- **GL-HAZ04** — bare ``time.time()``/``time.monotonic()`` inside a class
+  whose ``__init__`` declares an injectable ``clock``/``wallclock``
+  parameter.  The injection point exists so tests control time; a bare
+  call re-couples the class to the wall clock (the drift the
+  SessionRouter's TTL tests exist to prevent).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.graftlint.core import Finding, SourceFile
+
+_X64_DIRS = ("akka_game_of_life_tpu/ops/", "akka_game_of_life_tpu/parallel/")
+_X64_NAMES = {"int64", "uint64"}
+_CLOCK_PARAMS = {"clock", "wallclock"}
+_CLOCK_CALLS = {"time", "monotonic"}
+
+
+def _jnp_aliases(tree: ast.Module) -> Set[str]:
+    """Names bound to jax.numpy in this module (``jnp`` by idiom)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy":
+                    out.add(a.asname or "jax")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        out.add(a.asname or "numpy")
+    return out
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an attribute/call chain: jnp.lax.foo -> 'jnp'."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_cache_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Name):
+        return dec.id in ("lru_cache", "cache")
+    if isinstance(dec, ast.Attribute):
+        return dec.attr in ("lru_cache", "cache") and _root_name(dec) in (
+            "functools",
+        )
+    return False
+
+
+def _clock_classes(tree: ast.Module) -> Set[str]:
+    """Class names whose __init__ declares clock= / wallclock=."""
+    out: Set[str] = set()
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in cls.body:
+            if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+                args = node.args
+                names = {
+                    a.arg
+                    for a in args.args + args.kwonlyargs + args.posonlyargs
+                }
+                if names & _CLOCK_PARAMS:
+                    out.add(cls.name)
+    return out
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, src: SourceFile) -> None:
+        self.src = src
+        self.findings: List[Finding] = []
+        self.jnp = _jnp_aliases(src.tree)
+        self.x64_scope = any(d in src.rel for d in _X64_DIRS)
+        self.clock_classes = _clock_classes(src.tree)
+        self.cls_stack: List[str] = []
+        self.lock_depth = 0
+
+    def _flag(self, node: ast.AST, pass_id: str, message: str) -> None:
+        self.findings.append(self.src.finding(node.lineno, pass_id, message))
+
+    # -- context -------------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.cls_stack.append(node.name)
+        self.generic_visit(node)
+        self.cls_stack.pop()
+
+    def visit_With(self, node: ast.With) -> None:
+        lockish = 0
+        for item in node.items:
+            ctx = item.context_expr
+            name = None
+            if isinstance(ctx, ast.Attribute):
+                name = ctx.attr
+            elif isinstance(ctx, ast.Name):
+                name = ctx.id
+            if name and ("lock" in name.lower() or "cond" in name.lower()):
+                lockish += 1
+        self.lock_depth += lockish
+        self.generic_visit(node)
+        self.lock_depth -= lockish
+
+    visit_AsyncWith = visit_With
+
+    # -- GL-HAZ01 ------------------------------------------------------------
+
+    def _visit_func(self, node) -> None:
+        if self.cls_stack:
+            args = node.args.posonlyargs + node.args.args
+            if args and args[0].arg == "self":
+                for dec in node.decorator_list:
+                    if _is_cache_decorator(dec):
+                        self._flag(
+                            dec, "GL-HAZ01",
+                            f"lru_cache on instance method "
+                            f"{self.cls_stack[-1]}.{node.name} keys on self "
+                            f"and pins every instance (and its arrays) in a "
+                            f"class-level cache for the process lifetime — "
+                            f"cache on the instance or a module function",
+                        )
+        self.generic_visit(node)
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _visit_func
+
+    # -- GL-HAZ02 ------------------------------------------------------------
+
+    def _is_jnp(self, node: ast.AST) -> bool:
+        """``node`` evaluates to jax.numpy: a recorded alias, or the bare
+        ``jax.numpy`` attribute chain (unaliased import)."""
+        if isinstance(node, ast.Name):
+            return node.id in self.jnp
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "numpy"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+        )
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            self.x64_scope
+            and node.attr in _X64_NAMES
+            and self._is_jnp(node.value)
+        ):
+            self._flag(
+                node, "GL-HAZ02",
+                f"{ast.unparse(node.value)}.{node.attr} in x64-disabled kernel code "
+                f"silently narrows to 32 bits — use paired uint32 lanes "
+                f"(ops/digest.py) or host numpy",
+            )
+        self.generic_visit(node)
+
+    # -- GL-HAZ03 / GL-HAZ04 -------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        root = _root_name(node.func)
+        if self.lock_depth > 0:
+            if root in self.jnp or root == "jax":
+                self._flag(
+                    node, "GL-HAZ03",
+                    f"device compute ({ast.unparse(node.func)}) under a "
+                    f"lock starves every thread queued on it — snapshot "
+                    f"under the lock, compute outside",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                self._flag(
+                    node, "GL-HAZ03",
+                    "block_until_ready() under a lock holds it for a whole "
+                    "device round-trip — sync outside the lock",
+                )
+        if (
+            self.x64_scope
+            and root in self.jnp
+            and any(
+                kw.arg == "dtype"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value in _X64_NAMES
+                for kw in node.keywords
+            )
+        ):
+            self._flag(
+                node, "GL-HAZ02",
+                "dtype='[u]int64' in a jnp call in x64-disabled kernel code "
+                "silently narrows to 32 bits",
+            )
+        if (
+            self.cls_stack
+            and self.cls_stack[-1] in self.clock_classes
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CLOCK_CALLS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time"
+        ):
+            self._flag(
+                node, "GL-HAZ04",
+                f"bare time.{node.func.attr}() inside {self.cls_stack[-1]}, "
+                f"which declares an injectable clock — use the injected "
+                f"clock so tests keep controlling time",
+            )
+        self.generic_visit(node)
+
+
+def check(src: SourceFile) -> List[Finding]:
+    checker = _Checker(src)
+    checker.visit(src.tree)
+    return checker.findings

@@ -1,0 +1,246 @@
+"""GL-LOCK: lock-discipline pass — guarded attributes stay under their lock.
+
+Declaration, once per class, either as a trailing comment on the
+attribute's init line::
+
+    self._rings = {}  # graftlint: guarded-by _lock
+
+or (for lock-heavy classes) as one class-level registry::
+
+    _GRAFTLINT_GUARDED = {"_rings": "_lock", "_pending": "_lock"}
+
+Every ``self.<attr>`` read or write of a declared attribute must then occur
+
+- lexically inside ``with self.<lock>:`` (RLock-aware — nested ``with``
+  blocks of the same lock are fine; ``threading.Condition`` attributes
+  count, acquiring a condition acquires its lock), or
+- inside a method whose name ends with ``_locked`` (the repo's existing
+  callers-hold-the-lock convention), or
+- inside ``__init__`` (construction precedes publication; the thread that
+  allocates the object is the only one that can see it), or
+- under a per-site waiver carrying a reason
+  (``# graftlint: waive GL-LOCK01 -- why this racy access is sound``).
+
+This is the pass that makes the PR 9 bug class unwritable: the ring
+last/prev rotation that raced until a second manual review moved it into
+``_step_tile``'s locked section would have been one ``GL-LOCK01`` line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from tools.graftlint.core import Finding, SourceFile
+
+_GUARD_COMMENT = re.compile(r"#\s*graftlint:\s*guarded-by\s+(\S+)")
+_SELF_ASSIGN = re.compile(r"self\.(\w+)\s*(?::[^=]+)?=[^=]")
+_IDENT = re.compile(r"^\w+$")
+REGISTRY_NAME = "_GRAFTLINT_GUARDED"
+
+
+def _class_guard_map(
+    src: SourceFile, cls: ast.ClassDef, findings: List[Finding]
+) -> Dict[str, str]:
+    """attr -> lock for one class, from the registry and init-line comments."""
+    guarded: Dict[str, str] = {}
+    # Class-level registry.
+    for node in cls.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == REGISTRY_NAME
+                for t in node.targets
+            )
+        ):
+            continue
+        ok = isinstance(node.value, ast.Dict) and all(
+            isinstance(k, ast.Constant) and isinstance(k.value, str)
+            and isinstance(v, ast.Constant) and isinstance(v.value, str)
+            for k, v in zip(node.value.keys, node.value.values)
+        )
+        if not ok:
+            findings.append(
+                src.finding(
+                    node.lineno, "GL-LOCK02",
+                    f"{REGISTRY_NAME} must be a literal "
+                    f"{{'attr': 'lock'}} dict of strings",
+                )
+            )
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            guarded[k.value] = v.value
+    # Init-line comments anywhere in the class body.
+    end = cls.end_lineno or cls.lineno
+    for ln in range(cls.lineno, end + 1):
+        text = src.line_text(ln)
+        m = _GUARD_COMMENT.search(text)
+        if not m:
+            continue
+        lock = m.group(1)
+        attrs = _SELF_ASSIGN.findall(text.split("#", 1)[0])
+        if not _IDENT.match(lock):
+            findings.append(
+                src.finding(
+                    ln, "GL-LOCK02",
+                    f"guarded-by names invalid lock attribute {lock!r}",
+                )
+            )
+            continue
+        if not attrs:
+            findings.append(
+                src.finding(
+                    ln, "GL-LOCK02",
+                    "guarded-by comment on a line with no 'self.<attr> =' "
+                    "assignment to declare",
+                )
+            )
+            continue
+        for attr in attrs:
+            guarded[attr] = lock
+    return guarded
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, src: SourceFile) -> None:
+        self.src = src
+        self.findings: List[Finding] = []
+        # Innermost class context: (guarded map, lock-depth counters).
+        self.cls_stack: List[Tuple[Dict[str, str], Dict[str, int]]] = []
+        self.func_stack: List[str] = []
+        # Same-module inheritance: a subclass of an annotated base inherits
+        # its guard map (``_CounterChild.inc`` touching ``_Child._value``
+        # is still checked).  Bases named from other modules are opaque to
+        # a lexical pass and are skipped.
+        self.by_name: Dict[str, ast.ClassDef] = {
+            n.name: n
+            for n in ast.walk(src.tree)
+            if isinstance(n, ast.ClassDef)
+        }
+        self._merged: Dict[str, Dict[str, str]] = {}
+
+    def _guard_map(self, node: ast.ClassDef) -> Dict[str, str]:
+        if node.name in self._merged:
+            return self._merged[node.name]
+        self._merged[node.name] = {}  # cycle guard
+        merged: Dict[str, str] = {}
+        for base in node.bases:
+            if isinstance(base, ast.Name) and base.id in self.by_name:
+                merged.update(self._guard_map(self.by_name[base.id]))
+        merged.update(_class_guard_map(self.src, node, self.findings))
+        self._merged[node.name] = merged
+        return merged
+
+    # -- context tracking ----------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        guarded = self._guard_map(node)
+        self.cls_stack.append((guarded, {}))
+        outer_funcs, self.func_stack = self.func_stack, []
+        self.generic_visit(node)
+        self.func_stack = outer_funcs
+        self.cls_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        name = getattr(node, "name", "<lambda>")
+        self.func_stack.append(name)
+        if self.cls_stack:
+            # A nested function/lambda executes LATER, not under whatever
+            # lock is lexically held at its definition site — a callback
+            # registered inside ``with self._lock:`` runs unlocked on
+            # another thread.  Suspend the held-lock counts for its body.
+            counts = self.cls_stack[-1][1]
+            saved = dict(counts)
+            counts.clear()
+            self.generic_visit(node)
+            counts.clear()
+            counts.update(saved)
+        else:
+            self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _visit_func
+    visit_Lambda = _visit_func
+
+    def visit_With(self, node: ast.With) -> None:
+        held = []
+        for item in node.items:
+            ctx = item.context_expr
+            if (
+                isinstance(ctx, ast.Attribute)
+                and isinstance(ctx.value, ast.Name)
+                and ctx.value.id == "self"
+            ):
+                held.append(ctx.attr)
+        if not self.cls_stack or not held:
+            return self.generic_visit(node)
+        counts = self.cls_stack[-1][1]
+        for name in held:
+            counts[name] = counts.get(name, 0) + 1
+        # The context expressions themselves evaluate before acquisition,
+        # but they are lock attributes, never guarded state — safe to visit
+        # the whole node with the locks counted held.
+        self.generic_visit(node)
+        for name in held:
+            counts[name] -= 1
+
+    visit_AsyncWith = visit_With
+
+    # -- the check -----------------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            self.cls_stack
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            guarded, held = self.cls_stack[-1]
+            lock = guarded.get(node.attr)
+            if lock is not None and not self._allowed(lock):
+                self.findings.append(
+                    self.src.finding(
+                        node.lineno, "GL-LOCK01",
+                        f"self.{node.attr} (guarded-by {lock}) touched "
+                        f"outside 'with self.{lock}:' — hold the lock, move "
+                        f"the access into a *_locked method, or waive with "
+                        f"a reason",
+                    )
+                )
+        self.generic_visit(node)
+
+    def _allowed(self, lock: str) -> bool:
+        if self.cls_stack[-1][1].get(lock, 0) > 0:
+            return True
+        # The *_locked convention names no lock, so it can only vouch for
+        # the class's PRIMARY lock (``_lock`` when declared, else the
+        # class's single lock) — a ``_foo_locked`` method touching state
+        # guarded by a secondary lock must hold that lock explicitly.
+        # Innermost function only: a closure defined inside a *_locked
+        # method runs later, outside the caller's critical section.
+        if (
+            self.func_stack
+            and self.func_stack[-1].endswith("_locked")
+            and lock == self._primary_lock()
+        ):
+            return True
+        # Construction: the allocating thread is the only one with a
+        # reference, so writes in __init__'s own body (where guards are
+        # declared) cannot race.  Closures DEFINED inside __init__ are NOT
+        # exempt — a thread target outlives construction and runs after
+        # publication on another thread.
+        return len(self.func_stack) == 1 and self.func_stack[0] == "__init__"
+
+    def _primary_lock(self) -> Optional[str]:
+        locks_in_use = set(self.cls_stack[-1][0].values())
+        if "_lock" in locks_in_use:
+            return "_lock"
+        if len(locks_in_use) == 1:
+            return next(iter(locks_in_use))
+        return None
+
+
+def check(src: SourceFile) -> List[Finding]:
+    checker = _Checker(src)
+    checker.visit(src.tree)
+    return checker.findings

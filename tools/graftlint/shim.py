@@ -1,0 +1,31 @@
+"""Shared CLI behavior for the legacy ``tools/check_*.py`` shims: exit
+codes unchanged (0 clean, 1 findings, 2 broken scan), every finding in
+the repo-wide ``path:line: PASS-ID message`` shape.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, List
+
+from tools.graftlint import bijection
+from tools.graftlint.core import REPO
+
+
+def shim_main(spec, *, prog: str, scan: Callable[[], bool],
+              ok: Callable[[], str]) -> int:
+    """Run one spec with the legacy CLI contract: ``scan`` truthy proves
+    the scan sees its surface (else exit 2 — a broken lint, not a clean
+    repo); ``ok`` builds the success line, only on the clean path."""
+    if not scan():
+        print(f"{prog}: scan found nothing — the scan is broken, not the "
+              f"checked surface", file=sys.stderr)
+        return 2
+    bad: List = bijection.problems(spec, REPO)
+    if bad:
+        print(f"{prog}: {len(bad)} problem(s):", file=sys.stderr)
+        for f in bad:
+            print(f.render(), file=sys.stderr)
+        return 1
+    print(f"{prog}: {ok()}")
+    return 0

@@ -1,0 +1,161 @@
+"""The repo's drift-lint surface as data — one spec per legacy check_*.py.
+
+Adding a new config plane or catalog is one spec here (plus a doc row in
+OPERATIONS.md's "Static analysis" table, which GL-DOC04 will demand); the
+engine (:mod:`tools.graftlint.bijection`) does the rest.
+"""
+
+from __future__ import annotations
+
+from tools.graftlint.bijection import (
+    CatalogSpec,
+    FlagConfigSpec,
+    Relation,
+    Side,
+)
+
+_PKG_GLOB = "akka_game_of_life_tpu/**/*.py"
+_DOC = "docs/OPERATIONS.md"
+
+# A metric-name literal: the gol_ prefix is the package's namespace.
+_METRIC = r"""["'](gol_[a-z0-9_]+)["']"""
+
+# A span-creation call with a literal name (.span/.start/._span).  Dynamic
+# names (profiling.timed's labels) don't match — documented as a family.
+_SPAN_CALL = r"""\.(?:span|start|_span)\(\s*\n?\s*["']([a-z][a-z0-9_.]*)["']"""
+
+CHAOS_CONFIG = FlagConfigSpec(
+    name="chaos_config", pass_id="GL-CFG01",
+    flag_regex=r"""["'](--chaos-net(?:-[a-z0-9-]+)?)["']""",
+    config_class="NetworkChaosConfig", field_regex=r"^    (\w+)\s*:",
+    flag_strip="--chaos-net", bare_field="enabled",
+)
+
+RING_CONFIG = FlagConfigSpec(
+    name="ring_config", pass_id="GL-CFG02",
+    flag_regex=r"""["'](--ring-[a-z0-9-]+)["']""",
+    config_class="SimulationConfig", field_regex=r"^    (ring_\w+)\s*:",
+    flag_strip="--",
+)
+
+REBALANCE_CONFIG = FlagConfigSpec(
+    name="rebalance_config", pass_id="GL-CFG03",
+    flag_regex=r"""["'](--rebalance(?:-[a-z0-9-]+)?)["']""",
+    config_class="SimulationConfig", field_regex=r"^    (rebalance_\w+)\s*:",
+    flag_strip="--rebalance", field_prefix="rebalance_",
+    bare_field="rebalance_enabled",
+)
+
+SERVE_CONFIG = FlagConfigSpec(
+    name="serve_config", pass_id="GL-CFG04",
+    flag_regex=r"""["'](--serve-[a-z0-9-]+)["']""",
+    config_class="SimulationConfig", field_regex=r"^    (serve_\w+)\s*:",
+    flag_strip="--serve", field_prefix="serve_",
+)
+
+SPARSE_CONFIG = FlagConfigSpec(
+    name="sparse_config", pass_id="GL-CFG05",
+    flag_regex=r"""["'](--sparse-[a-z0-9-]+)["']""",
+    config_class="SimulationConfig", field_regex=r"^    (sparse_\w+)\s*:",
+    flag_strip="--sparse", field_prefix="sparse_",
+)
+
+METRICS_DOC = CatalogSpec(
+    name="metrics_doc", pass_id="GL-DOC01",
+    sides={
+        "code": Side(kind="files", glob=_PKG_GLOB, regex=_METRIC),
+        "catalog": Side(
+            kind="block", path="akka_game_of_life_tpu/obs/catalog.py",
+            start="CATALOG = (", end="\n)\n", regex=_METRIC,
+        ),
+        "doc": Side(kind="text", path=_DOC, member_fmt="{name}"),
+    },
+    relations=(
+        Relation("code", "doc", "metric {name} registered in code but "
+                 "missing from docs/OPERATIONS.md — the operator-facing "
+                 "catalog cannot rot"),
+        Relation("code", "catalog", "metric {name} registered in code but "
+                 "missing from obs/catalog.py CATALOG — add it so scrapes "
+                 "pre-register the full surface, zeros included"),
+    ),
+    scan_guard=("code", "scan broken: found NO gol_* metric literals"),
+)
+
+TRACE_NAMES = CatalogSpec(
+    name="trace_names", pass_id="GL-DOC02",
+    sides={
+        "code": Side(kind="files", glob=_PKG_GLOB, regex=_SPAN_CALL),
+        "catalog": Side(
+            kind="block", path="akka_game_of_life_tpu/obs/tracing.py",
+            start="SPAN_CATALOG = (", end="\n)\n",
+            regex=r"""^\s*\(\s*["']([a-z][a-z0-9_.]*)["']\s*,""",
+        ),
+        "doc": Side(kind="text", path=_DOC, member_fmt="`{name}`"),
+    },
+    relations=(
+        Relation("code", "catalog", "span {name} emitted in code but not "
+                 "in SPAN_CATALOG — no ad-hoc names sneaking past the "
+                 "catalog"),
+        Relation("catalog", "doc", "span {name} in SPAN_CATALOG but "
+                 "missing from docs/OPERATIONS.md"),
+    ),
+    scan_guard=("code", "scan broken: found NO .span()/.start() literals"),
+)
+
+PROTOCOL_MSGS = CatalogSpec(
+    name="protocol_msgs", pass_id="GL-DOC03",
+    sides={
+        # NAME = "wire_value" at column 0 (the anchor excludes the
+        # docstring's indented table rows).
+        "decl": Side(
+            kind="files", glob="akka_game_of_life_tpu/runtime/protocol.py",
+            regex=r'^[A-Z][A-Z0-9_]*\s*=\s*"([a-z][a-z0-9_]*)"\s*$',
+        ),
+        # A table row: | `value` | ... (scoped to the table so message
+        # values in prose elsewhere don't satisfy/poison the reverse check).
+        "doc": Side(
+            kind="section", path=_DOC, start="### Protocol messages",
+            end="#", regex=r"^\|\s*`([a-z][a-z0-9_]*)`\s*\|",
+        ),
+    },
+    relations=(
+        Relation("decl", "doc", "protocol message {name} has no row in the "
+                 "OPERATIONS.md protocol table — invisible exactly when a "
+                 "wire capture needs decoding"),
+        Relation("doc", "decl", "OPERATIONS.md documents protocol message "
+                 "{name} which protocol.py does not declare — worse than "
+                 "no row"),
+    ),
+    scan_guard=("decl", "scan broken: found NO message constants in "
+                "runtime/protocol.py"),
+)
+
+GRAFTLINT_DOC = CatalogSpec(
+    name="graftlint_doc", pass_id="GL-DOC04",
+    sides={
+        "catalog": Side(
+            kind="block", path="tools/graftlint/core.py",
+            start="PASS_CATALOG: Tuple[Tuple[str, str], ...] = (",
+            end="\n)\n", regex=r"""["'](GL-[A-Z0-9]+)["']""",
+        ),
+        # Row-anchored: prose mentions must not satisfy the row check.
+        "doc": Side(
+            kind="section", path=_DOC, start="## Static analysis",
+            end="## ", regex=r"^\|\s*`(GL-[A-Z0-9]+)`",
+        ),
+    },
+    relations=(
+        Relation("catalog", "doc", "graftlint pass {name} has no row in "
+                 "the OPERATIONS.md static-analysis table"),
+        Relation("doc", "catalog", "OPERATIONS.md names graftlint pass "
+                 "{name} which tools/graftlint/core.py PASS_CATALOG does "
+                 "not declare"),
+    ),
+    scan_guard=("catalog", "scan broken: PASS_CATALOG not found in "
+                "tools/graftlint/core.py"),
+)
+
+SPECS = (
+    CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SPARSE_CONFIG,
+    METRICS_DOC, TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
+)

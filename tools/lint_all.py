@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""The one lint command: graftlint (all three pass families) + every
+legacy ``check_*.py`` shim CLI, aggregated.
+
+    python tools/lint_all.py            # human: findings + per-lint status
+    python tools/lint_all.py --json     # CI: one JSON summary document
+
+Exit is nonzero when ANY lint finds anything (or any shim CLI breaks), so
+CI and humans share one command and one answer.  The shims run as real
+subprocesses — this is also the standing proof that each legacy CLI still
+works after the migration onto the bijection engine.  Driven by
+``tests/test_graftlint.py::test_lint_all_repo_clean`` (tier-1).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.graftlint import core  # noqa: E402
+
+SHIMS = (
+    "check_chaos_config",
+    "check_ring_config",
+    "check_rebalance_config",
+    "check_serve_config",
+    "check_sparse_config",
+    "check_metrics_doc",
+    "check_trace_names",
+    "check_protocol_msgs",
+)
+
+
+def run_shims() -> list:
+    """[(name, returncode, output)] for every legacy shim CLI."""
+    out = []
+    for name in SHIMS:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / f"{name}.py")],
+            capture_output=True,
+            text=True,
+        )
+        out.append((name, proc.returncode, (proc.stdout + proc.stderr).strip()))
+    return out
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    try:
+        findings = core.run()
+    except (OSError, SyntaxError) as e:
+        # Same contract as `python -m tools.graftlint`: a scan that cannot
+        # even parse is rc 2 (broken), never rc 1 (findings).
+        print(f"lint_all: scan failed: {e}", file=sys.stderr)
+        return 2
+    unwaived = [f for f in findings if not f.waived]
+    shims = run_shims()
+    shim_failures = [(n, rc) for n, rc, _ in shims if rc != 0]
+    rc = 1 if (unwaived or shim_failures) else 0
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "ok": rc == 0,
+                    "graftlint": {
+                        "unwaived": len(unwaived),
+                        "waived": len(findings) - len(unwaived),
+                        "findings": [f.to_dict() for f in findings],
+                    },
+                    "shims": {n: code for n, code, _ in shims},
+                },
+                indent=2,
+            )
+        )
+        return rc
+    for f in unwaived:
+        print(f.render(), file=sys.stderr)
+    for name, code, output in shims:
+        status = "ok" if code == 0 else f"FAILED rc={code}"
+        print(f"lint_all: {name}: {status}")
+        if code != 0 and output:
+            print(output, file=sys.stderr)
+    waived = len(findings) - len(unwaived)
+    print(
+        f"lint_all: graftlint {len(unwaived)} finding(s) ({waived} waived), "
+        f"{len(SHIMS) - len(shim_failures)}/{len(SHIMS)} shims clean"
+    )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
