@@ -19,6 +19,19 @@ from typing import Optional, Sequence
 
 from akka_game_of_life_tpu.runtime.config import load_config, parse_duration
 
+# The --kernel choice surface.  A literal (not an import of
+# runtime.config.KERNEL_CHOICES) on purpose: the drift lints parse both
+# files textually so they can run before the environment exists —
+# graftlint GL-CFG06 enforces that this tuple, the config tuple, and the
+# docs/OPERATIONS.md "Kernel selection" table never diverge.
+_KERNEL_CHOICES = (
+    "auto",
+    "dense",
+    "bitpack",
+    "pallas",
+    "matmul",
+)
+
 
 def _apply_platform(platform: Optional[str]) -> None:
     """Pin the JAX platform before anything touches devices.
@@ -72,11 +85,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--steps-per-call", type=int)
     p.add_argument(
         "--kernel",
-        choices=["auto", "dense", "bitpack", "pallas"],
+        choices=list(_KERNEL_CHOICES),
         help="stencil kernel: auto picks the Mosaic temporal-blocking pallas "
         "kernel on a real TPU for binary rules, single-device or sharded "
         "over the mesh (bitpack fallback if Mosaic fails), else bitpack "
-        "(32 cells/uint32 SWAR) on 32-aligned widths, else dense uint8",
+        "(32 cells/uint32 SWAR) on 32-aligned widths, else dense uint8; "
+        "matmul is the banded matrix-multiply (MXU) family — any "
+        "box-neighborhood rule incl. radius-R LtL, single-device, "
+        "intermediates guard-priced up front (docs/OPERATIONS.md "
+        '"MXU stencil path")',
     )
     p.add_argument("--pallas-block-rows", type=int)
     p.add_argument(
@@ -664,7 +681,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     _add_platform(st_p)
     st_p.add_argument(
         "--kernel",
-        choices=["auto", "dense", "bitpack", "pallas"],
+        choices=list(_KERNEL_CHOICES),
         default="auto",
         help="kernel the checks drive (default auto — what `run` would pick)",
     )

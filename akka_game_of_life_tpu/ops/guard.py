@@ -1,0 +1,81 @@
+"""Shared intermediate-size guard: refuse loudly, never allocate-and-die.
+
+The recorded LtL OOM lesson (``ops/ltl.py``, ``artifacts/tpu_session_r3b``):
+an 8192² radius-5 board once materialized a 17.2 GB conv intermediate and
+killed the run *after* the allocator had already committed — the failure
+surfaced as a device OOM deep inside XLA instead of a config error naming
+the knob.  Every kernel family that materializes off-board intermediates
+(the LtL shift-add count planes, the banded-matmul operands and products)
+now prices them *up front*, at trace/closure-build time, through this one
+helper: estimate the bytes, compare against a configurable cap, and raise
+a ``ValueError`` that names the shapes, the cap, and the knob that raises
+it — before anything is allocated.
+
+The cap is deliberately coarse (it bounds *planned* scratch, not a
+promise about allocator behavior) and generous by default: it exists to
+catch the two-orders-of-magnitude surprises, not to haggle over 10%.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Tuple
+
+# Environment override, in MiB.  The default covers every intermediate this
+# repo's kernels plan at the flagship shapes on a 16 GB v5e HBM or this
+# host's RAM, while refusing the pathological (full-board conv padding,
+# no-divisor full-band matrices at 65536²) before the allocator sees them.
+CAP_ENV = "GOL_INTERMEDIATE_CAP_MB"
+DEFAULT_CAP_MB = 8192
+
+
+def intermediate_cap_bytes() -> int:
+    """The active cap in bytes (``GOL_INTERMEDIATE_CAP_MB`` or the
+    default).  Read per call — tests and operators can flip the env var
+    without reimporting kernels."""
+    try:
+        mb = int(os.environ.get(CAP_ENV, DEFAULT_CAP_MB))
+    except ValueError:
+        raise ValueError(
+            f"{CAP_ENV}={os.environ.get(CAP_ENV)!r} is not an integer MiB count"
+        ) from None
+    return mb * 2**20
+
+
+def plane_bytes(shape: Tuple[int, ...], itemsize: int) -> int:
+    """Bytes of one dense plane of ``shape`` at ``itemsize`` bytes/element."""
+    total = itemsize
+    for dim in shape:
+        total *= int(dim)
+    return total
+
+
+def require_intermediates_fit(
+    estimated_bytes: int,
+    *,
+    what: str,
+    detail: str = "",
+    shapes: Iterable[Tuple[Tuple[int, ...], int]] = (),
+) -> None:
+    """Raise ``ValueError`` if ``estimated_bytes`` exceeds the cap.
+
+    ``what`` names the kernel/path (appears first in the message);
+    ``detail`` adds the actionable remedy beyond raising the cap;
+    ``shapes`` optionally itemizes (shape, itemsize) planes for the
+    message so the operator sees *which* intermediate blew up.
+    """
+    cap = intermediate_cap_bytes()
+    if estimated_bytes <= cap:
+        return
+    itemized = "; ".join(
+        f"{tuple(s)}x{i}B={plane_bytes(s, i) / 2**20:.0f}MiB" for s, i in shapes
+    )
+    raise ValueError(
+        f"{what} would materialize ~{estimated_bytes / 2**20:.0f} MiB of "
+        f"intermediates, over the {cap / 2**20:.0f} MiB cap"
+        + (f" ({itemized})" if itemized else "")
+        + " — refusing up front instead of allocate-and-die (the recorded "
+        f"LtL OOM lesson, ops/ltl.py). "
+        + (f"{detail} " if detail else "")
+        + f"Raise {CAP_ENV} (MiB) to override."
+    )

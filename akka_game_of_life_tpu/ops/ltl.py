@@ -23,6 +23,17 @@ intermediates:
 Counts ≤ max_neighbors ≤ 440 are exact in bf16 (integers to 256) when
 they fit and in f32 beyond, chosen automatically.
 
+The OOM lesson above is now enforced, not just remembered: both count
+engines price their intermediates through the shared :mod:`ops/guard`
+helper at trace/closure-build time and refuse over-cap shapes loudly
+instead of allocate-and-die.  And the MXU formulation is back in a shape
+that works: ``kernel=matmul`` delegates the radius-R window sum to the
+banded matrix-multiply family (:mod:`ops/matmul_stencil`, ``A_R·S·A_Rᵀ``
+evaluated block-diagonally — no single-channel conv padding, so no 17.2 GB
+intermediate), which applies THIS module's rule tables, so the two paths
+are bit-identical by construction.  Box neighborhoods only — the diamond
+is not separable and stays on the cumsum path here.
+
 The birth/survive sets are arbitrary subsets of 0..max_neighbors, applied as a
 table gather (XLA lowers the tiny lookup into the fused epilogue).  With
 R=1 this reduces exactly to the classic outer-totalistic step — the
@@ -42,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from akka_game_of_life_tpu.ops import guard
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 
 STATE_DTYPE = jnp.uint8
@@ -119,6 +131,26 @@ def _apply(state: jax.Array, neighbor_counts: jax.Array, rule: Rule) -> jax.Arra
     return jnp.where(state == 1, jnp.take(survive_t, c), jnp.take(birth_t, c))
 
 
+def _require_window_fits(padded_shape, rule: Rule) -> None:
+    """Price the shift-add intermediates (the padded count-dtype plane plus
+    the separable column-sum plane) through the shared guard — runs at
+    trace time, where shapes are static, so an over-cap request raises
+    with the knob's name before XLA allocates anything."""
+    ph, pw = int(padded_shape[-2]), int(padded_shape[-1])
+    item = jnp.dtype(_count_dtype(rule)).itemsize
+    planes = [((ph, pw), item), ((ph - 2 * rule.radius, pw), item)]
+    guard.require_intermediates_fit(
+        sum(guard.plane_bytes(s, i) for s, i in planes),
+        what=(
+            f"ltl shift-add window sums ({rule}, padded {ph}x{pw}, "
+            f"radius {rule.radius})"
+        ),
+        detail="Shard the board (mesh/cluster) so each tile prices only "
+        "its own slice.",
+        shapes=planes,
+    )
+
+
 def step_padded_ltl(padded: jax.Array, rule) -> jax.Array:
     """One LtL step on an R-halo-padded tile: (H+2R, W+2R) → (H, W).
 
@@ -126,6 +158,7 @@ def step_padded_ltl(padded: jax.Array, rule) -> jax.Array:
     sharded halo path and the toroidal step below both feed it."""
     rule = resolve_rule(rule)
     r = rule.radius
+    _require_window_fits(padded.shape, rule)
     alive = (padded == 1).astype(STATE_DTYPE)
     counts = _window_counts(alive, r, rule.neighborhood, _count_dtype(rule))
     interior = padded[r:-r, r:-r]
@@ -134,21 +167,34 @@ def step_padded_ltl(padded: jax.Array, rule) -> jax.Array:
     return _apply(interior, neighbors, rule)
 
 
-def step_ltl(state: jax.Array, rule) -> jax.Array:
-    """One toroidal LtL step on an (H, W) uint8 board."""
+def step_ltl(state: jax.Array, rule, engine: str = "shift-add") -> jax.Array:
+    """One toroidal LtL step on an (H, W) uint8 board.
+
+    ``engine`` selects the count path: ``"shift-add"`` (the separable VPU
+    kernel above) or ``"matmul"`` (the banded matrix-multiply family,
+    ``ops/matmul_stencil`` — what ``kernel=matmul`` mounts).  Both apply
+    this module's rule tables, so their outputs are bit-identical."""
     rule = resolve_rule(rule)
+    if engine == "matmul":
+        from akka_game_of_life_tpu.ops import matmul_stencil
+
+        return matmul_stencil.step_matmul(state, rule)
+    if engine != "shift-add":
+        raise ValueError(f"unknown ltl count engine {engine!r}")
     r = rule.radius
     return step_padded_ltl(jnp.pad(state, r, mode="wrap"), rule)
 
 
 @functools.lru_cache(maxsize=None)
-def ltl_multi_step_fn(rule_key, n_steps: int) -> Callable[[jax.Array], jax.Array]:
+def ltl_multi_step_fn(
+    rule_key, n_steps: int, engine: str = "shift-add"
+) -> Callable[[jax.Array], jax.Array]:
     rule = resolve_rule(rule_key)
 
     @jax.jit
     def _run(state: jax.Array) -> jax.Array:
         def body(s, _):
-            return step_ltl(s, rule), None
+            return step_ltl(s, rule, engine), None
 
         out, _ = jax.lax.scan(body, state, None, length=n_steps)
         return out

@@ -1,0 +1,387 @@
+"""MXU stencil family: neighbor counting as banded matrix multiplies.
+
+CAT ("Cellular Automata on Tensor cores", PAPERS.md) observes that the
+Moore window sum factors into two banded matrix products
+
+    W = A_R · S · A_Rᵀ
+
+where ``A_R`` is the ±R-band circulant (ones on diagonals −R..R, wrapping
+at the torus seam) — the shape tensor units execute at int8/bf16 rates
+while every other kernel in this repo counts neighbors with VPU
+shift-adds.  The same factorization gives radius-R Larger-than-Life for
+free (band of width 2R+1, where ``ops/ltl.py`` pays 2(2R+1) separable
+shift-add passes), and it is the substrate the continuous-CA roadmap item
+compiles onto: radius-R convolution *is* this banded matmul (CAX).
+
+The band is evaluated **block-diagonally**, never as a dense (n, n)
+operand: each row/column tile multiplies a (K, K+2R) slab of ``A_R``
+against a contiguous slice of the board, with the torus wrap folded into
+the edge tiles' operands — O(K) MACs/cell instead of O(n), with K sized
+so every product is one large rank-2 GEMM (``jnp.dot``), the MXU's native
+diet.  The recorded LtL OOM lesson applies doubly here (a full-size band
+matrix at 65536² is 16 GiB before the first multiply), so every plan is
+priced through :mod:`ops/guard` at trace time — refuse loudly, never
+allocate-and-die.
+
+Three dtype lanes, all producing **exactly** the same integer counts:
+
+- ``int8``: int8 operands accumulating to int32 via
+  ``preferred_element_type`` — counts never overflow (row sums ≤ 2R+1 ≤
+  21 fit int8; window sums ≤ (2R+1)² ≤ 441 fit int32 trivially).  The MXU
+  lane; default on TPU.
+- ``bf16``: bf16 operands, f32 accumulation.  Exact because every operand
+  value ≤ 2R+1 ≤ 21 is bf16-representable and f32 accumulation of ≤ 2²⁴
+  integers is exact; A/B'd for accuracy-equivalence against int32 in
+  ``tests/test_matmul_stencil.py`` at the max count (2R+1)²−1.
+- ``f32`` (host default): f32 GEMMs with **digit packing** — d torus
+  column groups ride one f32 word as base-b digits (b a power of two >
+  (2R+1)², so window sums never carry between digits and stay < 2²⁴,
+  f32's exact-integer range; the torus seam rotates digits in the pad
+  columns).  Packing divides GEMM width and memory traffic by d: on this
+  host's CPU it is what pushes the banded path past the shift-add kernel
+  at 16384² for every measured R ≥ 2.
+
+Counts are exact integers on every lane, so applying the existing rule
+tables (``ops/rules.py`` masks via ``stencil.apply_rule``, LtL tables via
+``ltl._apply``) is **bit-identical to the dense oracle by construction**
+— certified through the PR 5 digest plane in ``bench_suite`` config 15
+and ``tests/test_matmul_stencil.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from akka_game_of_life_tpu.ops import guard
+from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
+from akka_game_of_life_tpu.ops.stencil import STATE_DTYPE, alive_mask, apply_rule
+
+# Counts and digit-packed words must stay exact integers in f32.
+_MAX_EXACT_F32 = 1 << 24
+# Digit-packing depth cap: beyond 6 the per-digit bases stop fitting the
+# f32 mantissa for any radius; 4 is the practical ceiling on power-of-two
+# boards (d must divide the width).
+_MAX_DIGITS = 6
+# Row/column tile bound: measured knee on this host (bigger tiles burn
+# O(K) MACs/cell for no GEMM-efficiency gain; smaller ones fragment the
+# GEMMs below the rank-2 fast path).  Also the MXU-friendly multiple.
+_MAX_TILE = 512
+
+MODES = ("auto", "f32", "int8", "bf16")
+
+
+def band_matrix(n: int, radius: int, wrap: bool = True) -> np.ndarray:
+    """The (n, n) ±radius band matrix ``A_R`` (f32 ones), circulant when
+    ``wrap`` — the mathematical object the blocked kernel evaluates.
+    Exported for tests and for the continuous-CA work to build on."""
+    a = np.zeros((n, n), np.float32)
+    idx = np.arange(n)
+    for k in range(-radius, radius + 1):
+        if wrap:
+            a[idx, (idx + k) % n] = 1.0
+        else:
+            j = idx + k
+            ok = (j >= 0) & (j < n)
+            a[idx[ok], j[ok]] = 1.0
+    return a
+
+
+def _band_slab(tile: int, radius: int) -> np.ndarray:
+    """(tile, tile + 2·radius) slab of ``A_R``: row t has ones on columns
+    t..t+2R — the per-tile GEMM operand (shared by every interior tile)."""
+    slab = np.zeros((tile, tile + 2 * radius), np.float32)
+    for t in range(tile):
+        slab[t, t : t + 2 * radius + 1] = 1.0
+    return slab
+
+
+def _pick_tile(n: int) -> int:
+    """Largest divisor of ``n`` at most ``_MAX_TILE`` (n itself when small
+    or awkwardly prime — the guard prices the resulting full-band slab)."""
+    if n <= _MAX_TILE:
+        return n
+    best = 1
+    for k in range(1, int(math.isqrt(n)) + 1):
+        if n % k == 0:
+            for d in (k, n // k):
+                if best < d <= _MAX_TILE:
+                    best = d
+    return best if best >= 8 else n
+
+
+def _pick_digits(width: int, radius: int) -> Tuple[int, int]:
+    """(digits, base) for f32 packing: the deepest d dividing ``width``
+    whose packed window sums stay under 2²⁴ (base = next power of two
+    above the max window sum, so digit extraction is exact floor-divs)."""
+    wmax = (2 * radius + 1) ** 2
+    base = 1 << max(1, (wmax + 1).bit_length())
+    for d in range(_MAX_DIGITS, 0, -1):
+        if width % d:
+            continue
+        if width // d < max(radius, 1):
+            continue  # seam slivers need R columns per digit group
+        if wmax * (base**d - 1) // (base - 1) < _MAX_EXACT_F32:
+            return d, base
+    return 1, base
+
+
+@dataclasses.dataclass(frozen=True)
+class MatmulPlan:
+    """A validated banded-matmul execution plan for one (shape, R, mode).
+
+    Built once per combination (lru-cached) at trace/closure-build time;
+    construction runs the :mod:`ops/guard` intermediate-size check, so an
+    infeasible plan raises with the shapes and the cap knob named before
+    any device allocation happens."""
+
+    height: int
+    width: int
+    radius: int
+    mode: str  # resolved: f32 | int8 | bf16
+    digits: int
+    base: int
+    row_tile: int
+    col_tile: int
+    est_bytes: int
+
+    @property
+    def packed_width(self) -> int:
+        return self.width // self.digits
+
+
+def _resolve_mode(mode: str) -> str:
+    if mode not in MODES:
+        raise ValueError(f"unknown matmul dtype mode {mode!r}; use {MODES}")
+    if mode == "auto":
+        return "int8" if jax.default_backend() == "tpu" else "f32"
+    return mode
+
+
+@functools.lru_cache(maxsize=None)
+def plan_matmul(
+    shape: Tuple[int, int],
+    radius: int,
+    mode: str = "auto",
+    neighborhood: str = "box",
+) -> MatmulPlan:
+    """Validate and price a banded-matmul plan; raises ``ValueError`` with
+    an actionable message for every infeasible request."""
+    h, w = int(shape[-2]), int(shape[-1])
+    if neighborhood != "box":
+        raise ValueError(
+            "kernel=matmul supports box (Moore) neighborhoods only: the "
+            "von Neumann diamond is not separable into A_R·S·A_Rᵀ — use "
+            "the cumsum-difference path on kernel=dense"
+        )
+    if min(h, w) < 2 * radius + 1:
+        raise ValueError(
+            f"kernel=matmul needs min(height, width) >= 2R+1 "
+            f"({2 * radius + 1} for radius {radius}), got {h}x{w}: the "
+            f"torus window must not wrap onto itself"
+        )
+    mode = _resolve_mode(mode)
+    digits, base = _pick_digits(w, radius) if mode == "f32" else (1, 0)
+    wd = w // digits
+    kr, kc = _pick_tile(h), _pick_tile(wd)
+    item = {"f32": 4, "int8": 1, "bf16": 2}[mode]
+    acc_item = 4  # int32 / f32 accumulator planes
+    planes = [
+        ((h, wd + 2 * radius), item),  # packed, column-padded operand
+        ((h, wd + 2 * radius), item),  # pass-1 row sums (operand dtype)
+        ((h, wd), acc_item),  # pass-2 window sums (accumulator dtype)
+        ((h, w), 4),  # unpacked int32 counts feeding the rule epilogue
+        ((kr, kr + 2 * radius), item),  # row band slab
+        ((kc, kc + 2 * radius), item),  # column band slab
+    ]
+    est = sum(guard.plane_bytes(s, i) for s, i in planes)
+    guard.require_intermediates_fit(
+        est,
+        what=f"kernel=matmul ({mode}, {h}x{w}, radius {radius})",
+        detail=(
+            "Shrink the board/radius, or use kernel=dense (the shift-add "
+            "path keeps intermediates board-sized)."
+        ),
+        shapes=planes,
+    )
+    return MatmulPlan(h, w, radius, mode, digits, base, kr, kc, est)
+
+
+def _operand_dtype(plan: MatmulPlan):
+    return {"f32": jnp.float32, "int8": jnp.int8, "bf16": jnp.bfloat16}[plan.mode]
+
+
+def _accum_dtype(plan: MatmulPlan):
+    return jnp.int32 if plan.mode == "int8" else jnp.float32
+
+
+def _dot(a: jax.Array, b: jax.Array, plan: MatmulPlan) -> jax.Array:
+    """Rank-2 banded-slab product with overflow-safe accumulation: int8
+    operands accumulate to int32, bf16/f32 to f32 — counts never wrap."""
+    return jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=_accum_dtype(plan),
+    )
+
+
+def _packed_window_sums(alive: jax.Array, plan: MatmulPlan) -> jax.Array:
+    """(H, W) 0/1 alive plane → (H, W/digits) window sums in the packed
+    (digit-carrying) accumulator layout — the two blocked banded matrix
+    multiplies without the unpack, so consumers can fuse digit extraction
+    into their own epilogue instead of materializing an int32 board."""
+    h, w, r = plan.height, plan.width, plan.radius
+    d, wd = plan.digits, plan.packed_width
+    od = _operand_dtype(plan)
+
+    # 1. Pack: d torus column groups per word as base-b digits (d == 1 is
+    # the identity cast).  Fused by XLA into one pass over the board.
+    if d > 1:
+        pows = [float(plan.base) ** i for i in range(d)]
+        packed = alive[:, :wd].astype(od) * pows[0]
+        for i in range(1, d):
+            packed = packed + alive[:, i * wd : (i + 1) * wd].astype(od) * pows[i]
+        p_hi = pows[-1]
+        base = float(plan.base)
+        # Torus seam: column -k carries x[:, m·wd - k] in digit m, i.e.
+        # the neighbor word's digits rotated up (and symmetrically down on
+        # the right).  Exact: values are integers < 2²⁴ and base is a
+        # power of two, so the floor-divisions are exact.
+        left = packed[:, wd - r :]
+        right = packed[:, :r]
+        left = jnp.floor(left / p_hi) + (left % p_hi) * base
+        right = jnp.floor(right / base) + (right % base) * p_hi
+    else:
+        packed = alive.astype(od)
+        left = packed[:, wd - r :]
+        right = packed[:, :r]
+    x_cp = jnp.concatenate([left, packed, right], axis=1)  # (h, wd + 2r)
+
+    # 2. Row pass: y = A_R · x, tiled over rows.  Interior tiles read
+    # contiguous slices; the torus wrap rides in the edge tiles' operands
+    # (small concats), so no full padded copy is ever materialized.
+    kr = plan.row_tile
+    nbr = h // kr
+    slab_r = jnp.asarray(_band_slab(kr, r).astype(od))
+    rows = []
+    for c in range(nbr):
+        if nbr == 1:
+            op = jnp.concatenate([x_cp[h - r :], x_cp, x_cp[:r]], axis=0)
+        elif c == 0:
+            op = jnp.concatenate([x_cp[h - r :], x_cp[: kr + r]], axis=0)
+        elif c == nbr - 1:
+            op = jnp.concatenate([x_cp[c * kr - r :], x_cp[:r]], axis=0)
+        else:
+            op = jax.lax.dynamic_slice_in_dim(x_cp, c * kr - r, kr + 2 * r, axis=0)
+        rows.append(_dot(slab_r, op, plan))
+    # Row sums ≤ (2R+1)·digit ≤ 21 per digit: exact back in operand dtype.
+    y = jnp.concatenate(rows, axis=0).astype(od)  # (h, wd + 2r), col-padded
+
+    # 3. Column pass: W = y · A_Rᵀ, tiled over packed columns.  The column
+    # pads (with their seam digit rotation) were carried through the row
+    # pass, so every tile — edges included — is one contiguous slice.
+    kc = plan.col_tile
+    nbc = wd // kc
+    slab_ct = jnp.asarray(_band_slab(kc, r).T.astype(od))
+    cols = [
+        _dot(
+            jax.lax.dynamic_slice_in_dim(y, c * kc, kc + 2 * r, axis=1),
+            slab_ct,
+            plan,
+        )
+        for c in range(nbc)
+    ]
+    return jnp.concatenate(cols, axis=1)  # (h, wd) accumulator dtype
+
+
+def _extract_digit(packed_sums: jax.Array, plan: MatmulPlan, i: int) -> jax.Array:
+    """Digit ``i`` of the packed window sums as int32 — exact, because
+    values are integers < 2²⁴ and the base is a power of two, so the
+    floor-division is a representable scale."""
+    if plan.digits == 1:
+        return packed_sums.astype(jnp.int32)
+    base = float(plan.base)
+    return (jnp.floor(packed_sums / base**i) % base).astype(jnp.int32)
+
+
+def window_counts_matmul(alive: jax.Array, plan: MatmulPlan) -> jax.Array:
+    """(H, W) 0/1 alive plane → (H, W) int32 window sums INCLUDING the
+    center, on a torus, as two blocked banded matrix multiplies."""
+    out_p = _packed_window_sums(alive, plan)
+    if plan.digits == 1:
+        return _extract_digit(out_p, plan, 0)
+    return jnp.concatenate(
+        [_extract_digit(out_p, plan, i) for i in range(plan.digits)], axis=1
+    )
+
+
+def neighbor_counts_matmul(
+    alive: jax.Array, radius: int = 1, mode: str = "auto"
+) -> jax.Array:
+    """Torus neighbor counts EXCLUDING the center — the banded-matmul twin
+    of ``stencil.neighbor_counts`` (R=1) and the LtL window sums (R>1)."""
+    plan = plan_matmul(tuple(alive.shape), radius, mode)
+    window = window_counts_matmul(alive, plan)
+    return window - alive.astype(jnp.int32)
+
+
+def step_matmul(state: jax.Array, rule, mode: str = "auto") -> jax.Array:
+    """One toroidal CA step with banded-matmul neighbor counts.  Supports
+    every rule family whose window is the Moore box: binary/Generations
+    totalistic, wireworld, and box-neighborhood LtL (the diamond refuses
+    in ``plan_matmul``).  Bit-identical to ``stencil.step`` /
+    ``ltl.step_ltl`` by construction: the counts are exact integers and
+    the rule epilogues are the existing ones.
+
+    The rule is applied per digit group straight off the packed window
+    sums — digit extraction fuses into the epilogue's elementwise pass,
+    so no full-board int32 counts plane is ever materialized (a ~1 GiB
+    round trip at 16384² that the A/B showed on the critical path)."""
+    rule = resolve_rule(rule)
+    plan = plan_matmul(tuple(state.shape), rule.radius, mode, rule.neighborhood)
+    alive = alive_mask(state)
+    out_p = _packed_window_sums(alive, plan)
+    wd = plan.packed_width
+
+    def _epilogue(state_slab, neighbors):
+        if rule.kind == "ltl":
+            from akka_game_of_life_tpu.ops import ltl
+
+            return ltl._apply(state_slab, neighbors, rule)
+        return apply_rule(state_slab, neighbors, rule)
+
+    if plan.digits == 1:
+        window = _extract_digit(out_p, plan, 0)
+        return _epilogue(state, window - alive.astype(jnp.int32))
+    parts = []
+    for i in range(plan.digits):
+        sl = slice(i * wd, (i + 1) * wd)
+        window = _extract_digit(out_p, plan, i)
+        parts.append(
+            _epilogue(state[:, sl], window - alive[:, sl].astype(jnp.int32))
+        )
+    return jnp.concatenate(parts, axis=1)
+
+
+@functools.lru_cache(maxsize=None)
+def matmul_multi_step_fn(
+    rule_key, n_steps: int, mode: str = "auto"
+) -> Callable[[jax.Array], jax.Array]:
+    """A jitted ``n_steps``-per-call banded-matmul closure (cached per
+    (rule, n, mode)) — the ``kernel=matmul`` stepper Simulation mounts."""
+    rule = resolve_rule(rule_key)
+
+    @jax.jit
+    def _run(state: jax.Array) -> jax.Array:
+        def body(s, _):
+            return step_matmul(s, rule, mode), None
+
+        out, _ = jax.lax.scan(body, state, None, length=n_steps)
+        return out
+
+    return _run

@@ -56,6 +56,20 @@ def parse_size_classes(spec) -> Tuple[int, ...]:
     return classes
 
 
+# The stencil-kernel selection surface, one name per kernel family (see
+# docs/OPERATIONS.md "Kernel selection" and docs/KERNELS.md).  The CLI
+# mirrors this tuple as a literal (cli.py _KERNEL_CHOICES) so the lints
+# stay import-free; graftlint GL-CFG06 enforces the bijection between the
+# two literals and the operator doc's table.
+KERNEL_CHOICES = (
+    "auto",
+    "dense",
+    "bitpack",
+    "pallas",
+    "matmul",
+)
+
+
 def parse_duration(value) -> float:
     """Parse a duration into seconds: 5, 5.0, "5s", "3000ms", "1 second"."""
     if isinstance(value, (int, float)):
@@ -212,6 +226,10 @@ class SimulationConfig:
     #             shards over the mesh via parallel/pallas_halo.py;
     #             Generations/wireworld plane sweeps and box-LtL slabs
     #             are single-device opt-ins
+    #   matmul  — banded matrix-multiply neighbor counts (A_R·S·A_Rᵀ,
+    #             ops/matmul_stencil.py): the MXU/tensor-core family per
+    #             CAT; any box-neighborhood rule incl. radius-R LtL;
+    #             single-device, intermediates guard-priced up front
     #   auto    — pallas on a real TPU for binary totalistic rules,
     #             single-device or meshed (size-adaptive block rows,
     #             bitpack fallback if Mosaic fails), else bitpack/planes
@@ -480,8 +498,10 @@ class SimulationConfig:
             raise ValueError(f"board must be positive, got {self.height}x{self.width}")
         if self.backend not in ("tpu", "actor", "actor-native"):
             raise ValueError(f"unknown backend {self.backend!r}")
-        if self.kernel not in ("auto", "dense", "bitpack", "pallas"):
-            raise ValueError(f"unknown kernel {self.kernel!r}")
+        if self.kernel not in KERNEL_CHOICES:
+            raise ValueError(
+                f"unknown kernel {self.kernel!r}; choose from {KERNEL_CHOICES}"
+            )
         if self.pallas_block_rows < 8 or self.pallas_block_rows % 8:
             # Mosaic requires sublane-dim block sizes in multiples of 8
             # (ops/pallas_stencil.py); catch it here with the knob's name

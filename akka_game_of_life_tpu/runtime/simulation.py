@@ -340,14 +340,15 @@ class Simulation:
             )
         # Binary-totalistic AND plane-rule pallas shard via the Mosaic
         # sweeps inside shard_map (parallel/pallas_halo.py); the LtL pallas
-        # kernel has no sharded form, so explicit pallas for it pins to one
-        # device — an explicit mesh_shape then errors in _resolve_kernel
-        # rather than silently ignoring either request.
-        unsharded_pallas = (
+        # kernel and the banded-matmul kernel have no sharded form, so
+        # explicitly selecting them pins to one device — an explicit
+        # mesh_shape then errors in _resolve_kernel rather than silently
+        # ignoring either request.
+        unsharded_kernel = (
             config.kernel == "pallas" and self.rule.kind == "ltl"
-        )
+        ) or config.kernel == "matmul"
         self._use_mesh = config.mesh_shape is not None or (
-            n_dev > 1 and not unsharded_pallas
+            n_dev > 1 and not unsharded_kernel
         )
         self._kernel_auto = config.kernel == "auto"
         if self._sparse is not None:
@@ -471,6 +472,25 @@ class Simulation:
                 return "bitpack"
             # Generations rules: bit planes (0.25·m B/cell vs 1 B/cell dense).
             return "bitpack" if self.rule.states <= 256 else "dense"
+        if kernel == "matmul":
+            # The banded matrix-multiply family (ops/matmul_stencil.py):
+            # explicit opt-in, single device, box neighborhoods, any rule
+            # family.  plan_matmul re-checks all of it AND prices the
+            # intermediates through ops/guard — called HERE so an
+            # infeasible config (diamond, window self-wrap, over-cap
+            # shapes) fails at __init__ with the knob's name, never
+            # allocate-and-dies mid-advance (the recorded LtL OOM lesson).
+            from akka_game_of_life_tpu.ops import matmul_stencil
+
+            if self._use_mesh:
+                raise ValueError(
+                    "kernel=matmul is single-device (no sharded form); "
+                    "use kernel=dense on a mesh"
+                )
+            matmul_stencil.plan_matmul(
+                cfg.shape, self.rule.radius, "auto", self.rule.neighborhood
+            )
+            return kernel
         if kernel == "bitpack" and self.rule.kind == "ltl":
             raise ValueError(
                 f"kernel=bitpack supports totalistic and wireworld rules "
@@ -848,6 +868,14 @@ class Simulation:
             elif self.mesh is not None:
                 self._steppers[k] = sharded_step_fn(
                     self.mesh, self.rule, steps_per_call=k, halo_width=self._halo_for(k)
+                )
+            elif self.kernel == "matmul":
+                # Banded matrix-multiply counts (dense uint8 layout, single
+                # device — _resolve_kernel planned and guard-priced it).
+                from akka_game_of_life_tpu.ops import matmul_stencil
+
+                self._steppers[k] = matmul_stencil.matmul_multi_step_fn(
+                    self.rule, k
                 )
             elif self.kernel == "pallas":
                 # Only the LtL pallas kernel reaches here (dense layout,

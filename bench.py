@@ -515,7 +515,7 @@ def main() -> None:
         cmd = [
             sys.executable,
             str(pathlib.Path(__file__).resolve().parent / "bench_suite.py"),
-            "--config", "1", "2", "3", "4", "7", "8", "10",
+            "--config", "1", "2", "3", "4", "7", "8", "10", "15",
         ]
         if args.platform or fallback:
             cmd += ["--platform", args.platform or fallback]

@@ -56,6 +56,18 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
                          --sweep-exchange-width): the same seeded cluster
                          at exchange_width 1/2/4/8, throughput per T,
                          every T digest-certified against the dense oracle.
+ 15. matmul-ab           the MXU stencil A/B (ops/matmul_stencil.py):
+                         Conway dense-vs-banded-matmul across sizes
+                         (1024²…16384² at scale 1; --scale 4 parameterizes
+                         the 65536² headline shape for the next hardware
+                         window), plus an LtL matmul-vs-shift-add radius
+                         sweep at 12288² (3-smooth, so the f32 lane's
+                         digit packing reaches depth 3-4 at every swept R
+                         — power-of-two widths cap R=4-5 at depth 2) with
+                         the measured crossover R in the summary line —
+                         every variant digest-certified bit-identical to
+                         the dense oracle (docs/OPERATIONS.md "MXU
+                         stencil path").
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -678,6 +690,152 @@ def bench_sparse_dilute(size: int, epochs: int = 128, steps: int = 8) -> None:
     print(json.dumps(line), flush=True)
 
 
+def bench_matmul_ab(
+    sizes,
+    ltl_size: int,
+    radii=(2, 3, 4, 5, 8, 10),
+    moore_steps: int = 8,
+    ltl_steps: int = 4,
+) -> None:
+    """Config 15: neighbor counting as banded matrix multiplies, A/B'd.
+
+    Part A prices Conway through the dense roll-sum oracle vs the banded
+    matmul family at every size; part B sweeps LtL radius at the largest
+    size against the separable shift-add kernel and reports the measured
+    crossover R (the smallest R from which the banded path wins, the
+    acceptance number for the MXU stencil work).  Every pair of finals is
+    certified bit-identical through the digest plane — equal 64-bit
+    digests, not just equal throughput claims."""
+    import jax
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.models import get_model
+    from akka_game_of_life_tpu.ops import digest as odigest, ltl, matmul_stencil
+    from akka_game_of_life_tpu.ops.rules import Rule
+
+    dfn = jax.jit(lambda b: odigest.digest_dense(b))
+    population = lambda x: int(jnp.sum(x != 0))
+
+    def _ab(config: str, label: str, steps: int, runs, board) -> dict:
+        """Time each (name, fn) from the same ``board``; certify equal
+        digests; emit one line per variant; return {name: rate}."""
+        rates = {}
+        digests = {}
+        for name, fn in runs:
+            out = fn(board)
+            assert population(out) > 0  # warm compile + sync
+            # Median of 3: the crossover claim rides ratios within a few
+            # percent, so a single scheduler hiccup must not decide it.
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = fn(board)
+                pop = population(out)
+                times.append(time.perf_counter() - t0)
+            dt = sorted(times)[1]
+            assert pop > 0, f"{config}: board died; timing meaningless"
+            rates[name] = board.shape[0] * board.shape[1] * steps / dt
+            # Determinism makes the timed output THE final state: both
+            # paths started from the same board, so equal digests here
+            # certify the whole run, ~8 fetched bytes per variant.
+            digests[name] = odigest.value(np.asarray(dfn(out)))
+            _emit(
+                config,
+                f"cell-updates/sec/chip, {label} ({name})",
+                rates[name],
+                "cell-updates/sec",
+                PER_CHIP_TARGET,
+            )
+        names = [n for n, _ in runs]
+        assert len(set(digests.values())) == 1, (
+            f"{config}: digest divergence across paths — "
+            + ", ".join(f"{n}={digests[n]:016x}" for n in names)
+        )
+        line = {
+            "config": config,
+            "metric": f"{names[1]} / {names[0]} throughput ratio, {label}",
+            "value": rates[names[1]] / rates[names[0]],
+            "unit": "x",
+            "vs_baseline": rates[names[1]] / rates[names[0]],
+            "digest": odigest.format_digest(digests[names[0]]),
+        }
+        print(json.dumps(line), flush=True)
+        return rates
+
+    rng = np.random.default_rng(0)
+    # Part A: Moore counts, dense roll-sum oracle vs banded matmul.
+    for size in sizes:
+        board = jnp.asarray((rng.random((size, size)) < 0.5).astype(np.uint8))
+        _ab(
+            f"matmul-ab-moore-{size}",
+            f"conway {size}x{size} torus, {moore_steps} steps",
+            moore_steps,
+            [
+                ("dense-oracle", get_model("conway").run(moore_steps)),
+                ("matmul", matmul_stencil.matmul_multi_step_fn("conway", moore_steps)),
+            ],
+            board,
+        )
+
+    # Part B: LtL radius sweep at the largest size — shift-add vs banded.
+    # The rule family is Bugs (Evans) rescaled per radius: birth/survive
+    # bands at the same window fractions as the canonical R=5 rule, so the
+    # board stays alive at every R instead of flashing to extinction the
+    # way ad-hoc wide birth bands do.
+    board = jnp.asarray((rng.random((ltl_size, ltl_size)) < 0.35).astype(np.uint8))
+    crossover = None
+    ratios = {}
+    for radius in radii:
+        w = (2 * radius + 1) ** 2
+        rule = Rule(
+            frozenset(range(int(0.28 * w), int(0.37 * w) + 1)),
+            frozenset(range(int(0.27 * w), int(0.48 * w) + 1)),
+            radius=radius,
+            kind="ltl",
+        )
+        # Liveness probe (doubles as the warm compile — the closure is
+        # lru-cached): big radii on smoke-scale boards can die out, which
+        # would make the timing a const-fold artifact; skip them loudly.
+        if int(jnp.sum(ltl.ltl_multi_step_fn(rule, ltl_steps)(board))) == 0:
+            print(json.dumps({
+                "config": f"matmul-ab-ltl-{ltl_size}",
+                "metric": f"ltl R{radius} A/B skipped",
+                "value": None, "unit": None, "vs_baseline": None,
+                "note": f"board died within {ltl_steps} steps at "
+                        f"{ltl_size}² — a smoke-scale artifact; rerun at "
+                        f"a larger --scale for this radius",
+            }), flush=True)
+            continue
+        rates = _ab(
+            f"matmul-ab-ltl-{ltl_size}",
+            f"ltl R{radius} {ltl_size}x{ltl_size} torus, {ltl_steps} steps",
+            ltl_steps,
+            [
+                ("shift-add", ltl.ltl_multi_step_fn(rule, ltl_steps)),
+                ("matmul", matmul_stencil.matmul_multi_step_fn(rule, ltl_steps)),
+            ],
+            board,
+        )
+        ratios[radius] = rates["matmul"] / rates["shift-add"]
+        if crossover is None and ratios[radius] >= 1.0:
+            crossover = radius
+        elif ratios[radius] < 1.0:
+            crossover = None  # must win from here UP, not once
+    line = {
+        "config": "matmul-ab",
+        "metric": (
+            f"LtL banded-matmul crossover radius at {ltl_size}x{ltl_size} "
+            f"(smallest R from which matmul beats shift-add for all "
+            f"larger measured R; null = never)"
+        ),
+        "value": crossover,
+        "unit": "radius",
+        "vs_baseline": None,
+        "ratios_by_radius": {str(r): round(v, 3) for r, v in ratios.items()},
+    }
+    print(json.dumps(line), flush=True)
+
+
 def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
     """Config 6: the TCP cluster's width-k communication-avoiding exchange —
     an in-process frontend + 2 workers (jax engines) stepping a size² board
@@ -740,7 +898,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", type=int, nargs="*",
-        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14],
+        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15],
     )
     parser.add_argument(
         "--scale", type=float, default=1.0,
@@ -835,6 +993,18 @@ def main() -> None:
         from bench_cluster import bench_cluster_tsweep
 
         bench_cluster_tsweep(size=s(1024), epochs=64, widths=(1, 2, 4, 8))
+    if 15 in args.config:
+        # The MXU stencil A/B (ROADMAP item 2): banded-matmul neighbor
+        # counts vs the VPU paths, digest-certified, with the LtL
+        # crossover radius as the summary number.  The size grid dedupes
+        # after scaling (tiny --scale collapses neighbors); --scale 4
+        # parameterizes the 65536² headline shape for a hardware window.
+        sizes = sorted({s(n, 32 * 8) for n in (1024, 2048, 4096, 8192, 16384)})
+        # The LtL sweep runs at a 3-smooth size (12288 = 2¹²·3, scaling to
+        # 768/49152 at the smoke/headline scales): digit depth must divide
+        # the width, so 3-divisible widths let the f32 lane pack depth 3-4
+        # across the whole R sweep where 2^k widths cap R=4-5 at depth 2.
+        bench_matmul_ab(sizes=sizes, ltl_size=s(12288, 32 * 8))
 
 
 if __name__ == "__main__":

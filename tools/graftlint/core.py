@@ -44,6 +44,7 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
     ("GL-CFG03", "--rebalance-* flags ↔ SimulationConfig rebalance_* fields"),
     ("GL-CFG04", "--serve-* flags ↔ SimulationConfig serve_* fields"),
     ("GL-CFG05", "--sparse-* flags ↔ SimulationConfig sparse_* fields"),
+    ("GL-CFG06", "--kernel choices ↔ config KERNEL_CHOICES ↔ OPERATIONS.md"),
     ("GL-DOC01", "gol_* metric literals ↔ obs catalog ↔ OPERATIONS.md"),
     ("GL-DOC02", "span names ↔ SPAN_CATALOG ↔ OPERATIONS.md"),
     ("GL-DOC03", "protocol messages ↔ OPERATIONS.md table"),

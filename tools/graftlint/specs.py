@@ -60,6 +60,45 @@ SPARSE_CONFIG = FlagConfigSpec(
     flag_strip="--sparse", field_prefix="sparse_",
 )
 
+# The --kernel choice surface is a VALUE set, not a flag family: the CLI
+# mirrors runtime.config.KERNEL_CHOICES as a literal tuple (so the lint
+# stays textual/import-free), and the operator doc carries one table row
+# per choice.  Three-way: cli ↔ config ↔ doc, all two-way.
+KERNEL_CONFIG = CatalogSpec(
+    name="kernel_config", pass_id="GL-CFG06",
+    sides={
+        "config": Side(
+            kind="block", path="akka_game_of_life_tpu/runtime/config.py",
+            start="KERNEL_CHOICES = (", end="\n)\n",
+            regex=r"""["']([a-z]+)["']""",
+        ),
+        "cli": Side(
+            kind="block", path="akka_game_of_life_tpu/cli.py",
+            start="_KERNEL_CHOICES = (", end="\n)\n",
+            regex=r"""["']([a-z]+)["']""",
+        ),
+        "doc": Side(
+            kind="section", path=_DOC, start="## Kernel selection",
+            end="### ", regex=r"^\|\s*`([a-z]+)`\s*\|",
+        ),
+    },
+    relations=(
+        Relation("cli", "config", "cli.py offers --kernel {name} which "
+                 "runtime/config.py KERNEL_CHOICES does not accept — the "
+                 "flag would fail validation after parsing"),
+        Relation("config", "cli", "config accepts kernel={name} which the "
+                 "--kernel CLI choices do not offer — a kernel the CLI "
+                 "cannot select silently rots"),
+        Relation("config", "doc", "kernel choice {name} has no row in the "
+                 "OPERATIONS.md Kernel selection table"),
+        Relation("doc", "config", "OPERATIONS.md documents kernel choice "
+                 "{name} which KERNEL_CHOICES does not declare — worse "
+                 "than no row"),
+    ),
+    scan_guard=("config", "scan broken: KERNEL_CHOICES tuple not found in "
+                "runtime/config.py"),
+)
+
 METRICS_DOC = CatalogSpec(
     name="metrics_doc", pass_id="GL-DOC01",
     sides={
@@ -157,5 +196,5 @@ GRAFTLINT_DOC = CatalogSpec(
 
 SPECS = (
     CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SPARSE_CONFIG,
-    METRICS_DOC, TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
+    KERNEL_CONFIG, METRICS_DOC, TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
 )
