@@ -213,6 +213,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "chunk runs the plain dense kernel and only the change bitmap is "
         "recomputed (default 0.5)",
     )
+    _add_ff(p)
     p.add_argument("--log-file")
     p.add_argument("--inject-faults", action="store_true", default=None)
     p.add_argument(
@@ -227,6 +228,44 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--coordinator", metavar="HOST:PORT")
     p.add_argument("--num-processes", type=int)
     p.add_argument("--process-id", type=int)
+
+
+def _add_ff(p: argparse.ArgumentParser) -> None:
+    """The logarithmic fast-forward knobs (``ops/fastforward.py``).  Every
+    ``--ff-X`` flag maps 1:1 onto ``SimulationConfig.ff_X`` (dashes to
+    underscores) — graftlint ``GL-CFG07`` lint-enforces the CLI ↔ config
+    ↔ operator-doc bijection."""
+    g = p.add_argument_group(
+        "logarithmic fast-forward",
+        "jump T epochs of an XOR-linear (odd-rule) board in O(log T) "
+        "device programs instead of O(T) (see docs/OPERATIONS.md "
+        "\"Logarithmic fast-forward\"); non-linear rules are provably "
+        "refused, never silently jumped",
+    )
+    g.add_argument(
+        "--ff-enabled",
+        choices=["on", "off"],
+        default=None,
+        help="master switch (default on): off makes Simulation.fast_forward "
+        "refuse and the serve plane answer 429 `max_steps` past the "
+        "serve_max_steps bound even for linear rules",
+    )
+    g.add_argument(
+        "--ff-certify-steps", type=int, default=None, metavar="T",
+        help="jump-vs-iterate digest certification sample per jump "
+        "(default 8): min(T, jump span) epochs also run through the "
+        "ordinary stepper and the digests must agree; 0 skips (headline-"
+        "size timing runs certify via a separate anchor jump instead)",
+    )
+
+
+def _ff_overrides(args: argparse.Namespace) -> dict:
+    """``--ff-*`` flags → SimulationConfig override kwargs (None = unset,
+    dropped by load_config)."""
+    return {
+        "ff_enabled": {"on": True, "off": False, None: None}[args.ff_enabled],
+        "ff_certify_steps": args.ff_certify_steps,
+    }
 
 
 def _add_ring_plane(p: argparse.ArgumentParser) -> None:
@@ -573,6 +612,7 @@ def _overrides(args: argparse.Namespace) -> dict:
         ],
         "sparse_block": args.sparse_block,
         "sparse_threshold": args.sparse_threshold,
+        **_ff_overrides(args),
         "log_file": args.log_file,
         "distributed": args.distributed,
         "coordinator_address": args.coordinator,
@@ -629,6 +669,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="write the final board as a Golly/LifeWiki .rle file "
         "(O(board) host fetch — meant for boards you would also render)",
     )
+    run_p.add_argument(
+        "--fast-forward",
+        type=int,
+        default=None,
+        metavar="T",
+        help="jump to epoch T up front via the O(log T) linear-rule fast "
+        "path (ops/fastforward.py; XOR-linear rules only — refused loudly "
+        "otherwise), then run the normal loop for any remaining "
+        "--max-epochs.  T is an ABSOLUTE epoch like --max-epochs: a "
+        "resumed run jumps only the remainder, so interrupted and "
+        "uninterrupted runs land on the same trajectory; prints the "
+        "landed epoch + digest",
+    )
 
     fe_p = sub.add_parser("frontend", help="control-plane coordinator (RunFrontend)")
     _add_common(fe_p)
@@ -671,6 +724,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "(default 0 = ephemeral, printed at startup)",
     )
     _add_serve(sv_p)
+    _add_ff(sv_p)
 
     st_p = sub.add_parser(
         "selftest",
@@ -878,6 +932,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "role": "serve",
                 "metrics_port": args.metrics_port,
                 **_serve_overrides(args),
+                **_ff_overrides(args),
             },
         )
         from akka_game_of_life_tpu.obs import get_tracer
@@ -930,6 +985,26 @@ def _run_simulation(args, cfg, sim) -> int:
         # --max-epochs is the absolute end epoch: a resumed run (from a
         # checkpoint at epoch E) advances the remaining max_epochs - E.
         try:
+            if getattr(args, "fast_forward", None):
+                from akka_game_of_life_tpu.ops.digest import format_digest
+
+                # Absolute target, like --max-epochs: a resumed run (from
+                # a checkpoint at epoch E) jumps only the remaining
+                # fast_forward - E, never re-applies the whole span.
+                try:
+                    ep = sim.fast_forward(
+                        max(0, args.fast_forward - sim.epoch)
+                    )
+                except ValueError as e:
+                    # Predictable operator misuse (non-linear rule, ff
+                    # disabled, actor backend): one line, not a traceback.
+                    raise SystemExit(f"--fast-forward: {e}")
+                print(
+                    f"fast-forwarded to epoch {ep}: "
+                    f"digest={format_digest(sim.board_digest())}",
+                    file=sim.observer.out,
+                    flush=True,
+                )
             sim.advance(max(0, cfg.max_epochs - sim.epoch))
         except KeyboardInterrupt:
             # Graceful ^C: the board is consistent at the last completed

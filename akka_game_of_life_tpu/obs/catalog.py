@@ -123,6 +123,17 @@ CATALOG = (
     ("gol_serve_step_seconds", "histogram",
      "Wall seconds per step request, enqueue to result (queue wait + "
      "batch run)", ()),
+    ("gol_serve_ff_jumps_total", "counter",
+     "Serve fast-path jumps committed (linear-rule sessions stepping "
+     "past serve_max_steps via O(log T) fast-forward)", ()),
+    # -- logarithmic fast-forward (ops/fastforward.py) ------------------------
+    ("gol_ff_jumps_total", "counter",
+     "Fast-forward jumps committed by Simulation.fast_forward", ()),
+    ("gol_ff_epochs_total", "counter",
+     "Epochs advanced via O(log T) fast-forward jumps", ()),
+    ("gol_ff_seconds", "histogram",
+     "Wall seconds per fast-forward jump (certify + jump + board swap)",
+     ()),
     # -- activity-gated sparse stepping --------------------------------------
     ("gol_tiles_skipped_total", "counter",
      "Tile chunks skipped by quiescent cluster tiles (frontend-merged "

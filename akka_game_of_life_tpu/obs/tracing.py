@@ -51,6 +51,8 @@ SPAN_CATALOG = (
     # -- standalone runtime ---------------------------------------------------
     ("sim.advance", "one Simulation.advance() call (the standalone run loop)"),
     ("sim.chunk", "one stepper chunk (steps_per_call epochs, one device round-trip)"),
+    ("sim.fastforward", "one O(log T) linear-rule jump (certify + jump + "
+     "board swap)"),
     ("chaos.crash", "injected crash taking effect (state discarded)"),
     ("chaos.recover", "checkpoint restore + deterministic replay after a crash"),
     # -- cluster frontend -----------------------------------------------------

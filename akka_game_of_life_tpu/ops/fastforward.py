@@ -1,0 +1,440 @@
+"""Logarithmic time travel: O(log T) fast-forward for XOR-linear rules.
+
+Every stepper in this repo — dense, bit-packed, Pallas, banded-matmul,
+sparse-gated — pays O(T) device programs to advance T epochs.  For the
+odd-rule family (``ops/rules.linear_kernel``) the update is *linear over
+GF(2)*: one step is XOR-convolution of the board by a fixed ±R kernel
+("Odd-Rule Cellular Automata on the Square Grid", PAPERS.md), and step
+composition is legal across the whole neighborhood (the Linear
+Acceleration Theorem, PAPERS.md).  T steps therefore collapse to ONE
+convolution by the kernel's T-th XOR-power — and over GF(2) that power
+has special structure this module exploits twice:
+
+- **Squaring is free (Frobenius).**  In a ring of characteristic 2,
+  ``(Σ aᵢ xⁱ)² = Σ aᵢ x²ⁱ``: squaring a kernel just doubles every offset
+  (mod the torus).  So ``K^(2^k)`` is the base kernel with offsets scaled
+  by ``2^k`` — never more set cells than K itself.
+- **The factored jump.**  ``K^T = Π K^(2^k)`` over T's set bits, and the
+  factors commute, so the board is advanced by applying each scaled base
+  kernel directly: ``popcount(T) ≤ log₂T + 1`` device programs of ≤ |K|
+  rolls + XORs each (:func:`fast_forward`).  Epoch 2³⁰ of a 16384² board
+  is ONE program of 8 rolls — O(board) work total, whatever T is.
+
+The *materialized* composed kernel (:func:`pow_offsets` /
+:func:`kernel_plane`, genuine XOR-convolution square-and-multiply on a
+sparse offset set) exists for certification, analysis, and the
+single-wrapped-convolution story: its support dilates as R·T per the PR 9
+influence bound (:func:`support_radius`) until it wraps the torus, where
+it caps at the board size — every intermediate working set is priced
+through :mod:`ops/guard` *before* composition, never allocate-and-die.
+
+For the separable linear kernels (the Fredkin family: full (2R+1)² box,
+center included, = ones ⊗ ones) the T-step jump also factors into two
+one-dimensional XOR-powers, so it evaluates as two blocked banded matrix
+multiplies over GF(2) — the PR 11 MXU machinery with the band *pattern*
+generalized from contiguous ±R to the 1-D kernel's XOR-power mask
+(:func:`jump_matmul_fn`); counts accumulate exactly (int8→int32 on TPU,
+f32 elsewhere) and reduce mod 2, so the MXU path rides for free.
+
+Certification (:func:`certify_jump`) compares the digest of a jump
+against the digest of the same T iterated through the ordinary stepper —
+the jump-vs-iterate contract every product surface samples
+(``Simulation.fast_forward``, the serve fast path, ``bench_suite``
+config 16).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from akka_game_of_life_tpu.ops import guard
+from akka_game_of_life_tpu.ops.rules import linear_kernel, resolve_rule
+
+
+def kernel_offsets(rule) -> np.ndarray:
+    """The linear rule's one-step kernel as centered ``(k, 2)`` int64
+    offsets (the sparse twin of ``linear_kernel``'s plane).  Raises
+    ``ValueError`` for non-linear rules — the refusal every fast-forward
+    surface routes through, so a non-linear rule can never be silently
+    jumped."""
+    rule = resolve_rule(rule)
+    kern = linear_kernel(rule)
+    if kern is None:
+        raise ValueError(
+            f"rule {rule} is not XOR-linear: fast-forward applies only to "
+            f"the odd-rule family (birth on odd counts with odd/even "
+            f"survival — see ops/rules.linear_kernel); every other rule "
+            f"must iterate"
+        )
+    r = rule.radius
+    ys, xs = np.nonzero(kern)
+    return np.stack([ys.astype(np.int64) - r, xs.astype(np.int64) - r], 1)
+
+
+def support_radius(rule, t: int) -> int:
+    """The composed kernel's support half-width after ``t`` steps: R·t —
+    the same one-cell-per-step influence bound PR 9's activity gate rests
+    on, applied T times.  The torus caps it: once ``2·R·t + 1`` reaches
+    the board side the kernel wraps and support saturates at board size."""
+    return resolve_rule(rule).radius * int(t)
+
+
+def _parity_dedup(offs: np.ndarray, shape: Tuple[int, int]) -> np.ndarray:
+    """Canonicalize offsets mod the torus and cancel pairs — XOR-conv
+    coefficients live in GF(2), so an offset appearing an even number of
+    times vanishes."""
+    h, w = shape
+    if len(offs) == 0:
+        return offs.reshape(0, 2)
+    offs = np.stack([offs[:, 0] % h, offs[:, 1] % w], 1)
+    uniq, counts = np.unique(offs, axis=0, return_counts=True)
+    return uniq[counts % 2 == 1]
+
+
+def _compose_guard(n_left: int, n_right: int, what: str) -> None:
+    """Price one XOR-convolution's offset working set (the n_left·n_right
+    candidate rows materialized before parity cancellation) up front."""
+    rows = n_left * n_right
+    guard.require_intermediates_fit(
+        rows * 2 * 8 * 2,  # (rows, 2) int64, candidate + unique scratch
+        what=what,
+        detail=(
+            "Use the factored jump (fast_forward) instead — it applies "
+            "the per-bit scaled kernels to the board directly and never "
+            "materializes the composed kernel."
+        ),
+        shapes=[((rows, 2), 8), ((rows, 2), 8)],
+    )
+
+
+# Span ceiling: every surface bounds its per-jump program count (and jit
+# cache growth) by the span's bit length, so one absurd request cannot
+# mint unbounded compiles.  Purely a DoS bound — offset arithmetic is
+# exact at ANY span, because scale factors reduce mod the torus side
+# BEFORE multiplying (``_scaled_offsets``: 2^k·o ≡ (2^k mod n)·o mod n,
+# and (n−1)·radius always fits int64).  2^62 epochs is beyond any
+# physical use, so the cap costs nothing.
+MAX_SPAN_BITS = 62
+
+
+def _scaled_offsets(base: np.ndarray, k: int, shape: Tuple[int, int]) -> np.ndarray:
+    """The 2^k-Frobenius-scaled kernel offsets, canonical mod the torus
+    and parity-deduped.  The scale reduces mod each side first — a raw
+    int64 ``base << k`` would silently wrap for k ≥ 61, and
+    (x mod 2^64) mod n ≠ x mod n on non-power-of-two sides."""
+    h, w = shape
+    sy, sx = pow(2, k, h), pow(2, k, w)
+    return _parity_dedup(
+        np.stack([base[:, 0] * sy, base[:, 1] * sx], 1), (h, w)
+    )
+
+
+def _require_span(t: int) -> int:
+    t = int(t)
+    if t < 0:
+        raise ValueError(f"cannot fast-forward a negative span: t={t}")
+    if t.bit_length() > MAX_SPAN_BITS:
+        raise ValueError(
+            f"fast-forward span t={t} exceeds {MAX_SPAN_BITS} bits "
+            f"(offsets scale as 2^k in int64, and the per-jump program "
+            f"count is bounded by the span's bit length)"
+        )
+    return t
+
+
+def pow_offsets(rule, t: int, shape: Tuple[int, int]) -> np.ndarray:
+    """The T-th XOR-power of the one-step kernel as sparse offsets on the
+    ``(H, W)`` torus, by square-and-multiply: squaring is the Frobenius
+    offset-doubling (exact, free); each multiply-by-base is a genuine
+    XOR-convolution whose candidate working set is guard-priced before it
+    is built.  Support is bounded by ``min(2·R·t + 1, side)`` per axis
+    (:func:`support_radius`), so the offset count never exceeds the board
+    — the composed kernel *is* the single wrapped convolution once the
+    dilation front laps the torus."""
+    rule = resolve_rule(rule)
+    base = kernel_offsets(rule)
+    h, w = int(shape[-2]), int(shape[-1])
+    t = _require_span(t)
+    if t == 0:
+        return np.zeros((1, 2), dtype=np.int64)  # the identity kernel
+    acc = _parity_dedup(base, (h, w))
+    for bit in bin(t)[3:]:  # remaining bits below the MSB, high to low
+        acc = _parity_dedup(2 * acc, (h, w))  # Frobenius: K² offsets = 2·offsets
+        if bit == "1":
+            _compose_guard(
+                len(acc), len(base),
+                what=f"fastforward kernel composition ({rule}, t={t}, "
+                     f"{h}x{w})",
+            )
+            cand = (acc[None, :, :] + base[:, None, :]).reshape(-1, 2)
+            acc = _parity_dedup(cand, (h, w))
+    return acc
+
+
+def kernel_plane(rule, t: int, shape: Tuple[int, int]) -> np.ndarray:
+    """The T-step kernel rendered as a wrapped ``(H, W)`` uint8 plane
+    (guard-priced): ``jump(board) == board ⊛ kernel_plane`` over GF(2).
+    Row/col 0 is the zero offset (apply with ``apply_kernel``)."""
+    h, w = int(shape[-2]), int(shape[-1])
+    guard.require_intermediates_fit(
+        h * w,
+        what=f"fastforward kernel plane ({resolve_rule(rule)}, t={t}, {h}x{w})",
+        detail="Use pow_offsets (sparse) or the factored fast_forward jump.",
+        shapes=[((h, w), 1)],
+    )
+    plane = np.zeros((h, w), dtype=np.uint8)
+    offs = pow_offsets(rule, t, (h, w))
+    plane[offs[:, 0], offs[:, 1]] ^= 1
+    return plane
+
+
+def apply_offsets(board: jax.Array, offs: np.ndarray) -> jax.Array:
+    """XOR-convolve a 0/1 board by a sparse offset kernel: one roll + XOR
+    per set offset (``next[p] = XOR_o board[p + o]``).  The generic apply
+    for materialized kernels — tests use it to check the composed kernel
+    against iteration; the hot path is :func:`fast_forward`."""
+    if len(offs) == 0:
+        return jnp.zeros_like(board)
+    acc = None
+    for dy, dx in offs:
+        term = (
+            board
+            if (dy % board.shape[-2], dx % board.shape[-1]) == (0, 0)
+            else jnp.roll(board, (-int(dy), -int(dx)), axis=(-2, -1))
+        )
+        acc = term if acc is None else jnp.bitwise_xor(acc, term)
+    return acc
+
+
+# Bounded: serve clients control (rule, k, shape), so an unbounded cache
+# would pin one jitted closure per distinct key for the process lifetime
+# (the retained-compile hazard class GL-HAZ01 catches in method form).
+# Eviction just recompiles a ~|K|-roll program on the next miss.
+@functools.lru_cache(maxsize=2048)
+def _jump_pow2_fn(rule_key, k: int, shape: Tuple[int, int]) -> Callable:
+    """A jitted 2^k-epoch jump (cached per (rule, k, shape)): the base
+    kernel with offsets scaled by 2^k (Frobenius), applied as ≤ |K| rolls
+    + XORs in one device program.  Scaled offsets that collide mod the
+    torus cancel in pairs (GF(2)), so the roll list is parity-deduped
+    host-side before tracing."""
+    rule = resolve_rule(rule_key)
+    scaled = _scaled_offsets(kernel_offsets(rule), k, shape)
+    shifts = [(int(dy), int(dx)) for dy, dx in scaled]
+
+    @jax.jit
+    def _run(board: jax.Array) -> jax.Array:
+        return apply_offsets(board, np.asarray(shifts).reshape(-1, 2))
+
+    return _run
+
+
+def fast_forward(board: jax.Array, rule, t: int) -> jax.Array:
+    """Advance a dense 0/1 board ``t`` epochs under a linear rule in
+    ``popcount(t)`` device programs — the factored jump (each set bit of
+    ``t`` applies one Frobenius-scaled copy of the base kernel; the
+    factors commute, so order is free).  Bit-identical to iterating ``t``
+    steps; raises ``ValueError`` for non-linear rules."""
+    rule = resolve_rule(rule)
+    kernel_offsets(rule)  # the linearity proof/refusal, before any work
+    t = _require_span(t)
+    h, w = int(board.shape[-2]), int(board.shape[-1])
+    out = board
+    k = 0
+    while t:
+        if t & 1:
+            out = _jump_pow2_fn(rule, k, (h, w))(out)
+        t >>= 1
+        k += 1
+    return out
+
+
+def fast_forward_np(board: np.ndarray, rule, t: int) -> np.ndarray:
+    """Host-array convenience wrapper (the serve fast path's shape):
+    numpy in, numpy out, device compute in between."""
+    return np.asarray(fast_forward(jnp.asarray(board, dtype=jnp.uint8), rule, t))
+
+
+def certify_jump(board, rule, t: int) -> int:
+    """The jump-vs-iterate certificate: fast-forward ``board`` by ``t``
+    AND iterate the same ``t`` through the ordinary dense stepper; their
+    on-device digests must agree.  Returns the agreed digest; raises
+    ``RuntimeError`` on divergence (a linearity-math or kernel bug — the
+    caller must not trust the jump).  O(t) stepper work, so callers
+    sample small t (the ``ff_certify_steps`` knob), never the full span."""
+    from akka_game_of_life_tpu.ops import digest as odigest, stencil
+
+    rule = resolve_rule(rule)
+    board = jnp.asarray(board, dtype=jnp.uint8)
+    jumped = fast_forward(board, rule, t)
+    iterated = stencil.multi_step_fn(rule, t)(board) if t else board
+    dfn = jax.jit(odigest.digest_dense)
+    d_jump = odigest.value(np.asarray(dfn(jumped), dtype=np.uint32))
+    d_iter = odigest.value(np.asarray(dfn(iterated), dtype=np.uint32))
+    if d_jump != d_iter:
+        raise RuntimeError(
+            f"fast-forward certification failed for {rule} at t={t}: "
+            f"jump digest {d_jump:016x} != iterate digest {d_iter:016x} — "
+            f"refusing to trust the jump"
+        )
+    return d_jump
+
+
+# -- the banded-matmul GF(2) lane (separable kernels: the Fredkin family) ------
+
+
+def _pow1d_offsets(radius: int, t: int, n: int) -> np.ndarray:
+    """The 1-D XOR-power mask: T-th GF(2) power of ``ones(2R+1)`` on the
+    length-``n`` circle, as sorted residues — same square-and-multiply as
+    :func:`pow_offsets`, one axis (trinomial coefficients mod 2 for R=1:
+    the Sierpinski structure that keeps these masks sparse at 2^k)."""
+    base = np.arange(-radius, radius + 1, dtype=np.int64)
+
+    def dedup(vals: np.ndarray) -> np.ndarray:
+        uniq, counts = np.unique(vals % n, return_counts=True)
+        return uniq[counts % 2 == 1]
+
+    if t == 0:
+        return np.zeros(1, dtype=np.int64)
+    acc = dedup(base)
+    for bit in bin(t)[3:]:
+        acc = dedup(2 * acc)
+        if bit == "1":
+            acc = dedup((acc[None, :] + base[:, None]).ravel())
+    return acc
+
+
+def _centered(residues: np.ndarray, n: int) -> np.ndarray:
+    """Map circle residues to the centered range (-n//2, n//2]."""
+    return ((residues + n // 2 - 1) % n) - (n // 2 - 1) if n > 1 else residues * 0
+
+
+def _mask_slab(tile: int, centered: np.ndarray, s: int) -> np.ndarray:
+    """(tile, tile + 2s) GEMM operand slab: row t has ones at columns
+    t + s + o for each centered mask offset o — the PR 11 band slab with
+    the contiguous ±R band generalized to an arbitrary 0/1 mask."""
+    slab = np.zeros((tile, tile + 2 * s), np.float32)
+    for off in centered:
+        slab[np.arange(tile), np.arange(tile) + s + int(off)] = 1.0
+    return slab
+
+
+@functools.lru_cache(maxsize=64)  # keyed on raw t — bench/test lane, bounded
+def jump_matmul_fn(rule_key, t: int, shape: Tuple[int, int], mode: str = "auto"):
+    """The T-step jump as two blocked banded matrix multiplies over GF(2)
+    — the MXU lane, for SEPARABLE linear kernels only (the full-box
+    Fredkin family, whose kernel is ``ones ⊗ ones``; replicator-style
+    center-less kernels are not rank-1 and take the roll path).
+
+    ``W = parity(A_rows(T) · parity(S stage)) ``: the row pass sums each
+    column's 1-D XOR-power window and reduces mod 2 *between* passes (so
+    every GEMM accumulates counts ≤ board side, exactly representable on
+    all three PR 11 dtype lanes), the column pass does the same along
+    rows, and the epilogue takes the final parity.  Operands, pads, and
+    slabs are guard-priced at closure-build time: once the mask wraps the
+    torus the slabs approach (K, K + side) — the capped working set the
+    issue's wrap story names."""
+    from akka_game_of_life_tpu.ops.matmul_stencil import (
+        _pick_tile,
+        _resolve_mode,
+    )
+
+    rule = resolve_rule(rule_key)
+    t = _require_span(t)
+    kern = linear_kernel(rule)
+    if kern is None or not kern.all():
+        raise ValueError(
+            f"rule {rule} has no separable (full-box) linear kernel; the "
+            f"banded GF(2) matmul jump needs ones⊗ones — use fast_forward "
+            f"(the factored roll path) instead"
+        )
+    h, w = int(shape[-2]), int(shape[-1])
+    mode = _resolve_mode(mode)
+    rows_c = _centered(_pow1d_offsets(rule.radius, t, h), h)
+    cols_c = _centered(_pow1d_offsets(rule.radius, t, w), w)
+    sr = int(np.max(np.abs(rows_c))) if len(rows_c) else 0
+    sc = int(np.max(np.abs(cols_c))) if len(cols_c) else 0
+    kr, kc = _pick_tile(h), _pick_tile(w)
+    item = {"f32": 4, "int8": 1, "bf16": 2}[mode]
+    planes = [
+        ((h + 2 * sr, w), item),  # row-padded operand
+        ((h, w), 4),  # row-pass counts (accumulator dtype)
+        ((h, w + 2 * sc), item),  # col-padded parity plane
+        ((h, w), 4),  # col-pass counts
+        ((kr, kr + 2 * sr), item),  # row mask slab
+        ((kc, kc + 2 * sc), item),  # col mask slab
+    ]
+    est = sum(guard.plane_bytes(s, i) for s, i in planes)
+    guard.require_intermediates_fit(
+        est,
+        what=f"fastforward matmul jump ({rule}, t={t}, {h}x{w}, {mode})",
+        detail="Use fast_forward (the factored roll path keeps working "
+               "sets board-sized at any T).",
+        shapes=planes,
+    )
+    od = {"f32": jnp.float32, "int8": jnp.int8, "bf16": jnp.bfloat16}[mode]
+    acc_t = jnp.int32 if mode == "int8" else jnp.float32
+    slab_r = jnp.asarray(_mask_slab(kr, rows_c, sr).astype(od))
+    slab_ct = jnp.asarray(_mask_slab(kc, cols_c, sc).T.astype(od))
+
+    def _dot(a, b):
+        return jax.lax.dot_general(
+            a, b, (((a.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=acc_t,
+        )
+
+    @jax.jit
+    def _run(board: jax.Array) -> jax.Array:
+        x = board.astype(od)
+        xp = jnp.concatenate([x[h - sr:], x, x[:sr]], axis=0) if sr else x
+        rows = [
+            _dot(slab_r, jax.lax.dynamic_slice_in_dim(xp, c * kr, kr + 2 * sr, 0))
+            for c in range(h // kr)
+        ]
+        # Parity BETWEEN passes: keeps the column GEMM's counts ≤ the
+        # mask weight (< 2²⁴), exact on every dtype lane.
+        y = (jnp.concatenate(rows, axis=0).astype(jnp.int32) & 1).astype(od)
+        yp = jnp.concatenate([y[:, w - sc:], y, y[:, :sc]], axis=1) if sc else y
+        cols = [
+            _dot(jax.lax.dynamic_slice_in_dim(yp, c * kc, kc + 2 * sc, 1), slab_ct)
+            for c in range(w // kc)
+        ]
+        out = jnp.concatenate(cols, axis=1).astype(jnp.int32) & 1
+        return out.astype(board.dtype)
+
+    return _run
+
+
+def jump_plan(rule, t: int, shape: Tuple[int, int]) -> dict:
+    """What a jump will cost, as data (the serve admission path and bench
+    report this): device programs, per-factor roll counts, support
+    half-width, and whether the composed kernel has wrapped the torus.
+
+    ``factor_rolls[i]`` is the set-cell count of the i-th scaled factor
+    AFTER torus parity cancellation — on a 2^m-side torus a factor scaled
+    by 2^k with k ≥ m collapses every offset onto the center, so a whole
+    power-of-two jump can legitimately reduce to the zero/identity map
+    (the odd-rule self-replication periodicity); the plan makes that
+    visible so a benchmark can never pass a trivial program off as
+    work."""
+    rule = resolve_rule(rule)
+    t = _require_span(t)
+    base = kernel_offsets(rule)
+    h, w = int(shape[-2]), int(shape[-1])
+    s = support_radius(rule, t)
+    factor_rolls = [
+        int(len(_scaled_offsets(base, k, (h, w))))
+        for k in range(max(1, int(t)).bit_length())
+        if (t >> k) & 1
+    ]
+    return {
+        "programs": max(1, bin(int(t)).count("1")),
+        "rolls_per_program": int(len(base)),
+        "factor_rolls": factor_rolls,
+        "support_radius": s,
+        "wrapped": 2 * s + 1 >= min(h, w),
+    }

@@ -50,6 +50,33 @@ def plane_bytes(shape: Tuple[int, ...], itemsize: int) -> int:
     return total
 
 
+def nearest_3smooth(n: int) -> int:
+    """The smallest 3-smooth width (2^a · 3^b with b ≥ 1 and a ≥ 5, so
+    32 | width keeps every packed kernel eligible) that is ≥ ``n`` — the
+    pad target refusal messages suggest when a power-of-two board width
+    caps the matmul family's f32 digit-packing depth at 2.
+
+    The documented PR 11 residue this makes discoverable at the point of
+    failure: digit depth must *divide* the width, so 2^k widths only admit
+    depths {1, 2, 4, ...} and the mantissa budget caps them at 2 for
+    R ≥ 5, while a width with a factor of 3 reaches depth 3–6 (the
+    ``bench_suite`` config 15 LtL sweep runs at 12288 = 2¹²·3 for exactly
+    this reason)."""
+    if n < 1:
+        raise ValueError(f"width must be positive, got {n}")
+    best = None
+    b = 1
+    while 3**b <= max(n, 96) * 2:
+        a = 5
+        while (3**b) << a < n:
+            a += 1
+        cand = (3**b) << a
+        if best is None or cand < best:
+            best = cand
+        b += 1
+    return best
+
+
 def require_intermediates_fit(
     estimated_bytes: int,
     *,

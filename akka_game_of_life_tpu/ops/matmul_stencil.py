@@ -201,13 +201,29 @@ def plan_matmul(
         ((kc, kc + 2 * radius), item),  # column band slab
     ]
     est = sum(guard.plane_bytes(s, i) for s, i in planes)
+    detail = (
+        "Shrink the board/radius, or use kernel=dense (the shift-add "
+        "path keeps intermediates board-sized)."
+    )
+    if mode == "f32" and digits <= 2:
+        # The documented PR 11 residue, surfaced at the point of failure:
+        # digit depth must divide the width, so power-of-two widths cap
+        # packing at d=2 where a 3-divisible width would pack deeper and
+        # shrink every packed plane by the same factor.
+        w3 = guard.nearest_3smooth(w)
+        d3, _ = _pick_digits(w3, radius)
+        if d3 > digits:
+            detail += (
+                f" Or pad the width to the nearest 3-smooth size "
+                f"({w} → {w3}): depth-{digits} digit packing is the "
+                f"power-of-two-width cap here, while width {w3} packs "
+                f"d={d3} digits and divides the packed planes (and the "
+                f"GEMM width) by {d3}/{digits}."
+            )
     guard.require_intermediates_fit(
         est,
         what=f"kernel=matmul ({mode}, {h}x{w}, radius {radius})",
-        detail=(
-            "Shrink the board/radius, or use kernel=dense (the shift-add "
-            "path keeps intermediates board-sized)."
-        ),
+        detail=detail,
         shapes=planes,
     )
     return MatmulPlan(h, w, radius, mode, digits, base, kr, kc, est)

@@ -21,6 +21,8 @@ import dataclasses
 import re
 from typing import FrozenSet, Optional
 
+import numpy as np
+
 _MAX_NEIGHBORS = 8  # Moore neighborhood
 
 
@@ -110,6 +112,14 @@ class Rule:
     @property
     def is_totalistic(self) -> bool:
         return self.kind == "totalistic"
+
+    @property
+    def is_linear(self) -> bool:
+        """True iff this rule's global update is XOR-linear over GF(2) —
+        the odd-rule family :func:`linear_kernel` proves membership of.
+        Linear rules are the ones ``ops/fastforward.py`` can jump T epochs
+        in O(log T) device programs instead of O(T)."""
+        return linear_kernel(self) is not None
 
     @property
     def max_neighbors(self) -> int:
@@ -232,6 +242,59 @@ def parse_rule(rulestring: str, name: Optional[str] = None) -> Rule:
     raise ValueError(f"unrecognized rulestring: {rulestring!r}")
 
 
+def linear_kernel(spec) -> Optional[np.ndarray]:
+    """The GF(2) one-step kernel of an XOR-linear rule, or ``None``.
+
+    A rule is XOR-linear ("odd rule", Odd-Rule Cellular Automata on the
+    Square Grid / the Linear Acceleration Theorem, PAPERS.md) iff its next
+    state is the XOR of a fixed cell subset of the neighborhood — then T
+    steps compose into ONE convolution by the kernel's T-th XOR-power
+    (``ops/fastforward.py``).  This predicate is a *proof by case
+    analysis*, not a heuristic: an outer-totalistic binary rule treats all
+    neighbors symmetrically, so the only GF(2)-linear members are
+
+    - ``birth = odd counts, survive = odd counts``  → next = parity of the
+      neighborhood (center excluded) — the replicator family;
+    - ``birth = odd counts, survive = even counts`` → next = center XOR
+      neighborhood parity — the Fredkin family;
+    - ``birth = ∅, survive = all counts``           → the identity map;
+    - ``birth = ∅, survive = ∅``                    → the zero map.
+
+    Everything else (Conway, HighLife, Seeds, every Generations/wireworld
+    rule, every non-parity LtL band) is provably non-linear and returns
+    ``None`` — it must never be fast-forwarded.  The returned kernel is a
+    centered ``(2R+1, 2R+1)`` uint8 0/1 plane (box or diamond support,
+    center set for the Fredkin/identity cases)."""
+    rule = resolve_rule(spec)
+    if rule.states != 2 or rule.kind not in ("totalistic", "ltl"):
+        return None  # Generations decay / wireworld phases are affine-free
+    m = rule.max_neighbors
+    odd = frozenset(range(1, m + 1, 2))
+    even = frozenset(range(0, m + 1, 2))
+    r = rule.radius
+    side = 2 * r + 1
+    nbhd = np.zeros((side, side), dtype=np.uint8)
+    for dy in range(-r, r + 1):
+        for dx in range(-r, r + 1):
+            if (dy, dx) == (0, 0):
+                continue
+            if rule.neighborhood == "diamond" and abs(dy) + abs(dx) > r:
+                continue
+            nbhd[dy + r, dx + r] = 1
+    if rule.birth == odd and rule.survive == odd:
+        return nbhd  # pure neighborhood parity (replicator family)
+    if rule.birth == odd and rule.survive == even:
+        nbhd[r, r] = 1  # center XOR parity (Fredkin family)
+        return nbhd
+    if not rule.birth and rule.survive == frozenset(range(m + 1)):
+        ident = np.zeros((side, side), dtype=np.uint8)
+        ident[r, r] = 1
+        return ident
+    if not rule.birth and not rule.survive:
+        return np.zeros((side, side), dtype=np.uint8)
+    return None
+
+
 # Named rules covering the BASELINE.json benchmark configs.
 CONWAY = Rule(frozenset({3}), frozenset({2, 3}), name="conway")
 HIGHLIFE = Rule(frozenset({3, 6}), frozenset({2, 3}), name="highlife")
@@ -259,6 +322,39 @@ BUGS = Rule(
     kind="ltl",
     name="bugs",
 )
+# The XOR-linear (odd-rule) catalog — the rules ops/fastforward.py can
+# jump T epochs in O(log T) device programs (see linear_kernel above).
+# Fredkin (B1357/S02468): next = center XOR Moore-8 parity — every pattern
+# replicates into 8 copies of itself.  Replicator (B1357/S1357): pure
+# neighborhood parity, center excluded.
+FREDKIN = Rule(
+    frozenset({1, 3, 5, 7}), frozenset({0, 2, 4, 6, 8}), name="fredkin"
+)
+REPLICATOR = Rule(
+    frozenset({1, 3, 5, 7}), frozenset({1, 3, 5, 7}), name="replicator"
+)
+# The von Neumann parity rule (the classic 1-bit replicator on the L1
+# diamond) and a radius-2 LtL member — witnesses that linearity detection
+# covers diamond neighborhoods and radius > 1.
+FREDKIN_DIAMOND = Rule(
+    frozenset({1, 3}),
+    frozenset({0, 2, 4}),
+    radius=1,
+    kind="ltl",
+    neighborhood="diamond",
+    name="fredkin-diamond",
+)
+REPLICATOR_R2 = Rule(
+    frozenset(range(1, 25, 2)),
+    frozenset(range(1, 25, 2)),
+    radius=2,
+    kind="ltl",
+    name="replicator-r2",
+)
+
+# Every named linear rule (tests sweep this alongside the non-linear rest
+# of NAMED_RULES; docs/OPERATIONS.md "Logarithmic fast-forward").
+LINEAR_RULES = (FREDKIN, REPLICATOR, FREDKIN_DIAMOND, REPLICATOR_R2)
 
 NAMED_RULES = {
     r.name: r
@@ -273,6 +369,7 @@ NAMED_RULES = {
         WIREWORLD,
         BUGS,
     )
+    + LINEAR_RULES
 }
 
 

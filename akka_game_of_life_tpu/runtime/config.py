@@ -385,6 +385,21 @@ class SimulationConfig:
     # bucket into a handful of compiled programs; boards beyond the
     # largest class are refused with 400.
     serve_size_classes: str = "32,64,128,256"
+    # -- logarithmic fast-forward (docs/OPERATIONS.md "Logarithmic
+    # fast-forward").  XOR-linear (odd-rule) boards jump T epochs in
+    # O(log T) device programs (ops/fastforward.py); non-linear rules are
+    # provably refused, never silently jumped.  Every field maps to a
+    # --ff-* flag and a doc knob-table row (graftlint GL-CFG07 + GL-DOC05
+    # lint-enforce the CLI ↔ config ↔ operator-doc bijection, two-way).
+    # Master switch: Simulation.fast_forward and the serve fast path
+    # refuse when off (serve then answers 429 `max_steps` past the bound).
+    ff_enabled: bool = True
+    # Jump-vs-iterate certification sample: before a jump commits,
+    # min(T, this) epochs are ALSO iterated through the ordinary dense
+    # stepper and the two digests must agree (RuntimeError on divergence).
+    # 0 = skip; the sample costs O(sample · area), so headline-size
+    # runbooks time with 0 and certify via a separate anchor jump.
+    ff_certify_steps: int = 8
     # -- activity-gated sparse stepping (docs/OPERATIONS.md "Activity-gated
     # sparse stepping").  Two independent tiers that convert throughput from
     # O(area) toward O(activity) on dilute boards; every field maps to a
@@ -597,6 +612,11 @@ class SimulationConfig:
                 f"evict)"
             )
         parse_size_classes(self.serve_size_classes)
+        if self.ff_certify_steps < 0:
+            raise ValueError(
+                f"ff_certify_steps={self.ff_certify_steps} must be >= 0 "
+                f"(0 = skip jump-vs-iterate certification)"
+            )
         if self.sparse_block < 1:
             raise ValueError(
                 f"sparse_block={self.sparse_block} must be >= 1"

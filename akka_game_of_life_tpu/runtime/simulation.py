@@ -1011,6 +1011,94 @@ class Simulation:
         self._obs_resolve()
         return self.epoch
 
+    def fast_forward(self, epochs: int) -> int:
+        """Jump ``epochs`` generations in O(log epochs) device programs —
+        XOR-linear (odd-rule) rules only (``ops/fastforward.py``; the
+        linearity proof is ``ops/rules.linear_kernel``).  Before the jump
+        commits, ``ff_certify_steps`` epochs are jump-vs-iterate
+        digest-certified (sampled small T; the big jump rides the proven
+        linear algebra).  Single-host; works on the dense, bit-packed
+        (unpack → jump → repack), sparse-gated, and single-host meshed
+        (gather → jump → re-shard) layouts.  Raises
+        ``ValueError`` for non-linear rules, disabled config, or
+        unsupported topologies — a rule outside the linear family is
+        never silently fast-forwarded."""
+        from akka_game_of_life_tpu.ops import (
+            digest as odigest,
+            fastforward,
+        )
+
+        cfg = self.config
+        # Span validation FIRST (negative / past the 2^62 ceiling): the
+        # refusal must land before the O(board) relayout gather and the
+        # O(cert·area) certification do any work.
+        epochs = fastforward._require_span(epochs)
+        if epochs == 0:
+            return self.epoch
+        if not cfg.ff_enabled:
+            raise ValueError(
+                "fast_forward is disabled (ff_enabled=False / --ff-enabled "
+                "off); advance() iterates as usual"
+            )
+        fastforward.kernel_offsets(self.rule)  # the linearity refusal
+        if self._actor_board is not None:
+            raise ValueError(
+                "fast_forward needs the tpu backend's dense planes; the "
+                "per-cell actor backends iterate"
+            )
+        if jax.process_count() > 1:
+            raise ValueError(
+                "fast_forward is single-host (a meshed jump gathers the "
+                "board through this host and re-shards; a cross-host "
+                "gather has no collective form yet) — run single-host or "
+                "iterate"
+            )
+        t0 = time.perf_counter()
+        with self.tracer.span(
+            "sim.fastforward", node=self._node,
+            epoch=self.epoch, epochs=epochs,
+        ) as span:
+            # One dense uint8 plane whatever the resident layout: packed
+            # and meshed boards gather/unpack once (O(board), amortized
+            # over the whole jump — the jump itself is O(board) work
+            # regardless of T, so the relayout never dominates).
+            relayout = (
+                self._packed or self._sparse is not None
+                or self.mesh is not None
+            )
+            board = jnp.asarray(self.board_host()) if relayout else self.board
+            cert = min(epochs, cfg.ff_certify_steps)
+            if cert:
+                dig_t0 = time.perf_counter()
+                try:
+                    digest = fastforward.certify_jump(board, self.rule, cert)
+                except RuntimeError:
+                    self.metrics.counter("gol_digest_mismatches_total").inc()
+                    raise
+                self._m_digest_seconds.observe(time.perf_counter() - dig_t0)
+                self._m_digest_checks.inc()
+                span.set(certified_steps=cert,
+                         digest=odigest.format_digest(digest))
+            jumped = fastforward.fast_forward(board, self.rule, epochs)
+            # Sync before the swap: dispatch is async, and the recorded
+            # jump seconds must cover the compute, not just the enqueue.
+            np.asarray(jax.device_get(jumped[(0,) * jumped.ndim]))
+            with _shield_sigint():
+                # Atomic wrt ^C, like advance(): an interrupt-checkpoint
+                # must never see a jumped board at the pre-jump epoch.
+                self.board = (
+                    self._to_device(np.asarray(jumped)) if relayout else jumped
+                )
+                self.epoch += epochs
+        self.metrics.counter("gol_ff_jumps_total").inc()
+        self.metrics.counter("gol_ff_epochs_total").inc(epochs)
+        self.metrics.histogram("gol_ff_seconds").observe(
+            time.perf_counter() - t0
+        )
+        self.metrics.gauge("gol_epoch").set(self.epoch)
+        self.events.emit("fast_forward", epoch=self.epoch, epochs=epochs)
+        return self.epoch
+
     def _halo_bytes_per_chunk(self, k: int) -> int:
         """Analytic bytes one k-epoch chunk moves across the device mesh —
         the Casper-style data-movement signal (``gol_halo_bytes_total``).

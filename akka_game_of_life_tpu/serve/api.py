@@ -12,8 +12,15 @@ port serves ``/metrics``, ``/healthz``, ``/trace``, AND the board API.
 | POST /boards/<id>/step   | {steps?}                                  | 200 {epoch, digest, steps} |
 | DELETE /boards/<id>      | —                                         | 200 {deleted} |
 
+``steps`` beyond ``serve_max_steps`` is an admission question: an
+XOR-linear rule session answers through the O(log T) fast-forward path
+(``ops/fastforward.py`` — n=1,000,000 in milliseconds, bypassing the
+ticker), while any other session is refused **429** ``max_steps`` so a
+giant request can never monopolize the ticker.
+
 Error mapping — admission control answers, it never wedges: a capacity
-refusal (session cap, cell budget, full step queue, shutdown drain) is
+refusal (session cap, cell budget, full step queue, shutdown drain,
+over-bound steps on a non-linear rule) is
 **429** with the machine-readable ``reason`` (the same string on
 ``gol_serve_rejects_total{reason}``) and a ``Retry-After`` hint in the
 body; a step that timed out is **503** (the body says whether it was

@@ -16,7 +16,11 @@ and always answers instead of wedging:
   batch-memory resource a count cap alone cannot bound);
 - ``serve_queue_depth``  — pending-job bound; a full queue REJECTS the new
   job (the caller's 429 + retry) rather than dropping a queued one —
-  dropping would lose a request whose client is already blocked on it.
+  dropping would lose a request whose client is already blocked on it;
+- ``serve_max_steps``    — per-request epoch bound for QUEUED jobs (the
+  scan length is the ticker's unit of fairness); beyond it, XOR-linear
+  rule sessions answer through the O(log T) fast-forward path
+  (``ops/fastforward.py``) and everything else is refused ``max_steps``.
 
 Rejections raise :class:`AdmissionError` with a machine-readable
 ``reason`` (the HTTP layer maps it to 429 and the reason rides the
@@ -39,7 +43,7 @@ import numpy as np
 
 from akka_game_of_life_tpu.obs import get_registry
 from akka_game_of_life_tpu.obs.tracing import get_tracer
-from akka_game_of_life_tpu.ops import digest as odigest
+from akka_game_of_life_tpu.ops import digest as odigest, fastforward
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 from akka_game_of_life_tpu.serve import batch as sbatch
 from akka_game_of_life_tpu.utils.patterns import random_grid
@@ -54,6 +58,15 @@ JOB_TIMEOUT_S = 120.0
 # write-back cannot be recalled.
 JOB_GRACE_S = 60.0
 
+# Bound on CONCURRENT fast-forward jumps (the linear-rule step fast path
+# runs on caller threads, not the ticker): each jump is milliseconds on
+# serve-class boards, but without a cap N simultaneous over-bound requests
+# would run N certify+jump computations at once and starve the ticker's
+# CPU — the very monopolization the max_steps bound exists to prevent.
+# Over-limit requests get the retryable 429 (reason queue_full), never a
+# wedge.
+FF_MAX_CONCURRENT = 8
+
 # Tenant ids label metrics (gol_serve_*{tenant=...}); they must be short
 # and tame or a client could mint unbounded exposition series from junk.
 _TENANT_MAX = 64
@@ -65,7 +78,9 @@ _TENANT_OK = frozenset(
 class AdmissionError(Exception):
     """A request refused by admission control (HTTP 429).  ``reason`` is
     machine-readable: ``max_sessions`` | ``max_cells`` | ``queue_full`` |
-    ``draining``."""
+    ``draining`` | ``max_steps`` (a step request beyond ``serve_max_steps``
+    for a session whose rule cannot fast-forward — linear-rule sessions
+    bypass the bound via the O(log T) fast path instead)."""
 
     def __init__(self, reason: str, detail: str) -> None:
         super().__init__(detail)
@@ -180,6 +195,11 @@ class SessionRouter:
             "gol_serve_rejects_total", labelnames=("reason",)
         )
         self._m_queue = self.metrics.gauge("gol_serve_queue_depth")
+        self._m_ff = self.metrics.counter("gol_serve_ff_jumps_total")
+        self._m_digest_mismatch = self.metrics.counter(
+            "gol_digest_mismatches_total"
+        )
+        self._ff_slots = threading.BoundedSemaphore(FF_MAX_CONCURRENT)
         # Buckets passed explicitly (count-scale, not latency-scale): the
         # registry may be a plain MetricsRegistry without the catalog
         # installed, and _get_or_create would not flag the mismatch.
@@ -346,13 +366,26 @@ class SessionRouter:
     def step(self, sid: str, steps: int = 1) -> Tuple[int, int]:
         """Advance a session by ``steps`` epochs; blocks until the batch
         that carried the job lands.  Returns (epoch, digest).  Raises
-        KeyError (404), ValueError (400), AdmissionError (429)."""
-        if not (1 <= steps <= self.max_steps):
+        KeyError (404), ValueError (400), AdmissionError (429).
+
+        ``steps`` beyond ``serve_max_steps`` is an *admission* question,
+        not a validity one: an XOR-linear rule session takes the O(log T)
+        fast-forward path (``ops/fastforward.py`` — answers n=1,000,000
+        in milliseconds instead of queueing 10⁶ ticks), everything else
+        is refused 429 ``max_steps`` so one giant request can never
+        monopolize the ticker for every other tenant."""
+        if steps < 1:
+            raise ValueError(f"steps {steps} must be >= 1")
+        if int(steps).bit_length() > fastforward.MAX_SPAN_BITS:
+            # A 400, not an admission question: beyond the span ceiling
+            # even the fast path refuses (its per-jump program count is
+            # bounded by the span's bit length — the DoS guard).
             raise ValueError(
-                f"steps {steps} out of range 1..{self.max_steps}"
+                f"steps {steps} exceeds the fast-forward span ceiling "
+                f"(2^{fastforward.MAX_SPAN_BITS})"
             )
         t0 = time.perf_counter()
-        job = _Job(sid=sid, steps=steps)
+        job = None
         with self._lock:
             if self._stopped:
                 # The ticker is gone: enqueueing would strand the caller
@@ -365,15 +398,49 @@ class SessionRouter:
                 raise KeyError(sid)
             if self._draining:
                 self._reject("draining", "router is draining for shutdown")
-            if len(self._queue) >= self.queue_depth:
+            fast = steps > self.max_steps
+            if fast:
+                linear = sess.rule.is_linear
+                if not linear or not self.config.ff_enabled:
+                    why = (
+                        "fast-forward is disabled (ff_enabled=False)"
+                        if linear
+                        else f"rule {sess.rule} is not XOR-linear"
+                    )
+                    self._reject(
+                        "max_steps",
+                        f"steps {steps} over serve_max_steps="
+                        f"{self.max_steps} and {why}; bound the request "
+                        f"(the scan length is the ticker's unit of "
+                        f"fairness) or use a linear rule",
+                    )
+            else:
+                if len(self._queue) >= self.queue_depth:
+                    self._reject(
+                        "queue_full",
+                        f"step queue depth {self.queue_depth} reached",
+                    )
+                sess.last_used = self._clock()
+                job = _Job(sid=sid, steps=steps)
+                self._queue.append(job)
+                self._m_queue.set(len(self._queue))
+                self._wake.notify_all()
+        if fast:
+            if not self._ff_slots.acquire(blocking=False):
+                # The fast path's own admission bound: it bypasses the
+                # ticker queue, so queue_depth cannot bound it — the
+                # slot cap does, with the same retryable 429 contract.
                 self._reject(
                     "queue_full",
-                    f"step queue depth {self.queue_depth} reached",
+                    f"fast-forward concurrency bound "
+                    f"({FF_MAX_CONCURRENT}) reached; retry",
                 )
-            sess.last_used = self._clock()
-            self._queue.append(job)
-            self._m_queue.set(len(self._queue))
-            self._wake.notify_all()
+            try:
+                result = self._fast_forward_step(sess, steps)
+            finally:
+                self._ff_slots.release()
+            self._m_req.observe(time.perf_counter() - t0)
+            return result
         if not job.done.wait(JOB_TIMEOUT_S):
             with self._lock:
                 try:
@@ -398,6 +465,61 @@ class SessionRouter:
             raise job.error
         self._m_req.observe(time.perf_counter() - t0)
         return job.result
+
+    def _fast_forward_step(self, sess: Session, steps: int) -> Tuple[int, int]:
+        """The linear-rule fast path: jump ``steps`` epochs in O(log steps)
+        device programs, bypassing the ticker queue entirely.
+
+        The jump computes OUTSIDE every lock (holding the router lock
+        across device work would starve all tenants) against a snapshot
+        of (board, epoch); the write-back is an optimistic commit — if a
+        concurrently queued batch job's scatter-back landed in between,
+        the jump recomputes from the new state (bounded retries; jumps
+        are milliseconds on serve-class boards, batches serialize one job
+        per session per tick, so contention is rare and shrinking).  A
+        session deleted mid-jump still gets its stepped result, like a
+        mid-batch delete.  Each jump is jump-vs-iterate digest-certified
+        on a ``ff_certify_steps`` sample before it commits."""
+        for _ in range(8):
+            with self._lock:
+                if self._sessions.get(sess.sid) is not sess:
+                    raise KeyError(sess.sid)
+                board0, epoch0 = sess.board, sess.epoch
+                sess.last_used = self._clock()
+            cert = min(steps, self.config.ff_certify_steps)
+            if cert:
+                try:
+                    fastforward.certify_jump(board0, sess.rule, cert)
+                except RuntimeError:
+                    # The documented kernel-bug signal: same counter the
+                    # Simulation surface ticks on jump-vs-iterate
+                    # divergence, so serve-path math failures alert too.
+                    self._m_digest_mismatch.inc()
+                    raise
+            out = fastforward.fast_forward_np(board0, sess.rule, steps)
+            lanes = odigest.digest_dense_np(out)
+            population = int((out == 1).sum())
+            with self._lock:
+                if self._sessions.get(sess.sid) is not sess:
+                    # Deleted mid-jump: the client still gets its result;
+                    # the table write-back is skipped (the mid-batch
+                    # delete contract).
+                    return epoch0 + steps, odigest.value(lanes)
+                if sess.board is board0 and sess.epoch == epoch0:
+                    sess.board = out
+                    sess.lanes = lanes
+                    sess.population = population
+                    sess.epoch = epoch0 + steps
+                    sess.last_used = self._clock()
+                    self._m_steps.labels(tenant=sess.tenant).inc(steps)
+                    self._m_ff.inc()
+                    return sess.epoch, odigest.value(lanes)
+            # A batch write-back raced the commit: loop and recompute
+            # from the session's new state.
+        raise TimeoutError(
+            f"fast-forward for {sess.sid} kept losing the commit race to "
+            f"batched step jobs; retry"
+        )
 
     # -- drill hooks ---------------------------------------------------------
 
@@ -491,7 +613,7 @@ class SessionRouter:
         """Group this tick's jobs by size class, advance each group in one
         device program, scatter results back.  A failed batch fails its
         jobs, never the ticker."""
-        groups: Dict[int, List[Tuple[_Job, Session, np.ndarray]]] = {}
+        groups: Dict[int, List[Tuple[_Job, Session, np.ndarray, int]]] = {}
         with self._lock:
             for job in jobs:
                 sess = self._sessions.get(job.sid)
@@ -502,10 +624,14 @@ class SessionRouter:
                 cls = sbatch.size_class(
                     sess.height, sess.width, self.size_classes
                 )
-                # Snapshot the board reference: the ticker only ever
-                # REPLACES session boards, so the reference is stable
-                # outside the lock.
-                groups.setdefault(cls, []).append((job, sess, sess.board))
+                # Snapshot the board reference AND epoch: writers only
+                # ever REPLACE session boards, so the references are
+                # stable outside the lock — and the scatter-back commits
+                # only if this exact snapshot is still the session state
+                # (a fast-forward jump may land mid-batch).
+                groups.setdefault(cls, []).append(
+                    (job, sess, sess.board, sess.epoch)
+                )
         for cls, entries in sorted(groups.items()):
             try:
                 self._run_class_batch(cls, entries)
@@ -515,10 +641,10 @@ class SessionRouter:
                     job.done.set()
 
     def _run_class_batch(
-        self, cls: int, entries: List[Tuple[_Job, Session, np.ndarray]]
+        self, cls: int, entries: List[Tuple[_Job, Session, np.ndarray, int]]
     ) -> None:
         b_real = len(entries)
-        length = sbatch.next_pow2(max(job.steps for job, _, _ in entries))
+        length = sbatch.next_pow2(max(job.steps for job, _, _, _ in entries))
         b_pad = sbatch.next_pow2(b_real)
         boards = np.zeros((b_pad, cls, cls), dtype=np.uint8)
         birth = np.zeros(b_pad, dtype=np.uint32)
@@ -527,7 +653,7 @@ class SessionRouter:
         hs = np.ones(b_pad, dtype=np.int32)
         ws = np.ones(b_pad, dtype=np.int32)
         ns = np.zeros(b_pad, dtype=np.int32)
-        for i, (job, sess, board) in enumerate(entries):
+        for i, (job, sess, board, _) in enumerate(entries):
             boards[i, : sess.height, : sess.width] = board
             birth[i], survive[i], states[i] = sbatch.rule_operands(sess.rule)
             hs[i], ws[i] = sess.height, sess.width
@@ -545,29 +671,37 @@ class SessionRouter:
                 out[i, : sess.height, : sess.width].copy(),
                 lanes[i],
             )
-            for i, (_, sess, _) in enumerate(entries)
+            for i, (_, sess, _, _) in enumerate(entries)
         ]
         pops = [int((board == 1).sum()) for board, _ in results]
         with self._lock:
-            for (job, sess, _), (new_board, new_lanes), pop in zip(
+            for (job, sess, board0, epoch0), (new_board, new_lanes), pop in zip(
                 entries, results, pops
             ):
-                if self._sessions.get(job.sid) is sess:
+                if (
+                    self._sessions.get(job.sid) is sess
+                    and sess.board is board0
+                    and sess.epoch == epoch0
+                ):
                     sess.board = new_board
                     sess.lanes = new_lanes
                     sess.population = pop
-                    sess.epoch += job.steps
+                    sess.epoch = epoch0 + job.steps
                     sess.last_used = self._clock()
-                    epoch = sess.epoch
                     self._m_steps.labels(tenant=sess.tenant).inc(job.steps)
                 else:
-                    # Deleted mid-batch: the client still gets its result;
+                    # Deleted mid-batch — or a fast-forward jump committed
+                    # between this batch's gather and scatter-back (the
+                    # jump's epochs must never be clobbered by a stale
+                    # batch result).  Either way the client still gets its
+                    # result, computed from the snapshot it asked about;
                     # the table write-back is skipped, and so is the
-                    # per-tenant counter — _drop_locked may just have reclaimed
-                    # this tenant's metric children, and incrementing here
-                    # would re-mint a leaked child for a gone tenant.
-                    epoch = sess.epoch + job.steps
-                job.result = (epoch, odigest.value(new_lanes))
+                    # per-tenant counter — _drop_locked may just have
+                    # reclaimed this tenant's metric children, and
+                    # incrementing here would re-mint a leaked child for a
+                    # gone tenant.
+                    pass
+                job.result = (epoch0 + job.steps, odigest.value(new_lanes))
                 job.done.set()
 
     def drain(self, timeout: float = 30.0) -> bool:

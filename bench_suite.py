@@ -68,6 +68,19 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
                          every variant digest-certified bit-identical to
                          the dense oracle (docs/OPERATIONS.md "MXU
                          stencil path").
+ 16. fastforward         logarithmic time travel (ops/fastforward.py):
+                         O(log T) jump vs O(T) iterate for the XOR-linear
+                         replicator rule across T ∈ {2^10..2^30} at 4096²
+                         and 16384² — every point digest-certified against
+                         an independently iterated anchor (jump(T−a)
+                         advanced a epochs through the packed stepper),
+                         the smallest point ALSO iterated in full as a
+                         direct measured grounding, adversarial all-ones
+                         T points (popcount-maximal) beside the powers of
+                         two, the 16384²/2^30 under-a-second headline,
+                         and the separable-kernel banded GF(2) matmul
+                         (MXU lane) functional A/B (docs/OPERATIONS.md
+                         "Logarithmic fast-forward").
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -836,6 +849,232 @@ def bench_matmul_ab(
     print(json.dumps(line), flush=True)
 
 
+def bench_fastforward(sizes, anchor: int = 8, headline_size: int = 16384) -> None:
+    """Config 16: O(log T) fast-forward vs O(T) iterate, digest-certified.
+
+    Rule: replicator (B1357/S1357, XOR-linear).  The iterate side of the
+    A/B is the fastest O(T) path on this host (bit-packed SWAR), measured
+    over a 64-epoch chunk and extrapolated per T — plus ONE direct full
+    iterate at the smallest (size, T) as the measured grounding point.
+
+    Certification is per point and independent of the timed jump: the
+    jump's digest must equal the digest of ``jump(T − anchor)`` advanced
+    ``anchor`` epochs through the ordinary packed stepper (a different
+    binary decomposition AND a different kernel family compute the anchor,
+    so agreement is a real cross-check, not a self-comparison).
+
+    T sweep: powers of two across 2^10..2^30 plus the adversarial
+    all-ones points (2^20−1, 2^30−1) — popcount-maximal, so every jump
+    bit does real roll work even where a pure power of two legitimately
+    collapses on a power-of-two torus (``factor_rolls`` in each record
+    shows the collapse: odd-rule self-replication periodicity, not a
+    benchmark artifact).  Headline: at ``headline_size``, epoch 2^30
+    certified under 1 s.  Finally the separable-kernel (fredkin) banded
+    GF(2) matmul lane is functionally A/B'd against the roll path —
+    equal digests on CPU; the MXU perf claim waits for hardware."""
+    import jax
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.ops import (
+        bitpack,
+        digest as odigest,
+        fastforward,
+    )
+    from akka_game_of_life_tpu.ops.rules import FREDKIN, REPLICATOR
+
+    rule = REPLICATOR
+    rng = np.random.default_rng(0)
+
+    def sync(arr) -> None:
+        np.asarray(jax.device_get(arr[(0,) * arr.ndim]))
+
+    for size in sizes:
+        config = f"fastforward-{size}"
+        board_np = (rng.random((size, size)) < 0.5).astype(np.uint8)
+        board = jnp.asarray(board_np)
+        words0 = jnp.asarray(bitpack.pack_np(board_np))
+        dfn_dense = jax.jit(odigest.digest_dense)
+        dfn_packed = jax.jit(lambda x: odigest.digest_packed(x, size))
+
+        def ddense(b) -> int:
+            return odigest.value(np.asarray(dfn_dense(b), dtype=np.uint32))
+
+        # The O(T) baseline: bit-packed SWAR epochs/sec, measured.
+        it_chunk = 64
+        it_run = bitpack.packed_multi_step_fn(rule, it_chunk)
+        w = it_run(words0)
+        sync(w)  # warm compile
+        t0 = time.perf_counter()
+        w = it_run(words0)
+        sync(w)
+        it_dt = time.perf_counter() - t0
+        iterate_s_per_epoch = it_dt / it_chunk
+        _emit(
+            config,
+            f"cell-updates/sec/chip, replicator {size}x{size} bit-packed "
+            f"iterate (the O(T) baseline the jump is priced against)",
+            size * size * it_chunk / it_dt,
+            "cell-updates/sec",
+            PER_CHIP_TARGET,
+            bytes_per_cell=0.25,
+        )
+
+        def certify(t: int) -> int:
+            """digest(jump(t)) vs the independently iterated anchor."""
+            d_jump = ddense(fastforward.fast_forward(board, rule, t))
+            back = fastforward.fast_forward(board, rule, t - anchor)
+            aw = bitpack.packed_multi_step_fn(rule, anchor)(
+                jnp.asarray(bitpack.pack_np(np.asarray(back)))
+            )
+            d_anchor = odigest.value(
+                np.asarray(dfn_packed(aw), dtype=np.uint32)
+            )
+            assert d_jump == d_anchor, (
+                f"{config}: jump(T={t}) digest {d_jump:016x} != iterated "
+                f"anchor digest {d_anchor:016x} — the fast-forward math "
+                f"cannot be trusted"
+            )
+            return d_jump
+
+        sweep = [2**10, 2**14, 2**18, 2**20, 2**22, 2**26, 2**30,
+                 2**20 - 1, 2**30 - 1]
+        for t in sweep:
+            jump = lambda: fastforward.fast_forward(board, rule, t)
+            out = jump()
+            sync(out)  # warm every per-bit factor program
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = jump()
+                sync(out)
+                times.append(time.perf_counter() - t0)
+            jump_s = sorted(times)[1]
+            digest = certify(t)
+            iterate_s = iterate_s_per_epoch * t
+            plan = fastforward.jump_plan(rule, t, (size, size))
+            line = {
+                "config": config,
+                "metric": (
+                    f"jump / iterate speedup, replicator {size}x{size}, "
+                    f"T={t} (iterate extrapolated from the measured "
+                    f"packed rate)"
+                ),
+                "value": iterate_s / jump_s,
+                "unit": "x",
+                "vs_baseline": iterate_s / jump_s,
+                "T": t,
+                "jump_seconds": jump_s,
+                "iterate_seconds_extrapolated": iterate_s,
+                "digest": odigest.format_digest(digest),
+                "certified": f"anchor (jump(T-{anchor}) + {anchor} packed "
+                             f"epochs)",
+                "plan": plan,
+            }
+            print(json.dumps(line), flush=True)
+            if t == 2**20:
+                assert iterate_s / jump_s >= 1000, (
+                    f"{config}: jump speedup at T=2^20 is only "
+                    f"{iterate_s / jump_s:.0f}x (< 1000x)"
+                )
+            if t == 2**30 and size >= headline_size:
+                assert jump_s < 1.0, (
+                    f"{config}: headline epoch-2^30 jump took {jump_s:.2f}s "
+                    f"(>= 1s)"
+                )
+                line = {
+                    "config": config,
+                    "metric": f"HEADLINE: epoch 2^30 of a {size}x{size} "
+                              f"odd-rule universe, digest-certified "
+                              f"against an iterated anchor, wall seconds",
+                    "value": jump_s,
+                    "unit": "seconds",
+                    "vs_baseline": jump_s / 1.0,
+                    "digest": odigest.format_digest(digest),
+                    "under_1s": True,
+                }
+                print(json.dumps(line), flush=True)
+
+        # Direct measured grounding: the smallest T iterated IN FULL.
+        if size == min(sizes):
+            t_direct = 2**10
+            chunks = t_direct // it_chunk
+            w = words0
+            t0 = time.perf_counter()
+            for _ in range(chunks):
+                w = it_run(w)
+            sync(w)
+            direct_s = time.perf_counter() - t0
+            d_iter = odigest.value(np.asarray(dfn_packed(w), dtype=np.uint32))
+            jump = lambda: fastforward.fast_forward(board, rule, t_direct)
+            out = jump()
+            sync(out)
+            t0 = time.perf_counter()
+            out = jump()
+            sync(out)
+            jump_s = time.perf_counter() - t0
+            d_jump = ddense(out)
+            assert d_jump == d_iter, (
+                f"{config}: direct iterate digest {d_iter:016x} != jump "
+                f"digest {d_jump:016x} at T={t_direct}"
+            )
+            line = {
+                "config": config,
+                "metric": f"jump / iterate speedup, replicator "
+                          f"{size}x{size}, T={t_direct} (iterate MEASURED "
+                          f"in full — the extrapolation's grounding point)",
+                "value": direct_s / jump_s,
+                "unit": "x",
+                "vs_baseline": direct_s / jump_s,
+                "T": t_direct,
+                "jump_seconds": jump_s,
+                "iterate_seconds_measured": direct_s,
+                "digest": odigest.format_digest(d_jump),
+                "certified": "direct full iterate",
+            }
+            print(json.dumps(line), flush=True)
+
+    # The MXU lane, functionally: fredkin's separable kernel as two
+    # blocked banded GF(2) matmuls vs the roll path — equal digests
+    # required; CPU timings recorded for context only (the GEMM path is
+    # MXU-targeted; docs/OPERATIONS.md "Logarithmic fast-forward").
+    mm_size, mm_t = 1024, 65
+    b = jnp.asarray((rng.random((mm_size, mm_size)) < 0.5).astype(np.uint8))
+    dfn_dense = jax.jit(odigest.digest_dense)
+    runs = {
+        "rolls": lambda: fastforward.fast_forward(b, FREDKIN, mm_t),
+        "matmul-gf2": fastforward.jump_matmul_fn(
+            FREDKIN, mm_t, (mm_size, mm_size)
+        ),
+    }
+    digests, secs = {}, {}
+    for name, fn in runs.items():
+        out = fn() if name == "rolls" else fn(b)
+        sync(out)
+        t0 = time.perf_counter()
+        out = fn() if name == "rolls" else fn(b)
+        sync(out)
+        secs[name] = time.perf_counter() - t0
+        digests[name] = odigest.value(
+            np.asarray(dfn_dense(out), dtype=np.uint32)
+        )
+    assert digests["rolls"] == digests["matmul-gf2"], (
+        f"fastforward matmul lane diverged: {digests['rolls']:016x} != "
+        f"{digests['matmul-gf2']:016x}"
+    )
+    line = {
+        "config": "fastforward-mxu-lane",
+        "metric": f"banded GF(2) matmul jump vs roll jump, fredkin "
+                  f"{mm_size}x{mm_size}, T={mm_t} — functional A/B "
+                  f"(digest-equal; MXU perf claim waits for hardware)",
+        "value": secs["rolls"] / secs["matmul-gf2"],
+        "unit": "x",
+        "vs_baseline": secs["rolls"] / secs["matmul-gf2"],
+        "seconds": secs,
+        "digest": odigest.format_digest(digests["rolls"]),
+    }
+    print(json.dumps(line), flush=True)
+
+
 def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
     """Config 6: the TCP cluster's width-k communication-avoiding exchange —
     an in-process frontend + 2 workers (jax engines) stepping a size² board
@@ -898,7 +1137,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", type=int, nargs="*",
-        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15],
+        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16],
     )
     parser.add_argument(
         "--scale", type=float, default=1.0,
@@ -1005,6 +1244,13 @@ def main() -> None:
         # the width, so 3-divisible widths let the f32 lane pack depth 3-4
         # across the whole R sweep where 2^k widths cap R=4-5 at depth 2.
         bench_matmul_ab(sizes=sizes, ltl_size=s(12288, 32 * 8))
+    if 16 in args.config:
+        # Logarithmic fast-forward (ROADMAP item 4): O(log T) jump vs
+        # O(T) iterate for the XOR-linear replicator, T ∈ {2^10..2^30},
+        # every point digest-certified; the 16384²/2^30 headline asserts
+        # < 1 s at scale 1.
+        ff_sizes = sorted({s(4096, 32 * 8), s(16384, 32 * 8)})
+        bench_fastforward(ff_sizes, headline_size=s(16384, 32 * 8))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,495 @@
+"""Logarithmic fast-forward: linearity detection, jump ≡ iterate, guards.
+
+The contract under test (docs/OPERATIONS.md "Logarithmic fast-forward"):
+
+- ``linear_kernel`` is a *proof*: every linear catalog member yields a
+  kernel whose jump is bit-identical to iteration, and every non-linear
+  rule — Conway, HighLife, Generations, wireworld, LtL bands — is
+  refused by name, never silently fast-forwarded;
+- Frobenius squaring (offset doubling), the factored jump, the
+  materialized XOR-power kernel, and the banded GF(2) matmul lane all
+  agree with the dense oracle, including once the support wraps the
+  torus (where offset collisions must cancel mod 2);
+- composition working sets are guard-priced (the knob is named before
+  anything is built) and the matmul-family refusal suggests the nearest
+  3-smooth pad width on power-of-two boards (the PR 11 residue, made
+  discoverable at the point of failure).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from akka_game_of_life_tpu.ops import (  # noqa: E402
+    digest as odigest,
+    fastforward,
+    guard,
+    stencil,
+)
+from akka_game_of_life_tpu.ops.rules import (  # noqa: E402
+    CONWAY,
+    FREDKIN,
+    LINEAR_RULES,
+    NAMED_RULES,
+    REPLICATOR,
+    Rule,
+    linear_kernel,
+    parse_rule,
+    resolve_rule,
+)
+
+NONLINEAR = [
+    r for r in NAMED_RULES.values() if r.name not in {x.name for x in LINEAR_RULES}
+]
+
+
+def _board(h=32, w=48, seed=0, density=0.5):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray((rng.random((h, w)) < density).astype(np.uint8))
+
+
+def _iterate(board, rule, t):
+    return np.asarray(stencil.multi_step_fn(resolve_rule(rule), t)(board))
+
+
+# -- linearity detection: the property sweep over the rule catalog ------------
+
+
+def test_every_named_linear_rule_is_detected():
+    for rule in LINEAR_RULES:
+        kern = linear_kernel(rule)
+        assert kern is not None, rule.name
+        side = 2 * rule.radius + 1
+        assert kern.shape == (side, side)
+        assert rule.is_linear
+
+
+@pytest.mark.parametrize("rule", NONLINEAR, ids=lambda r: r.name)
+def test_nonlinear_catalog_rules_are_provably_refused(rule):
+    """Conway, HighLife, Generations, wireworld, LtL bands: the predicate
+    must return None AND every fast-forward surface must raise — a
+    non-linear rule is never silently jumped."""
+    assert linear_kernel(rule) is None
+    assert not rule.is_linear
+    with pytest.raises(ValueError, match="not XOR-linear"):
+        fastforward.fast_forward(_board(16, 16), rule, 4)
+    with pytest.raises(ValueError, match="not XOR-linear"):
+        fastforward.pow_offsets(rule, 4, (16, 16))
+
+
+def test_linearity_cases_are_exact_not_heuristic():
+    """The four case-analysis rows: parity, center-XOR-parity, identity,
+    zero — and near-misses that differ by one count must fail."""
+    # identity and zero maps (degenerate but linear)
+    ident = parse_rule("B/S012345678")
+    zero = parse_rule("B/S")
+    ki, kz = linear_kernel(ident), linear_kernel(zero)
+    assert ki is not None and ki.sum() == 1 and ki[1, 1] == 1
+    assert kz is not None and kz.sum() == 0
+    # near-misses: odd-birth but one survive count off either parity set
+    assert linear_kernel(parse_rule("B1357/S1356")) is None
+    assert linear_kernel(parse_rule("B1357/S0246")) is None  # missing 8
+    assert linear_kernel(parse_rule("B135/S1357")) is None  # missing 7
+    # Generations version of fredkin is NOT linear (decay states)
+    assert linear_kernel(Rule(FREDKIN.birth, FREDKIN.survive, states=3)) is None
+
+
+def test_replicator_kernel_geometry():
+    kern = linear_kernel(REPLICATOR)
+    assert kern.sum() == 8 and kern[1, 1] == 0  # Moore ring, center clear
+    kern = linear_kernel(FREDKIN)
+    assert kern.sum() == 9 and kern[1, 1] == 1  # full box
+    kern = linear_kernel(NAMED_RULES["fredkin-diamond"])
+    assert kern.sum() == 5 and kern[1, 1] == 1  # von Neumann + center
+    kern = linear_kernel(NAMED_RULES["replicator-r2"])
+    assert kern.sum() == 24 and kern[2, 2] == 0  # radius-2 box ring
+
+
+# -- jump ≡ iterate, bit-identically ------------------------------------------
+
+
+@pytest.mark.parametrize("rule", LINEAR_RULES, ids=lambda r: r.name)
+def test_jump_matches_iterate_bit_identically(rule):
+    board = _board(24, 40, seed=3)
+    for t in (0, 1, 2, 3, 7, 16, 37, 100):
+        jumped = np.asarray(fastforward.fast_forward(board, rule, t))
+        np.testing.assert_array_equal(jumped, _iterate(board, rule, t))
+
+
+def test_span_ceiling_bounds_every_surface():
+    """Spans beyond 2^62 are refused up front: offsets scale in int64 and
+    the per-jump program count is bounded by the span's bit length."""
+    board = _board(8, 8)
+    for surface in (
+        lambda: fastforward.fast_forward(board, REPLICATOR, 1 << 63),
+        lambda: fastforward.pow_offsets(REPLICATOR, 1 << 63, (8, 8)),
+        lambda: fastforward.jump_plan(REPLICATOR, 1 << 63, (8, 8)),
+        lambda: fastforward.jump_matmul_fn(FREDKIN, 1 << 63, (8, 8)),
+    ):
+        with pytest.raises(ValueError, match="62 bits"):
+            surface()
+    # the ceiling itself is fine
+    assert fastforward.jump_plan(REPLICATOR, (1 << 62) - 1, (8, 8))
+
+
+def test_huge_span_offset_scaling_is_exact():
+    """2^61-scale offsets must reduce the scale mod the torus BEFORE
+    multiplying: a raw int64 shift wraps mod 2^64, and (x mod 2^64) mod n
+    is wrong on non-power-of-two sides.  Radius 4 at bit 61 is exactly
+    where ``4 << 61`` overflows int64; the ground truth is the same
+    Frobenius factor computed with Python's arbitrary-precision ints."""
+    r4 = Rule(
+        frozenset(range(1, 81, 2)), frozenset(range(1, 81, 2)),
+        radius=4, kind="ltl",
+    )
+    assert linear_kernel(r4) is not None
+    board = _board(96, 96, seed=12)
+    t = 1 << 61  # one factor program, scale 2^61
+    base = fastforward.kernel_offsets(r4)
+    s = pow(2, 61, 96)
+    exact = np.array(
+        [[(int(dy) * s) % 96, (int(dx) * s) % 96] for dy, dx in base],
+        dtype=np.int64,
+    )
+    want = np.asarray(
+        fastforward.apply_offsets(
+            board, fastforward._parity_dedup(exact, (96, 96))
+        )
+    )
+    got = np.asarray(fastforward.fast_forward(board, r4, t))
+    np.testing.assert_array_equal(got, want)
+    plan = fastforward.jump_plan(r4, t, (96, 96))
+    assert plan["factor_rolls"] == [
+        len(fastforward._parity_dedup(exact, (96, 96)))
+    ]
+
+
+def test_jump_composition_property():
+    """jump(a) ∘ jump(b) == jump(a + b) — the Linear Acceleration
+    Theorem's composition, exercised at spans too big to iterate."""
+    board = _board(16, 16, seed=5)
+    a, b = 2**20 + 3, 2**19 + 11
+    one = fastforward.fast_forward(
+        fastforward.fast_forward(board, REPLICATOR, a), REPLICATOR, b
+    )
+    both = fastforward.fast_forward(board, REPLICATOR, a + b)
+    np.testing.assert_array_equal(np.asarray(one), np.asarray(both))
+
+
+def test_wrapped_support_cancels_correctly():
+    """Once R·T laps the torus, scaled offsets collide and must cancel
+    mod 2 — iterate 300 epochs of an 8×8 board as the oracle."""
+    board = _board(8, 8, seed=9)
+    it = board
+    step = stencil.step_fn(REPLICATOR)
+    for _ in range(300):
+        it = step(it)
+    np.testing.assert_array_equal(
+        np.asarray(fastforward.fast_forward(board, REPLICATOR, 300)),
+        np.asarray(it),
+    )
+
+
+def test_power_of_two_collapse_is_the_true_answer():
+    """On a 2^m-side torus, K^(2^m) collapses every offset onto the
+    center: replicator (8 offsets, even) becomes the zero map, fredkin
+    (9, odd) the identity — odd-rule self-replication periodicity, and
+    the oracle agrees."""
+    board = _board(16, 16, seed=2)
+    z = np.asarray(fastforward.fast_forward(board, REPLICATOR, 16))
+    np.testing.assert_array_equal(z, _iterate(board, REPLICATOR, 16))
+    assert not z.any()
+    f = np.asarray(fastforward.fast_forward(board, FREDKIN, 16))
+    np.testing.assert_array_equal(f, np.asarray(board))
+    plan = fastforward.jump_plan(REPLICATOR, 16, (16, 16))
+    assert plan["factor_rolls"] == [0]  # the collapse is visible as data
+
+
+# -- the materialized kernel (squaring machinery) ------------------------------
+
+
+def test_pow_offsets_matches_iteration_when_applied():
+    board = _board(24, 24, seed=4)
+    for rule in (REPLICATOR, FREDKIN):
+        for t in (1, 2, 5, 9):
+            offs = fastforward.pow_offsets(rule, t, (24, 24))
+            applied = np.asarray(fastforward.apply_offsets(board, offs))
+            np.testing.assert_array_equal(applied, _iterate(board, rule, t))
+
+
+def test_frobenius_squaring_equals_self_convolution():
+    """K^(2t) from square-and-multiply must equal K^t XOR-convolved with
+    itself — checked via the rendered planes."""
+    shape = (32, 32)
+    for t in (1, 2, 3, 5):
+        k_t = fastforward.pow_offsets(REPLICATOR, t, shape)
+        k_2t = fastforward.kernel_plane(REPLICATOR, 2 * t, shape)
+        # convolve K^t with itself by applying it to its own plane
+        plane_t = fastforward.kernel_plane(REPLICATOR, t, shape)
+        conv = np.asarray(
+            fastforward.apply_offsets(jnp.asarray(plane_t), -k_t)
+        )
+        np.testing.assert_array_equal(conv, k_2t)
+
+
+def test_support_radius_is_the_dilation_bound():
+    assert fastforward.support_radius(REPLICATOR, 7) == 7
+    assert fastforward.support_radius(NAMED_RULES["replicator-r2"], 7) == 14
+    offs = fastforward.pow_offsets(REPLICATOR, 7, (64, 64))
+    assert np.abs(((offs + 32) % 64) - 32).max() <= 7
+
+
+def test_composition_working_set_is_guard_priced(monkeypatch):
+    monkeypatch.setenv(guard.CAP_ENV, "1")
+    with pytest.raises(ValueError, match=guard.CAP_ENV):
+        # t = 0b111..1 forces multiplies at large support: the candidate
+        # offset rows blow the 1 MiB cap long before any allocation.
+        fastforward.pow_offsets(REPLICATOR, 2**14 - 1, (2**14, 2**14))
+
+
+# -- the banded GF(2) matmul lane ---------------------------------------------
+
+
+def test_matmul_lane_matches_iterate_for_separable_kernels():
+    board = _board(64, 96, seed=6)
+    for t in (1, 2, 5, 16, 33):
+        mm = np.asarray(
+            fastforward.jump_matmul_fn(FREDKIN, t, (64, 96))(board)
+        )
+        np.testing.assert_array_equal(mm, _iterate(board, FREDKIN, t))
+
+
+def test_matmul_lane_refuses_nonseparable_kernels():
+    with pytest.raises(ValueError, match="separable"):
+        fastforward.jump_matmul_fn(REPLICATOR, 4, (64, 64))
+    with pytest.raises(ValueError):
+        fastforward.jump_matmul_fn(CONWAY, 4, (64, 64))
+
+
+# -- certification -------------------------------------------------------------
+
+
+def test_certify_jump_agrees_and_returns_digest():
+    board = _board(24, 24, seed=8)
+    d = fastforward.certify_jump(board, REPLICATOR, 16)
+    want = odigest.value(odigest.digest_dense_np(_iterate(board, REPLICATOR, 16)))
+    assert d == want
+
+
+def test_certify_jump_detects_divergence(monkeypatch):
+    """Sabotage one factor program: certification must refuse loudly."""
+    board = _board(16, 16, seed=1)
+    real = fastforward._jump_pow2_fn
+
+    def sabotaged(rule_key, k, shape):
+        fn = real(rule_key, k, shape)
+        return lambda b: jnp.bitwise_xor(fn(b), jnp.uint8(1))
+
+    monkeypatch.setattr(fastforward, "_jump_pow2_fn", sabotaged)
+    with pytest.raises(RuntimeError, match="certification failed"):
+        fastforward.certify_jump(board, REPLICATOR, 5)
+
+
+# -- the guard's 3-smooth pad suggestion (PR 11 residue, satellite) -----------
+
+
+def test_nearest_3smooth():
+    assert guard.nearest_3smooth(16384) == 18432  # 2^11 · 9
+    assert guard.nearest_3smooth(2048) == 2304  # 2^8 · 9
+    assert guard.nearest_3smooth(96) == 96  # already 3-smooth
+    for n in (100, 1000, 5000, 65536):
+        w = guard.nearest_3smooth(n)
+        assert w >= n and w % 96 == 0  # 3-divisible and 32-aligned
+        m = w
+        while m % 2 == 0:
+            m //= 2
+        while m % 3 == 0:
+            m //= 3
+        assert m == 1  # 3-smooth
+    with pytest.raises(ValueError):
+        guard.nearest_3smooth(0)
+
+
+def test_matmul_refusal_suggests_3smooth_pad(monkeypatch):
+    """When the digit-packing depth caps at 2 on a power-of-two width and
+    the plan is refused, the message must name the mitigation."""
+    from akka_game_of_life_tpu.ops import matmul_stencil
+
+    monkeypatch.setenv(guard.CAP_ENV, "1")
+    with pytest.raises(ValueError, match="3-smooth") as ei:
+        matmul_stencil.plan_matmul((2048, 2048), 5, "f32")
+    assert "2304" in str(ei.value)  # the concrete pad target
+    assert guard.CAP_ENV in str(ei.value)  # the cap knob stays named
+
+
+# -- the Simulation product surface -------------------------------------------
+
+
+def _sim(**kw):
+    from akka_game_of_life_tpu.obs.catalog import install
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.runtime.simulation import Simulation
+
+    registry = install(MetricsRegistry())
+    cfg = SimulationConfig(flight_dir="", **kw)
+    return Simulation(cfg, registry=registry), registry
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {"kernel": "matmul"},  # dense single-device layout (no relayout)
+        {"sparse_kernel": True},  # host-gated layout, gate resets
+        {"kernel": "dense"},  # auto-meshed under the 8-device test env
+        {"kernel": "bitpack"},  # packed (meshed here): unpack→jump→repack
+    ],
+    ids=["dense-single", "sparse", "mesh-dense", "mesh-bitpack"],
+)
+def test_simulation_fast_forward_layouts(kw):
+    from akka_game_of_life_tpu.runtime.simulation import initial_board
+
+    t = 517
+    sim, registry = _sim(height=32, width=64, rule="replicator", seed=7, **kw)
+    try:
+        want = _iterate(jnp.asarray(initial_board(sim.config)), REPLICATOR, t)
+        assert sim.fast_forward(t) == t
+        np.testing.assert_array_equal(sim.board_host(), want)
+        snap = registry.snapshot()
+        assert snap["gol_ff_jumps_total"] == 1
+        assert snap["gol_ff_epochs_total"] == t
+        # The run keeps stepping normally after a jump (layout restored).
+        # The meshed steppers themselves are a known jax-0.4.37 gap in
+        # this test environment (jax.shard_map — the pinned seed failure
+        # set), which is about the stepper, not the jump surface.
+        try:
+            sim.advance(4)
+        except AttributeError as e:  # pragma: no cover - env-dependent
+            assert "shard_map" in str(e)
+            pytest.xfail("meshed stepper needs jax.shard_map (seed-known)")
+        np.testing.assert_array_equal(
+            sim.board_host(), _iterate(jnp.asarray(want), REPLICATOR, 4)
+        )
+        assert sim.epoch == t + 4
+    finally:
+        sim.close()
+
+
+def test_simulation_fast_forward_refusals():
+    sim, _ = _sim(height=16, width=32, rule="conway")
+    try:
+        with pytest.raises(ValueError, match="not XOR-linear"):
+            sim.fast_forward(10)
+    finally:
+        sim.close()
+    sim, _ = _sim(height=16, width=32, rule="replicator", ff_enabled=False)
+    try:
+        with pytest.raises(ValueError, match="ff_enabled"):
+            sim.fast_forward(10)
+        assert sim.fast_forward(0) == 0  # a zero-span jump is a no-op
+    finally:
+        sim.close()
+    sim, _ = _sim(height=16, width=32, rule="replicator")
+    try:
+        # Span ceiling refuses BEFORE any relayout/certification work.
+        with pytest.raises(ValueError, match="62 bits"):
+            sim.fast_forward(1 << 63)
+        assert sim.epoch == 0
+    finally:
+        sim.close()
+
+
+def test_cli_fast_forward_misuse_is_a_clean_exit():
+    from akka_game_of_life_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="not XOR-linear"):
+        main([
+            "run", "--platform", "cpu", "--kernel", "matmul",
+            "--rule", "conway", "--height", "16", "--width", "16",
+            "--fast-forward", "10", "--max-epochs", "0",
+        ])
+    sim, _ = _sim(height=16, width=32, rule="replicator", backend="actor")
+    try:
+        with pytest.raises(ValueError, match="actor"):
+            sim.fast_forward(10)
+    finally:
+        sim.close()
+
+
+def test_simulation_fast_forward_certifies(monkeypatch):
+    """ff_certify_steps samples jump-vs-iterate before the jump commits;
+    a sabotaged kernel must abort the jump with the epoch unmoved."""
+    sim, registry = _sim(
+        height=16, width=32, rule="replicator", ff_certify_steps=8
+    )
+    try:
+        real = fastforward.certify_jump
+
+        def boom(board, rule, t):
+            raise RuntimeError("fast-forward certification failed (test)")
+
+        monkeypatch.setattr(fastforward, "certify_jump", boom)
+        with pytest.raises(RuntimeError, match="certification failed"):
+            sim.fast_forward(100)
+        assert sim.epoch == 0  # nothing committed
+        assert registry.snapshot()["gol_digest_mismatches_total"] == 1
+        monkeypatch.setattr(fastforward, "certify_jump", real)
+        assert sim.fast_forward(100) == 100
+    finally:
+        sim.close()
+
+
+def test_cli_fast_forward_is_an_absolute_epoch_on_resume(tmp_path):
+    """`run --fast-forward T` targets epoch T like --max-epochs targets
+    the end: re-running the identical command against its own checkpoint
+    must NOT re-apply the whole span (an overshoot would silently land a
+    resumed run on a different trajectory than the uninterrupted one)."""
+    from akka_game_of_life_tpu.cli import main
+    from akka_game_of_life_tpu.runtime.checkpoint import make_store
+
+    ck = str(tmp_path / "ck")
+    argv = [
+        "run", "--platform", "cpu", "--kernel", "matmul",
+        "--rule", "replicator", "--height", "16", "--width", "32",
+        "--seed", "3", "--fast-forward", "100", "--max-epochs", "120",
+        "--steps-per-call", "4", "--checkpoint-dir", ck,
+        "--checkpoint-every", "4",
+    ]
+    assert main(argv) == 0
+    store = make_store(ck, "npz")
+    assert store.latest_epoch() == 120
+    # The resume: same command, checkpoint already at the end epoch —
+    # the jump must be the REMAINDER (0 here), never another +100.
+    assert main(argv) == 0
+    store = make_store(ck, "npz")
+    assert store.latest_epoch() == 120
+    from akka_game_of_life_tpu.utils.patterns import random_grid
+
+    want = _iterate(
+        jnp.asarray(random_grid((16, 32), density=0.5, seed=3)),
+        REPLICATOR, 120,
+    )
+    np.testing.assert_array_equal(store.load().board, want)
+
+
+def test_config_validates_ff_knobs():
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+
+    with pytest.raises(ValueError, match="ff_certify_steps"):
+        SimulationConfig(ff_certify_steps=-1)
+
+
+def test_cli_ff_flags_reach_config():
+    """--ff-* flags map onto ff_* fields through the override layer (the
+    live half of the GL-CFG07 bijection)."""
+    from akka_game_of_life_tpu.cli import _ff_overrides, main  # noqa: F401
+    import argparse
+
+    ns = argparse.Namespace(ff_enabled="off", ff_certify_steps=3)
+    assert _ff_overrides(ns) == {"ff_enabled": False, "ff_certify_steps": 3}
+    ns = argparse.Namespace(ff_enabled=None, ff_certify_steps=None)
+    assert _ff_overrides(ns) == {
+        "ff_enabled": None, "ff_certify_steps": None,
+    }

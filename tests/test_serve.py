@@ -541,8 +541,12 @@ def test_step_bounds_and_closed_router():
     sid = router.create(height=8, width=8)["id"]
     with pytest.raises(ValueError):
         router.step(sid, steps=0)
-    with pytest.raises(ValueError):
+    # Beyond serve_max_steps on a NON-linear rule: a 429 admission
+    # refusal with a machine-readable reason — not a 400, and never a
+    # queued 10^6-tick job monopolizing the ticker.
+    with pytest.raises(AdmissionError) as ei:
         router.step(sid, steps=17)
+    assert ei.value.reason == "max_steps"
     router.close()
     with pytest.raises(RuntimeError):
         router.create(height=8, width=8)
@@ -550,6 +554,146 @@ def test_step_bounds_and_closed_router():
         # Fail NOW, not after JOB_TIMEOUT_S: the ticker is gone, an
         # enqueued job would never drain.
         router.step(sid, steps=1)
+
+
+def test_linear_rule_fast_forward_bypasses_step_bound():
+    """The fast path: a replicator session answers n far beyond
+    serve_max_steps via the O(log n) jump — digest-checked against a
+    full single-board iterate at a span the oracle can actually run."""
+    registry = _registry()
+    cfg = _cfg(serve_max_steps=16)
+    router = SessionRouter(cfg, registry=registry)
+    try:
+        sid = router.create(rule="replicator", height=16, width=16, seed=5)["id"]
+        n = 4101  # > serve_max_steps, small enough to iterate as oracle
+        epoch, digest = router.step(sid, steps=n)
+        assert epoch == n
+        board0 = random_grid((16, 16), density=0.5, seed=5)
+        want = _oracle("replicator", board0, n)
+        assert digest == odigest.value(odigest.digest_dense_np(want))
+        # the table committed the jump: GET shows the advanced board
+        doc = router.get(sid)
+        np.testing.assert_array_equal(doc["board"], want)
+        assert doc["population"] == int((want == 1).sum())
+        # a giant span answers too (jump(a) then jump(b) == jump(a+b))
+        epoch2, digest2 = router.step(sid, steps=1_000_000 - n)
+        assert epoch2 == 1_000_000
+        from akka_game_of_life_tpu.ops import fastforward
+
+        want_far = fastforward.fast_forward_np(board0, "replicator", 1_000_000)
+        assert digest2 == odigest.value(odigest.digest_dense_np(want_far))
+        # small steps still ride the batch ticker, interleaved
+        epoch3, _ = router.step(sid, steps=4)
+        assert epoch3 == 1_000_004
+        snap = registry.snapshot()
+        assert snap["gol_serve_ff_jumps_total"] == 2.0
+        assert snap[
+            'gol_serve_steps_total{tenant="default"}'
+        ] == 1_000_004.0
+    finally:
+        router.close()
+
+
+def test_batch_scatter_back_never_clobbers_a_midbatch_jump(monkeypatch):
+    """The two board writers (ticker scatter-back, fast-forward commit)
+    are both optimistic: a batch whose snapshot went stale mid-flight —
+    because a jump committed between its gather and its scatter-back —
+    must NOT write back (the 10^6 jumped epochs would be silently lost
+    and the epoch would mislabel the board); the batch client still gets
+    its result, computed from the snapshot it asked about."""
+    from akka_game_of_life_tpu.serve import batch as sbatch_mod
+
+    gathered, release = threading.Event(), threading.Event()
+    real = sbatch_mod.batch_step_fn
+
+    def slow(cls, length):
+        fn = real(cls, length)
+
+        def run(*operands):
+            gathered.set()
+            assert release.wait(30)
+            return fn(*operands)
+
+        return run
+
+    monkeypatch.setattr(sbatch_mod, "batch_step_fn", slow)
+    router = SessionRouter(_cfg(serve_max_steps=16), registry=_registry())
+    try:
+        sid = router.create(rule="replicator", height=8, width=8, seed=1)["id"]
+        results = {}
+        tb = threading.Thread(
+            target=lambda: results.setdefault("batch", router.step(sid, steps=4))
+        )
+        tb.start()
+        assert gathered.wait(30)  # the ticker snapshotted; batch in flight
+        epoch_ff, dig_ff = router.step(sid, steps=1_000_000)  # jump commits
+        assert epoch_ff == 1_000_000
+        release.set()
+        tb.join(30)
+        assert results["batch"][0] == 4  # its own snapshot's epoch
+        doc = router.get(sid)
+        assert doc["epoch"] == 1_000_000  # the jump survived the scatter
+        assert odigest.value(odigest.digest_dense_np(doc["board"])) == dig_ff
+    finally:
+        release.set()
+        router.close()
+
+
+def test_fast_forward_concurrency_bound_rejects_not_wedges():
+    """The fast path bypasses the ticker queue, so queue_depth cannot
+    bound it — the slot cap must, with the same retryable-429 contract
+    (and release must survive the request, so the path recovers)."""
+    from akka_game_of_life_tpu.serve import sessions as sessions_mod
+
+    router = SessionRouter(_cfg(serve_max_steps=16), registry=_registry())
+    try:
+        sid = router.create(rule="replicator", height=8, width=8)["id"]
+        taken = 0
+        while router._ff_slots.acquire(blocking=False):
+            taken += 1
+        assert taken == sessions_mod.FF_MAX_CONCURRENT
+        with pytest.raises(AdmissionError) as ei:
+            router.step(sid, steps=17)
+        assert ei.value.reason == "queue_full"
+        for _ in range(taken):
+            router._ff_slots.release()
+        assert router.step(sid, steps=17)[0] == 17  # slots recovered
+    finally:
+        router.close()
+
+
+def test_step_span_ceiling_is_a_400_everywhere():
+    """An absurd span (beyond 2^62) is a malformed request, not an
+    admission question — even for linear rules, the fast path's program
+    count is bounded by the span's bit length (the DoS guard)."""
+    router = SessionRouter(_cfg(serve_max_steps=16), registry=_registry())
+    try:
+        sid = router.create(rule="replicator", height=8, width=8)["id"]
+        with pytest.raises(ValueError, match="span ceiling"):
+            router.step(sid, steps=10**100)
+    finally:
+        router.close()
+
+
+def test_fast_forward_disabled_or_nonlinear_rejects_with_reason():
+    registry = _registry()
+    router = SessionRouter(
+        _cfg(serve_max_steps=16, ff_enabled=False), registry=registry
+    )
+    try:
+        sid = router.create(rule="replicator", height=8, width=8)["id"]
+        with pytest.raises(AdmissionError) as ei:
+            router.step(sid, steps=17)
+        assert ei.value.reason == "max_steps"
+        assert "disabled" in str(ei.value)
+        # within the bound, linear rules batch like anyone else
+        epoch, _ = router.step(sid, steps=16)
+        assert epoch == 16
+        assert registry.snapshot()[
+            'gol_serve_rejects_total{reason="max_steps"}'
+        ] == 1.0
+    finally:
+        router.close()
 
 
 # -- HTTP surface on the registered-routes table ------------------------------
@@ -641,6 +785,42 @@ def test_http_error_mapping():
         assert _http(
             base, "POST", f"/boards/{sid}/step", {"steps": "lots"}
         )[0] == 400
+    finally:
+        server.close()
+        router.close()
+
+
+def test_http_step_fast_path_and_max_steps_reason():
+    """The HTTP shape of the bound: over-bound steps on a non-linear rule
+    is 429 `max_steps`; the same request on a linear-rule session lands
+    200 with the jumped epoch."""
+    router, server, base = _serve_stack(_cfg(serve_max_steps=16))
+    try:
+        status, doc = _http(base, "POST", "/boards", {"height": 8, "width": 8})
+        assert status == 201
+        status, doc = _http(
+            base, "POST", f"/boards/{doc['id']}/step", {"steps": 1_000_000}
+        )
+        assert status == 429 and doc["reason"] == "max_steps"
+        assert "retry_after_s" in doc
+
+        status, doc = _http(
+            base, "POST", "/boards",
+            {"rule": "replicator", "height": 8, "width": 8, "seed": 2},
+        )
+        assert status == 201
+        sid = doc["id"]
+        status, doc = _http(
+            base, "POST", f"/boards/{sid}/step", {"steps": 1_000_000}
+        )
+        assert status == 200 and doc["epoch"] == 1_000_000
+        board0 = random_grid((8, 8), density=0.5, seed=2)
+        from akka_game_of_life_tpu.ops import fastforward
+
+        want = fastforward.fast_forward_np(board0, "replicator", 1_000_000)
+        assert doc["digest"] == odigest.format_digest(
+            odigest.value(odigest.digest_dense_np(want))
+        )
     finally:
         server.close()
         router.close()

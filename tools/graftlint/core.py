@@ -45,10 +45,14 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
     ("GL-CFG04", "--serve-* flags ↔ SimulationConfig serve_* fields"),
     ("GL-CFG05", "--sparse-* flags ↔ SimulationConfig sparse_* fields"),
     ("GL-CFG06", "--kernel choices ↔ config KERNEL_CHOICES ↔ OPERATIONS.md"),
+    ("GL-CFG07", "--ff-* flags ↔ SimulationConfig ff_* fields ↔ "
+     "OPERATIONS.md knob table"),
     ("GL-DOC01", "gol_* metric literals ↔ obs catalog ↔ OPERATIONS.md"),
     ("GL-DOC02", "span names ↔ SPAN_CATALOG ↔ OPERATIONS.md"),
     ("GL-DOC03", "protocol messages ↔ OPERATIONS.md table"),
     ("GL-DOC04", "graftlint pass ids ↔ OPERATIONS.md static-analysis table"),
+    ("GL-DOC05", "SimulationConfig ff_* fields ↔ OPERATIONS.md fast-forward "
+     "knob table"),
 )
 PASS_IDS = frozenset(pid for pid, _ in PASS_CATALOG)
 

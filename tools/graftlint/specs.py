@@ -60,6 +60,43 @@ SPARSE_CONFIG = FlagConfigSpec(
     flag_strip="--sparse", field_prefix="sparse_",
 )
 
+# The fast-forward knob surface is three-way — --ff-* flags ↔ ff_*
+# config fields ↔ the operator doc's "Logarithmic fast-forward" knob
+# table — enforced as two passes along the repo's taxonomy: GL-CFG07 is
+# the flag ↔ field bijection (a FlagConfigSpec like every other config
+# plane) and GL-DOC05 closes the field ↔ doc-table edge, so the whole
+# cli ↔ config ↔ doc triangle is two-way on every edge.
+FF_CONFIG = FlagConfigSpec(
+    name="ff_config", pass_id="GL-CFG07",
+    flag_regex=r"""["'](--ff-[a-z0-9-]+)["']""",
+    config_class="SimulationConfig", field_regex=r"^    (ff_\w+)\s*:",
+    flag_strip="--ff", field_prefix="ff_",
+)
+
+FF_DOC = CatalogSpec(
+    name="ff_doc", pass_id="GL-DOC05",
+    sides={
+        "config": Side(
+            kind="block", path="akka_game_of_life_tpu/runtime/config.py",
+            start="class SimulationConfig", end="\n    def ",
+            regex=r"^    (ff_\w+)\s*:",
+        ),
+        "doc": Side(
+            kind="section", path=_DOC, start="## Logarithmic fast-forward",
+            end="## ", regex=r"^\|\s*`(ff_\w+)`",
+        ),
+    },
+    relations=(
+        Relation("config", "doc", "fast-forward knob {name} has no row in "
+                 "the OPERATIONS.md Logarithmic fast-forward knob table"),
+        Relation("doc", "config", "OPERATIONS.md documents fast-forward "
+                 "knob {name} which SimulationConfig does not declare — "
+                 "worse than no row"),
+    ),
+    scan_guard=("config", "scan broken: no ff_* fields found in "
+                "SimulationConfig"),
+)
+
 # The --kernel choice surface is a VALUE set, not a flag family: the CLI
 # mirrors runtime.config.KERNEL_CHOICES as a literal tuple (so the lint
 # stays textual/import-free), and the operator doc carries one table row
@@ -196,5 +233,6 @@ GRAFTLINT_DOC = CatalogSpec(
 
 SPECS = (
     CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SPARSE_CONFIG,
-    KERNEL_CONFIG, METRICS_DOC, TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
+    FF_CONFIG, FF_DOC, KERNEL_CONFIG, METRICS_DOC, TRACE_NAMES,
+    PROTOCOL_MSGS, GRAFTLINT_DOC,
 )
