@@ -423,14 +423,36 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
     g.add_argument(
         "--serve-size-classes", default=None, metavar="C1,C2,...",
         help="padded board size classes (square sides, ascending): mixed "
-        "shapes bucket into a few compiled programs; boards beyond the "
-        "largest class are refused (default 32,64,128,256)",
+        "shapes bucket into a few compiled programs; bigger boards run "
+        "as tiled sessions in cluster mode, and are refused single-"
+        "process (default 32,64,128,256)",
+    )
+    g.add_argument(
+        "--serve-cluster",
+        choices=["on", "off"],
+        default=None,
+        help="cluster-sharded serving: this process becomes the tenant-"
+        "facing cluster frontend, sessions hash-shard across joined "
+        "backend workers (each running its own vmapped batch engine), "
+        "session shards migrate under load/drain, and over-class boards "
+        "are admitted as tiled sessions (default off)",
+    )
+    g.add_argument(
+        "--serve-shards", type=int, default=None, metavar="N",
+        help="virtual session shards — the unit of placement and "
+        "migration across workers (default 64)",
+    )
+    g.add_argument(
+        "--serve-tile-chunk", type=int, default=None, metavar="K",
+        help="epochs per fan-out round of a tiled (mega-board) session "
+        "step; each tile ships a K-wide halo per round trip (default 8)",
     )
 
 
 def _serve_overrides(args: argparse.Namespace) -> dict:
     """``--serve-*`` flags → SimulationConfig override kwargs (empty
     entries are dropped by load_config's None filtering)."""
+    on_off = {"on": True, "off": False, None: None}
     return {
         "serve_max_sessions": args.serve_max_sessions,
         "serve_max_cells": args.serve_max_cells,
@@ -447,6 +469,9 @@ def _serve_overrides(args: argparse.Namespace) -> dict:
             else None
         ),
         "serve_size_classes": args.serve_size_classes,
+        "serve_cluster": on_off[args.serve_cluster],
+        "serve_shards": args.serve_shards,
+        "serve_tile_chunk": args.serve_tile_chunk,
     }
 
 
@@ -706,6 +731,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     _add_ring_plane(fe_p)
     _add_rebalance(fe_p)
+    # The simulation frontend can ALSO host the serve plane (one cluster,
+    # both products): --serve-cluster on mounts /boards on its obs port.
+    _add_serve(fe_p)
     _add_chaos_net(fe_p)
 
     sv_p = sub.add_parser(
@@ -722,6 +750,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         default=None,
         help="HTTP port for /boards + /metrics + /healthz + /trace "
         "(default 0 = ephemeral, printed at startup)",
+    )
+    sv_p.add_argument(
+        "--port", type=int, default=2551,
+        help="cluster listener port workers join (--serve-cluster on)",
+    )
+    sv_p.add_argument("--host", default="127.0.0.1")
+    sv_p.add_argument(
+        "--min-backends", type=int, default=1,
+        help="workers to wait for before serving (--serve-cluster on)",
     )
     _add_serve(sv_p)
     _add_ff(sv_p)
@@ -899,6 +936,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             tiles_per_worker=args.tiles_per_worker,
             **_ring_plane_overrides(args),
             **_rebalance_overrides(args),
+            **_serve_overrides(args),
             wait_for_backends_s=(
                 parse_duration(args.wait_for_backends)
                 if args.wait_for_backends is not None
@@ -931,12 +969,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             {
                 "role": "serve",
                 "metrics_port": args.metrics_port,
+                "host": args.host,
+                "port": args.port,
                 **_serve_overrides(args),
                 **_ff_overrides(args),
             },
         )
         from akka_game_of_life_tpu.obs import get_tracer
         from akka_game_of_life_tpu.runtime.signals import flight_dump_on_signals
+
+        if cfg.serve_cluster:
+            # Cluster-sharded mode: this process is a serve-only cluster
+            # frontend; workers join with the ordinary `backend` role and
+            # each hosts session shards in its own batch engine.
+            from akka_game_of_life_tpu.serve.cluster import run_serve_cluster
+
+            with _sigterm_as_interrupt(), flight_dump_on_signals(
+                get_tracer().flight
+            ):
+                try:
+                    return run_serve_cluster(
+                        cfg, min_backends=args.min_backends
+                    )
+                except KeyboardInterrupt:
+                    return 130
         from akka_game_of_life_tpu.serve.api import run_serve
 
         with _sigterm_as_interrupt(), flight_dump_on_signals(

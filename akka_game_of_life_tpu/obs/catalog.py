@@ -126,6 +126,32 @@ CATALOG = (
     ("gol_serve_ff_jumps_total", "counter",
      "Serve fast-path jumps committed (linear-rule sessions stepping "
      "past serve_max_steps via O(log T) fast-forward)", ()),
+    ("gol_serve_ff_jump_retries_total", "counter",
+     "Fast-path optimistic commits that lost the race to a batched "
+     "write-back and recomputed (bounded; the PR 12 residue, observable)",
+     ()),
+    # -- cluster-sharded serving (serve/cluster.py + serve/worker.py) ---------
+    ("gol_serve_shards", "gauge",
+     "Session shards owned, per serve worker (reclaimed to 0 on loss)",
+     ("member",)),
+    ("gol_serve_shard_sessions", "gauge",
+     "Sessions resident, per serve worker (reclaimed to 0 on loss)",
+     ("member",)),
+    ("gol_serve_worker_queue_depth", "gauge",
+     "Serve ops in flight toward each worker (unsent + unanswered; "
+     "reclaimed to 0 on loss)", ("member",)),
+    ("gol_serve_ops_total", "counter",
+     "Session ops forwarded to workers by the cluster frontend", ()),
+    ("gol_serve_op_frames_total", "counter",
+     "SERVE_OPS frames sent (ops_total / op_frames_total = the op-plane "
+     "coalescing ratio)", ()),
+    ("gol_serve_shard_migrations_total", "counter",
+     "Session-shard migrations committed (freeze → certify → commit)", ()),
+    ("gol_serve_shard_migration_aborts_total", "counter",
+     "Session-shard migrations rolled back (source unfroze, no loss)", ()),
+    ("gol_serve_tiled_sessions", "gauge",
+     "Mega-board sessions admitted as tiled (above the largest size "
+     "class, fanned across workers per chunk)", ()),
     # -- logarithmic fast-forward (ops/fastforward.py) ------------------------
     ("gol_ff_jumps_total", "counter",
      "Fast-forward jumps committed by Simulation.fast_forward", ()),

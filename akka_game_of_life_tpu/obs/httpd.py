@@ -99,6 +99,12 @@ class MetricsServer:
             # withholds the bytes must not pin this connection thread
             # forever — the stalled read raises and the connection closes.
             timeout = 30
+            # HTTP/1.1 so clients can keep connections alive: every
+            # response here carries an exact Content-Length (the _respond
+            # invariant), which is the precondition.  A serving-plane
+            # client stepping a board per tick would otherwise pay a TCP
+            # setup per request.
+            protocol_version = "HTTP/1.1"
             def _respond(self, code: int, ctype: str, body: bytes) -> None:
                 # Headers + body only AFTER the body is a finished byte
                 # string: rendering (and its locks) never overlaps the

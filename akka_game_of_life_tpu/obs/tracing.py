@@ -81,6 +81,8 @@ SPAN_CATALOG = (
     # -- multi-tenant serving plane -------------------------------------------
     ("serve.tick", "one serving-plane engine tick (batched device programs "
      "over this tick's step jobs)"),
+    ("serve.shard_migrate", "one session-shard migration, PREPARE to "
+     "COMMIT or abort (cluster-sharded serving)"),
     # -- durability -----------------------------------------------------------
     ("checkpoint.save", "one checkpoint save made durable"),
     ("checkpoint.restore", "one checkpoint load"),

@@ -702,6 +702,7 @@ class BackendWorker:
         # stream never surfaced (how many rings flowed, how many pulls went
         # stale); counters make them first-class.
         reg = registry if registry is not None else get_registry()
+        self.registry = reg
         # Tracing: step/halo/retry spans parent themselves under the trace
         # context the frontend embeds in TICK/DEPLOY envelopes, so a
         # frontend epoch span links to every chunk this worker steps for it.
@@ -749,6 +750,10 @@ class BackendWorker:
         )
 
         self.tiles: Dict[TileId, _Tile] = {}
+        # Cluster-sharded serving: constructed at WELCOME when the
+        # frontend's serve plane is on — this worker then hosts session
+        # shards in its own vmapped batch engine (serve/worker.py).
+        self.serve_plane = None
         self.rule: Optional[Rule] = None
         self.target = 0
         self.final_epoch = 0
@@ -860,6 +865,19 @@ class BackendWorker:
             self.obs_digest = bool(welcome["obs_digest"])
         if "sparse_cluster" in welcome:
             self.sparse_cluster = bool(welcome["sparse_cluster"])
+        if welcome.get("serve_cluster"):
+            from akka_game_of_life_tpu.serve.worker import ServeWorkerPlane
+
+            # The serve knobs arrive in WELCOME like every other cluster
+            # policy bundle; the plane owns a local SessionRouter (the PR 7
+            # batch engine, unchanged) plus the op/shard wire glue.
+            self.serve_plane = ServeWorkerPlane(
+                welcome.get("serve", {}),
+                self.channel.send,
+                name=self.name or "",
+                registry=self.registry,
+                tracer=self.tracer,
+            )
         self._retry_rng = random.Random(f"retry:{self.name}")
         self.breaker.node = self.name or "backend"
         if isinstance(self.channel, ChaosChannel):
@@ -925,6 +943,10 @@ class BackendWorker:
     def stop(self) -> None:
         self._stop.set()
         self._run_pre_stop_hooks()
+        if self.serve_plane is not None:
+            # Before the control channel closes: the plane's reply thread
+            # writes there, and its router must stop ticking.
+            self.serve_plane.close()
         if self.channel is not None:
             try:
                 # Graceful leave (cluster down): distinguishable from a crash.
@@ -1355,6 +1377,14 @@ class BackendWorker:
             self._on_migrate_prepare(msg)
         elif kind == P.MIGRATE_ABORT:
             self._on_migrate_abort(tuple(msg["tile"]))
+        elif kind in (
+            P.SERVE_OPS, P.SHARD_PREPARE, P.SHARD_COMMIT, P.SHARD_ABORT
+        ):
+            # Serve-plane frames enqueue to the plane's executor and never
+            # block this reader: a step op's batch tick must not stall
+            # heartbeat-adjacent control traffic.
+            if self.serve_plane is not None:
+                self.serve_plane.handle(msg)
         elif kind == P.DRAIN_COMPLETE:
             # The frontend released us: either every tile migrated off
             # (drained=True → rc 0) or the drain was refused (no placeable
@@ -1363,6 +1393,8 @@ class BackendWorker:
             self.stopped_reason = "drained" if drained else "drain_refused"
             self._stop.set()
             self._run_pre_stop_hooks()
+            if self.serve_plane is not None:
+                self.serve_plane.close()
             try:
                 # Deliberate leave, distinguishable from a crash — by now we
                 # own nothing, so the frontend evicts without redeploying.
@@ -1375,6 +1407,8 @@ class BackendWorker:
             self._stop.set()
             # Last words while the socket is still open (span-batch drain).
             self._run_pre_stop_hooks()
+            if self.serve_plane is not None:
+                self.serve_plane.close()
             self.channel.close()
 
     def _on_owners(self, msg: dict) -> None:
@@ -1645,7 +1679,14 @@ class BackendWorker:
         finally DRAIN_COMPLETE."""
         with self._lock:
             has_tiles = bool(self.tiles)
-        if not has_tiles or self.channel is None or self._stop.is_set():
+        # A serve-shard host with no tiles still drains: its sessions must
+        # migrate off before it may leave without losing tenant boards.
+        serving = self.serve_plane is not None
+        if (
+            (not has_tiles and not serving)
+            or self.channel is None
+            or self._stop.is_set()
+        ):
             return False
         try:
             self.channel.send({"type": P.DRAIN_REQUEST})

@@ -385,6 +385,24 @@ class SimulationConfig:
     # bucket into a handful of compiled programs; boards beyond the
     # largest class are refused with 400.
     serve_size_classes: str = "32,64,128,256"
+    # Cluster-sharded serving (docs/OPERATIONS.md "Serving plane"): fuse
+    # the serving plane with the elastic cluster — the frontend becomes
+    # the tenant-facing session router, sessions hash-shard across the
+    # joined workers (each running its own vmapped batch engine), the
+    # rebalancer migrates session shards under load and drain, and a
+    # board above the largest size class is admitted as a tiled session
+    # instead of being refused.  serve_max_* then bound the CLUSTER, not
+    # one process (workers keep the same values as their local backstop).
+    serve_cluster: bool = False
+    # Virtual session shards — the unit of placement and migration.
+    # Sessions hash onto shards (crc32 of the id), shards map onto
+    # workers; more shards = finer rebalancing granularity.
+    serve_shards: int = 64
+    # Epochs per fan-out round of a *tiled* (mega-board) session step:
+    # each tile ships with a serve_tile_chunk-wide halo and advances that
+    # many epochs per round trip — the exchange-width trade, serve-plane
+    # edition (bigger = fewer round trips, fatter halos).
+    serve_tile_chunk: int = 8
     # -- logarithmic fast-forward (docs/OPERATIONS.md "Logarithmic
     # fast-forward").  XOR-linear (odd-rule) boards jump T epochs in
     # O(log T) device programs (ops/fastforward.py); non-linear rules are
@@ -596,6 +614,8 @@ class SimulationConfig:
             "serve_max_cells",
             "serve_queue_depth",
             "serve_max_steps",
+            "serve_shards",
+            "serve_tile_chunk",
         ):
             if getattr(self, name) < 1:
                 raise ValueError(

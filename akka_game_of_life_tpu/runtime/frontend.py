@@ -21,6 +21,7 @@ Failure model implemented here (the reference's three layers, §5):
 
 from __future__ import annotations
 
+import dataclasses
 import queue
 import socket
 import threading
@@ -94,6 +95,8 @@ _MSG_REQUIRED = {
     P.GATHER_FAILED: ("tile", "epoch"),
     P.MIGRATE_STATE: ("tile", "epoch", "state", "digest", "seq"),
     P.DRAIN_REQUEST: (),
+    P.SERVE_RESULT: ("results",),
+    P.SHARD_STATE: ("shard", "seq"),
 }
 # TILE_STATE carries per-reason payloads; each declared reason needs its key.
 _REASON_PAYLOAD = {
@@ -128,6 +131,12 @@ def _validate_msg(msg) -> None:
         raise MalformedMessage(f"{kind} epoch {msg['epoch']!r} is not an int")
     if "seq" in required and not isinstance(msg["seq"], int):
         raise MalformedMessage(f"{kind} seq {msg.get('seq')!r} is not an int")
+    if "shard" in required and not isinstance(msg["shard"], int):
+        raise MalformedMessage(
+            f"{kind} shard {msg.get('shard')!r} is not an int"
+        )
+    if "results" in required and not isinstance(msg["results"], list):
+        raise MalformedMessage(f"{kind} results is not a list")
     if "state" in required and not isinstance(msg["state"], dict):
         raise MalformedMessage(f"{kind} state is not a tile payload dict")
     if kind == P.PROGRESS:
@@ -208,8 +217,12 @@ class Frontend:
         registry=None,
         tracer=None,
     ) -> None:
-        if config.max_epochs is None:
+        if config.max_epochs is None and not config.serve_cluster:
+            # A serve-only cluster (serve_cluster with no simulation) has
+            # no epoch target: the frontend is membership + serve plane.
             raise ValueError("frontend requires max_epochs")
+        if config.max_epochs is None:
+            config = dataclasses.replace(config, max_epochs=0)
         self.config = config
         self.rule = resolve_rule(config.rule)
         # Coordinator observability: membership churn and recovery actions
@@ -332,6 +345,23 @@ class Frontend:
         # under self._lock.
         self.rebalancer = Rebalancer(config)
         self._drain_spans: Dict[str, object] = {}
+        # Cluster-sharded serving (docs/OPERATIONS.md "Serving plane"):
+        # when serve_cluster is on, this frontend is ALSO the tenant-facing
+        # session router — sessions hash-shard across the same workers that
+        # host tiles, /boards mounts on the obs endpoint, and the plane's
+        # own Rebalancer instance migrates session shards (load + drain).
+        self.serve_plane = None
+        if config.serve_cluster:
+            from akka_game_of_life_tpu.serve.cluster import ClusterServePlane
+
+            self.serve_plane = ClusterServePlane(
+                config,
+                self.membership,
+                self._safe_send,
+                registry=self.metrics,
+                tracer=self.tracer,
+                events=self.events,
+            )
         if config.checkpoint_dir and config.checkpoint_format != "npz":
             # The cluster frontend streams per-tile saves (save_tile /
             # finalize_epoch), which only the npz store implements; orbax is
@@ -426,12 +456,21 @@ class Frontend:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        if self.config.metrics_port:
+        if self.config.metrics_port or self.serve_plane is not None:
+            routes = None
+            if self.serve_plane is not None:
+                from akka_game_of_life_tpu.serve.api import board_routes
+
+                # The tenant surface rides the obs endpoint, exactly like
+                # the single-process serve role (ephemeral port when no
+                # metrics_port was configured — printed by the role body).
+                routes = board_routes(self.serve_plane)
             self._metrics_server = MetricsServer(
                 self.metrics,
                 port=self.config.metrics_port,
                 health=self._health,
                 tracer=self.tracer,
+                routes=routes,
             )
         for fn in (self._accept_loop, self._maintenance_loop, self._io_loop):
             t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
@@ -447,7 +486,7 @@ class Frontend:
         now = time.monotonic()
         with self._lock:
             alive = self.membership.alive_members()
-            return {
+            doc = {
                 "ok": self.error is None,
                 "error": self.error,
                 "members_alive": len(alive),
@@ -464,6 +503,11 @@ class Frontend:
                 "paused": self.paused,
                 "degraded": self.degraded,
             }
+        if self.serve_plane is not None:
+            # Outside the frontend lock (frontend → plane is the one
+            # permitted nesting order, and health() takes the plane lock).
+            doc["serve"] = self.serve_plane.health()
+        return doc
 
     def _io_loop(self) -> None:
         while True:
@@ -791,6 +835,11 @@ class Frontend:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.serve_plane is not None:
+            # Before SHUTDOWN frames: pending tenant ops fail fast with
+            # "router is closed" instead of timing out against workers
+            # that are about to leave.
+            self.serve_plane.close()
         for m in self.membership.alive_members():
             try:
                 m.channel.send({"type": P.SHUTDOWN})
@@ -935,10 +984,22 @@ class Frontend:
             if isinstance(channel, ChaosChannel):
                 channel.dst = member.name
                 self.netchaos.register_node(member.name)
+            welcome_serve = {}
+            if self.serve_plane is not None:
+                from akka_game_of_life_tpu.serve.worker import serve_policy
+
+                # The serve knobs are frontend-owned cluster policy, like
+                # the ring/retry bundles: every worker builds its local
+                # SessionRouter from the SAME source of truth.
+                welcome_serve = {
+                    "serve_cluster": True,
+                    "serve": serve_policy(self.config),
+                }
             channel.send(
                 {
                     "type": P.WELCOME,
                     "name": member.name,
+                    **welcome_serve,
                     "heartbeat_s": self.config.heartbeat_s,
                     "max_pull_retries": self.config.max_pull_retries,
                     "exchange_width": self.config.exchange_width,
@@ -978,6 +1039,11 @@ class Frontend:
             self.events.emit(
                 "member_joined", member=member.name, engine=str(engine)
             )
+            if self.serve_plane is not None:
+                # The plane claims unowned shards for a first worker; a
+                # late joiner receives its shards through the rebalancer
+                # (empty shards flip instantly on the next poll).
+                self.serve_plane.on_member_joined(member.name)
             with self._lock:
                 late = self._started.is_set() and self.layout is not None
                 if late:
@@ -1085,6 +1151,12 @@ class Frontend:
             self._on_gather_failed(member, tuple(msg["tile"]), int(msg["epoch"]))
         elif kind == P.MIGRATE_STATE:
             self._on_migrate_state(member, msg)
+        elif kind == P.SERVE_RESULT:
+            if self.serve_plane is not None:
+                self.serve_plane.on_result(member.name, msg)
+        elif kind == P.SHARD_STATE:
+            if self.serve_plane is not None:
+                self.serve_plane.on_shard_state(member.name, msg)
         elif kind == P.DRAIN_REQUEST:
             self._on_drain_request(member)
         elif kind == P.GOODBYE:
@@ -1510,7 +1582,10 @@ class Frontend:
                 for m in self.membership.placeable_members()
                 if m.name != member.name
             ]
-            if not self._started.is_set() or not others:
+            # A serve-only cluster (serve plane, no simulation) honors
+            # drains from the moment it serves — _started never fires.
+            active = self._started.is_set() or self.serve_plane is not None
+            if not active or not others:
                 refused = True
             else:
                 refused = False
@@ -1562,6 +1637,13 @@ class Frontend:
                 )
                 if m.tiles or busy:
                     continue
+                if self.serve_plane is not None and (
+                    not self.serve_plane.member_clear(m.name)
+                ):
+                    # Still owns session shards (or a shard move touches
+                    # it): the serve analog of "owns tiles" — release only
+                    # once its sessions have migrated off.
+                    continue
                 m.drain_acked = True
                 self._m_drains.inc()
                 span = self._drain_spans.pop(m.name, None)
@@ -1611,6 +1693,11 @@ class Frontend:
                 self._abort_migration(
                     mig, "member_lost", notify_source=(mig.source != name)
                 )
+            if self.serve_plane is not None:
+                # Serve-plane bookkeeping: in-flight ops answered, shard
+                # ownership reassigned, gauges reclaimed (never under the
+                # frontend lock — plane methods take their own).
+                self.serve_plane.on_member_lost(name)
         if not self._started.is_set():
             return
         if self._stop.is_set() or self.done.is_set():
@@ -1802,6 +1889,12 @@ class Frontend:
             # able to leave gracefully mid-pause) but never reshapes for
             # load.
             self._rebalance_poll(now, drain_only=drain_only)
+            # The serve plane's elastic pass (session shards) runs even
+            # before/without start_simulation — a serve-only cluster
+            # rebalances from its first worker.
+            if self.serve_plane is not None and not degraded:
+                self.serve_plane.poll(now, drain_only=drain_only)
+                self._check_drains()
             # paced epoch announcements
             with self._lock:
                 if (

@@ -48,6 +48,14 @@ DRAIN_REQUEST /             (new) graceful scale-in: a worker asks to leave,
 DRAIN_COMPLETE              its tiles migrate off live, and only then is it
                             released — planned departure never trips the
                             node-loss redeploy path
+SERVE_OPS / SERVE_RESULT    (new) cluster-sharded serving: coalesced session
+                            ops (create/step/delete/get/adopt/step_raw) from
+                            the frontend's tenant surface to one worker's
+                            batch engine, and the coalesced results back
+SHARD_PREPARE /             (new) session-shard migration: freeze a shard's
+SHARD_STATE /               sessions on the source, ship them digest-
+SHARD_COMMIT / SHARD_ABORT  certified, commit ownership (or roll back) —
+                            the tile-migration protocol, session-shaped
 ==========================  ====================================================
 
 Every message constant below must appear in docs/OPERATIONS.md's
@@ -103,6 +111,17 @@ DRAIN_COMPLETE = "drain_complete"
 # backend → frontend
 MIGRATE_STATE = "migrate_state"
 DRAIN_REQUEST = "drain_request"
+
+# cluster-sharded serving plane: the session router as the frontend's
+# tenant-facing surface, with per-worker vmapped batch engines behind it
+# frontend → worker
+SERVE_OPS = "serve_ops"
+SHARD_PREPARE = "shard_prepare"
+SHARD_COMMIT = "shard_commit"
+SHARD_ABORT = "shard_abort"
+# worker → frontend
+SERVE_RESULT = "serve_result"
+SHARD_STATE = "shard_state"
 
 # worker ↔ worker (the peer-to-peer data plane)
 PEER_HELLO = "peer_hello"

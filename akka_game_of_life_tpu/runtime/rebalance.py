@@ -28,6 +28,14 @@ This module holds only the *policy* and the in-flight bookkeeping — pure
 data structures the frontend mutates under its own lock.  All wire traffic,
 membership, and metrics stay in :mod:`runtime.frontend`.
 
+The planner knows TWO resource types: big-board *tiles* (:meth:`plan`) and
+the serving plane's *session shards* (:meth:`plan_shards` — groups of
+tenant sessions hashed to a shard id, moved between workers by the same
+freeze → transfer → certify → commit protocol at session granularity; see
+:mod:`akka_game_of_life_tpu.serve.cluster`).  The in-flight bookkeeping is
+shared code: shard moves ride :class:`Migration` records keyed by the
+integer shard id in a serve-plane-owned Rebalancer instance.
+
 Failure handling follows the PR 3 discipline: an aborted migration puts its
 tile on a decorrelated-jitter cooldown (``delay = min(retry_max_s,
 uniform(retry_s, 3·last))``, reset on success) so a flapping destination
@@ -81,6 +89,7 @@ class Rebalancer:
         self.inflight: Dict[TileId, Migration] = {}
         self._seq = 0
         self._next_plan_at = 0.0
+        self._next_shard_plan_at = 0.0
         self._cooldown: Dict[TileId, float] = {}  # tile → not-before
         self._delay: Dict[TileId, float] = {}  # tile → last chosen backoff
 
@@ -101,11 +110,13 @@ class Rebalancer:
         self.inflight[tile] = mig
         return mig
 
-    def get(self, tile: TileId, seq: int) -> Optional[Migration]:
-        """The in-flight migration a MIGRATE_STATE answers, or None for a
-        stale/unknown (tile, seq) — a state frame from an already-aborted
-        attempt must be ignored, never committed."""
-        mig = self.inflight.get(tuple(tile))
+    def get(self, tile, seq: int) -> Optional[Migration]:
+        """The in-flight migration a MIGRATE_STATE / SHARD_STATE answers,
+        or None for a stale/unknown (key, seq) — a state frame from an
+        already-aborted attempt must be ignored, never committed.  Keys
+        are TileId tuples for tile moves, plain ints for shard moves."""
+        key = tuple(tile) if isinstance(tile, (list, tuple)) else tile
+        mig = self.inflight.get(key)
         return mig if mig is not None and mig.seq == seq else None
 
     def complete(self, tile: TileId) -> Optional[Migration]:
@@ -223,4 +234,107 @@ class Rebalancer:
                 loads[src.name] -= 1
                 loads[dest] += 1
                 budget -= 1
+        return moves
+
+    def plan_shards(
+        self,
+        owners: Dict[int, str],
+        weights: Dict[int, int],
+        members,
+        now: float,
+        drain_only: bool = False,
+    ) -> List[Tuple[int, str, str]]:
+        """(shard, source, dest) **session-shard** moves — the planner's
+        second resource type (the cluster-sharded serving plane; the serve
+        plane owns its own Rebalancer instance, so the in-flight budget
+        and cooldowns never contend with tile moves).
+
+        Same policy shape as :meth:`plan` with one deliberate difference:
+        load-driven spreading ignores ``rebalance_enabled``.  For tiles,
+        rebalancing is an optimization of a run that works anyway; for
+        serving, a worker with zero shards serves zero traffic — spreading
+        shards onto a late joiner IS how ``--grow-to`` buys boards/sec, so
+        it is product behavior, not tuning.  It stays cadenced by
+        ``interval_s`` and floored at a gap of 2 (a gap-1 shard move
+        ping-pongs exactly like a gap-1 tile move).  Drain-driven moves
+        come first and empty the drainer lightest-shards-first
+        (``weights`` = sessions per shard), so a draining worker is
+        released in the fewest protocol rounds blocked behind big
+        exports."""
+        moves: List[Tuple[int, str, str]] = []
+        # The in-flight budget bounds only LOADED shards (each move
+        # freezes sessions and runs the transfer protocol).  An EMPTY
+        # shard (weight 0) flips ownership with no wire traffic at all,
+        # so empties move budget-free — this is what lets a late joiner
+        # absorb half an idle cluster's shard table in one pass.
+        budget = self.max_inflight - len(self.inflight)
+        free_budget = len(owners)  # hard per-pass bound, not a resource
+        placeable = [m for m in members if m.alive and not m.draining]
+        if not placeable:
+            return moves
+        loads = {m.name: 0 for m in placeable}
+        for owner in owners.values():
+            if owner in loads:
+                loads[owner] += 1
+        for mig in self.inflight.values():
+            if mig.dest in loads:
+                loads[mig.dest] += 1
+            if mig.source in loads:
+                loads[mig.source] = max(0, loads[mig.source] - 1)
+        planned: set = set()
+
+        def movable(name: str) -> List[int]:
+            out = [
+                s
+                for s, o in owners.items()
+                if o == name
+                and s not in self.inflight
+                and s not in planned
+                and self._cooldown.get(s, 0.0) <= now
+            ]
+            out.sort(key=lambda s: (weights.get(s, 0), s))
+            return out
+
+        def charge(shard: int) -> bool:
+            nonlocal budget, free_budget
+            if weights.get(shard, 0) == 0:
+                if free_budget <= 0:
+                    return False
+                free_budget -= 1
+                return True
+            if budget <= 0:
+                return False
+            budget -= 1
+            return True
+
+        # 1. Drain-driven: always planned, every pass (lightest shards
+        # first, so the free empties flip out immediately).
+        for m in members:
+            if not (m.alive and m.draining):
+                continue
+            for shard in movable(m.name):
+                if not loads or not charge(shard):
+                    break
+                dest = min(loads, key=lambda n: loads[n])
+                moves.append((shard, m.name, dest))
+                planned.add(shard)
+                loads[dest] += 1
+
+        # 2. Load-driven spreading (shard-count gap ≥ 2), cadenced.
+        if not drain_only and now >= self._next_shard_plan_at:
+            self._next_shard_plan_at = now + self.interval_s
+            gap = max(2, self.min_gap)
+            while len(loads) >= 2:
+                src = max(placeable, key=lambda m: loads.get(m.name, 0))
+                dest = min(loads, key=lambda n: loads[n])
+                if dest == src.name or loads[src.name] - loads[dest] < gap:
+                    break
+                cands = movable(src.name)
+                if not cands or not charge(cands[0]):
+                    break
+                shard = cands[0]
+                moves.append((shard, src.name, dest))
+                planned.add(shard)
+                loads[src.name] -= 1
+                loads[dest] += 1
         return moves

@@ -86,6 +86,15 @@ class Channel:
         import threading
 
         self.sock = sock
+        try:
+            # Every frame is one sendall of a complete message; Nagle can
+            # only add latency here, never save bytes.  Decisive on the
+            # serve op plane, whose request/response frames are small and
+            # ping-pongy — without this, Nagle × delayed-ACK stalls every
+            # round trip by tens of ms once traffic fans across workers.
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except (OSError, ValueError):
+            pass  # non-TCP test doubles (socketpairs) don't support it
         self._rfile = sock.makefile("rb")
         self._wlock = threading.Lock()
         # Optional send deadline (seconds; 0 = block forever): a send into a

@@ -23,11 +23,29 @@ from akka_game_of_life_tpu.serve.sessions import (
 
 __all__ = [
     "AdmissionError",
+    "ClusterServePlane",
     "DEFAULT_SIZE_CLASSES",
+    "ServeWorkerPlane",
     "Session",
     "SessionRouter",
     "batch_step_fn",
     "board_routes",
     "run_serve",
+    "run_serve_cluster",
     "size_class",
 ]
+
+
+def __getattr__(name):
+    # The cluster-sharded plane imports runtime.frontend machinery; lazy
+    # so `import akka_game_of_life_tpu.serve` stays light for the
+    # single-process role.
+    if name in ("ClusterServePlane", "run_serve_cluster"):
+        from akka_game_of_life_tpu.serve import cluster as _c
+
+        return getattr(_c, name)
+    if name == "ServeWorkerPlane":
+        from akka_game_of_life_tpu.serve.worker import ServeWorkerPlane
+
+        return ServeWorkerPlane
+    raise AttributeError(name)

@@ -1,0 +1,1366 @@
+"""Cluster-sharded serving: the session router as the frontend's surface.
+
+PR 7's serving plane batches small boards on ONE process; PR 6's elastic
+cluster runs ONE big board across workers.  This module fuses them: the
+:class:`ClusterServePlane` is the cluster frontend's tenant-facing router —
+it owns cluster-wide admission and the session *index*, while the boards
+themselves live sharded across workers, each worker running its own
+vmapped batch engine (:mod:`serve.worker` wraps PR 7's ``SessionRouter``
+unchanged as the per-worker core).  Serve capacity then scales with
+``--grow-to``: boards/sec multiplies by worker count because every worker
+ticks its own device program concurrently.
+
+**Shard routing.**  Session ids hash onto ``serve_shards`` virtual shards
+(crc32, stable across processes); each shard is owned by one worker.  A
+session's whole life stays on its shard's owner — the board is resident
+worker-side between ticks (the Casper access-pattern lesson: move the
+session once, not its cells every tick), and ops for one worker coalesce
+into single ``SERVE_OPS`` frames (the PR 4 coalescing discipline on the
+control plane).
+
+**Shard migration.**  The PR 6 Rebalancer learns session shards as a
+second resource type (:meth:`runtime.rebalance.Rebalancer.plan_shards`):
+load-driven spreading (a late joiner starts receiving shards) and
+drain-driven evacuation ride the same freeze → transfer → certify →
+commit protocol as tile migration, at session granularity — every
+exported board is certified via ``digest_payload_np`` before commit, ops
+arriving mid-move are *held* and replayed at the new owner, and a
+mid-traffic SIGTERM drain loses zero admitted jobs.  A shard with no
+sessions flips ownership without any wire protocol.
+
+**Tiled (mega-board) sessions.**  A board above the largest size class is
+no longer rejected: it is admitted as a first-class *tiled* session on
+the existing halo/digest machinery — the frontend keeps the board, splits
+it into size-class-sided tiles, and each step fans ``step_raw`` chunks
+out across ALL workers (each tile ships with a k-wide toroidal halo, the
+worker steps k epochs, the halo absorbs wrap contamination, and the
+returned interior is exactly the global evolution).  Per-tile digest
+lanes computed at global offsets merge into the session digest — the
+same certification plane as the big-board cluster.  Worker crash
+mid-chunk just replays the pure chunk elsewhere.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from akka_game_of_life_tpu.obs import get_registry
+from akka_game_of_life_tpu.obs.tracing import get_tracer
+from akka_game_of_life_tpu.ops import digest as odigest
+from akka_game_of_life_tpu.runtime import protocol as P
+from akka_game_of_life_tpu.runtime.rebalance import Rebalancer
+from akka_game_of_life_tpu.runtime.wire import pack_tile, unpack_tile
+from akka_game_of_life_tpu.serve import batch as sbatch
+from akka_game_of_life_tpu.serve.sessions import (
+    JOB_GRACE_S,
+    JOB_TIMEOUT_S,
+    AdmissionError,
+    shard_of,
+    validate_create,
+)
+from akka_game_of_life_tpu.utils.patterns import random_grid
+
+# Bounded re-routes for one op (shard moved under it, worker lost before
+# the frame went out, worker answered "migrating"): each retry lands on a
+# live owner or fails loudly — never a silent drop, never a spin.
+OP_MAX_RETRIES = 4
+# Tile-chunk ops of a mega-board step are pure functions of their
+# operands: a worker loss mid-chunk replays the SAME chunk elsewhere.
+TILE_OP_RETRIES = 3
+
+
+class _Entry:
+    """Cluster-side session index row: where a session lives and the last
+    observed (epoch, digest) — the authoritative board stays worker-side
+    (or plane-side for tiled sessions)."""
+
+    __slots__ = (
+        "sid", "tenant", "kind", "rule_s", "height", "width",
+        "seed", "density", "shard", "epoch", "digest", "last_used",
+        "evicting",
+    )
+
+    def __init__(self, sid, tenant, kind, rule_s, height, width, seed,
+                 density, shard):
+        self.sid = sid
+        self.tenant = tenant
+        self.kind = kind  # "batch" | "tiled"
+        self.rule_s = rule_s
+        self.height = height
+        self.width = width
+        self.seed = seed
+        self.density = density
+        self.shard = shard  # None for tiled sessions (plane-resident)
+        self.epoch = 0
+        self.digest: Optional[str] = None
+        # TTL bookkeeping: the FRONTEND owns idle eviction in cluster mode
+        # (workers get serve_ttl_s=0 — a local eviction would silently
+        # leak the cluster admission budget this index charges).
+        self.last_used = time.monotonic()
+        self.evicting = False
+
+    def summary(self, owner: Optional[str]) -> dict:
+        return {
+            "id": self.sid,
+            "tenant": self.tenant,
+            "rule": self.rule_s,
+            "kind": self.kind,
+            "height": self.height,
+            "width": self.width,
+            "seed": self.seed,
+            "epoch": self.epoch,
+            "digest": self.digest,
+            "shard": self.shard,
+            "worker": owner,
+        }
+
+
+class _TiledSession:
+    """A frontend-resident mega-board and its tile grid."""
+
+    __slots__ = ("board", "lanes", "epoch", "tiles", "steplock")
+
+    def __init__(self, board: np.ndarray, tile_side: int) -> None:
+        self.board = board
+        self.lanes = odigest.digest_dense_np(board)
+        self.epoch = 0
+        h, w = board.shape
+        self.tiles: List[Tuple[int, int, int, int]] = [
+            (gy, gx, min(tile_side, h - gy), min(tile_side, w - gx))
+            for gy in range(0, h, tile_side)
+            for gx in range(0, w, tile_side)
+        ]
+        # Serializes concurrent steps of ONE mega session (each step is a
+        # multi-chunk read-modify-write of the resident board); different
+        # sessions step fully in parallel.
+        self.steplock = threading.Lock()
+
+
+class _Pending:
+    """One forwarded op awaiting its SERVE_RESULT (or internal callback)."""
+
+    __slots__ = (
+        "rid", "op", "sid", "shard", "kind", "member", "sent",
+        "retries", "event", "result", "error", "on_done",
+    )
+
+    def __init__(self, rid, op, *, sid=None, shard=None, kind="",
+                 member=None, on_done=None):
+        self.rid = rid
+        self.op = op
+        self.sid = sid
+        self.shard = shard
+        self.kind = kind
+        self.member = member  # None until routed; direct ops pre-target
+        self.sent = False
+        self.retries = 0
+        self.event = threading.Event()
+        self.result: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+        self.on_done = on_done
+
+
+class ClusterServePlane:
+    """The frontend's tenant-facing serve surface (SessionRouter-shaped:
+    ``BoardsRoute`` mounts it unchanged).  Thread layout: HTTP/caller
+    threads block on per-op events; one flusher thread coalesces queued
+    ops into per-worker SERVE_OPS frames; the frontend's reader threads
+    deliver results via :meth:`on_result`/:meth:`on_shard_state`; the
+    maintenance loop drives :meth:`poll`.
+
+    Lock discipline: ``self._lock`` (RLock) orders the shard table, the
+    session index, and the op queues.  NOTHING is sent on the wire while
+    it is held — sends go through the frontend's ``_safe_send``, whose
+    failure path takes the frontend lock (frontend lock → plane lock is
+    the only permitted nesting order)."""
+
+    def __init__(
+        self,
+        config,
+        membership,
+        send,
+        *,
+        registry=None,
+        tracer=None,
+        events=None,
+    ) -> None:
+        self.config = config
+        self.membership = membership
+        self._send_to = send  # callable(Member, dict); never under _lock
+        self.n_shards = int(config.serve_shards)
+        self.max_sessions = config.serve_max_sessions
+        self.max_cells = config.serve_max_cells
+        self.max_steps = config.serve_max_steps
+        self.size_classes = sbatch.parse_size_classes(
+            config.serve_size_classes
+        )
+        self.tile_side = self.size_classes[-1]
+        self.tile_chunk = max(1, int(config.serve_tile_chunk))
+        self.metrics = registry if registry is not None else get_registry()
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.events = events
+        self._m_rejects = self.metrics.counter(
+            "gol_serve_rejects_total", labelnames=("reason",)
+        )
+        self._m_shards = self.metrics.gauge(
+            "gol_serve_shards",
+            "Session shards owned, per serve worker",
+            ("member",),
+        )
+        self._m_shard_sessions = self.metrics.gauge(
+            "gol_serve_shard_sessions",
+            "Sessions resident, per serve worker",
+            ("member",),
+        )
+        self._m_wqueue = self.metrics.gauge(
+            "gol_serve_worker_queue_depth",
+            "Serve ops queued toward each worker (unsent + unanswered)",
+            ("member",),
+        )
+        self._m_ops = self.metrics.counter("gol_serve_ops_total")
+        self._m_frames = self.metrics.counter("gol_serve_op_frames_total")
+        self._m_migrations = self.metrics.counter(
+            "gol_serve_shard_migrations_total"
+        )
+        self._m_migration_aborts = self.metrics.counter(
+            "gol_serve_shard_migration_aborts_total"
+        )
+        self._m_tiled = self.metrics.gauge("gol_serve_tiled_sessions")
+        self._m_evictions = self.metrics.counter(
+            "gol_serve_session_evictions_total"
+        )
+        self.ttl_s = config.serve_ttl_s
+        self._m_digest_checks = self.metrics.counter("gol_digest_checks_total")
+        self._m_digest_mismatches = self.metrics.counter(
+            "gol_digest_mismatches_total"
+        )
+
+        # The elastic planner's second resource type rides a plane-owned
+        # Rebalancer: same policy/backoff machinery, zero contention with
+        # tile moves (budget and cooldowns are per-instance).
+        self.rebalancer = Rebalancer(config)
+
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._ids = itertools.count(1)
+        self._rids = itertools.count(1)
+        self._rr = itertools.count()  # tiled-chunk round-robin cursor
+        self.shard_owner: Dict[int, Optional[str]] = {  # graftlint: guarded-by _lock
+            s: None for s in range(self.n_shards)
+        }
+        self.sessions: Dict[str, _Entry] = {}  # graftlint: guarded-by _lock
+        self.tiled: Dict[str, _TiledSession] = {}  # graftlint: guarded-by _lock
+        self._cells = 0  # graftlint: guarded-by _lock
+        self._pending: Dict[int, _Pending] = {}  # graftlint: guarded-by _lock
+        self._outq: Dict[str, deque] = {}  # graftlint: guarded-by _lock
+        self._held: Dict[int, List[_Pending]] = {}  # graftlint: guarded-by _lock
+        self._draining = False  # graftlint: guarded-by _lock
+        self._stopped = False  # graftlint: guarded-by _lock
+        self._health_snapshot: Dict[str, dict] = {
+            "shards": {}, "sessions": {}, "queue_depths": {},
+        }
+        self._flusher = threading.Thread(
+            target=self._flush_loop, daemon=True, name="serve-flusher"
+        )
+        self._flusher.start()
+
+    # -- admission ------------------------------------------------------------
+
+    def _reject(self, reason: str, detail: str) -> None:
+        self._m_rejects.labels(reason=reason).inc()
+        raise AdmissionError(reason, detail)
+
+    def _admit_locked(self, height: int, width: int) -> None:
+        """Cluster-wide admission — the budget the frontend owns (worker
+        caps are only the backstop behind it)."""
+        if self._stopped:
+            raise RuntimeError("router is closed")
+        if self._draining:
+            self._reject("draining", "cluster serve plane is draining")
+        if not self.membership.alive_members():
+            self._reject(
+                "no_workers",
+                "no serve workers joined yet; retry once the cluster has "
+                "capacity",
+            )
+        if len(self.sessions) >= self.max_sessions:
+            self._reject(
+                "max_sessions",
+                f"cluster session cap {self.max_sessions} reached",
+            )
+        if self._cells + height * width > self.max_cells:
+            self._reject(
+                "max_cells",
+                f"cluster cell budget {self.max_cells} would be exceeded "
+                f"({self._cells} in use, {height * width} asked)",
+            )
+
+    # -- session lifecycle (the SessionRouter-shaped surface) -----------------
+
+    def create(
+        self,
+        tenant: str = "default",
+        rule="conway",
+        height: int = 64,
+        width: int = 64,
+        seed: int = 0,
+        density: float = 0.5,
+        with_board: bool = True,
+    ) -> dict:
+        tenant = str(tenant)
+        rule_r = validate_create(tenant, rule, height, width, density)
+        tiled = sbatch.size_class(height, width, self.size_classes) is None
+        with self._lock:
+            self._admit_locked(height, width)
+            sid = f"s{next(self._ids):08x}"
+            entry = _Entry(
+                sid, tenant, "tiled" if tiled else "batch",
+                rule_r.rulestring(), height, width, seed, density,
+                None if tiled else shard_of(sid, self.n_shards),
+            )
+            # Charged against the budget NOW — a racing create must not
+            # slip past the cap while this one's worker round-trip runs.
+            self.sessions[sid] = entry
+            self._cells += height * width
+        if tiled:
+            board = random_grid((height, width), density=density, seed=seed)
+            t = _TiledSession(board, self.tile_side)
+            with self._lock:
+                self.tiled[sid] = t
+                entry.digest = odigest.format_digest(odigest.value(t.lanes))
+                self._m_tiled.set(len(self.tiled))
+            doc = self._tiled_doc(sid, entry, t, with_board=with_board)
+            return doc
+        op = {
+            "op": "create", "rid": 0, "sid": sid, "tenant": tenant,
+            "rule": rule_r.rulestring(), "height": height, "width": width,
+            "seed": seed, "density": density,
+        }
+        p = None
+        try:
+            # Inside the try: a routing refusal (no_workers between the
+            # admission check and here) must refund the entry/budget just
+            # charged, not leak a ghost index row.
+            p = self._submit(op, sid=sid, shard=entry.shard, kind="create")
+            self._await(p)
+        except BaseException as e:
+            # A SENT create that timed out has an UNKNOWN outcome: the
+            # worker may still apply it after we refund the budget here.
+            # A compensating delete rides the same FIFO lane — it runs
+            # after the create if that applied (404s harmlessly if not),
+            # so the worker-local backstop can never leak orphan boards.
+            cleanup = p is not None and p.sent and isinstance(e, TimeoutError)
+            with self._lock:
+                if self.sessions.get(sid) is entry:
+                    del self.sessions[sid]
+                    self._cells -= height * width
+            if cleanup:
+                try:
+                    self._submit(
+                        {"op": "delete", "rid": 0, "sid": sid},
+                        sid=sid, shard=entry.shard, kind="evict",
+                        on_done=lambda _p: None,
+                    )
+                except Exception:  # noqa: BLE001 — best-effort compensation
+                    pass
+            raise
+        doc = dict(p.result["doc"])
+        with self._lock:
+            entry.epoch = int(doc.get("epoch", 0))
+            entry.digest = doc.get("digest")
+        return doc
+
+    def _tiled_doc(self, sid, entry, t, *, with_board: bool) -> dict:
+        doc = {
+            "id": sid,
+            "tenant": entry.tenant,
+            "rule": entry.rule_s,
+            "kind": "tiled",
+            "height": entry.height,
+            "width": entry.width,
+            "seed": entry.seed,
+            "epoch": t.epoch,
+            "population": int((t.board == 1).sum()),
+            "digest": odigest.format_digest(odigest.value(t.lanes)),
+            "tiles": len(t.tiles),
+        }
+        if with_board:
+            doc["board"] = t.board.copy()
+        return doc
+
+    def get(self, sid: str) -> dict:
+        with self._lock:
+            entry = self.sessions.get(sid)
+            if entry is None:
+                raise KeyError(sid)
+            entry.last_used = time.monotonic()
+            t = self.tiled.get(sid)
+        if t is not None:
+            with t.steplock:
+                return self._tiled_doc(sid, entry, t, with_board=True)
+        p = self._submit(
+            {"op": "get", "rid": 0, "sid": sid}, sid=sid,
+            shard=entry.shard, kind="get",
+        )
+        self._await(p)
+        doc = dict(p.result["doc"])
+        with self._lock:
+            entry.epoch = int(doc.get("epoch", entry.epoch))
+            entry.digest = doc.get("digest", entry.digest)
+        return doc
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return [
+                e.summary(
+                    None if e.shard is None else self.shard_owner.get(e.shard)
+                )
+                for e in self.sessions.values()
+            ]
+
+    def delete(self, sid: str) -> None:
+        with self._lock:
+            entry = self.sessions.get(sid)
+            if entry is None:
+                raise KeyError(sid)
+            if entry.kind == "tiled":
+                self.tiled.pop(sid, None)
+                del self.sessions[sid]
+                self._cells -= entry.height * entry.width
+                self._m_tiled.set(len(self.tiled))
+                return
+        p = self._submit(
+            {"op": "delete", "rid": 0, "sid": sid}, sid=sid,
+            shard=entry.shard, kind="delete",
+        )
+        self._await(p)
+        with self._lock:
+            if self.sessions.get(sid) is entry:
+                del self.sessions[sid]
+                self._cells -= entry.height * entry.width
+
+    def step(self, sid: str, steps: int = 1) -> Tuple[int, int]:
+        if steps < 1:
+            raise ValueError(f"steps {steps} must be >= 1")
+        with self._lock:
+            entry = self.sessions.get(sid)
+            if entry is None:
+                raise KeyError(sid)
+            if self._draining:
+                self._reject("draining", "cluster serve plane is draining")
+            entry.last_used = time.monotonic()
+            is_tiled = entry.kind == "tiled"
+        if is_tiled:
+            return self._step_tiled(sid, entry, steps)
+        p = self._submit(
+            {"op": "step", "rid": 0, "sid": sid, "steps": int(steps)},
+            sid=sid, shard=entry.shard, kind="step",
+        )
+        self._await(p, grace=True)
+        epoch, digest = int(p.result["epoch"]), int(p.result["digest"])
+        with self._lock:
+            if self.sessions.get(sid) is entry and epoch >= entry.epoch:
+                entry.epoch = epoch
+                entry.digest = odigest.format_digest(digest)
+        return epoch, digest
+
+    # -- op plumbing ----------------------------------------------------------
+
+    def _submit(self, op: dict, *, sid=None, shard=None, kind="",
+                member=None, on_done=None) -> _Pending:
+        with self._lock:
+            rid = next(self._rids)
+            op["rid"] = rid
+            p = _Pending(rid, op, sid=sid, shard=shard, kind=kind,
+                         member=member, on_done=on_done)
+            self._route_locked(p)
+            self._work.notify_all()
+        return p
+
+    def _route_locked(self, p: _Pending) -> None:
+        """Aim one op: direct-target ops go straight to their member's
+        queue; shard ops go to the shard's owner — or into the held list
+        while the shard is mid-migration (replayed at the new owner on
+        commit, at the old one on abort: zero admitted ops lost)."""
+        self._pending[p.rid] = p
+        if p.member is not None:
+            self._outq.setdefault(p.member, deque()).append(p)
+            return
+        if p.shard in self.rebalancer.inflight:
+            self._held.setdefault(p.shard, []).append(p)
+            return
+        owner = self.shard_owner.get(p.shard)
+        if owner is None:
+            owner = self._assign_shard_locked(p.shard)
+            if owner is None:
+                del self._pending[p.rid]
+                self._reject(
+                    "no_workers",
+                    "no serve workers available for this shard; retry",
+                )
+        p.member = owner
+        self._outq.setdefault(owner, deque()).append(p)
+
+    def _assign_shard_locked(self, shard: int) -> Optional[str]:
+        """First placement of an unowned (or orphaned-empty) shard: the
+        least-shard-loaded placeable member."""
+        members = self.membership.placeable_members() or (
+            self.membership.alive_members()
+        )
+        if not members:
+            return None
+        loads = {m.name: 0 for m in members}
+        for owner in self.shard_owner.values():
+            if owner in loads:
+                loads[owner] += 1
+        name = min(loads, key=lambda n: (loads[n], n))
+        self.shard_owner[shard] = name
+        return name
+
+    def _await(self, p: _Pending, *, grace: bool = False):
+        """Block the caller on its op with the PR 7 timeout contract: an
+        op cancelled UNSENT provably never ran (safe retry); a sent op
+        gets bounded grace, then reports with outcome unknown."""
+        if not p.event.wait(JOB_TIMEOUT_S):
+            with self._lock:
+                cancelled = False
+                if not p.sent and self._pending.pop(p.rid, None) is not None:
+                    q = self._outq.get(p.member)
+                    if q is not None and p in q:
+                        q.remove(p)
+                    held = self._held.get(p.shard)
+                    if held is not None and p in held:
+                        held.remove(p)
+                    cancelled = True
+            if cancelled:
+                raise TimeoutError(
+                    f"serve op for {p.sid} timed out unsent (cancelled; "
+                    f"not applied)"
+                )
+            if not p.event.wait(JOB_GRACE_S if grace else 1.0):
+                raise TimeoutError(
+                    f"serve op for {p.sid} timed out in flight on "
+                    f"{p.member}"
+                )
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _resolve(self, p: _Pending, *, result=None, error=None) -> None:
+        """Complete one op — caller must NOT hold the plane lock (the
+        callback path can send frames)."""
+        p.result = result
+        p.error = error
+        p.event.set()
+        if p.on_done is not None:
+            try:
+                p.on_done(p)
+            except Exception:  # noqa: BLE001 — internal-callback bug must not kill a reader thread
+                pass
+
+    @staticmethod
+    def _entry_error(entry: dict) -> BaseException:
+        kind = entry.get("err")
+        detail = str(entry.get("detail", ""))
+        if kind == "admission":
+            return AdmissionError(str(entry.get("reason", "unknown")), detail)
+        return {
+            "key": KeyError,
+            "value": ValueError,
+            "timeout": TimeoutError,
+        }.get(kind, RuntimeError)(detail)
+
+    # -- wire-in (frontend reader threads) ------------------------------------
+
+    def on_result(self, member_name: str, msg: dict) -> None:
+        for entry in msg.get("results", []):
+            try:
+                rid = int(entry["rid"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            with self._lock:
+                p = self._pending.pop(rid, None)
+            if p is None:
+                continue  # cancelled / already failed by member loss
+            if entry.get("ok"):
+                self._resolve(p, result=entry)
+                continue
+            err = self._entry_error(entry)
+            if (
+                isinstance(err, AdmissionError)
+                and err.reason == "migrating"
+                and p.retries < OP_MAX_RETRIES
+            ):
+                # The worker froze this shard before our frame arrived:
+                # re-route — the held list (or the post-commit owner)
+                # replays it, the tenant never sees the reason.  A
+                # re-route can itself refuse (last worker just died):
+                # that failure must resolve the op, never escape into
+                # the frontend's reader thread.
+                try:
+                    with self._lock:
+                        p.retries += 1
+                        p.sent = False
+                        p.member = None
+                        self._route_locked(p)
+                        self._work.notify_all()
+                    continue
+                except AdmissionError as e:
+                    err = e
+            self._resolve(p, error=err)
+
+    # -- the flusher (PR 4 coalescing, op-plane edition) ----------------------
+
+    def _enqueue_ctrl_locked(self, member: str, msg: dict) -> _Pending:
+        """Queue a raw control frame (SHARD_PREPARE/COMMIT/ABORT) through
+        the member's op lane — caller holds the lock.  EVERY shard-control
+        frame rides this one FIFO, which is the protocol's whole ordering
+        story: a create queued toward the old owner before a migration
+        began reaches it before the freeze; an abort can never overtake
+        its own prepare and leave sessions frozen forever; a ghost-cleanup
+        drop can never overtake the adopt it compensates."""
+        p = _Pending(0, msg, kind="ctrl", member=member)
+        self._outq.setdefault(member, deque()).append(p)
+        self._work.notify_all()
+        return p
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._stopped and not any(self._outq.values()):
+                    self._work.wait(timeout=0.25)
+                if self._stopped:
+                    return
+                batches: List[Tuple[str, List[_Pending]]] = []
+                for name, q in self._outq.items():
+                    if q:
+                        ops = list(q)
+                        q.clear()
+                        for p in ops:
+                            p.sent = True
+                        batches.append((name, ops))
+            for name, entries in batches:
+                m = self.membership.get(name)
+                if m is None or not m.alive:
+                    self._fail_worker_ops(
+                        name, [p for p in entries if p.kind != "ctrl"]
+                    )
+                    continue
+                # Coalesce runs of ops into SERVE_OPS frames, emitting
+                # interleaved ctrl frames in place so queue order IS wire
+                # order (the shard-prepare ordering guarantee).
+                run: List[_Pending] = []
+
+                def flush_run(member=m):
+                    if run:
+                        self._m_frames.inc()
+                        self._m_ops.inc(len(run))
+                        self._send_to(member, {
+                            "type": P.SERVE_OPS,
+                            "ops": [p.op for p in run],
+                        })
+                        run.clear()
+
+                for p in entries:
+                    if p.kind == "ctrl":
+                        flush_run()
+                        self._send_to(m, p.op)
+                    else:
+                        run.append(p)
+                flush_run()
+
+    def _reroute_unsent_locked(
+        self, p: _Pending, name: str
+    ) -> Optional[BaseException]:
+        """One UNSENT op aimed at dead ``name`` (caller holds the lock
+        and has popped it from ``_pending``): re-route what provably
+        never ran — creates re-hash to the shard's new owner, pure tile
+        chunks re-pick any worker — and return the error everything else
+        must resolve with (None = re-routed).  The ONE implementation of
+        this contract: the flusher's dead-member path and the membership
+        hook must not drift."""
+        if p.kind in ("create", "tile") and p.retries < OP_MAX_RETRIES:
+            p.retries += 1
+            p.sent = False
+            if p.kind == "tile":
+                p.member = self._pick_worker_locked()
+                if p.member is None:
+                    return AdmissionError(
+                        "no_workers", "no serve workers left"
+                    )
+            else:
+                p.member = None
+            try:
+                self._route_locked(p)
+                return None
+            except AdmissionError as e:
+                return e
+        return TimeoutError(
+            f"serve worker {name} lost before this op ran; retry"
+        )
+
+    def _fail_worker_ops(self, name: str, ops: List[_Pending]) -> None:
+        """Ops aimed at a member that died before the frame went out:
+        unsent work provably never ran — re-route what can move (creates,
+        tile chunks), fail the rest retryably."""
+        dead: List[Tuple[_Pending, BaseException]] = []
+        with self._lock:
+            for p in ops:
+                self._pending.pop(p.rid, None)
+                err = self._reroute_unsent_locked(p, name)
+                if err is not None:
+                    dead.append((p, err))
+            self._work.notify_all()
+        for p, err in dead:
+            self._resolve(p, error=err)
+
+    # -- membership hooks (called by the frontend) ----------------------------
+
+    def on_member_joined(self, name: str) -> None:
+        """A worker joined: claim any unowned shards for it (first worker
+        takes the whole table; later joiners receive shards through the
+        rebalancer — empty ones flip instantly, loaded ones migrate)."""
+        with self._lock:
+            if self._stopped:
+                return
+            unowned = [s for s, o in self.shard_owner.items() if o is None]
+            for shard in unowned:
+                self._assign_shard_locked(shard)
+        self._refresh_gauges()
+
+    def on_member_lost(self, name: str) -> None:
+        """A worker died: its resident sessions are gone (the serving
+        plane replicates nothing — honesty over magic).  Every in-flight
+        op gets an ANSWER (the never-silently-lost contract): sent ops
+        report unknown-outcome, unsent creates/tile-chunks replay
+        elsewhere, ops for dead sessions 404.  Its shards reassign empty
+        to survivors; migrations involving it roll back or — when the
+        certified state already left the source — complete anyway."""
+        resolutions: List[Tuple[_Pending, Optional[dict], Optional[BaseException]]] = []
+        aborts: List = []
+        with self._lock:
+            if self._stopped:
+                return  # teardown: member losses are expected, plane is done
+            doomed = self.rebalancer.drop_member(name)
+            for mig in doomed:
+                phase = getattr(mig, "phase", "prepare")
+                if mig.source == name and phase == "adopt":
+                    # The certified payload already left the dead source:
+                    # the in-flight adopt at the (live) dest completes the
+                    # move and the sessions SURVIVE their worker's death.
+                    continue
+                aborts.append((mig, "member_lost",
+                               mig.source != name, mig.source == name))
+            lost_shards = [
+                s for s, o in self.shard_owner.items()
+                if o == name and s not in self.rebalancer.inflight
+            ]
+            lost_sids = {
+                sid for sid, e in self.sessions.items()
+                if e.shard in lost_shards
+            }
+            for sid in lost_sids:
+                e = self.sessions.pop(sid)
+                self._cells -= e.height * e.width
+            for shard in lost_shards:
+                self.shard_owner[shard] = None
+                self._assign_shard_locked(shard)
+            for p in list(self._pending.values()):
+                if p.member != name:
+                    continue
+                self._pending.pop(p.rid, None)
+                if p.sent:
+                    resolutions.append((p, None, TimeoutError(
+                        f"serve worker {name} lost; op outcome unknown"
+                        + (" (session lost with it)" if p.sid in lost_sids
+                           else "")
+                    )))
+                elif p.sid in lost_sids:
+                    resolutions.append((p, None, KeyError(p.sid)))
+                else:
+                    q = self._outq.get(name)
+                    if q is not None and p in q:
+                        q.remove(p)
+                    err = self._reroute_unsent_locked(p, name)
+                    if err is not None:
+                        resolutions.append((p, None, err))
+            self._outq.pop(name, None)
+            self._work.notify_all()
+        for mig, reason, notify, lost in aborts:
+            self._abort_shard(mig, reason, source_alive=notify,
+                              sessions_lost=lost)
+        for p, result, error in resolutions:
+            self._resolve(p, result=result, error=error)
+        # Gauge reclaim, the heartbeat-age discipline: a dead member's
+        # series must read zero, not its last live value.
+        self._m_shards.labels(member=name).set(0)
+        self._m_shard_sessions.labels(member=name).set(0)
+        self._m_wqueue.labels(member=name).set(0)
+
+    def member_clear(self, name: str) -> bool:
+        """May a draining member be released?  Only once it owns no
+        shards, no migration involves it, and nothing is queued toward
+        it — the serve analog of 'owns no tiles'."""
+        with self._lock:
+            if any(o == name for o in self.shard_owner.values()):
+                return False
+            if any(
+                name in (m.source, m.dest)
+                for m in self.rebalancer.inflight.values()
+            ):
+                return False
+            q = self._outq.get(name)
+            if q:
+                return False
+            return not any(
+                p.member == name for p in self._pending.values()
+            )
+
+    # -- shard migration (frontend side) --------------------------------------
+
+    def poll(self, now: float, drain_only: bool = False) -> None:
+        """One maintenance pass: expire overdue moves, plan new ones
+        (drain evacuation always; load spreading cadenced), sweep the
+        idle-session TTL, refresh the per-worker gauges."""
+        with self._lock:
+            if self._stopped:
+                return
+            overdue = self.rebalancer.expired(now)
+        for mig in overdue:
+            self._abort_shard(mig, "deadline")
+        self._sweep_ttl(now)
+        with self._lock:
+            if self._stopped or self._draining:
+                self._refresh_gauges_locked()
+                return
+            members = self.membership.alive_members()
+            weights: Dict[int, int] = {}
+            for e in self.sessions.values():
+                if e.shard is not None:
+                    weights[e.shard] = weights.get(e.shard, 0) + 1
+            plans = self.rebalancer.plan_shards(
+                {s: o for s, o in self.shard_owner.items() if o is not None},
+                weights, members, now, drain_only=drain_only,
+            )
+            for shard, source, dest in plans:
+                sids = [
+                    sid for sid, e in self.sessions.items()
+                    if e.shard == shard
+                ]
+                busy = any(
+                    p.shard == shard for p in self._pending.values()
+                )
+                if not sids and not busy:
+                    # Empty shard: ownership flips without any protocol —
+                    # this is how a late joiner starts receiving shards
+                    # the moment the planner notices it.
+                    self.shard_owner[shard] = dest
+                    continue
+                mig = self.rebalancer.begin(shard, source, dest, now)
+                mig.phase = "prepare"
+                mig.sids = sids  # plan-time estimate; the WORKER's export
+                # is authoritative (it recomputes membership by hash when
+                # the prepare executes, after every earlier op frame)
+                mig.span = self.tracer.start(
+                    "serve.shard_migrate", node="frontend",
+                    shard=shard, source=source, dest=dest,
+                    sessions=len(sids),
+                )
+                # Queued through the source's op lane (NOT sent directly):
+                # wire order against already-routed ops is the correctness
+                # of the freeze — see _enqueue_ctrl_locked.
+                mig.prepare_pending = self._enqueue_ctrl_locked(source, {
+                    "type": P.SHARD_PREPARE, "shard": shard,
+                    "seq": mig.seq,
+                })
+            self._refresh_gauges_locked()
+
+    def _sweep_ttl(self, now: float) -> None:
+        """The cluster-wide idle-session TTL (workers run with ttl 0 —
+        eviction must retire the budget charged HERE, or idle sessions
+        would leak serve_max_cells forever).  Tiled sessions drop in
+        place; batch sessions retire through a real delete op so the
+        worker table and this index let go together."""
+        if self.ttl_s <= 0:
+            return
+        evict_ops: List[Tuple[str, int]] = []
+        with self._lock:
+            for sid, e in list(self.sessions.items()):
+                if (
+                    e.evicting
+                    or now - e.last_used <= self.ttl_s
+                    or (e.shard is not None
+                        and e.shard in self.rebalancer.inflight)
+                ):
+                    continue
+                if e.kind == "tiled":
+                    self.tiled.pop(sid, None)
+                    del self.sessions[sid]
+                    self._cells -= e.height * e.width
+                    self._m_tiled.set(len(self.tiled))
+                    self._m_evictions.inc()
+                else:
+                    e.evicting = True
+                    evict_ops.append((sid, e.shard))
+        for sid, shard in evict_ops:
+            try:
+                self._submit(
+                    {"op": "delete", "rid": 0, "sid": sid},
+                    sid=sid, shard=shard, kind="evict",
+                    on_done=lambda p, sid=sid: self._on_evicted(sid, p),
+                )
+            except (AdmissionError, KeyError, RuntimeError):
+                # No worker / plane closing: clear the mark so the next
+                # sweep retries instead of pinning the entry forever.
+                with self._lock:
+                    e = self.sessions.get(sid)
+                    if e is not None:
+                        e.evicting = False
+
+    def _on_evicted(self, sid: str, p: _Pending) -> None:
+        """An eviction delete answered.  Deleted (or already gone
+        worker-side) → release the index entry and its budget; any other
+        failure → unmark, the next sweep retries."""
+        with self._lock:
+            e = self.sessions.get(sid)
+            if e is None:
+                return
+            if p.error is None or isinstance(p.error, KeyError):
+                del self.sessions[sid]
+                self._cells -= e.height * e.width
+                self._m_evictions.inc()
+            else:
+                e.evicting = False
+
+    def on_shard_state(self, member_name: str, msg: dict) -> None:
+        """TRANSFER → CERTIFY → adopt-at-dest → COMMIT.  Exactly the tile
+        protocol's shape: every session payload re-derives its digest
+        lanes (``digest_payload_np``) before any ownership change; a
+        mismatch rolls back loudly and the source (which never dropped the
+        sessions) unfreezes."""
+        shard = int(msg["shard"])
+        seq = int(msg["seq"])
+        with self._lock:
+            mig = self.rebalancer.get(shard, seq)
+            if mig is None or mig.source != member_name:
+                return  # stale frame from an aborted attempt
+        if msg.get("error"):
+            self._abort_shard(mig, f"source: {msg['error']}")
+            return
+        payloads = msg.get("sessions", [])
+        # Certification OUTSIDE the lock: O(session bytes) per board.
+        for pay in payloads:
+            lanes = odigest.digest_payload_np(
+                pay["state"], (0, 0), int(pay["width"])
+            )
+            self._m_digest_checks.inc()
+            if [int(lanes[0]), int(lanes[1])] != [
+                int(v) for v in pay["digest"]
+            ]:
+                self._m_digest_mismatches.inc()
+                if self.events is not None:
+                    self.events.emit(
+                        "serve_shard_digest_mismatch",
+                        shard=shard, sid=pay.get("sid"), source=member_name,
+                    )
+                self._abort_shard(mig, "digest_mismatch")
+                return
+        with self._lock:
+            if self.rebalancer.get(shard, seq) is not mig:
+                return  # aborted while certifying
+            dest = self.membership.get(mig.dest)
+            if dest is None or not dest.alive:
+                dest = None
+            else:
+                mig.phase = "adopt"
+                mig.payload_sids = [p["sid"] for p in payloads]
+                # Submitted under the SAME lock acquisition that set the
+                # phase (RLock; _submit only enqueues): an abort racing
+                # this window must always see adopt_pending, or it could
+                # neither recall the adopt nor clean up after it.
+                mig.adopt_pending = self._submit(
+                    {"op": "adopt", "rid": 0, "sessions": payloads},
+                    kind="adopt", member=dest.name,
+                    on_done=lambda p, mig=mig: self._on_adopted(mig, p),
+                )
+        if dest is None:
+            self._abort_shard(mig, "dest_lost")
+            return
+
+    def _on_adopted(self, mig, p: _Pending) -> None:
+        if p.error is not None:
+            self._abort_shard(mig, f"adopt failed: {p.error!r}")
+            return
+        flush: List[_Pending] = []
+        with self._lock:
+            if self.rebalancer.get(mig.tile, mig.seq) is not mig:
+                return
+            self.rebalancer.complete(mig.tile)
+            self.shard_owner[mig.tile] = mig.dest
+            self._m_migrations.inc()
+            if mig.span is not None:
+                mig.span.set(outcome="commit").finish()
+                mig.span = None
+            src = self.membership.get(mig.source)
+            if src is not None and src.alive:
+                # Through the source's op lane, like the prepare: every
+                # shard-control frame for one worker rides ONE FIFO, so
+                # no control message can ever overtake another.
+                self._enqueue_ctrl_locked(mig.source, {
+                    "type": P.SHARD_COMMIT, "shard": mig.tile,
+                    "sids": getattr(mig, "payload_sids", mig.sids),
+                })
+            for held in self._held.pop(mig.tile, []):
+                held.member = mig.dest
+                held.sent = False
+                self._outq.setdefault(mig.dest, deque()).append(held)
+                flush.append(held)
+            self._work.notify_all()
+        if self.events is not None:
+            self.events.emit(
+                "serve_shard_migrated", shard=mig.tile,
+                source=mig.source, dest=mig.dest,
+                sessions=len(getattr(mig, "payload_sids", [])),
+                replayed_ops=len(flush),
+            )
+
+    def _abort_shard(
+        self, mig, reason: str, *, source_alive: bool = True,
+        sessions_lost: bool = False,
+    ) -> None:
+        """Roll a shard move back.  ``sessions_lost`` (dead source before
+        transfer): the shard's sessions died with their worker — index
+        entries release, held writes 404, held creates re-route."""
+        resolutions: List[Tuple[_Pending, BaseException]] = []
+        with self._lock:
+            if self.rebalancer.get(mig.tile, mig.seq) is not mig:
+                return
+            self.rebalancer.abort(mig.tile, time.monotonic())
+            self._m_migration_aborts.inc()
+            # An abort racing the adopt phase (deadline mid-install, dest
+            # flapping) must not strand GHOST session copies at the
+            # destination while the unfrozen source keeps serving: an
+            # adopt still in the queue is recalled; otherwise a drop of
+            # the same sids rides the dest's SAME op lane — the one FIFO
+            # guarantees it lands after the adopt whatever the flusher
+            # was doing when the abort fired (p.sent alone cannot tell:
+            # the flusher marks it before the frame is actually written).
+            ap = getattr(mig, "adopt_pending", None)
+            if ap is not None:
+                self._pending.pop(ap.rid, None)
+                q = self._outq.get(ap.member)
+                if q is not None and ap in q:
+                    q.remove(ap)
+                else:
+                    dst = self.membership.get(mig.dest)
+                    if dst is not None and dst.alive:
+                        self._enqueue_ctrl_locked(mig.dest, {
+                            "type": P.SHARD_COMMIT, "shard": mig.tile,
+                            "sids": getattr(mig, "payload_sids", mig.sids),
+                        })
+            if mig.span is not None:
+                mig.span.set(outcome="abort", reason=reason).finish()
+                mig.span = None
+            # A source that died mid-protocol means the shard's sessions
+            # died with it even when the CALLER didn't know that (e.g. the
+            # member-loss path let an in-flight adopt run on, and the
+            # adopt then failed): without this, shard_owner would point at
+            # the dead member forever — membership already evicted it, so
+            # nothing else would ever reassign the shard — wedging every
+            # future op for 1/serve_shards of the keyspace.
+            src_m = self.membership.get(mig.source)
+            lost = sessions_lost or src_m is None or not src_m.alive
+            if lost:
+                # Recomputed LIVE from the index (not the plan-time
+                # snapshot): a create that landed on the shard after the
+                # migration was planned died with the source too.
+                for sid in [
+                    s for s, e in self.sessions.items()
+                    if e.shard == mig.tile
+                ]:
+                    e = self.sessions.pop(sid)
+                    self._cells -= e.height * e.width
+                self.shard_owner[mig.tile] = None
+                self._assign_shard_locked(mig.tile)
+            held = self._held.pop(mig.tile, [])
+            for p in held:
+                if lost and p.kind != "create":
+                    self._pending.pop(p.rid, None)
+                    resolutions.append((p, KeyError(p.sid)))
+                else:
+                    # Replay at whoever owns the shard now (the unfrozen
+                    # source on a plain abort; a survivor on source loss).
+                    self._pending.pop(p.rid, None)
+                    p.sent = False
+                    p.member = None
+                    try:
+                        self._route_locked(p)
+                    except AdmissionError as e:
+                        resolutions.append((p, e))
+            if source_alive and not lost:
+                # A prepare still sitting in the queue is simply recalled
+                # (no freeze will ever happen); otherwise the abort rides
+                # the SAME lane, so it always lands after the freeze it
+                # undoes and the worker unfreezes the set IT froze.
+                pp = getattr(mig, "prepare_pending", None)
+                q = self._outq.get(mig.source)
+                if pp is not None and q is not None and pp in q:
+                    q.remove(pp)
+                else:
+                    self._enqueue_ctrl_locked(mig.source, {
+                        "type": P.SHARD_ABORT, "shard": mig.tile,
+                    })
+            self._work.notify_all()
+        if self.events is not None:
+            self.events.emit(
+                "serve_shard_migration_aborted", shard=mig.tile,
+                source=mig.source, dest=mig.dest, reason=reason,
+            )
+        for p, err in resolutions:
+            self._resolve(p, error=err)
+
+    # -- tiled (mega-board) sessions ------------------------------------------
+
+    def _pick_worker_locked(self) -> Optional[str]:
+        members = self.membership.placeable_members() or (
+            self.membership.alive_members()
+        )
+        if not members:
+            return None
+        names = sorted(m.name for m in members)
+        return names[next(self._rr) % len(names)]
+
+    def _step_tiled(self, sid: str, entry: _Entry, steps: int) -> Tuple[int, int]:
+        if steps > self.max_steps:
+            # No fast-forward lane for tiled sessions (their rules are the
+            # general totalistic family); the fairness bound stands.
+            self._reject(
+                "max_steps",
+                f"steps {steps} over serve_max_steps={self.max_steps} "
+                f"for a tiled session; chunk the request",
+            )
+        with self._lock:
+            t = self.tiled.get(sid)
+        if t is None:
+            raise KeyError(sid)
+        with t.steplock:
+            board = t.board
+            H, W = board.shape
+            remaining = steps
+            lanes_parts: List = []
+            while remaining > 0:
+                k = min(remaining, self.tile_chunk)
+                pends: List[_Pending] = []
+                for gy, gx, th, tw in t.tiles:
+                    rows = np.arange(gy - k, gy + th + k) % H
+                    cols = np.arange(gx - k, gx + tw + k) % W
+                    padded = np.ascontiguousarray(board[np.ix_(rows, cols)])
+                    with self._lock:
+                        member = self._pick_worker_locked()
+                    if member is None:
+                        self._reject(
+                            "no_workers",
+                            "no serve workers available for tile chunks",
+                        )
+                    pends.append(self._submit(
+                        {
+                            "op": "step_raw", "rid": 0, "rule": entry.rule_s,
+                            "k": int(k), "state": pack_tile(padded),
+                            "origin": [int(gy), int(gx)], "width": int(W),
+                            "interior": [int(k), int(k + th), int(k),
+                                         int(k + tw)],
+                        },
+                        sid=sid, kind="tile", member=member,
+                    ))
+                # ALL chunk results land before ANY tile scatters: a
+                # failure mid-chunk (worker losses exhausting the retry
+                # budget) must leave the board wholly at its pre-chunk
+                # epoch — a half-scattered board would mix epochs and
+                # serve silently corrupt state with a fresh digest.
+                results = [self._await_tile(p) for p in pends]
+                lanes_parts = []
+                for result, (gy, gx, th, tw) in zip(results, t.tiles):
+                    board[gy:gy + th, gx:gx + tw] = unpack_tile(
+                        result["state"]
+                    )
+                    lanes_parts.append(
+                        [int(result["digest"][0]), int(result["digest"][1])]
+                    )
+                remaining -= k
+                t.epoch += k
+                # Per ROUND, not after the loop: a later round's failure
+                # leaves the board legitimately advanced to THIS round's
+                # epoch, and the stored lanes must describe that state —
+                # a stale digest on the certification surface is worse
+                # than a partial step.
+                t.lanes = odigest.merge_lanes(lanes_parts)
+            epoch, digest = t.epoch, odigest.value(t.lanes)
+        with self._lock:
+            if self.sessions.get(sid) is entry:
+                entry.epoch = epoch
+                entry.digest = odigest.format_digest(digest)
+        return epoch, digest
+
+    def _await_tile(self, p: _Pending) -> dict:
+        """Wait one pure tile chunk out; a worker loss just replays it on
+        another worker (the op is a function of its operands — nothing to
+        lose)."""
+        last: Optional[BaseException] = None
+        for _ in range(TILE_OP_RETRIES):
+            try:
+                return self._await(p)
+            except (TimeoutError, RuntimeError) as e:
+                last = e
+                with self._lock:
+                    member = self._pick_worker_locked()
+                if member is None:
+                    break
+                op = dict(p.op)
+                p = self._submit(op, sid=p.sid, kind="tile", member=member)
+        raise last if last is not None else RuntimeError("tile chunk failed")
+
+    # -- stats / health / lifecycle -------------------------------------------
+
+    def _refresh_gauges_locked(self) -> None:
+        shards: Dict[str, int] = {}
+        for owner in self.shard_owner.values():
+            if owner is not None:
+                shards[owner] = shards.get(owner, 0) + 1
+        sess: Dict[str, int] = {}
+        for e in self.sessions.values():
+            if e.shard is not None:
+                owner = self.shard_owner.get(e.shard)
+                if owner is not None:
+                    sess[owner] = sess.get(owner, 0) + 1
+        queues: Dict[str, int] = {}
+        for p in self._pending.values():
+            if p.member is not None:
+                queues[p.member] = queues.get(p.member, 0) + 1
+        for m in self.membership.alive_members():
+            self._m_shards.labels(member=m.name).set(shards.get(m.name, 0))
+            self._m_shard_sessions.labels(member=m.name).set(
+                sess.get(m.name, 0)
+            )
+            self._m_wqueue.labels(member=m.name).set(queues.get(m.name, 0))
+        self._health_snapshot = {
+            "shards": shards, "sessions": sess, "queue_depths": queues,
+        }
+
+    def _refresh_gauges(self) -> None:
+        with self._lock:
+            self._refresh_gauges_locked()
+
+    def health(self) -> dict:
+        """The /healthz contribution: per-worker session-shard counts and
+        queue depths (the migrations_inflight shape, serve edition)."""
+        with self._lock:
+            self._refresh_gauges_locked()
+            snap = self._health_snapshot
+            return {
+                "sessions": len(self.sessions),
+                "cells": self._cells,
+                "tiled_sessions": len(self.tiled),
+                "shards_total": self.n_shards,
+                "shards_by_worker": dict(snap["shards"]),
+                "sessions_by_worker": dict(snap["sessions"]),
+                "queue_depth_by_worker": dict(snap["queue_depths"]),
+                "shard_migrations_inflight": len(self.rebalancer.inflight),
+                "held_ops": sum(len(v) for v in self._held.values()),
+                "draining": self._draining,
+            }
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "sessions": len(self.sessions),
+                "cells": self._cells,
+                "queue_depth": len(self._pending),
+                "max_sessions": self.max_sessions,
+                "max_cells": self.max_cells,
+                "size_classes": list(self.size_classes),
+                "shards": self.n_shards,
+                "workers": len(self.membership.alive_members()),
+            }
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Refuse NEW work, run the in-flight ops dry — the plane half of
+        a graceful shutdown (worker drains are the per-member story; this
+        is whole-service SIGTERM)."""
+        with self._lock:
+            self._draining = True
+        deadline = time.monotonic() + timeout  # graftlint: waive GL-HAZ04 -- real-time bound pairs with the real sleep pacing below; shutdown must stay bounded
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._pending:
+                    return True
+            time.sleep(0.05)
+        return False
+
+    def close(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            doomed = list(self._pending.values())
+            self._pending.clear()
+            self._outq.clear()
+            self._held.clear()
+            self._work.notify_all()
+        for p in doomed:
+            self._resolve(p, error=RuntimeError("router is closed"))
+        self._flusher.join(timeout=5)
+
+
+def run_serve_cluster(config, *, min_backends: int = 1) -> int:
+    """The ``serve --serve-cluster on`` role body: a serve-only cluster
+    frontend — workers join like any cluster (``backend`` role), the
+    tenant surface rides the obs endpoint, and SIGTERM drains."""
+    from akka_game_of_life_tpu.runtime.frontend import Frontend
+    from akka_game_of_life_tpu.runtime.signals import mask_interrupts
+
+    fe = Frontend(config, min_backends=min_backends)
+    fe.start()
+    print(
+        f"serve frontend listening on {config.host}:{fe.port} "
+        f"({config.serve_shards} shards)",
+        flush=True,
+    )
+    try:
+        if not fe.wait_for_backends():
+            print(
+                f"error: only {len(fe.membership.alive_members())} of "
+                f"{min_backends} backends joined within "
+                f"{config.wait_for_backends_s}s",
+                flush=True,
+            )
+            fe.stop()
+            return 1
+        port = fe._metrics_server.port if fe._metrics_server else None
+        print(
+            f"cluster serving /boards (+/metrics,/healthz,/trace) on "
+            f":{port} — {fe.serve_plane.max_sessions} sessions / "
+            f"{fe.serve_plane.max_cells} cells cluster-wide, "
+            f"{len(fe.membership.alive_members())} worker(s)",
+            flush=True,
+        )
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("serve: interrupted; draining", flush=True)
+        drained = fe.serve_plane.drain()
+        print(
+            "serve: drained" if drained
+            else "serve: drain timed out; aborting pending ops",
+            flush=True,
+        )
+        with mask_interrupts():
+            fe.stop()
+        return 130
+    fe.stop()
+    return 0

@@ -37,7 +37,7 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -45,6 +45,7 @@ from akka_game_of_life_tpu.obs import get_registry
 from akka_game_of_life_tpu.obs.tracing import get_tracer
 from akka_game_of_life_tpu.ops import digest as odigest, fastforward
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
+from akka_game_of_life_tpu.runtime.wire import pack_tile, unpack_tile
 from akka_game_of_life_tpu.serve import batch as sbatch
 from akka_game_of_life_tpu.utils.patterns import random_grid
 
@@ -80,11 +81,48 @@ class AdmissionError(Exception):
     machine-readable: ``max_sessions`` | ``max_cells`` | ``queue_full`` |
     ``draining`` | ``max_steps`` (a step request beyond ``serve_max_steps``
     for a session whose rule cannot fast-forward — linear-rule sessions
-    bypass the bound via the O(log T) fast path instead)."""
+    bypass the bound via the O(log T) fast path instead) | ``migrating``
+    (the session's shard is mid-migration on the cluster plane — always
+    retryable; the cluster frontend holds such ops and replays them at the
+    shard's new owner, so tenants never see this reason)."""
 
     def __init__(self, reason: str, detail: str) -> None:
         super().__init__(detail)
         self.reason = reason
+
+
+def shard_of(sid: str, n_shards: int) -> int:
+    """Stable session-shard hash (crc32 — identical across processes and
+    restarts).  Lives here because BOTH halves of the cluster serve plane
+    route by it: the frontend picks owners, and a worker answering
+    SHARD_PREPARE recomputes its OWN resident membership for the shard
+    (the authoritative freeze set — a frontend-snapshotted sid list could
+    miss a create that was in flight when the migration was planned)."""
+    import zlib
+
+    return zlib.crc32(sid.encode("utf-8")) % n_shards
+
+
+def validate_create(tenant, rule, height: int, width: int, density: float):
+    """Shared create-request validation (raises ValueError, the HTTP
+    400); returns the resolved Rule.  ONE implementation on purpose: the
+    single-process router and the cluster plane must accept exactly the
+    same requests, or the two surfaces drift."""
+    tenant = str(tenant)
+    if not tenant or len(tenant) > _TENANT_MAX or not (
+        set(tenant) <= _TENANT_OK
+    ):
+        raise ValueError(
+            f"tenant must be 1..{_TENANT_MAX} chars of [A-Za-z0-9._:-] "
+            f"(it labels metrics), got {tenant!r}"
+        )
+    rule_r = resolve_rule(rule)
+    sbatch.rule_operands(rule_r)  # totalistic-only; raises ValueError
+    if height < 1 or width < 1:
+        raise ValueError(f"board must be positive, got {height}x{width}")
+    if not (0.0 <= density <= 1.0):
+        raise ValueError(f"density {density} must be in [0, 1]")
+    return rule_r
 
 
 @dataclasses.dataclass
@@ -139,6 +177,11 @@ class _Job:
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     result: Optional[Tuple[int, int]] = None  # (epoch, digest)
     error: Optional[BaseException] = None
+    # Completion callback for async submitters (the cluster serve worker
+    # plane coalesces results back onto the wire instead of blocking a
+    # thread per job).  Fired exactly once, after result/error is set and
+    # ``done`` fires, never under the router lock.
+    on_done: Optional[Callable[["_Job"], None]] = None
 
 
 class SessionRouter:
@@ -196,6 +239,9 @@ class SessionRouter:
         )
         self._m_queue = self.metrics.gauge("gol_serve_queue_depth")
         self._m_ff = self.metrics.counter("gol_serve_ff_jumps_total")
+        self._m_ff_retries = self.metrics.counter(
+            "gol_serve_ff_jump_retries_total"
+        )
         self._m_digest_mismatch = self.metrics.counter(
             "gol_digest_mismatches_total"
         )
@@ -211,12 +257,28 @@ class SessionRouter:
         self._m_tick = self.metrics.histogram("gol_serve_tick_seconds")
         self._m_req = self.metrics.histogram("gol_serve_step_seconds")
 
+        # Drill hook (None in production): called between a fast-forward
+        # jump's compute and its commit attempt, so tests can provoke the
+        # optimistic-commit retry deterministically (pause the ticker, queue
+        # a batch job, let it land inside this window — the blocked-batch
+        # drill that certifies gol_serve_ff_jump_retries_total).
+        self._drill_ff_precommit: Optional[Callable[[], None]] = None
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._sessions: Dict[str, Session] = {}  # graftlint: guarded-by _lock
         self._cells = 0  # graftlint: guarded-by _lock
         self._queue: deque = deque()  # graftlint: guarded-by _lock
         self._ids = itertools.count(1)
+        # Sessions frozen by an in-flight shard migration: present (GETs
+        # still answer) but refusing writes with the retryable "migrating"
+        # reason, exempt from TTL eviction, until commit drops them or
+        # abort unfreezes them.
+        self._frozen: set = set()  # graftlint: guarded-by _lock
+        # sids of jobs the ticker has taken for the CURRENT batch (between
+        # queue drain and scatter-back) — what wait_idle must see beyond
+        # the queue, or an export could snapshot a board whose in-flight
+        # write-back lands after the transfer and is silently lost.
+        self._inflight_sids: set = set()  # graftlint: guarded-by _lock
         self._paused = False  # graftlint: guarded-by _lock
         self._draining = False  # graftlint: guarded-by _lock
         self._stopped = False  # graftlint: guarded-by _lock
@@ -236,26 +298,17 @@ class SessionRouter:
         seed: int = 0,
         density: float = 0.5,
         with_board: bool = True,
+        sid: Optional[str] = None,
     ) -> dict:
         """Admit a new session and seed its board.  Raises ValueError for a
         malformed request (the HTTP 400), AdmissionError when a capacity
         cap refuses it (the HTTP 429).  ``with_board=False`` skips the
         returned doc's O(h·w) board copy — the HTTP 201 deliberately
-        carries no cells."""
+        carries no cells.  ``sid`` overrides the locally minted id: the
+        cluster frontend allocates ids itself (the id's hash picks the
+        shard, so the router must honor the id that routed here)."""
         tenant = str(tenant)
-        if not tenant or len(tenant) > _TENANT_MAX or not (
-            set(tenant) <= _TENANT_OK
-        ):
-            raise ValueError(
-                f"tenant must be 1..{_TENANT_MAX} chars of [A-Za-z0-9._:-] "
-                f"(it labels metrics), got {tenant!r}"
-            )
-        rule = resolve_rule(rule)
-        sbatch.rule_operands(rule)  # totalistic-only; raises ValueError
-        if height < 1 or width < 1:
-            raise ValueError(f"board must be positive, got {height}x{width}")
-        if not (0.0 <= density <= 1.0):
-            raise ValueError(f"density {density} must be in [0, 1]")
+        rule = validate_create(tenant, rule, height, width, density)
         if sbatch.size_class(height, width, self.size_classes) is None:
             raise ValueError(
                 f"board {height}x{width} exceeds the largest size class "
@@ -273,9 +326,11 @@ class SessionRouter:
         population = int((board == 1).sum())
         with self._lock:
             self._admit_locked(height, width)
+            if sid is not None and sid in self._sessions:
+                raise ValueError(f"session id {sid!r} already exists")
             now = self._clock()
             sess = Session(
-                sid=f"b{next(self._ids):08x}",
+                sid=sid if sid is not None else f"b{next(self._ids):08x}",
                 tenant=tenant,
                 rule=rule,
                 height=height,
@@ -315,6 +370,14 @@ class SessionRouter:
 
     def delete(self, sid: str) -> None:
         with self._lock:
+            if sid in self._frozen:
+                # A delete that raced a shard migration: the authoritative
+                # copy is in flight — the cluster plane retries it at the
+                # shard's post-commit owner.
+                self._reject(
+                    "migrating",
+                    f"session {sid} is mid-shard-migration; retry",
+                )
             self._drop_locked(sid, evicted=False)
 
     def _drop_locked(self, sid: str, *, evicted: bool) -> None:
@@ -363,17 +426,24 @@ class SessionRouter:
 
     # -- stepping ------------------------------------------------------------
 
-    def step(self, sid: str, steps: int = 1) -> Tuple[int, int]:
-        """Advance a session by ``steps`` epochs; blocks until the batch
-        that carried the job lands.  Returns (epoch, digest).  Raises
-        KeyError (404), ValueError (400), AdmissionError (429).
+    def submit(
+        self,
+        sid: str,
+        steps: int = 1,
+        on_done: Optional[Callable[[_Job], None]] = None,
+    ) -> _Job:
+        """Admit one step request and return its job handle WITHOUT
+        blocking on the result — the async half of :meth:`step`.  The
+        cluster serve worker plane submits every step of a coalesced
+        SERVE_OPS frame this way and lets ``on_done`` route completions
+        back onto the wire instead of parking one thread per job.
 
-        ``steps`` beyond ``serve_max_steps`` is an *admission* question,
-        not a validity one: an XOR-linear rule session takes the O(log T)
-        fast-forward path (``ops/fastforward.py`` — answers n=1,000,000
-        in milliseconds instead of queueing 10⁶ ticks), everything else
-        is refused 429 ``max_steps`` so one giant request can never
-        monopolize the ticker for every other tenant."""
+        Admission refusals (AdmissionError/KeyError/ValueError/
+        RuntimeError) raise synchronously — the request never became a
+        job.  An over-bound linear-rule request runs the O(log T)
+        fast-forward path INLINE on the calling thread (milliseconds on
+        serve-class boards) and returns an already-completed job whose
+        ``error`` carries any jump failure."""
         if steps < 1:
             raise ValueError(f"steps {steps} must be >= 1")
         if int(steps).bit_length() > fastforward.MAX_SPAN_BITS:
@@ -384,8 +454,6 @@ class SessionRouter:
                 f"steps {steps} exceeds the fast-forward span ceiling "
                 f"(2^{fastforward.MAX_SPAN_BITS})"
             )
-        t0 = time.perf_counter()
-        job = None
         with self._lock:
             if self._stopped:
                 # The ticker is gone: enqueueing would strand the caller
@@ -396,6 +464,11 @@ class SessionRouter:
                 # Looked up BEFORE the drain gate: an unknown id is a
                 # terminal 404, not a retryable 429.
                 raise KeyError(sid)
+            if sid in self._frozen:
+                self._reject(
+                    "migrating",
+                    f"session {sid} is mid-shard-migration; retry",
+                )
             if self._draining:
                 self._reject("draining", "router is draining for shutdown")
             fast = steps > self.max_steps
@@ -421,26 +494,43 @@ class SessionRouter:
                         f"step queue depth {self.queue_depth} reached",
                     )
                 sess.last_used = self._clock()
-                job = _Job(sid=sid, steps=steps)
+                job = _Job(sid=sid, steps=steps, on_done=on_done)
                 self._queue.append(job)
                 self._m_queue.set(len(self._queue))
                 self._wake.notify_all()
-        if fast:
-            if not self._ff_slots.acquire(blocking=False):
-                # The fast path's own admission bound: it bypasses the
-                # ticker queue, so queue_depth cannot bound it — the
-                # slot cap does, with the same retryable 429 contract.
-                self._reject(
-                    "queue_full",
-                    f"fast-forward concurrency bound "
-                    f"({FF_MAX_CONCURRENT}) reached; retry",
-                )
-            try:
-                result = self._fast_forward_step(sess, steps)
-            finally:
-                self._ff_slots.release()
-            self._m_req.observe(time.perf_counter() - t0)
-            return result
+                return job
+        # Fast path, inline: bypasses the ticker queue, so queue_depth
+        # cannot bound it — the slot cap does, with the same retryable
+        # 429 contract.
+        if not self._ff_slots.acquire(blocking=False):
+            self._reject(
+                "queue_full",
+                f"fast-forward concurrency bound "
+                f"({FF_MAX_CONCURRENT}) reached; retry",
+            )
+        job = _Job(sid=sid, steps=steps, on_done=on_done)
+        try:
+            job.result = self._fast_forward_step(sess, steps)
+        except BaseException as e:  # noqa: BLE001 — carried to the waiter
+            job.error = e
+        finally:
+            self._ff_slots.release()
+        self._finish(job)
+        return job
+
+    def step(self, sid: str, steps: int = 1) -> Tuple[int, int]:
+        """Advance a session by ``steps`` epochs; blocks until the batch
+        that carried the job lands.  Returns (epoch, digest).  Raises
+        KeyError (404), ValueError (400), AdmissionError (429).
+
+        ``steps`` beyond ``serve_max_steps`` is an *admission* question,
+        not a validity one: an XOR-linear rule session takes the O(log T)
+        fast-forward path (``ops/fastforward.py`` — answers n=1,000,000
+        in milliseconds instead of queueing 10⁶ ticks), everything else
+        is refused 429 ``max_steps`` so one giant request can never
+        monopolize the ticker for every other tenant."""
+        t0 = time.perf_counter()
+        job = self.submit(sid, steps)
         if not job.done.wait(JOB_TIMEOUT_S):
             with self._lock:
                 try:
@@ -465,6 +555,18 @@ class SessionRouter:
             raise job.error
         self._m_req.observe(time.perf_counter() - t0)
         return job.result
+
+    def _finish(self, job: _Job) -> None:
+        """Fire a job's completion — the done event, then the async
+        callback.  Called with result/error already assigned and NEVER
+        under the router lock (callbacks enqueue wire replies and must not
+        serialize behind, or deadlock against, table operations)."""
+        job.done.set()
+        if job.on_done is not None:
+            try:
+                job.on_done(job)
+            except Exception:  # noqa: BLE001 — a callback bug must not kill the ticker
+                pass
 
     def _fast_forward_step(self, sess: Session, steps: int) -> Tuple[int, int]:
         """The linear-rule fast path: jump ``steps`` epochs in O(log steps)
@@ -499,6 +601,12 @@ class SessionRouter:
             out = fastforward.fast_forward_np(board0, sess.rule, steps)
             lanes = odigest.digest_dense_np(out)
             population = int((out == 1).sum())
+            hook = self._drill_ff_precommit
+            if hook is not None:
+                # Deterministic interleave point for the retry drill: a
+                # test parks here while a blocked batch's scatter-back
+                # lands, then observes the commit race below.
+                hook()
             with self._lock:
                 if self._sessions.get(sess.sid) is not sess:
                     # Deleted mid-jump: the client still gets its result;
@@ -515,7 +623,10 @@ class SessionRouter:
                     self._m_ff.inc()
                     return sess.epoch, odigest.value(lanes)
             # A batch write-back raced the commit: loop and recompute
-            # from the session's new state.
+            # from the session's new state.  Counted so the (rare, bounded)
+            # recompute-on-race residue of the optimistic commit is
+            # observable in production, not just documented.
+            self._m_ff_retries.inc()
         raise TimeoutError(
             f"fast-forward for {sess.sid} kept losing the commit race to "
             f"batched step jobs; retry"
@@ -535,6 +646,116 @@ class SessionRouter:
             self._paused = False
             self._wake.notify_all()
 
+    # -- shard migration (the cluster serve plane's worker half) -------------
+
+    def freeze_sessions(self, sids) -> None:
+        """Freeze sessions for an in-flight shard migration: writes refuse
+        with the retryable ``migrating`` reason, TTL eviction skips them,
+        reads still answer.  Unknown ids are ignored (already evicted —
+        the export simply ships fewer sessions)."""
+        with self._lock:
+            self._frozen.update(s for s in sids if s in self._sessions)
+
+    def wait_idle(self, sids, timeout: float = 10.0) -> bool:
+        """The freeze barrier: block until no queued OR in-flight job
+        references ``sids`` — admitted jobs complete (their write-backs
+        belong in the exported state), new ones are already refused.
+        Bounded by REAL time like :meth:`drain`, and for the same reason."""
+        sids = set(sids)
+        deadline = time.monotonic() + timeout  # graftlint: waive GL-HAZ04 -- pairs with the real time.sleep pacing below; a frozen injected test clock must not unbound migration
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = self._inflight_sids | {j.sid for j in self._queue}
+                if not (busy & sids):
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def export_sessions(self, sids) -> List[dict]:
+        """Snapshot sessions as self-contained wire payloads (``pack_tile``
+        boards + digest lanes) — the TRANSFER half of a shard migration.
+        Boards pack OUTSIDE the lock: writers only ever replace board
+        references, and the sessions are frozen anyway."""
+        with self._lock:
+            rows = [
+                (s, s.board, s.lanes)
+                for s in (self._sessions.get(sid) for sid in sids)
+                if s is not None
+            ]
+        return [
+            {
+                "sid": sess.sid,
+                "tenant": sess.tenant,
+                "rule": sess.rule.rulestring(),
+                "height": sess.height,
+                "width": sess.width,
+                "seed": sess.seed,
+                "density": sess.density,
+                "epoch": sess.epoch,
+                "population": sess.population,
+                "state": pack_tile(board),
+                "digest": [int(lanes[0]), int(lanes[1])],
+            }
+            for sess, board, lanes in rows
+        ]
+
+    def unfreeze_sessions(self, sids) -> None:
+        """Roll a shard migration back: the sessions never left."""
+        with self._lock:
+            self._frozen.difference_update(sids)
+
+    def drop_sessions(self, sids) -> None:
+        """COMMIT: the shard's sessions now live on the destination —
+        release them here (cells/gauges/tenant children), not as
+        evictions."""
+        with self._lock:
+            for sid in sids:
+                self._frozen.discard(sid)
+                if sid in self._sessions:
+                    self._drop_locked(sid, evicted=False)
+
+    def import_sessions(self, payloads: List[dict]) -> None:
+        """Install migrated sessions (the destination half of a shard
+        move).  Deliberately bypasses the admission caps: cluster-wide
+        admission is the frontend's budget, already charged when these
+        sessions were created — a move must never bounce off the local
+        backstop while both copies transiently exist."""
+        rows = []
+        for p in payloads:
+            board = unpack_tile(p["state"])
+            lanes = np.asarray(
+                [int(p["digest"][0]), int(p["digest"][1])], dtype=np.uint32
+            )
+            rows.append((p, board, lanes, int((board == 1).sum())))
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError("router is closed")
+            now = self._clock()
+            for p, board, lanes, pop in rows:
+                if p["sid"] in self._sessions:
+                    # A re-delivered adopt (frontend retry): replace, never
+                    # double-count.
+                    self._drop_locked(p["sid"], evicted=False)
+                sess = Session(
+                    sid=p["sid"],
+                    tenant=p["tenant"],
+                    rule=resolve_rule(p["rule"]),
+                    height=int(p["height"]),
+                    width=int(p["width"]),
+                    seed=int(p["seed"]),
+                    density=float(p["density"]),
+                    board=board,
+                    lanes=lanes,
+                    population=int(p.get("population", pop)),
+                    epoch=int(p["epoch"]),
+                    created=now,
+                    last_used=now,
+                )
+                self._sessions[sess.sid] = sess
+                self._cells += sess.height * sess.width
+                self._m_cells.set(self._cells)
+                self._m_sessions.labels(tenant=sess.tenant).inc()
+
     # -- the tick loop -------------------------------------------------------
 
     def _tick_loop(self) -> None:
@@ -548,35 +769,52 @@ class SessionRouter:
                     if not self._paused:
                         self._evict_idle_locked()
                 if self._stopped:
-                    self._fail_pending_locked(RuntimeError("router closed"))
-                    return
-                # Sweep here too: a router under sustained load never
-                # sits in the idle wait above.
-                self._evict_idle_locked()
-                taken = self._take_jobs_locked()
+                    failed = self._fail_pending_locked(
+                        RuntimeError("router closed")
+                    )
+                    taken = None
+                else:
+                    # Sweep here too: a router under sustained load never
+                    # sits in the idle wait above.
+                    self._evict_idle_locked()
+                    taken, failed = self._take_jobs_locked()
+                    self._inflight_sids = {j.sid for j in taken}
+            for job in failed:
+                self._finish(job)
+            if taken is None:
+                return
             if taken:
                 t0 = time.perf_counter()
-                with self.tracer.span("serve.tick", jobs=len(taken)):
-                    self._run_tick(taken)
+                try:
+                    with self.tracer.span("serve.tick", jobs=len(taken)):
+                        self._run_tick(taken)
+                finally:
+                    with self._lock:
+                        self._inflight_sids = set()
                 dt = time.perf_counter() - t0
                 self._m_tick.observe(dt)
                 if self.tick_s > 0 and dt < self.tick_s:
                     # Pacing floor: at most one batch launch per tick_s.
                     time.sleep(self.tick_s - dt)
+            else:
+                with self._lock:
+                    self._inflight_sids = set()
 
-    def _take_jobs_locked(self) -> List[_Job]:
+    def _take_jobs_locked(self) -> Tuple[List[_Job], List[_Job]]:
         """Drain this tick's jobs: at most ONE job per session (a second
         pending step for the same board serializes into the next tick so
-        each job's result is the state after exactly its own steps);
-        dead-session jobs fail out here."""
+        each job's result is the state after exactly its own steps).
+        Returns (taken, dead) — dead-session jobs carry their KeyError but
+        are finished by the caller OUTSIDE the lock (callback discipline)."""
         taken: List[_Job] = []
+        dead: List[_Job] = []
         rest: deque = deque()
         seen = set()
         while self._queue:
             job = self._queue.popleft()
             if job.sid not in self._sessions:
                 job.error = KeyError(job.sid)
-                job.done.set()
+                dead.append(job)
                 continue
             if job.sid in seen:
                 rest.append(job)
@@ -585,14 +823,18 @@ class SessionRouter:
             taken.append(job)
         self._queue = rest
         self._m_queue.set(len(self._queue))
-        return taken
+        return taken, dead
 
-    def _fail_pending_locked(self, err: BaseException) -> None:
+    def _fail_pending_locked(self, err: BaseException) -> List[_Job]:
+        """Error out every queued job; the caller fires completions
+        outside the lock."""
+        failed: List[_Job] = []
         while self._queue:
             job = self._queue.popleft()
             job.error = err
-            job.done.set()
+            failed.append(job)
         self._m_queue.set(0)
+        return failed
 
     def _evict_idle_locked(self) -> None:
         if self.ttl_s <= 0:
@@ -600,12 +842,17 @@ class SessionRouter:
         now = self._clock()
         # A session with an ADMITTED queued job is never idle — evicting
         # it would 404 a client already blocked on that job, breaking the
-        # "a queued job always completes" admission contract.
+        # "a queued job always completes" admission contract.  Frozen
+        # sessions belong to an in-flight shard migration: their clock
+        # stopped with their traffic, so the sweep must not race the
+        # commit that is about to move them.
         busy = {job.sid for job in self._queue}
         for sid in [
             s.sid
             for s in self._sessions.values()
-            if s.sid not in busy and now - s.last_used > self.ttl_s
+            if s.sid not in busy
+            and s.sid not in self._frozen
+            and now - s.last_used > self.ttl_s
         ]:
             self._drop_locked(sid, evicted=True)
 
@@ -614,12 +861,13 @@ class SessionRouter:
         device program, scatter results back.  A failed batch fails its
         jobs, never the ticker."""
         groups: Dict[int, List[Tuple[_Job, Session, np.ndarray, int]]] = {}
+        dead: List[_Job] = []
         with self._lock:
             for job in jobs:
                 sess = self._sessions.get(job.sid)
                 if sess is None:
                     job.error = KeyError(job.sid)
-                    job.done.set()
+                    dead.append(job)
                     continue
                 cls = sbatch.size_class(
                     sess.height, sess.width, self.size_classes
@@ -632,13 +880,15 @@ class SessionRouter:
                 groups.setdefault(cls, []).append(
                     (job, sess, sess.board, sess.epoch)
                 )
+        for job in dead:
+            self._finish(job)
         for cls, entries in sorted(groups.items()):
             try:
                 self._run_class_batch(cls, entries)
             except Exception as e:  # noqa: BLE001 — jobs fail, ticker lives
-                for job, _, _ in entries:
+                for job, _, _, _ in entries:
                     job.error = e
-                    job.done.set()
+                    self._finish(job)
 
     def _run_class_batch(
         self, cls: int, entries: List[Tuple[_Job, Session, np.ndarray, int]]
@@ -702,7 +952,10 @@ class SessionRouter:
                     # gone tenant.
                     pass
                 job.result = (epoch0 + job.steps, odigest.value(new_lanes))
-                job.done.set()
+        # Completions fire after the table writes are released: callbacks
+        # (the cluster plane's wire replies) must never run under the lock.
+        for job, _, _, _ in entries:
+            self._finish(job)
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Refuse NEW work and run the already-admitted queue dry (bounded)

@@ -1,0 +1,336 @@
+"""Worker half of the cluster-sharded serving plane.
+
+A :class:`ServeWorkerPlane` turns one :class:`runtime.backend.BackendWorker`
+into a serving shard host: it owns a local :class:`serve.sessions.SessionRouter`
+(PR 7's vmapped batch engine, unchanged as the per-worker core) and speaks
+the serve wire protocol with the frontend:
+
+- ``SERVE_OPS``  — one frame carrying every op the frontend coalesced for
+  this worker (create/step/delete/get, shard ``adopt`` installs, and
+  stateless ``step_raw`` tile chunks for frontend-resident mega-board
+  sessions).  Ops run on a dedicated executor thread — the control reader
+  must never block behind a batch tick.
+- ``SERVE_RESULT`` — completions coalesced back: results accumulate while
+  a frame is in flight and flush as one frame (the PR 4 discipline, reply
+  side).  Step jobs complete asynchronously via the router's ``on_done``
+  callback, so a tick's worth of jobs ride one result frame instead of
+  parking one thread each.
+- ``SHARD_PREPARE`` / ``SHARD_COMMIT`` / ``SHARD_ABORT`` — the worker side
+  of a session-shard migration: freeze the named sessions, run their
+  admitted jobs dry, export them digest-stamped (``SHARD_STATE``), then
+  drop on commit or unfreeze on abort.  Shard control rides the same
+  executor queue as ops, so it orders behind every op frame that preceded
+  it on the wire.
+
+The plane is constructed from the WELCOME policy bundle (the frontend owns
+the ``serve_*`` knobs cluster-wide, exactly like the ring/retry policy).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from akka_game_of_life_tpu.obs import get_registry
+from akka_game_of_life_tpu.obs.tracing import get_tracer
+from akka_game_of_life_tpu.ops import digest as odigest
+from akka_game_of_life_tpu.runtime import protocol as P
+from akka_game_of_life_tpu.runtime.wire import pack_tile, unpack_tile
+from akka_game_of_life_tpu.serve.sessions import (
+    AdmissionError,
+    SessionRouter,
+    shard_of,
+)
+
+# WELCOME policy keys the worker adopts into its local router config —
+# the cluster's serve knobs have ONE source of truth, the frontend's
+# SimulationConfig (the local caps are only the backstop behind the
+# frontend's cluster-wide admission budget).
+SERVE_POLICY_KEYS = (
+    "serve_shards",
+    "serve_max_sessions",
+    "serve_max_cells",
+    "serve_queue_depth",
+    "serve_max_steps",
+    "serve_tick_s",
+    "serve_ttl_s",
+    "serve_size_classes",
+    "ff_enabled",
+    "ff_certify_steps",
+)
+
+
+def serve_policy(config) -> Dict[str, object]:
+    """The WELCOME ``serve`` bundle from the frontend's config.
+
+    ``serve_ttl_s`` ships as 0: in cluster mode the FRONTEND owns the TTL
+    sweep (it must — it charges the cluster admission budget, and a
+    worker evicting locally would leak that budget forever since nothing
+    reports evictions upstream).  The frontend sweep issues real delete
+    ops, so worker tables and the cluster index retire together."""
+    policy = {k: getattr(config, k) for k in SERVE_POLICY_KEYS}
+    policy["serve_ttl_s"] = 0.0
+    return policy
+
+
+def _err_entry(rid: int, e: BaseException) -> dict:
+    """One failed op as a wire result entry; the frontend re-raises the
+    matching exception class at the tenant-facing surface."""
+    if isinstance(e, AdmissionError):
+        return {"rid": rid, "err": "admission", "reason": e.reason,
+                "detail": str(e)}
+    kind = {
+        KeyError: "key",
+        ValueError: "value",
+        TypeError: "value",
+        TimeoutError: "timeout",
+    }.get(type(e), "runtime")
+    detail = e.args[0] if kind == "key" and e.args else str(e)
+    return {"rid": rid, "err": kind, "detail": str(detail)}
+
+
+class ServeWorkerPlane:
+    """One worker's serving engine + its wire glue.  Thread layout: an
+    executor thread runs ops/shard control in arrival order; a reply
+    thread coalesces completed results into SERVE_RESULT frames; batch
+    step completions arrive via router callbacks."""
+
+    def __init__(
+        self,
+        policy: Dict[str, object],
+        send,
+        *,
+        name: str = "",
+        registry=None,
+        tracer=None,
+    ) -> None:
+        from akka_game_of_life_tpu.runtime.config import SimulationConfig
+
+        cfg = SimulationConfig(
+            **{k: policy[k] for k in SERVE_POLICY_KEYS if k in policy}
+        )
+        self.name = name
+        self._send = send  # callable(msg) -> None; raises OSError when dead
+        self.metrics = registry if registry is not None else get_registry()
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.router = SessionRouter(
+            cfg, registry=self.metrics, tracer=self.tracer
+        )
+        self.n_shards = int(cfg.serve_shards)
+        # shard → the sid set THIS worker froze at prepare (executor-thread
+        # only, so unlocked): commit/abort without explicit sids act on it.
+        self._shard_frozen: Dict[int, List[str]] = {}
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._inbox: deque = deque()  # graftlint: guarded-by _lock
+        self._results: List[dict] = []  # graftlint: guarded-by _lock
+        self._stopped = False  # graftlint: guarded-by _lock
+        self._exec = threading.Thread(
+            target=self._exec_loop, daemon=True, name=f"serve-exec-{name}"
+        )
+        self._reply = threading.Thread(
+            target=self._reply_loop, daemon=True, name=f"serve-reply-{name}"
+        )
+        self._exec.start()
+        self._reply.start()
+
+    # -- wire-in (called from the worker's control reader thread) ------------
+
+    def handle(self, msg: dict) -> None:
+        """Enqueue one serve-plane control message; never blocks."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._inbox.append(msg)
+            self._work.notify_all()
+
+    def has_sessions(self) -> bool:
+        return self.router.stats()["sessions"] > 0
+
+    # -- executor -------------------------------------------------------------
+
+    def _exec_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._stopped and not self._inbox:
+                    self._work.wait(timeout=0.25)
+                if self._stopped:
+                    return
+                msg = self._inbox.popleft()
+            try:
+                kind = msg.get("type")
+                if kind == P.SERVE_OPS:
+                    for op in msg.get("ops", []):
+                        self._run_op(op)
+                elif kind == P.SHARD_PREPARE:
+                    self._on_prepare(msg)
+                elif kind == P.SHARD_COMMIT:
+                    self.router.drop_sessions(self._shard_sids(msg))
+                elif kind == P.SHARD_ABORT:
+                    self.router.unfreeze_sessions(self._shard_sids(msg))
+            except Exception as e:  # noqa: BLE001 — one bad frame must not
+                # kill the executor: every op answers, malformed ones loudly
+                print(f"serve plane: dropped bad frame: {e!r}", flush=True)
+
+    def _run_op(self, op: dict) -> None:
+        rid = int(op["rid"])
+        kind = op.get("op")
+        try:
+            if kind == "create":
+                doc = self.router.create(
+                    tenant=str(op.get("tenant", "default")),
+                    rule=op.get("rule", "conway"),
+                    height=int(op.get("height", 64)),
+                    width=int(op.get("width", 64)),
+                    seed=int(op.get("seed", 0)),
+                    density=float(op.get("density", 0.5)),
+                    with_board=False,
+                    sid=str(op["sid"]),
+                )
+                self._push({"rid": rid, "ok": 1, "doc": doc})
+            elif kind == "step":
+                # Async: the job's on_done callback pushes the result when
+                # its batch lands — the executor moves straight on to the
+                # next op, so every step of a frame rides the same tick.
+                self.router.submit(
+                    str(op["sid"]),
+                    int(op.get("steps", 1)),
+                    on_done=lambda job, rid=rid: self._push(
+                        _err_entry(rid, job.error)
+                        if job.error is not None
+                        else {
+                            "rid": rid,
+                            "ok": 1,
+                            "epoch": job.result[0],
+                            "digest": job.result[1],
+                        }
+                    ),
+                )
+            elif kind == "get":
+                self._push(
+                    {"rid": rid, "ok": 1, "doc": self.router.get(str(op["sid"]))}
+                )
+            elif kind == "delete":
+                self.router.delete(str(op["sid"]))
+                self._push({"rid": rid, "ok": 1})
+            elif kind == "adopt":
+                self.router.import_sessions(op["sessions"])
+                self._push({"rid": rid, "ok": 1})
+            elif kind == "step_raw":
+                self._push(self._step_raw(rid, op))
+            else:
+                raise ValueError(f"unknown serve op {kind!r}")
+        except BaseException as e:  # noqa: BLE001 — answered, never dropped
+            self._push(_err_entry(rid, e))
+
+    def _step_raw(self, rid: int, op: dict) -> dict:
+        """A stateless tile chunk of a frontend-resident tiled (mega-board)
+        session: step the k-halo-padded slab k epochs (halo absorbs the
+        padded-torus wrap contamination, so the interior is exactly the
+        global evolution), return the interior packed plus its digest
+        lanes at the tile's global offsets."""
+        import jax.numpy as jnp
+
+        from akka_game_of_life_tpu.ops import stencil
+        from akka_game_of_life_tpu.ops.rules import resolve_rule
+
+        rule = resolve_rule(op["rule"])
+        k = int(op["k"])
+        padded = unpack_tile(op["state"])
+        out = np.asarray(stencil.multi_step_fn(rule, k)(jnp.asarray(padded)))
+        y0, y1, x0, x1 = (int(v) for v in op["interior"])
+        interior = np.ascontiguousarray(out[y0:y1, x0:x1])
+        lanes = odigest.digest_dense_np(
+            interior,
+            origin=tuple(int(v) for v in op["origin"]),
+            width=int(op["width"]),
+        )
+        return {
+            "rid": rid,
+            "ok": 1,
+            "state": pack_tile(interior),
+            "digest": [int(lanes[0]), int(lanes[1])],
+        }
+
+    # -- shard migration (worker side) ---------------------------------------
+
+    def _shard_sids(self, msg: dict) -> List[str]:
+        """The sid set a commit/abort acts on: the frontend's explicit
+        list when present (a commit carries the exact exported set; the
+        ghost-cleanup drop at a destination names adopted sids), else the
+        set THIS worker froze at prepare."""
+        shard = int(msg["shard"])
+        remembered = self._shard_frozen.pop(shard, [])
+        if "sids" in msg:
+            return [str(s) for s in msg["sids"]]
+        return remembered
+
+    def _on_prepare(self, msg: dict) -> None:
+        """Freeze → run admitted jobs dry → export digest-stamped.  The
+        freeze set is computed HERE, by hash over the sessions actually
+        resident when the prepare executes — the executor has already run
+        every op frame that preceded it on the wire, so a create routed
+        before the migration was planned is included; a frontend snapshot
+        could not promise that.  A freeze that cannot go idle in time
+        reports the failure instead of exporting a snapshot an in-flight
+        write-back could invalidate."""
+        shard = int(msg["shard"])
+        seq = int(msg["seq"])
+        sids = [
+            doc["id"]
+            for doc in self.router.list()
+            if shard_of(doc["id"], self.n_shards) == shard
+        ]
+        self._shard_frozen[shard] = sids
+        self.router.freeze_sessions(sids)
+        reply: dict = {"type": P.SHARD_STATE, "shard": shard, "seq": seq}
+        if not self.router.wait_idle(sids):
+            # Unfreeze here too: the frontend will abort, but its abort
+            # frame could race a crash — never leave sessions frozen on a
+            # failure the worker itself detected.
+            self.router.unfreeze_sessions(sids)
+            reply["error"] = "freeze timeout (jobs still in flight)"
+            reply["sessions"] = []
+        else:
+            reply["sessions"] = self.router.export_sessions(sids)
+        try:
+            self._send(reply)
+        except (OSError, ValueError):
+            # Dead control channel: the worker is leaving anyway; the
+            # frontend's member-loss path owns the outcome.
+            self.router.unfreeze_sessions(sids)
+
+    # -- reply coalescer ------------------------------------------------------
+
+    def _push(self, entry: dict) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._results.append(entry)
+            self._work.notify_all()
+
+    def _reply_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._stopped and not self._results:
+                    self._work.wait(timeout=0.25)
+                if self._stopped:
+                    return
+                batch, self._results = self._results, []
+            # One frame per flush: results that accumulate while this
+            # send is on the wire coalesce into the next frame.
+            try:
+                self._send({"type": P.SERVE_RESULT, "results": batch})
+            except (OSError, ValueError):
+                # Dead control channel — nothing to answer to; the
+                # frontend's member-loss path fails the in-flight ops.
+                return
+
+    def close(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._work.notify_all()
+        self.router.close()

@@ -95,6 +95,40 @@ def test_trend_empty_dir_fails_loud(tmp_path):
     assert "no BENCH-format lines" in proc.stderr
 
 
+def test_trend_folds_serve_shard_sweep_records(tmp_path):
+    """The cluster-sharded sweep's per-point records (serve-shard-wN,
+    bench_serve.py --workers) fold into the trajectory table like any
+    other config — one row per worker count, last-wins per round."""
+    out = tmp_path / "sweep_r13.jsonl"
+    out.write_text(
+        "\n".join(
+            json.dumps({
+                "config": f"serve-shard-w{n}",
+                "metric": "cluster-sharded step requests/sec",
+                "value": 100.0 * n,
+                "unit": "boards/sec",
+                "workers": n,
+                "scaling_vs_w1": float(n),
+            })
+            for n in (1, 2, 4)
+        )
+        + "\n"
+        + json.dumps({
+            "config": "serve-shard-sweep",
+            "metric": "boards/sec scaling vs 1 worker",
+            "value": 4.0,
+            "unit": "x",
+        }),
+        encoding="utf-8",
+    )
+    pairs = list(bench_trend.scan_record_file(out))
+    trend = bench_trend.build_trend(pairs)
+    assert trend["serve-shard-w4"]["rounds"][13] == 400.0
+    assert trend["serve-shard-sweep"]["unit"] == "x"
+    table = bench_trend.render_table(trend)
+    assert "serve-shard-w2" in table and "r13" in table
+
+
 def test_trend_on_real_repo_records():
     """The actual BENCH_r*/MULTICHIP_r* records at the repo root parse
     (they exist on this tree; their tails mix tracebacks with records)."""

@@ -1,0 +1,504 @@
+"""Cluster-sharded serving: shard routing, elastic shard migration,
+failure paths, tiled mega-board sessions, and the serve lint surface.
+
+Every cluster test runs a REAL in-process serve-only frontend plus
+BackendWorker threads speaking the actual wire protocol — the same stack
+`python -m akka_game_of_life_tpu serve --serve-cluster on` runs — and
+certifies end states against single-board oracles via the digest plane.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from akka_game_of_life_tpu.obs.catalog import install
+from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+from akka_game_of_life_tpu.obs.tracing import Tracer
+from akka_game_of_life_tpu.ops import digest as odigest, stencil
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.runtime.backend import BackendWorker
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.frontend import Frontend
+from akka_game_of_life_tpu.runtime.rebalance import Rebalancer
+from akka_game_of_life_tpu.serve.cluster import shard_of
+from akka_game_of_life_tpu.serve.sessions import AdmissionError, SessionRouter
+from akka_game_of_life_tpu.utils.patterns import random_grid
+
+
+def _oracle_digest(rule: str, shape, seed: int, epochs: int) -> str:
+    board0 = random_grid(shape, density=0.5, seed=seed)
+    board = (
+        np.asarray(
+            stencil.multi_step_fn(resolve_rule(rule), epochs)(
+                jnp.asarray(board0)
+            )
+        )
+        if epochs
+        else board0
+    )
+    return odigest.format_digest(odigest.value(odigest.digest_dense_np(board)))
+
+
+@contextlib.contextmanager
+def serve_cluster(n_workers: int, **cfg_kw):
+    """In-process serve-only cluster: frontend + n shard-host workers."""
+    cfg_kw.setdefault("serve_shards", 16)
+    cfg_kw.setdefault("rebalance_interval_s", 0.05)
+    cfg = SimulationConfig(
+        role="serve", serve_cluster=True, port=0, max_epochs=None,
+        flight_dir="", **cfg_kw,
+    )
+    registry = install(MetricsRegistry())
+    tracer = Tracer(node="test-serve-cluster")
+    fe = Frontend(cfg, min_backends=n_workers, registry=registry,
+                  tracer=tracer)
+    fe.start()
+    workers, threads = [], []
+
+    def add_worker(name):
+        w = BackendWorker(
+            "127.0.0.1", fe.port, name=name, engine="numpy",
+            registry=registry, tracer=tracer,
+        )
+        w.crash_hook = w.stop
+        w.connect()
+        t = threading.Thread(target=w.run, daemon=True, name=name)
+        t.start()
+        workers.append(w)
+        threads.append(t)
+        return w, t
+
+    fe.add_serve_worker = add_worker  # test hook
+    for i in range(n_workers):
+        add_worker(f"w{i}")
+    assert fe.wait_for_backends(timeout=10)
+    _wait_spread(fe, n_workers)
+    try:
+        yield fe, workers, threads, registry
+    finally:
+        fe.stop()
+        for w in workers:
+            w.stop()
+
+
+def _wait_spread(fe, n: int, timeout: float = 10.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        alive = len(fe.membership.alive_members())
+        by = fe._health()["serve"]["shards_by_worker"]
+        if len(by) == min(n, alive) and (
+            len(by) < 2 or max(by.values()) - min(by.values()) <= 2
+        ):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"shards never spread: {fe._health()['serve']}")
+
+
+# -- lint surface --------------------------------------------------------------
+
+
+def test_serve_lint_surface_clean():
+    """The new routing knobs and protocol rows hold all three bijections:
+    --serve-* ↔ serve_* (GL-CFG04), serve_* ↔ doc knob table (GL-DOC06),
+    and protocol.py ↔ the doc's protocol table (GL-DOC03)."""
+    from pathlib import Path
+
+    from tools.graftlint import bijection
+    from tools.graftlint.specs import PROTOCOL_MSGS, SERVE_CONFIG, SERVE_DOC
+
+    repo = Path(__file__).resolve().parent.parent
+    for spec in (SERVE_CONFIG, SERVE_DOC, PROTOCOL_MSGS):
+        problems = [f.render() for f in bijection.problems(spec, repo)]
+        assert problems == [], problems
+
+
+def test_shard_hash_stable_and_bounded():
+    assert shard_of("s00000001", 64) == shard_of("s00000001", 64)
+    seen = {shard_of(f"s{i:08x}", 16) for i in range(256)}
+    assert seen <= set(range(16))
+    assert len(seen) > 8  # spreads, not clumps
+
+
+# -- planner units -------------------------------------------------------------
+
+
+class _M:
+    def __init__(self, name, draining=False):
+        self.name = name
+        self.alive = True
+        self.draining = draining
+        self.tiles = []
+
+
+def test_plan_shards_spreads_empties_budget_free():
+    cfg = SimulationConfig(rebalance_max_inflight=1)
+    rb = Rebalancer(cfg)
+    owners = {s: "a" for s in range(16)}
+    moves = rb.plan_shards(owners, {}, [_M("a"), _M("b")], now=1e9)
+    dests = {d for _, _, d in moves}
+    assert dests == {"b"} and len(moves) == 8  # half the table, one pass
+
+
+def test_plan_shards_drain_first_and_loaded_budget_bound():
+    cfg = SimulationConfig(rebalance_max_inflight=1)
+    rb = Rebalancer(cfg)
+    owners = {0: "a", 1: "a", 2: "b", 3: "b"}
+    weights = {0: 3, 1: 0, 2: 1, 3: 1}
+    moves = rb.plan_shards(
+        owners, weights, [_M("a", draining=True), _M("b")], now=1e9
+    )
+    # Both of a's shards plan off it: the empty one free, the loaded one
+    # charged against the in-flight budget of 1; lightest-first ordering
+    # puts the free flip first.
+    assert [(s, src, d) for s, src, d in moves] == [
+        (1, "a", "b"), (0, "a", "b")
+    ]
+
+
+def test_plan_shards_gap_floor_no_ping_pong():
+    cfg = SimulationConfig(rebalance_max_inflight=4)
+    rb = Rebalancer(cfg)
+    owners = {0: "a", 1: "a", 2: "b"}  # gap 1: must not move
+    assert rb.plan_shards(owners, {}, [_M("a"), _M("b")], now=1e9) == []
+
+
+# -- end-to-end: routing + certification --------------------------------------
+
+
+def test_cluster_roundtrip_vs_oracle():
+    rules = ("conway", "highlife", "brians-brain")
+    with serve_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        specs = []
+        for i in range(9):
+            doc = plane.create(
+                tenant=f"t{i % 2}", rule=rules[i % 3], height=18 + i,
+                width=16, seed=i, with_board=False,
+            )
+            specs.append((doc["id"], rules[i % 3], (18 + i, 16), i))
+        for sid, rule, shape, seed in specs:
+            epoch, digest = plane.step(sid, 4)
+            assert epoch == 4
+            assert odigest.format_digest(digest) == _oracle_digest(
+                rule, shape, seed, 4
+            )
+        # GET round-trips the full board; list shows owners.
+        doc = plane.get(specs[0][0])
+        assert doc["board"].shape == specs[0][2]
+        owners = {e["worker"] for e in plane.list()}
+        assert owners <= {"w0", "w1"}
+        plane.delete(specs[0][0])
+        with pytest.raises(KeyError):
+            plane.get(specs[0][0])
+
+
+def test_cluster_admission_budget_and_healthz():
+    with serve_cluster(2, serve_max_sessions=4, serve_max_cells=870) as (
+        fe, workers, threads, registry,
+    ):
+        plane = fe.serve_plane
+        for i in range(3):
+            plane.create(height=16, width=16, seed=i, with_board=False)
+        # Cell budget refuses before the session cap does.
+        with pytest.raises(AdmissionError) as e:
+            plane.create(height=30, width=30, with_board=False)
+        assert e.value.reason == "max_cells"
+        plane.create(height=8, width=8, with_board=False)
+        with pytest.raises(AdmissionError) as e:
+            plane.create(height=8, width=8, with_board=False)
+        assert e.value.reason == "max_sessions"
+        # /healthz mirrors the per-worker shard/session/queue shape.
+        doc = fe._health()["serve"]
+        assert doc["sessions"] == 4
+        assert set(doc) >= {
+            "shards_by_worker", "sessions_by_worker",
+            "queue_depth_by_worker", "shard_migrations_inflight",
+        }
+        assert sum(doc["shards_by_worker"].values()) == 16
+
+
+def test_late_join_starts_receiving_shards():
+    with serve_cluster(1) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        specs = []
+        for i in range(12):
+            doc = plane.create(height=16, width=16, seed=i, with_board=False)
+            specs.append(doc["id"])
+        # Late joiner: the planner spreads shards onto it — empties flip
+        # instantly, loaded shards migrate digest-certified.
+        fe.add_serve_worker("late")
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            by = fe._health()["serve"]["shards_by_worker"]
+            if by.get("late", 0) >= 6:
+                break
+            time.sleep(0.05)
+        assert fe._health()["serve"]["shards_by_worker"].get("late", 0) >= 6
+        # Sessions keep serving correctly across/after the reshuffle.
+        for i, sid in enumerate(specs):
+            epoch, digest = plane.step(sid, 3)
+            assert epoch == 3
+            assert odigest.format_digest(digest) == _oracle_digest(
+                "conway", (16, 16), i, 3
+            )
+
+
+def test_drain_zero_admitted_loss_mid_traffic():
+    with serve_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        specs = [
+            plane.create(height=16, width=16, seed=i, with_board=False)["id"]
+            for i in range(16)
+        ]
+        issued = {sid: 0 for sid in specs}
+        errors, lock = [], threading.Lock()
+        stop = threading.Event()
+
+        def loader(k):
+            i = 0
+            while not stop.is_set():
+                sid = specs[(k + i) % len(specs)]
+                try:
+                    plane.step(sid, 1)
+                    with lock:
+                        issued[sid] += 1
+                except Exception as e:  # noqa: BLE001 — the assertion below
+                    errors.append((sid, repr(e)))
+                i += 1
+
+        pool = [threading.Thread(target=loader, args=(k,)) for k in range(3)]
+        for t in pool:
+            t.start()
+        time.sleep(0.2)
+        assert workers[0].request_drain()
+        threads[0].join(30)
+        time.sleep(0.2)
+        stop.set()
+        for t in pool:
+            t.join()
+        assert workers[0].stopped_reason == "drained"
+        assert not errors, errors[:3]
+        # Every session survived, bit-exactly, on the surviving worker.
+        doc = fe._health()["serve"]
+        assert doc["sessions_by_worker"] == {"w1": 16}
+        for i, sid in enumerate(specs):
+            got = plane.get(sid)
+            assert got["epoch"] == issued[sid]
+            assert got["digest"] == _oracle_digest(
+                "conway", (16, 16), i, issued[sid]
+            )
+        assert registry.snapshot().get(
+            "gol_serve_shard_migrations_total"
+        ) >= 1
+
+
+def test_worker_crash_answers_never_hangs():
+    with serve_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        specs = [
+            plane.create(height=16, width=16, seed=i, with_board=False)["id"]
+            for i in range(12)
+        ]
+        outcomes, lock = [], threading.Lock()
+        stop = threading.Event()
+
+        def loader(k):
+            i = 0
+            while not stop.is_set():
+                sid = specs[(k + i) % len(specs)]
+                try:
+                    plane.step(sid, 1)
+                    with lock:
+                        outcomes.append("ok")
+                except BaseException as e:  # noqa: BLE001
+                    with lock:
+                        outcomes.append(type(e).__name__)
+                i += 1
+
+        pool = [threading.Thread(target=loader, args=(k,)) for k in range(3)]
+        for t in pool:
+            t.start()
+        time.sleep(0.2)
+        workers[1].channel.close()  # abrupt death, mid-traffic
+        time.sleep(0.5)
+        stop.set()
+        for t in pool:
+            t.join(20)
+        assert not any(t.is_alive() for t in pool), (
+            "a step hung across the crash instead of answering"
+        )
+        live = {e["id"] for e in plane.list()}
+        lost = [sid for sid in specs if sid not in live]
+        kept = [sid for sid in specs if sid in live]
+        assert lost and kept  # both workers held sessions
+        for sid in kept[:3]:
+            plane.step(sid, 1)
+        for sid in lost[:3]:
+            with pytest.raises(KeyError):
+                plane.step(sid, 1)
+        # Gauges reclaimed on loss, the heartbeat-age discipline.
+        snap = registry.snapshot()
+        assert snap.get('gol_serve_shards{member="w1"}') == 0.0
+        assert snap.get('gol_serve_shard_sessions{member="w1"}') == 0.0
+        assert snap.get('gol_serve_worker_queue_depth{member="w1"}') == 0.0
+
+
+# -- tiled (mega-board) sessions ----------------------------------------------
+
+
+def test_mega_board_admitted_as_tiled_session_and_certifies():
+    with serve_cluster(2, serve_size_classes="16,32") as (
+        fe, workers, threads, registry,
+    ):
+        plane = fe.serve_plane
+        # 72x40 over 32-sided tiles: a 3x2 grid with ragged edges.
+        doc = plane.create(rule="conway", height=72, width=40, seed=7,
+                           with_board=False)
+        sid = doc["id"]
+        assert doc["kind"] == "tiled" and doc["tiles"] == 6
+        epoch, digest = plane.step(sid, 10)
+        assert epoch == 10
+        board0 = random_grid((72, 40), density=0.5, seed=7)
+        oracle = np.asarray(
+            stencil.multi_step_fn(resolve_rule("conway"), 10)(
+                jnp.asarray(board0)
+            )
+        )
+        assert odigest.format_digest(digest) == odigest.format_digest(
+            odigest.value(odigest.digest_dense_np(oracle))
+        )
+        got = plane.get(sid)
+        assert np.array_equal(got["board"], oracle)
+        assert registry.snapshot().get("gol_serve_tiled_sessions") == 1.0
+        # The ticker-fairness bound still stands (no ff lane for tiled).
+        with pytest.raises(AdmissionError) as e:
+            plane.step(sid, 100000)
+        assert e.value.reason == "max_steps"
+        plane.delete(sid)
+        with pytest.raises(KeyError):
+            plane.get(sid)
+        assert registry.snapshot().get("gol_serve_tiled_sessions") == 0.0
+
+
+def test_mega_board_survives_worker_crash_mid_step():
+    """Tile chunks are pure: a dead worker's chunk replays elsewhere and
+    the step still certifies — frontend-resident state loses nothing."""
+    with serve_cluster(2, serve_size_classes="16,32",
+                       serve_tile_chunk=2) as (
+        fe, workers, threads, registry,
+    ):
+        plane = fe.serve_plane
+        sid = plane.create(rule="conway", height=48, width=48, seed=3,
+                           with_board=False)["id"]
+        done = {}
+
+        def stepper():
+            done["result"] = plane.step(sid, 12)
+
+        t = threading.Thread(target=stepper)
+        t.start()
+        time.sleep(0.05)  # a few chunks in flight
+        workers[0].channel.close()  # crash one worker mid-step
+        t.join(60)
+        assert not t.is_alive(), "tiled step hung across worker crash"
+        epoch, digest = done["result"]
+        assert epoch == 12
+        board0 = random_grid((48, 48), density=0.5, seed=3)
+        oracle = np.asarray(
+            stencil.multi_step_fn(resolve_rule("conway"), 12)(
+                jnp.asarray(board0)
+            )
+        )
+        assert odigest.format_digest(digest) == odigest.format_digest(
+            odigest.value(odigest.digest_dense_np(oracle))
+        )
+
+
+def test_cluster_ttl_sweep_retires_budget_everywhere():
+    """Idle eviction is frontend-owned in cluster mode: workers run with
+    TTL 0 (a local eviction would silently leak the cluster admission
+    budget), the plane sweep deletes idle sessions through real ops, and
+    the freed budget admits new creates — tiled sessions included."""
+    with serve_cluster(2, serve_ttl_s=0.3, serve_size_classes="16,32") as (
+        fe, workers, threads, registry,
+    ):
+        plane = fe.serve_plane
+        sid = plane.create(height=16, width=16, with_board=False)["id"]
+        mega = plane.create(height=48, width=48, with_board=False)["id"]
+        assert workers[0].serve_plane.router.ttl_s == 0  # frontend owns it
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if plane.stats()["sessions"] == 0:
+                break
+            time.sleep(0.05)
+        assert plane.stats()["sessions"] == 0, plane.stats()
+        assert plane.stats()["cells"] == 0  # the budget actually freed
+        for s in (sid, mega):
+            with pytest.raises(KeyError):
+                plane.get(s)
+        # Worker tables retired WITH the index (real deletes, not a
+        # frontend-only forget).
+        assert sum(
+            w.serve_plane.router.stats()["sessions"] for w in workers
+        ) == 0
+        assert registry.snapshot().get(
+            "gol_serve_session_evictions_total"
+        ) == 2.0
+
+
+# -- the PR 12 residue made observable ----------------------------------------
+
+
+def test_ff_jump_retry_counter_via_blocked_batch_drill():
+    """Provoke exactly one optimistic-commit retry on the serve fast
+    path: park the jump between compute and commit (the drill hook),
+    land a blocked batch job in the window, and watch
+    gol_serve_ff_jump_retries_total tick while the final state is still
+    exactly right."""
+    registry = install(MetricsRegistry())
+    cfg = SimulationConfig(role="serve", serve_max_steps=4, flight_dir="")
+    router = SessionRouter(cfg, registry=registry)
+    try:
+        sid = router.create(rule="fredkin", height=16, width=16, seed=1,
+                            with_board=False)["id"]
+        router.pause()
+        batch_done = threading.Event()
+        threading.Thread(
+            target=lambda: (router.step(sid, 1), batch_done.set()),
+            daemon=True,
+        ).start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if router.stats()["queue_depth"] >= 1:
+                break
+            time.sleep(0.01)
+        assert router.stats()["queue_depth"] >= 1
+        fired = threading.Event()
+
+        def hook():
+            if fired.is_set():
+                return  # the retry's second pass must commit cleanly
+            fired.set()
+            router.resume()
+            assert batch_done.wait(30)
+
+        router._drill_ff_precommit = hook
+        epoch, digest = router.step(sid, 100)  # > max_steps → ff path
+        assert fired.is_set()
+        assert epoch == 101  # the blocked batch's epoch was NOT clobbered
+        assert registry.snapshot().get(
+            "gol_serve_ff_jump_retries_total"
+        ) == 1.0
+        assert odigest.format_digest(digest) == _oracle_digest(
+            "fredkin", (16, 16), 1, 101
+        )
+    finally:
+        router.close()

@@ -53,6 +53,8 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
     ("GL-DOC04", "graftlint pass ids ↔ OPERATIONS.md static-analysis table"),
     ("GL-DOC05", "SimulationConfig ff_* fields ↔ OPERATIONS.md fast-forward "
      "knob table"),
+    ("GL-DOC06", "SimulationConfig serve_* fields ↔ OPERATIONS.md serving-"
+     "plane knob table"),
 )
 PASS_IDS = frozenset(pid for pid, _ in PASS_CATALOG)
 

@@ -53,6 +53,35 @@ SERVE_CONFIG = FlagConfigSpec(
     flag_strip="--serve", field_prefix="serve_",
 )
 
+# The serve knob surface closes its config ↔ operator-doc edge like the
+# fast-forward plane: GL-CFG04 (above) holds --serve-* ↔ serve_*, this
+# pass holds serve_* ↔ the "Serving plane" knob-table rows — the added
+# cluster-sharded routing knobs (serve_cluster/serve_shards/
+# serve_tile_chunk) cannot ship undocumented.
+SERVE_DOC = CatalogSpec(
+    name="serve_doc", pass_id="GL-DOC06",
+    sides={
+        "config": Side(
+            kind="block", path="akka_game_of_life_tpu/runtime/config.py",
+            start="class SimulationConfig", end="\n    def ",
+            regex=r"^    (serve_\w+)\s*:",
+        ),
+        "doc": Side(
+            kind="section", path=_DOC, start="## Serving plane",
+            end="## ", regex=r"^\|\s*`(serve_\w+)`",
+        ),
+    },
+    relations=(
+        Relation("config", "doc", "serve knob {name} has no row in the "
+                 "OPERATIONS.md Serving plane knob table"),
+        Relation("doc", "config", "OPERATIONS.md documents serve knob "
+                 "{name} which SimulationConfig does not declare — worse "
+                 "than no row"),
+    ),
+    scan_guard=("config", "scan broken: no serve_* fields found in "
+                "SimulationConfig"),
+)
+
 SPARSE_CONFIG = FlagConfigSpec(
     name="sparse_config", pass_id="GL-CFG05",
     flag_regex=r"""["'](--sparse-[a-z0-9-]+)["']""",
@@ -232,7 +261,7 @@ GRAFTLINT_DOC = CatalogSpec(
 )
 
 SPECS = (
-    CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SPARSE_CONFIG,
-    FF_CONFIG, FF_DOC, KERNEL_CONFIG, METRICS_DOC, TRACE_NAMES,
-    PROTOCOL_MSGS, GRAFTLINT_DOC,
+    CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SERVE_DOC,
+    SPARSE_CONFIG, FF_CONFIG, FF_DOC, KERNEL_CONFIG, METRICS_DOC,
+    TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
 )
