@@ -447,6 +447,33 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
         help="epochs per fan-out round of a tiled (mega-board) session "
         "step; each tile ships a K-wide halo per round trip (default 8)",
     )
+    g.add_argument(
+        "--serve-replicate",
+        choices=["on", "off"],
+        default=None,
+        help="session replication & crash failover: every session shard "
+        "gets a replica worker the primary streams state to; on worker "
+        "loss the frontend promotes the replica (sessions resume from "
+        "their last acked replicated epoch, digest-certified) instead of "
+        "404ing (default on; degrades to single-copy when no second "
+        "placeable worker exists)",
+    )
+    g.add_argument(
+        "--serve-replicate-every", type=int, default=None, metavar="N",
+        help="replication epoch cadence: a session re-streams to its "
+        "replica after advancing N epochs past the acked watermark "
+        "(idle dirty sessions flush regardless; default 8)",
+    )
+    g.add_argument(
+        "--serve-replicate-interval-s", default=None, metavar="DUR",
+        help="the primary's replication stream-pass interval (e.g. "
+        "250ms; default 0.25s)",
+    )
+    g.add_argument(
+        "--serve-replicate-max-lag-s", default=None, metavar="DUR",
+        help="replication lag bound: lag past this is surfaced loudly "
+        "(event + /healthz lag_alert_shards; default 30s)",
+    )
 
 
 def _serve_overrides(args: argparse.Namespace) -> dict:
@@ -472,6 +499,18 @@ def _serve_overrides(args: argparse.Namespace) -> dict:
         "serve_cluster": on_off[args.serve_cluster],
         "serve_shards": args.serve_shards,
         "serve_tile_chunk": args.serve_tile_chunk,
+        "serve_replicate": on_off[args.serve_replicate],
+        "serve_replicate_every": args.serve_replicate_every,
+        "serve_replicate_interval_s": (
+            parse_duration(args.serve_replicate_interval_s)
+            if args.serve_replicate_interval_s is not None
+            else None
+        ),
+        "serve_replicate_max_lag_s": (
+            parse_duration(args.serve_replicate_max_lag_s)
+            if args.serve_replicate_max_lag_s is not None
+            else None
+        ),
     }
 
 

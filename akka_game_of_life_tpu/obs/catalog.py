@@ -152,6 +152,23 @@ CATALOG = (
     ("gol_serve_tiled_sessions", "gauge",
      "Mega-board sessions admitted as tiled (above the largest size "
      "class, fanned across workers per chunk)", ()),
+    # -- session replication & failover (serve/cluster.py) --------------------
+    ("gol_serve_replication_lag_seconds", "gauge",
+     "Age of the oldest session update the shard's replica has not yet "
+     "acked, per shard (0 = caught up; defined only while a replica "
+     "exists, reclaimed when caught up/lost)", ("shard",)),
+    ("gol_serve_replica_bytes_total", "counter",
+     "Bit-packed session snapshot bytes relayed to replicas", ()),
+    ("gol_serve_promotions_total", "counter",
+     "Shard replicas promoted to primary after a worker loss "
+     "(digest-certified; sessions resumed at their replicated epoch)",
+     ()),
+    ("gol_serve_single_copy_shards", "gauge",
+     "Owned shards with NO placeable replica — the honest single-copy "
+     "degradation level (0 when replication is healthy)", ()),
+    ("gol_serve_sessions_lost_total", "counter",
+     "Sessions lost to worker failure (no replica, never-acked, or a "
+     "double failure) — each one is a tenant-visible 404", ()),
     # -- logarithmic fast-forward (ops/fastforward.py) ------------------------
     ("gol_ff_jumps_total", "counter",
      "Fast-forward jumps committed by Simulation.fast_forward", ()),

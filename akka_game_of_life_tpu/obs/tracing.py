@@ -83,6 +83,9 @@ SPAN_CATALOG = (
      "over this tick's step jobs)"),
     ("serve.shard_migrate", "one session-shard migration, PREPARE to "
      "COMMIT or abort (cluster-sharded serving)"),
+    ("serve.promote", "one shard replica promoted to primary after a "
+     "worker loss (digest-certified; sessions resume at their "
+     "replicated epoch)"),
     # -- durability -----------------------------------------------------------
     ("checkpoint.save", "one checkpoint save made durable"),
     ("checkpoint.restore", "one checkpoint load"),

@@ -1378,7 +1378,8 @@ class BackendWorker:
         elif kind == P.MIGRATE_ABORT:
             self._on_migrate_abort(tuple(msg["tile"]))
         elif kind in (
-            P.SERVE_OPS, P.SHARD_PREPARE, P.SHARD_COMMIT, P.SHARD_ABORT
+            P.SERVE_OPS, P.SHARD_PREPARE, P.SHARD_COMMIT, P.SHARD_ABORT,
+            P.SHARD_REPLICATE_ACK,
         ):
             # Serve-plane frames enqueue to the plane's executor and never
             # block this reader: a step op's batch tick must not stall

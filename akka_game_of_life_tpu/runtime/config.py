@@ -403,6 +403,25 @@ class SimulationConfig:
     # many epochs per round trip — the exchange-width trade, serve-plane
     # edition (bigger = fewer round trips, fatter halos).
     serve_tile_chunk: int = 8
+    # Session replication & crash failover (docs/OPERATIONS.md "Session
+    # replication & failover"): each session shard gets a replica worker
+    # (never the primary); the primary streams shard state to it at the
+    # cadence below, and on worker loss the frontend PROMOTES the replica
+    # instead of 404ing — sessions resume from their last acked
+    # replicated epoch, digest-certified.  Off = the PR 13 single-copy
+    # plane (a crashed worker's boards 404 honestly).
+    serve_replicate: bool = True
+    # Epoch cadence: a session re-streams to its replica once it has
+    # advanced this many epochs past the acked watermark (new sessions
+    # and idle dirty sessions flush regardless — convergence is exact
+    # once traffic stops, the cadence only batches under sustained load).
+    serve_replicate_every: int = 8
+    # The primary's stream-pass interval (how often dirty sessions are
+    # exported and shipped; also paces watermark retransmit on loss).
+    serve_replicate_interval_s: float = 0.25
+    # Replication lag past this bound is surfaced LOUDLY (event + the
+    # /healthz lag_alert_shards field) — never silently unbounded.
+    serve_replicate_max_lag_s: float = 30.0
     # -- logarithmic fast-forward (docs/OPERATIONS.md "Logarithmic
     # fast-forward").  XOR-linear (odd-rule) boards jump T epochs in
     # O(log T) device programs (ops/fastforward.py); non-linear rules are
@@ -616,11 +635,22 @@ class SimulationConfig:
             "serve_max_steps",
             "serve_shards",
             "serve_tile_chunk",
+            "serve_replicate_every",
         ):
             if getattr(self, name) < 1:
                 raise ValueError(
                     f"{name}={getattr(self, name)} must be >= 1"
                 )
+        if self.serve_replicate_interval_s <= 0:
+            raise ValueError(
+                f"serve_replicate_interval_s="
+                f"{self.serve_replicate_interval_s} must be > 0"
+            )
+        if self.serve_replicate_max_lag_s <= 0:
+            raise ValueError(
+                f"serve_replicate_max_lag_s="
+                f"{self.serve_replicate_max_lag_s} must be > 0"
+            )
         if self.serve_tick_s < 0:
             raise ValueError(
                 f"serve_tick_s={self.serve_tick_s} must be >= 0 (0 = "
@@ -684,6 +714,8 @@ _DURATION_FIELDS = {
     "rebalance_deadline_s",
     "serve_tick_s",
     "serve_ttl_s",
+    "serve_replicate_interval_s",
+    "serve_replicate_max_lag_s",
     "breaker_cooldown_s",
     "send_deadline_s",
     "delay_s",

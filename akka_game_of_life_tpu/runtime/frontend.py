@@ -1157,6 +1157,9 @@ class Frontend:
         elif kind == P.SHARD_STATE:
             if self.serve_plane is not None:
                 self.serve_plane.on_shard_state(member.name, msg)
+        elif kind == P.SHARD_REPLICATE:
+            if self.serve_plane is not None:
+                self.serve_plane.on_shard_replicate(member.name, msg)
         elif kind == P.DRAIN_REQUEST:
             self._on_drain_request(member)
         elif kind == P.GOODBYE:

@@ -56,6 +56,14 @@ SHARD_PREPARE /             (new) session-shard migration: freeze a shard's
 SHARD_STATE /               sessions on the source, ship them digest-
 SHARD_COMMIT / SHARD_ABORT  certified, commit ownership (or roll back) —
                             the tile-migration protocol, session-shaped
+SHARD_REPLICATE /           (new) session replication: a shard primary
+SHARD_REPLICATE_ACK         streams dirty session snapshots (bit-packed +
+                            digest lanes) to the frontend, which relays
+                            them to the shard's replica worker through its
+                            op FIFO and acks the primary with the per-
+                            session epoch watermark (or parks/resets the
+                            stream) — promotion on worker loss resumes
+                            from the last acked state
 ==========================  ====================================================
 
 Every message constant below must appear in docs/OPERATIONS.md's
@@ -119,9 +127,15 @@ SERVE_OPS = "serve_ops"
 SHARD_PREPARE = "shard_prepare"
 SHARD_COMMIT = "shard_commit"
 SHARD_ABORT = "shard_abort"
+# session replication: ack-watermark half (frontend → primary, on the
+# per-worker op FIFO so it can never reorder against shard control)
+SHARD_REPLICATE_ACK = "shard_replicate_ack"
 # worker → frontend
 SERVE_RESULT = "serve_result"
 SHARD_STATE = "shard_state"
+# session replication: data half (primary → frontend, relayed to the
+# shard's replica as a ``replicate`` op on the replica's op FIFO)
+SHARD_REPLICATE = "shard_replicate"
 
 # worker ↔ worker (the peer-to-peer data plane)
 PEER_HELLO = "peer_hello"

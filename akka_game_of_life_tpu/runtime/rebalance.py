@@ -243,6 +243,7 @@ class Rebalancer:
         members,
         now: float,
         drain_only: bool = False,
+        replicas: Optional[Dict[int, Optional[str]]] = None,
     ) -> List[Tuple[int, str, str]]:
         """(shard, source, dest) **session-shard** moves — the planner's
         second resource type (the cluster-sharded serving plane; the serve
@@ -260,7 +261,17 @@ class Rebalancer:
         come first and empty the drainer lightest-shards-first
         (``weights`` = sessions per shard), so a draining worker is
         released in the fewest protocol rounds blocked behind big
-        exports."""
+        exports.
+
+        ``replicas`` (shard → replica worker, from the serve plane's
+        replication table) is a PLACEMENT CONSTRAINT: a shard and its
+        replica should not co-reside, so a shard's replica is avoided as
+        its migration destination whenever any other placeable member
+        exists.  When the replica is the ONLY destination (a 2-worker
+        drain), the move still happens — wedging a drain forever would be
+        worse than a transient co-residence, and the serve plane's
+        post-commit replica refresh re-homes the replica in the same lock
+        hold that commits the move."""
         moves: List[Tuple[int, str, str]] = []
         # The in-flight budget bounds only LOADED shards (each move
         # freezes sessions and runs the transfer protocol).  An EMPTY
@@ -307,32 +318,51 @@ class Rebalancer:
             budget -= 1
             return True
 
+        def pick_dest(shard: int, exclude=()) -> Optional[str]:
+            """Least-loaded placeable destination, avoiding the shard's
+            replica (the no-co-residence constraint) unless the replica
+            is the only destination left."""
+            pool = [n for n in loads if n not in exclude]
+            if not pool:
+                return None
+            banned = (replicas or {}).get(shard)
+            cands = [n for n in pool if n != banned] or pool
+            return min(cands, key=lambda n: (loads[n], n))
+
         # 1. Drain-driven: always planned, every pass (lightest shards
         # first, so the free empties flip out immediately).
         for m in members:
             if not (m.alive and m.draining):
                 continue
             for shard in movable(m.name):
-                if not loads or not charge(shard):
+                if not loads:
                     break
-                dest = min(loads, key=lambda n: loads[n])
+                dest = pick_dest(shard)
+                if dest is None or not charge(shard):
+                    continue
                 moves.append((shard, m.name, dest))
                 planned.add(shard)
                 loads[dest] += 1
 
-        # 2. Load-driven spreading (shard-count gap ≥ 2), cadenced.
+        # 2. Load-driven spreading (shard-count gap ≥ 2), cadenced.  The
+        # (shard, dest) pair is chosen together: each candidate shard's
+        # replica bans ITS least-loaded destination individually, so one
+        # shard's constraint never blocks the whole pass.
         if not drain_only and now >= self._next_shard_plan_at:
             self._next_shard_plan_at = now + self.interval_s
             gap = max(2, self.min_gap)
             while len(loads) >= 2:
                 src = max(placeable, key=lambda m: loads.get(m.name, 0))
-                dest = min(loads, key=lambda n: loads[n])
-                if dest == src.name or loads[src.name] - loads[dest] < gap:
+                choice = None
+                for s in movable(src.name):
+                    d = pick_dest(s, exclude=(src.name,))
+                    if d is None or loads[src.name] - loads[d] < gap:
+                        continue
+                    choice = (s, d)
                     break
-                cands = movable(src.name)
-                if not cands or not charge(cands[0]):
+                if choice is None or not charge(choice[0]):
                     break
-                shard = cands[0]
+                shard, dest = choice
                 moves.append((shard, src.name, dest))
                 planned.add(shard)
                 loads[src.name] -= 1

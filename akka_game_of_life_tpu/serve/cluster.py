@@ -28,6 +28,31 @@ arriving mid-move are *held* and replayed at the new owner, and a
 mid-traffic SIGTERM drain loses zero admitted jobs.  A shard with no
 sessions flips ownership without any wire protocol.
 
+**Session replication & crash failover.**  Each owned shard gets a
+*replica* worker (sticky once assigned — churn discards acked standby
+state; rendezvous-hashed by (shard, worker) on fresh assignment so a
+membership change re-homes only the shards that must move; never the
+primary): the primary streams dirty session snapshots —
+bit-packed boards plus digest lanes — to the frontend
+(``SHARD_REPLICATE``), which relays them to the replica as ``replicate``
+ops on the replica's op FIFO and acks the primary with the per-session
+epoch watermark (``SHARD_REPLICATE_ACK``, on the primary's op FIFO — so
+replication can never reorder against shard control).  On worker loss
+the frontend *promotes* replicas instead of 404ing: the replica
+certifies every standby payload against its streamed lanes and installs
+it, promoted sessions resume from their last acked replicated epoch, and
+ops caught in the window answer the retryable 429 ``failover`` (the
+board is provably at its replicated epoch) rather than 404.  When no
+second placeable worker exists the plane degrades honestly to
+single-copy mode (``gol_serve_single_copy_shards`` + the /healthz flag;
+primaries park their streams), and replication lag past
+``serve_replicate_max_lag_s`` is surfaced loudly, never silently
+unbounded.  A shard and its replica do not co-reside — the rebalancer
+avoids a shard's replica as a migration destination (falling back only
+when it is the last placeable member, with the replica re-homed in the
+same lock hold that commits the move), and drains re-home replicas (a
+draining worker is released only once nothing replicates to it).
+
 **Tiled (mega-board) sessions.**  A board above the largest size class is
 no longer rejected: it is admitted as a first-class *tiled* session on
 the existing halo/digest machinery — the frontend keeps the board, splits
@@ -83,7 +108,7 @@ class _Entry:
     __slots__ = (
         "sid", "tenant", "kind", "rule_s", "height", "width",
         "seed", "density", "shard", "epoch", "digest", "last_used",
-        "evicting",
+        "evicting", "repl_epoch", "repl_dirty_since",
     )
 
     def __init__(self, sid, tenant, kind, rule_s, height, width, seed,
@@ -104,6 +129,23 @@ class _Entry:
         # leak the cluster admission budget this index charges).
         self.last_used = time.monotonic()
         self.evicting = False
+        # Replication watermark: the highest epoch the shard's replica has
+        # ACKED for this session (-1 = nothing replicated — a promotion
+        # cannot save it), and when the session first advanced past it
+        # (None = clean; the lag gauge reads this).
+        self.repl_epoch = -1
+        self.repl_dirty_since: Optional[float] = time.monotonic()
+
+    def mark_dirty(self, now: float) -> None:
+        """Epoch moved past the acked watermark: start the lag clock
+        (idempotent — the clock keeps its ORIGINAL dirty time until the
+        replica catches all the way up)."""
+        if (
+            self.shard is not None
+            and self.epoch > self.repl_epoch
+            and self.repl_dirty_since is None
+        ):
+            self.repl_dirty_since = now
 
     def summary(self, owner: Optional[str]) -> dict:
         return {
@@ -240,6 +282,27 @@ class ClusterServePlane:
         self._m_digest_mismatches = self.metrics.counter(
             "gol_digest_mismatches_total"
         )
+        # Session replication & failover (docs/OPERATIONS.md "Session
+        # replication & failover").
+        self._replicate = bool(config.serve_replicate)
+        self.repl_max_lag_s = float(config.serve_replicate_max_lag_s)
+        self._m_repl_lag = self.metrics.gauge(
+            "gol_serve_replication_lag_seconds",
+            "Age of the oldest un-acked session update, per shard",
+            ("shard",),
+        )
+        self._m_repl_bytes = self.metrics.counter(
+            "gol_serve_replica_bytes_total"
+        )
+        self._m_promotions = self.metrics.counter(
+            "gol_serve_promotions_total"
+        )
+        self._m_single_copy = self.metrics.gauge(
+            "gol_serve_single_copy_shards"
+        )
+        self._m_sessions_lost = self.metrics.counter(
+            "gol_serve_sessions_lost_total"
+        )
 
         # The elastic planner's second resource type rides a plane-owned
         # Rebalancer: same policy/backoff machinery, zero contention with
@@ -260,6 +323,11 @@ class ClusterServePlane:
         self._pending: Dict[int, _Pending] = {}  # graftlint: guarded-by _lock
         self._outq: Dict[str, deque] = {}  # graftlint: guarded-by _lock
         self._held: Dict[int, List[_Pending]] = {}  # graftlint: guarded-by _lock
+        self.shard_replica: Dict[int, Optional[str]] = {}  # graftlint: guarded-by _lock
+        self._promoting: Dict[int, dict] = {}  # graftlint: guarded-by _lock
+        self._lag_alert: set = set()  # graftlint: guarded-by _lock
+        self._lag_minted: set = set()  # graftlint: guarded-by _lock
+        self._lag_snapshot: Dict[int, float] = {}  # graftlint: guarded-by _lock
         self._draining = False  # graftlint: guarded-by _lock
         self._stopped = False  # graftlint: guarded-by _lock
         self._health_snapshot: Dict[str, dict] = {
@@ -374,6 +442,7 @@ class ClusterServePlane:
         with self._lock:
             entry.epoch = int(doc.get("epoch", 0))
             entry.digest = doc.get("digest")
+            entry.mark_dirty(time.monotonic())
         return doc
 
     def _tiled_doc(self, sid, entry, t, *, with_board: bool) -> dict:
@@ -413,6 +482,7 @@ class ClusterServePlane:
         with self._lock:
             entry.epoch = int(doc.get("epoch", entry.epoch))
             entry.digest = doc.get("digest", entry.digest)
+            entry.mark_dirty(time.monotonic())
         return doc
 
     def list(self) -> List[dict]:
@@ -444,6 +514,9 @@ class ClusterServePlane:
             if self.sessions.get(sid) is entry:
                 del self.sessions[sid]
                 self._cells -= entry.height * entry.width
+                # The replica's standby copy must go too, or a later
+                # promotion would resurrect a deleted board.
+                self._replicate_forget_locked(entry.shard, sid)
 
     def step(self, sid: str, steps: int = 1) -> Tuple[int, int]:
         if steps < 1:
@@ -468,6 +541,7 @@ class ClusterServePlane:
             if self.sessions.get(sid) is entry and epoch >= entry.epoch:
                 entry.epoch = epoch
                 entry.digest = odigest.format_digest(digest)
+                entry.mark_dirty(time.monotonic())
         return epoch, digest
 
     # -- op plumbing ----------------------------------------------------------
@@ -492,6 +566,18 @@ class ClusterServePlane:
         if p.member is not None:
             self._outq.setdefault(p.member, deque()).append(p)
             return
+        if p.shard in self._promoting:
+            # The shard's primary just died and its replica is being
+            # promoted: EVERY op (step/get/delete/create) answers the
+            # retryable 429 ``failover`` — the 404-vs-retryable
+            # distinction is the client contract (the board provably
+            # resumes at its replicated epoch; a retry lands post-commit).
+            del self._pending[p.rid]
+            self._reject(
+                "failover",
+                f"shard {p.shard} is mid-promotion after a worker loss; "
+                f"the board resumes at its last replicated epoch — retry",
+            )
         if p.shard in self.rebalancer.inflight:
             self._held.setdefault(p.shard, []).append(p)
             return
@@ -732,18 +818,27 @@ class ClusterServePlane:
             unowned = [s for s, o in self.shard_owner.items() if o is None]
             for shard in unowned:
                 self._assign_shard_locked(shard)
+            # The joiner may be the FIRST second worker: single-copy
+            # shards get their replica (and the primaries a stream reset)
+            # right away, not at the next maintenance pass.
+            self._refresh_replicas_locked()
         self._refresh_gauges()
 
     def on_member_lost(self, name: str) -> None:
-        """A worker died: its resident sessions are gone (the serving
-        plane replicates nothing — honesty over magic).  Every in-flight
-        op gets an ANSWER (the never-silently-lost contract): sent ops
-        report unknown-outcome, unsent creates/tile-chunks replay
-        elsewhere, ops for dead sessions 404.  Its shards reassign empty
-        to survivors; migrations involving it roll back or — when the
-        certified state already left the source — complete anyway."""
+        """A worker died.  Shards with a live replica PROMOTE — their
+        sessions survive, resuming from the last acked replicated epoch,
+        and ops caught in the window answer the retryable 429
+        ``failover``.  Shards without one lose their sessions honestly
+        (404 + ``gol_serve_sessions_lost_total``).  Every in-flight op
+        gets an ANSWER (the never-silently-lost contract): sent ops on
+        promoting shards answer ``failover``, other sent ops report
+        unknown-outcome, unsent creates/tile-chunks replay elsewhere,
+        ops for dead sessions 404.  Migrations involving the member roll
+        back or — when the certified state already left the source —
+        complete anyway."""
         resolutions: List[Tuple[_Pending, Optional[dict], Optional[BaseException]]] = []
         aborts: List = []
+        promotions: List[Tuple[int, dict]] = []
         with self._lock:
             if self._stopped:
                 return  # teardown: member losses are expected, plane is done
@@ -761,21 +856,38 @@ class ClusterServePlane:
                 s for s, o in self.shard_owner.items()
                 if o == name and s not in self.rebalancer.inflight
             ]
-            lost_sids = {
-                sid for sid, e in self.sessions.items()
-                if e.shard in lost_shards
-            }
-            for sid in lost_sids:
-                e = self.sessions.pop(sid)
-                self._cells -= e.height * e.width
+            lost_sids: set = set()
             for shard in lost_shards:
+                info = self._begin_promotion_locked(shard)
+                if info is not None:
+                    promotions.append((shard, info))
+                    lost_sids |= info["dropped"]
+                    continue
+                # No live replica (replication off, single-copy shard, or
+                # a double failure): honest loss.
+                for sid in [
+                    s for s, e in self.sessions.items() if e.shard == shard
+                ]:
+                    e = self.sessions.pop(sid)
+                    self._cells -= e.height * e.width
+                    self._m_sessions_lost.inc()
+                    lost_sids.add(sid)
                 self.shard_owner[shard] = None
                 self._assign_shard_locked(shard)
+            promoting = set(self._promoting)
             for p in list(self._pending.values()):
                 if p.member != name:
                     continue
                 self._pending.pop(p.rid, None)
-                if p.sent:
+                if p.shard in promoting:
+                    # The board provably resumes at its replicated epoch:
+                    # retryable, never an unknown-outcome shrug.
+                    resolutions.append((p, None, AdmissionError(
+                        "failover",
+                        f"serve worker {name} lost mid-op; the shard's "
+                        f"replica is being promoted — retry",
+                    )))
+                elif p.sent:
                     resolutions.append((p, None, TimeoutError(
                         f"serve worker {name} lost; op outcome unknown"
                         + (" (session lost with it)" if p.sid in lost_sids
@@ -791,10 +903,17 @@ class ClusterServePlane:
                     if err is not None:
                         resolutions.append((p, None, err))
             self._outq.pop(name, None)
+            # A dead member may also have been a REPLICA: re-home every
+            # replica assignment that pointed at it (the primaries get a
+            # reset, so their streams start from scratch toward the new
+            # replica).
+            self._refresh_replicas_locked()
             self._work.notify_all()
         for mig, reason, notify, lost in aborts:
             self._abort_shard(mig, reason, source_alive=notify,
                               sessions_lost=lost)
+        for shard, info in promotions:
+            self._launch_promotion(shard, info, lost_member=name)
         for p, result, error in resolutions:
             self._resolve(p, result=result, error=error)
         # Gauge reclaim, the heartbeat-age discipline: a dead member's
@@ -809,6 +928,15 @@ class ClusterServePlane:
         it — the serve analog of 'owns no tiles'."""
         with self._lock:
             if any(o == name for o in self.shard_owner.values()):
+                return False
+            if any(r == name for r in self.shard_replica.values()):
+                # Still a replica somewhere: releasing it now would
+                # silently drop standby state the re-homing pass (drains
+                # re-home replicas every poll) hasn't moved yet.
+                return False
+            if any(
+                info["dest"] == name for info in self._promoting.values()
+            ):
                 return False
             if any(
                 name in (m.source, m.dest)
@@ -835,18 +963,32 @@ class ClusterServePlane:
         for mig in overdue:
             self._abort_shard(mig, "deadline")
         self._sweep_ttl(now)
+        lag_events: Dict[int, float] = {}
         with self._lock:
             if self._stopped or self._draining:
                 self._refresh_gauges_locked()
                 return
+            # Replica upkeep before planning: drains re-home replicas
+            # (a draining worker is not placeable), losses already
+            # re-homed in on_member_lost, and the single-copy gauge
+            # tracks the honest degradation level.
+            self._refresh_replicas_locked()
+            lag_events = {
+                s: self._lag_snapshot.get(s, 0.0)
+                for s in self._update_lag_locked(now)
+            }
             members = self.membership.alive_members()
             weights: Dict[int, int] = {}
             for e in self.sessions.values():
                 if e.shard is not None:
                     weights[e.shard] = weights.get(e.shard, 0) + 1
             plans = self.rebalancer.plan_shards(
-                {s: o for s, o in self.shard_owner.items() if o is not None},
+                {
+                    s: o for s, o in self.shard_owner.items()
+                    if o is not None and s not in self._promoting
+                },
                 weights, members, now, drain_only=drain_only,
+                replicas=self.shard_replica,
             )
             for shard, source, dest in plans:
                 sids = [
@@ -880,6 +1022,14 @@ class ClusterServePlane:
                     "seq": mig.seq,
                 })
             self._refresh_gauges_locked()
+        if self.events is not None:
+            for shard, lag in sorted(lag_events.items()):
+                # Loud, transition-edged (only shards NEWLY over the
+                # bound): replication lag is never silently unbounded.
+                self.events.emit(
+                    "serve_replication_lag_exceeded", shard=shard,
+                    lag_s=round(lag, 3), bound_s=self.repl_max_lag_s,
+                )
 
     def _sweep_ttl(self, now: float) -> None:
         """The cluster-wide idle-session TTL (workers run with ttl 0 —
@@ -935,6 +1085,7 @@ class ClusterServePlane:
                 del self.sessions[sid]
                 self._cells -= e.height * e.width
                 self._m_evictions.inc()
+                self._replicate_forget_locked(e.shard, sid)
             else:
                 e.evicting = False
 
@@ -1021,6 +1172,11 @@ class ClusterServePlane:
                 held.sent = False
                 self._outq.setdefault(mig.dest, deque()).append(held)
                 flush.append(held)
+            # Ownership moved: the replica may now co-reside with the new
+            # owner (it was the migration dest's sibling constraint, but
+            # membership may have shifted) — reconcile immediately, so the
+            # co-residence window is one lock hold, not one poll tick.
+            self._refresh_replicas_locked()
             self._work.notify_all()
         if self.events is not None:
             self.events.emit(
@@ -1076,27 +1232,44 @@ class ClusterServePlane:
             # future op for 1/serve_shards of the keyspace.
             src_m = self.membership.get(mig.source)
             lost = sessions_lost or src_m is None or not src_m.alive
+            promotion = None
             if lost:
-                # Recomputed LIVE from the index (not the plan-time
-                # snapshot): a create that landed on the shard after the
-                # migration was planned died with the source too.
-                for sid in [
-                    s for s, e in self.sessions.items()
-                    if e.shard == mig.tile
-                ]:
-                    e = self.sessions.pop(sid)
-                    self._cells -= e.height * e.width
-                self.shard_owner[mig.tile] = None
-                self._assign_shard_locked(mig.tile)
+                # A source that died mid-migration is just a worker loss
+                # wearing a migration: a live replica PROMOTES — the op
+                # FIFO makes the race safe (the promote lands at the
+                # replica after every replicate install already queued,
+                # and the recalled adopt/cleanup rides the dest's own
+                # lane) — and only a replica-less shard loses sessions.
+                promotion = self._begin_promotion_locked(mig.tile)
+                if promotion is None:
+                    # Recomputed LIVE from the index (not the plan-time
+                    # snapshot): a create that landed on the shard after
+                    # the migration was planned died with the source too.
+                    for sid in [
+                        s for s, e in self.sessions.items()
+                        if e.shard == mig.tile
+                    ]:
+                        e = self.sessions.pop(sid)
+                        self._cells -= e.height * e.width
+                        self._m_sessions_lost.inc()
+                    self.shard_owner[mig.tile] = None
+                    self._assign_shard_locked(mig.tile)
             held = self._held.pop(mig.tile, [])
             for p in held:
-                if lost and p.kind != "create":
-                    self._pending.pop(p.rid, None)
+                self._pending.pop(p.rid, None)
+                if lost and promotion is not None:
+                    # Mid-promotion: the retryable contract, never a 404
+                    # for a board that provably survives.
+                    resolutions.append((p, AdmissionError(
+                        "failover",
+                        f"shard {mig.tile} is being promoted after its "
+                        f"worker died mid-migration; retry",
+                    )))
+                elif lost and p.kind != "create":
                     resolutions.append((p, KeyError(p.sid)))
                 else:
                     # Replay at whoever owns the shard now (the unfrozen
                     # source on a plain abort; a survivor on source loss).
-                    self._pending.pop(p.rid, None)
                     p.sent = False
                     p.member = None
                     try:
@@ -1124,6 +1297,372 @@ class ClusterServePlane:
             )
         for p, err in resolutions:
             self._resolve(p, error=err)
+        if promotion is not None:
+            self._launch_promotion(
+                mig.tile, promotion, lost_member=mig.source
+            )
+
+    # -- session replication & failover ---------------------------------------
+
+    def _replica_for_locked(
+        self, shard: int, owner: Optional[str], names: List[str],
+        current: Optional[str] = None,
+    ) -> Optional[str]:
+        """The shard's replica — STICKY first, rendezvous-hashed second,
+        never the primary.  A still-valid current replica is kept: every
+        reassignment discards acked standby state and resets the stream,
+        so churn IS a board-loss window (a primary dying before the new
+        replica's from-scratch stream acks loses what the old replica
+        still held).  Fresh assignments use rendezvous hashing
+        (highest-random-weight by (shard, worker)), so a membership
+        change re-homes only the shards that must move, not ~all of them
+        the way a modulo ring would."""
+        import zlib
+
+        if not self._replicate or owner is None:
+            return None
+        if current is not None and current != owner and current in names:
+            return current
+        pool = [n for n in names if n != owner]
+        if not pool:
+            return None
+        return max(
+            pool,
+            key=lambda n: (zlib.crc32(f"{shard}:{n}".encode("utf-8")), n),
+        )
+
+    def _refresh_replicas_locked(self) -> None:
+        """Reconcile replica assignments with the current membership and
+        shard table (caller holds the lock).  A change resets the
+        frontend watermarks for the shard, tells the primary to restart
+        its stream from scratch (the new replica holds nothing), and
+        tells a surviving old replica to drop its standby copies.  Also
+        refreshes the single-copy gauge — the honest-degradation signal."""
+        now = time.monotonic()
+        names = sorted(
+            m.name for m in self.membership.placeable_members()
+        )
+        single = 0
+        for shard, owner in self.shard_owner.items():
+            if shard in self._promoting:
+                continue  # ownership settles at the promote result first
+            desired = self._replica_for_locked(
+                shard, owner, names, current=self.shard_replica.get(shard)
+            )
+            if owner is not None and desired is None and self._replicate:
+                single += 1
+            cur = self.shard_replica.get(shard)
+            if desired == cur:
+                continue
+            self.shard_replica[shard] = desired
+            if cur is not None:
+                old = self.membership.get(cur)
+                if old is not None and old.alive:
+                    self._submit(
+                        {"op": "replica_drop", "rid": 0, "shard": shard},
+                        kind="replicate", member=cur,
+                        on_done=lambda _p: None,
+                    )
+            # The new replica starts empty: frontend watermarks reset and
+            # the primary streams the shard from scratch.
+            for e in self.sessions.values():
+                if e.shard == shard:
+                    e.repl_epoch = -1
+                    if e.repl_dirty_since is None:
+                        e.repl_dirty_since = now
+            if owner is not None:
+                pm = self.membership.get(owner)
+                if pm is not None and pm.alive:
+                    self._enqueue_ctrl_locked(owner, {
+                        "type": P.SHARD_REPLICATE_ACK, "shard": shard,
+                        "reset": True,
+                    })
+        self._m_single_copy.set(single if self._replicate else 0)
+
+    def _replicate_forget_locked(self, shard, sid: str) -> None:
+        """A session left the index (delete/evict): its replica standby
+        copy must go too, or a later promotion would resurrect it."""
+        if not self._replicate or shard is None:
+            return
+        repl = self.shard_replica.get(shard)
+        m = self.membership.get(repl) if repl is not None else None
+        if m is None or not m.alive:
+            return
+        self._submit(
+            {"op": "replicate", "rid": 0, "shard": int(shard),
+             "sessions": [], "deleted": [sid]},
+            kind="replicate", member=repl, on_done=lambda _p: None,
+        )
+
+    def on_shard_replicate(self, member_name: str, msg: dict) -> None:
+        """A primary's replication stream frame: relay the payloads to
+        the shard's replica through the replica's op FIFO (so an install
+        can never reorder against a promote/adopt there), or park the
+        stream when no replica is placeable."""
+        if not self._replicate:
+            return
+        shard = int(msg["shard"])
+        payloads = msg.get("sessions", [])
+        with self._lock:
+            if self._stopped:
+                return
+            if (
+                self.shard_owner.get(shard) != member_name
+                or shard in self._promoting
+            ):
+                return  # stale stream from a former owner; ignore
+            repl = self.shard_replica.get(shard)
+            m = self.membership.get(repl) if repl is not None else None
+            if m is None or not m.alive:
+                # Single-copy mode: park the primary's stream instead of
+                # letting it re-ship every board every pass to nobody.
+                self._enqueue_ctrl_locked(member_name, {
+                    "type": P.SHARD_REPLICATE_ACK, "shard": shard,
+                    "parked": True,
+                })
+                return
+            # A session deleted mid-stream must not resurrect standby-side.
+            keep = [
+                pay for pay in payloads
+                if (e := self.sessions.get(str(pay.get("sid")))) is not None
+                and e.shard == shard
+            ]
+            if not keep:
+                return
+            nbytes = 0
+            for pay in keep:
+                data = pay.get("state", {}).get("data")
+                nbytes += getattr(data, "nbytes", 0)
+            self._m_repl_bytes.inc(nbytes)
+            self._submit(
+                {"op": "replicate", "rid": 0, "shard": shard,
+                 "sessions": keep},
+                kind="replicate", member=repl,
+                on_done=lambda p, shard=shard, primary=member_name: (
+                    self._on_replicated(shard, primary, p)
+                ),
+            )
+
+    def _on_replicated(self, shard: int, primary: str, p: _Pending) -> None:
+        """A replica acked an install: advance the frontend watermarks
+        and relay the ack to the primary (its op FIFO) so its stream
+        moves on.  A failed install is simply NOT acked — the primary's
+        next pass retransmits (the watermark-retransmit contract)."""
+        if p.error is not None or not p.result:
+            return
+        acked = {
+            str(sid): int(epoch)
+            for sid, epoch in dict(p.result.get("acked", {})).items()
+        }
+        if not acked:
+            return
+        with self._lock:
+            if self.shard_replica.get(shard) != p.member:
+                return  # replica reassigned since: a stale ack must not
+                # advance watermarks the NEW replica never earned
+            now = time.monotonic()
+            for sid, epoch in acked.items():
+                e = self.sessions.get(sid)
+                if e is None or e.shard != shard:
+                    continue
+                if epoch > e.repl_epoch:
+                    e.repl_epoch = epoch
+                    # Re-base the lag clock on every watermark advance:
+                    # the oldest un-acked update is now at most this old.
+                    # Without this, a continuously-stepped session's lag
+                    # would read time-since-FIRST-dirty and fire a false
+                    # over-bound alert under perfectly healthy sustained
+                    # traffic.
+                    e.repl_dirty_since = (
+                        None if e.repl_epoch >= e.epoch else now
+                    )
+                elif e.repl_epoch >= e.epoch:
+                    e.repl_dirty_since = None
+            pm = self.membership.get(primary)
+            if pm is not None and pm.alive:
+                self._enqueue_ctrl_locked(primary, {
+                    "type": P.SHARD_REPLICATE_ACK, "shard": shard,
+                    "acked": acked,
+                })
+
+    def _begin_promotion_locked(self, shard: int) -> Optional[dict]:
+        """Mark one dead-owner shard for promotion (caller holds the
+        lock): flip ownership to the live replica, drop the sessions the
+        replica never acked (nothing can save them — counted lost), and
+        open the ``serve.promote`` span.  Returns the promotion record,
+        or None when no live replica exists (the caller takes the honest-
+        loss path)."""
+        repl = self.shard_replica.get(shard) if self._replicate else None
+        m = self.membership.get(repl) if repl is not None else None
+        if m is None or not m.alive or shard in self._promoting:
+            return None
+        dropped: set = set()
+        kept = 0
+        for sid in [
+            s for s, e in self.sessions.items() if e.shard == shard
+        ]:
+            e = self.sessions[sid]
+            if e.repl_epoch < 0:
+                del self.sessions[sid]
+                self._cells -= e.height * e.width
+                self._m_sessions_lost.inc()
+                dropped.add(sid)
+            else:
+                kept += 1
+        self.shard_owner[shard] = repl
+        self.shard_replica[shard] = None
+        info = {
+            "dest": repl,
+            "t0": time.monotonic(),
+            "sessions": kept,
+            "dropped": dropped,
+            "span": self.tracer.start(
+                "serve.promote", node="frontend", shard=shard,
+                dest=repl, sessions=kept,
+            ),
+        }
+        self._promoting[shard] = info
+        return info
+
+    def _launch_promotion(
+        self, shard: int, info: dict, *, lost_member: str = ""
+    ) -> None:
+        """Fire one promotion (caller must NOT hold the lock): flight
+        dump — a promotion is exactly the moment a post-mortem wants
+        context for — then the ``promote`` op through the replica's op
+        FIFO, ordered after every replicate install already queued
+        there."""
+        flight = getattr(self.tracer, "flight", None)
+        if flight is not None:
+            flight.dump("serve_promote", node="frontend")
+        if self.events is not None:
+            self.events.emit(
+                "serve_promotion_started", shard=shard,
+                dest=info["dest"], lost=lost_member,
+                sessions=info["sessions"],
+                unreplicated_lost=len(info["dropped"]),
+            )
+        try:
+            self._submit(
+                {"op": "promote", "rid": 0, "shard": shard},
+                kind="promote", member=info["dest"],
+                on_done=lambda p, shard=shard: self._on_promoted(shard, p),
+            )
+        except Exception as e:  # noqa: BLE001 — a submit failure must
+            # resolve the promotion (double failure), never strand the
+            # shard mid-promotion forever
+            fake = _Pending(0, {}, kind="promote", member=info["dest"])
+            fake.error = e
+            self._on_promoted(shard, fake)
+
+    def _on_promoted(self, shard: int, p: _Pending) -> None:
+        """The replica answered the promote.  Success: promoted sessions
+        resume at their certified replicated epoch (index epochs roll
+        BACK to it — that is the honest state), a new replica is
+        appointed, and the new primary streams from scratch.  Failure
+        (the replica died too — double failure): the shard's remaining
+        sessions are lost honestly."""
+        lost: List[str] = []
+        failed: List[str] = []
+        promoted = 0
+        with self._lock:
+            info = self._promoting.get(shard)
+            if info is None or info["dest"] != p.member:
+                return
+            del self._promoting[shard]
+            span = info["span"]
+            now = time.monotonic()
+            if p.error is not None or not p.result:
+                for sid in [
+                    s for s, e in self.sessions.items() if e.shard == shard
+                ]:
+                    e = self.sessions.pop(sid)
+                    self._cells -= e.height * e.width
+                    self._m_sessions_lost.inc()
+                    lost.append(sid)
+                if self.shard_owner.get(shard) == p.member:
+                    self.shard_owner[shard] = None
+                    self._assign_shard_locked(shard)
+                if span is not None:
+                    span.set(outcome="lost", error=repr(p.error)).finish()
+            else:
+                installed = {
+                    str(row["sid"]): row
+                    for row in p.result.get("installed", [])
+                }
+                failed = [str(s) for s in p.result.get("failed", [])]
+                for sid in [
+                    s for s, e in self.sessions.items() if e.shard == shard
+                ]:
+                    e = self.sessions[sid]
+                    row = installed.get(sid)
+                    if row is None:
+                        # Standby missing or digest-refused: lost, loudly.
+                        del self.sessions[sid]
+                        self._cells -= e.height * e.width
+                        self._m_sessions_lost.inc()
+                        if sid in failed:
+                            self._m_digest_mismatches.inc()
+                        lost.append(sid)
+                        continue
+                    # Certified resume point: the index rolls back to the
+                    # replicated epoch — that IS the board's state now.
+                    e.epoch = int(row["epoch"])
+                    e.digest = odigest.format_digest(odigest.value(
+                        np.asarray(row["digest"], dtype=np.uint32)
+                    ))
+                    e.repl_epoch = -1
+                    e.repl_dirty_since = now
+                    promoted += 1
+                self._m_promotions.inc()
+                if span is not None:
+                    span.set(
+                        outcome="promoted", sessions=promoted,
+                        latency_s=round(now - info["t0"], 6),
+                    ).finish()
+                # Appoint the next replica; the new primary streams the
+                # shard from scratch (it has no watermark state).
+                self._refresh_replicas_locked()
+            self._work.notify_all()
+        if self.events is not None:
+            self.events.emit(
+                "serve_promotion_finished", shard=shard, dest=p.member,
+                promoted=promoted, lost=len(lost),
+                digest_refused=len(failed),
+                outcome="lost" if p.error is not None else "promoted",
+            )
+
+    def _update_lag_locked(self, now: float) -> set:
+        """Per-shard replication lag gauges (age of the oldest un-acked
+        update; defined only while a replica exists — single-copy shards
+        surface through the single-copy gauge instead) and the over-bound
+        alert set.  Returns shards NEWLY over the bound so the caller can
+        emit events outside the lock."""
+        lag: Dict[int, float] = {}
+        if self._replicate:
+            for e in self.sessions.values():
+                if (
+                    e.shard is None
+                    or e.repl_dirty_since is None
+                    or self.shard_replica.get(e.shard) is None
+                ):
+                    continue
+                lag[e.shard] = max(
+                    lag.get(e.shard, 0.0), now - e.repl_dirty_since
+                )
+        for shard in self._lag_minted - set(lag):
+            # Reclaim, the breaker-reset hygiene discipline: a caught-up
+            # (or emptied, or lost) shard's series reads 0, not its last
+            # stale lag.
+            self._m_repl_lag.labels(shard=str(shard)).set(0.0)
+        for shard, val in lag.items():
+            self._m_repl_lag.labels(shard=str(shard)).set(val)
+        self._lag_minted |= set(lag)
+        alert = {s for s, v in lag.items() if v > self.repl_max_lag_s}
+        fresh = alert - self._lag_alert
+        self._lag_alert = alert
+        self._lag_snapshot = lag
+        return fresh
 
     # -- tiled (mega-board) sessions ------------------------------------------
 
@@ -1262,6 +1801,16 @@ class ClusterServePlane:
         with self._lock:
             self._refresh_gauges_locked()
             snap = self._health_snapshot
+            replicas: Dict[str, int] = {}
+            single = 0
+            for shard, owner in self.shard_owner.items():
+                if owner is None:
+                    continue
+                r = self.shard_replica.get(shard)
+                if r is not None:
+                    replicas[r] = replicas.get(r, 0) + 1
+                elif self._replicate and shard not in self._promoting:
+                    single += 1
             return {
                 "sessions": len(self.sessions),
                 "cells": self._cells,
@@ -1273,6 +1822,22 @@ class ClusterServePlane:
                 "shard_migrations_inflight": len(self.rebalancer.inflight),
                 "held_ops": sum(len(v) for v in self._held.values()),
                 "draining": self._draining,
+                "replication": {
+                    "enabled": self._replicate,
+                    "replicas_by_worker": replicas,
+                    "single_copy_shards": (
+                        single if self._replicate
+                        else sum(
+                            1 for o in self.shard_owner.values()
+                            if o is not None
+                        )
+                    ),
+                    "promotions_inflight": len(self._promoting),
+                    "max_lag_s": round(
+                        max(self._lag_snapshot.values(), default=0.0), 3
+                    ),
+                    "lag_alert_shards": sorted(self._lag_alert),
+                },
             }
 
     def stats(self) -> dict:
@@ -1311,6 +1876,12 @@ class ClusterServePlane:
             self._pending.clear()
             self._outq.clear()
             self._held.clear()
+            # Promotion spans must not outlive the run (the elastic-plane
+            # discipline): finish open ones with outcome=shutdown.
+            for info in self._promoting.values():
+                if info.get("span") is not None:
+                    info["span"].set(outcome="shutdown").finish()
+            self._promoting.clear()
             self._work.notify_all()
         for p in doomed:
             self._resolve(p, error=RuntimeError("router is closed"))

@@ -84,7 +84,10 @@ class AdmissionError(Exception):
     bypass the bound via the O(log T) fast path instead) | ``migrating``
     (the session's shard is mid-migration on the cluster plane — always
     retryable; the cluster frontend holds such ops and replays them at the
-    shard's new owner, so tenants never see this reason)."""
+    shard's new owner, so tenants never see this reason) | ``failover``
+    (the session's shard is mid-promotion after its worker died — always
+    retryable: the board provably resumes at its last replicated epoch,
+    and the retry lands at the promoted replica)."""
 
     def __init__(self, reason: str, detail: str) -> None:
         super().__init__(detail)

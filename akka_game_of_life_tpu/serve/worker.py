@@ -21,6 +21,19 @@ the serve wire protocol with the frontend:
   drop on commit or unfreeze on abort.  Shard control rides the same
   executor queue as ops, so it orders behind every op frame that preceded
   it on the wire.
+- ``SHARD_REPLICATE`` / ``SHARD_REPLICATE_ACK`` — session replication:
+  a streamer thread exports *dirty* resident sessions (epoch past the
+  acked watermark by ``serve_replicate_every``, or new, or idle-dirty)
+  at ``serve_replicate_interval_s`` cadence and ships them to the
+  frontend, which relays each shard's payloads to its replica worker as
+  a ``replicate`` op and acks this primary with the per-session epoch
+  watermark.  Watermarks only advance on ack, so a dropped frame in
+  either direction is retransmitted by the next pass — convergence is
+  exact once traffic stops.  The replica side is the ``replicate`` /
+  ``promote`` / ``replica_drop`` ops below: standby payloads live in a
+  plain dict OUTSIDE the router (they must not pollute shard-hash freeze
+  sets or session listings) until a promotion certifies and installs
+  them.
 
 The plane is constructed from the WELCOME policy bundle (the frontend owns
 the ``serve_*`` knobs cluster-wide, exactly like the ring/retry policy).
@@ -58,9 +71,18 @@ SERVE_POLICY_KEYS = (
     "serve_tick_s",
     "serve_ttl_s",
     "serve_size_classes",
+    "serve_replicate",
+    "serve_replicate_every",
+    "serve_replicate_interval_s",
     "ff_enabled",
     "ff_certify_steps",
 )
+
+# A snapshot streamed but not yet acked is not re-sent until the ack
+# timeout passes (the ack may simply be in flight); after it, the next
+# pass retransmits — the loss-recovery half of the watermark protocol.
+# Scaled with the stream interval, floored here.
+REPL_ACK_TIMEOUT_FLOOR_S = 0.5
 
 
 def serve_policy(config) -> Dict[str, object]:
@@ -123,11 +145,29 @@ class ServeWorkerPlane:
         # shard → the sid set THIS worker froze at prepare (executor-thread
         # only, so unlocked): commit/abort without explicit sids act on it.
         self._shard_frozen: Dict[int, List[str]] = {}
+        # Replica half: shard → {sid: wire payload} standby copies, kept
+        # OUTSIDE the router so they never pollute shard-hash freeze sets,
+        # listings, or the local admission backstop (executor-thread only,
+        # like _shard_frozen).
+        self._standby: Dict[int, Dict[str, dict]] = {}
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._inbox: deque = deque()  # graftlint: guarded-by _lock
         self._results: List[dict] = []  # graftlint: guarded-by _lock
         self._stopped = False  # graftlint: guarded-by _lock
+        # Primary half of replication: per-session watermark state (acked
+        # epoch, last streamed epoch/time, last pass's epoch for the
+        # idle-flush rule) and the shard park set (no replica placeable —
+        # the frontend parks the stream instead of letting this worker
+        # re-ship every board every pass in single-copy mode).
+        self._repl_state: Dict[str, dict] = {}  # graftlint: guarded-by _lock
+        self._repl_parked: set = set()  # graftlint: guarded-by _lock
+        self.replicate = bool(cfg.serve_replicate)
+        self._repl_interval_s = float(cfg.serve_replicate_interval_s)
+        self._repl_every = int(cfg.serve_replicate_every)
+        self._ack_timeout_s = max(
+            REPL_ACK_TIMEOUT_FLOOR_S, 4 * self._repl_interval_s
+        )
         self._exec = threading.Thread(
             target=self._exec_loop, daemon=True, name=f"serve-exec-{name}"
         )
@@ -136,6 +176,12 @@ class ServeWorkerPlane:
         )
         self._exec.start()
         self._reply.start()
+        if self.replicate:
+            self._repl = threading.Thread(
+                target=self._repl_loop, daemon=True,
+                name=f"serve-repl-{name}",
+            )
+            self._repl.start()
 
     # -- wire-in (called from the worker's control reader thread) ------------
 
@@ -171,6 +217,8 @@ class ServeWorkerPlane:
                     self.router.drop_sessions(self._shard_sids(msg))
                 elif kind == P.SHARD_ABORT:
                     self.router.unfreeze_sessions(self._shard_sids(msg))
+                elif kind == P.SHARD_REPLICATE_ACK:
+                    self._on_replicate_ack(msg)
             except Exception as e:  # noqa: BLE001 — one bad frame must not
                 # kill the executor: every op answers, malformed ones loudly
                 print(f"serve plane: dropped bad frame: {e!r}", flush=True)
@@ -218,6 +266,13 @@ class ServeWorkerPlane:
                 self._push({"rid": rid, "ok": 1})
             elif kind == "adopt":
                 self.router.import_sessions(op["sessions"])
+                self._push({"rid": rid, "ok": 1})
+            elif kind == "replicate":
+                self._push(self._replicate_op(rid, op))
+            elif kind == "promote":
+                self._push(self._promote_op(rid, op))
+            elif kind == "replica_drop":
+                self._standby.pop(int(op["shard"]), None)
                 self._push({"rid": rid, "ok": 1})
             elif kind == "step_raw":
                 self._push(self._step_raw(rid, op))
@@ -302,6 +357,157 @@ class ServeWorkerPlane:
             # Dead control channel: the worker is leaving anyway; the
             # frontend's member-loss path owns the outcome.
             self.router.unfreeze_sessions(sids)
+
+    # -- session replication (replica half: standby install + promotion) -----
+
+    def _replicate_op(self, rid: int, op: dict) -> dict:
+        """Install/refresh standby copies for one shard (idempotent —
+        re-delivered frames after a lost ack just overwrite), drop
+        deleted sids, and ack each installed session's epoch — the
+        watermark the frontend records and relays to the primary."""
+        shard = int(op["shard"])
+        store = self._standby.setdefault(shard, {})
+        acked: Dict[str, int] = {}
+        for pay in op.get("sessions", []):
+            sid = str(pay["sid"])
+            cur = store.get(sid)
+            if cur is None or int(pay["epoch"]) >= int(cur["epoch"]):
+                # Never step a standby copy BACKWARD: a reordered/
+                # retransmitted older snapshot must not undo a newer one.
+                store[sid] = pay
+            acked[sid] = int(store[sid]["epoch"])
+        for sid in op.get("deleted", []):
+            store.pop(str(sid), None)
+        if not store:
+            self._standby.pop(shard, None)
+        return {"rid": rid, "ok": 1, "shard": shard, "acked": acked}
+
+    def _promote_op(self, rid: int, op: dict) -> dict:
+        """Worker loss failover: certify this shard's standby payloads
+        against their streamed digest lanes and install the good ones
+        into the router — this worker is the shard's primary from here
+        on.  A corrupt payload is refused per-session (reported in
+        ``failed``), never installed with a wrong digest."""
+        shard = int(op["shard"])
+        store = self._standby.pop(shard, {})
+        good: List[dict] = []
+        installed: List[dict] = []
+        failed: List[str] = []
+        for sid, pay in sorted(store.items()):
+            lanes = odigest.digest_payload_np(
+                pay["state"], (0, 0), int(pay["width"])
+            )
+            if [int(lanes[0]), int(lanes[1])] == [
+                int(v) for v in pay["digest"]
+            ]:
+                good.append(pay)
+            else:
+                failed.append(sid)
+        self.router.import_sessions(good)
+        for pay in good:
+            installed.append({
+                "sid": pay["sid"],
+                "epoch": int(pay["epoch"]),
+                "digest": [int(v) for v in pay["digest"]],
+            })
+        return {
+            "rid": rid, "ok": 1, "shard": shard,
+            "installed": installed, "failed": failed,
+        }
+
+    # -- session replication (primary half: the watermark stream) ------------
+
+    def _on_replicate_ack(self, msg: dict) -> None:
+        """The frontend's watermark/park/reset frame, on the op FIFO."""
+        shard = int(msg["shard"])
+        with self._lock:
+            if msg.get("reset"):
+                # Replica reassigned (loss, drain re-home, promotion):
+                # everything the OLD replica acked is gone — stream the
+                # shard from scratch.
+                self._repl_parked.discard(shard)
+                for sid in list(self._repl_state):
+                    if shard_of(sid, self.n_shards) == shard:
+                        del self._repl_state[sid]
+                return
+            if msg.get("parked"):
+                # No replica placeable (single-copy mode): stop paying
+                # bandwidth for a stream nobody stores; a reset unparks.
+                self._repl_parked.add(shard)
+                return
+            for sid, epoch in dict(msg.get("acked", {})).items():
+                st = self._repl_state.get(str(sid))
+                if st is not None:
+                    st["acked"] = max(st["acked"], int(epoch))
+
+    def _repl_loop(self) -> None:
+        """The primary's stream pass: every interval, export sessions
+        dirty past the watermark (cadence-due, never-acked, or idle —
+        unchanged since the last pass, so convergence is exact once
+        traffic stops) and ship them grouped per shard.  Watermarks only
+        advance on ack; anything unacked past REPL_ACK_TIMEOUT_S
+        retransmits."""
+        import time
+
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+            time.sleep(self._repl_interval_s)
+            try:
+                by_shard = self._repl_pass(time.monotonic())
+            except Exception as e:  # noqa: BLE001 — replication is a
+                # background best-effort stream; a pass failure must never
+                # kill the thread (the next pass retransmits)
+                print(f"serve replication pass failed: {e!r}", flush=True)
+                continue
+            for shard, sessions in sorted(by_shard.items()):
+                try:
+                    self._send({
+                        "type": P.SHARD_REPLICATE,
+                        "shard": shard,
+                        "sessions": sessions,
+                    })
+                except (OSError, ValueError):
+                    return  # dead control channel: the worker is leaving
+
+    def _repl_pass(self, now: float) -> Dict[int, List[dict]]:
+        """One pass: pick the dirty-and-due sids, export, mark sent."""
+        docs = self.router.list()
+        with self._lock:
+            live = {d["id"] for d in docs}
+            for sid in list(self._repl_state):
+                if sid not in live:
+                    del self._repl_state[sid]
+            due: List[str] = []
+            for doc in docs:
+                sid, epoch = doc["id"], int(doc["epoch"])
+                shard = shard_of(sid, self.n_shards)
+                st = self._repl_state.setdefault(
+                    sid, {"acked": -1, "sent": -1, "sent_t": 0.0, "seen": -1}
+                )
+                seen, st["seen"] = st["seen"], epoch
+                if shard in self._repl_parked or epoch <= st["acked"]:
+                    continue
+                cadence_due = (
+                    st["acked"] < 0
+                    or epoch - st["acked"] >= self._repl_every
+                    or epoch == seen  # idle flush: dirty, not advancing
+                )
+                awaiting = (
+                    st["sent"] >= epoch
+                    and now - st["sent_t"] < self._ack_timeout_s
+                )
+                if cadence_due and not awaiting:
+                    due.append(sid)
+                    st["sent"] = epoch
+                    st["sent_t"] = now
+        by_shard: Dict[int, List[dict]] = {}
+        for pay in self.router.export_sessions(due):
+            by_shard.setdefault(
+                shard_of(pay["sid"], self.n_shards), []
+            ).append(pay)
+        return by_shard
 
     # -- reply coalescer ------------------------------------------------------
 

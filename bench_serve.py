@@ -43,7 +43,15 @@ as a tiled session, stepped, and digest-certified against the dense
 oracle.  ``tools/bench_trend.py`` folds the per-point configs
 (``serve-shard-wN``) into its trajectory table like any other config.
 
-Also wired into ``bench_suite.py`` as config 12.
+**Failover chaos drill** (``--workers 3 --kill-worker-at S``): SIGKILL —
+not SIGTERM — one worker of a session-replicated cluster mid-traffic
+(``bench_serve_failover``): zero 404s on admitted sessions, zero boards
+lost, every promoted session digest-certified against its single-board
+oracle at its replicated resume epoch, promotion latency p50/p99 in
+BENCH format.
+
+Also wired into ``bench_suite.py`` as configs 12 (traffic) and 17
+(failover).
 """
 
 from __future__ import annotations
@@ -759,6 +767,212 @@ def bench_serve_sharded(
     return records
 
 
+def bench_serve_failover(
+    workers: int = 3,
+    sessions: int = 48,
+    steps: int = 4,
+    kill_at_s: float = 2.0,
+    run_s: float = 6.0,
+    tenants: int = 8,
+    rules=DEFAULT_RULES,
+    sizes=(48, 64),
+    emit=print,
+) -> dict:
+    """The ``--kill-worker-at`` chaos drill: SIGKILL (not SIGTERM — no
+    drain, no goodbye, the socket just dies) one worker of a replicated
+    cluster mid-traffic and hold the plane to the failover contract:
+
+    - **zero 404s** on admitted sessions — every response is 200 or a
+      retryable 429/503, because promoted shards resume from their last
+      acked replicated epoch;
+    - **zero boards lost** — every session still listed afterwards, and
+      ``gol_serve_sessions_lost_total`` stays 0;
+    - **every promoted session digest-certified** — its served digest at
+      its reported epoch equals a fresh single-board oracle run to that
+      epoch (the reported epoch IS the replicated resume point; that is
+      the honesty being certified);
+    - **promotion latency p50/p99** — client-observed, first failover
+      429 to first subsequent 200 per session — in BENCH format.
+    """
+    import signal as _signal
+
+    from akka_game_of_life_tpu.obs.catalog import install
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.obs.tracing import Tracer
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+
+    assert workers >= 3, "the failover drill wants a 3-worker cluster"
+    registry = install(MetricsRegistry())
+    tracer = Tracer(node="bench-serve-failover")
+    cfg = SimulationConfig(
+        role="serve",
+        serve_cluster=True,
+        port=0,
+        max_epochs=None,
+        serve_max_sessions=sessions + 8,
+        serve_queue_depth=max(64, 8 * workers),
+        serve_max_steps=max(64, steps),
+        rebalance_interval_s=0.05,
+        # Tight replication so the drill's resume points trail live
+        # epochs closely (the contract holds at ANY cadence; tight just
+        # makes the drill fast).
+        serve_replicate_every=1,
+        serve_replicate_interval_s=0.1,
+        flight_dir="",
+    )
+    fe, procs = _spin_cluster(cfg, workers, registry, tracer)
+    base = f"http://127.0.0.1:{fe._metrics_server.port}"
+    config = f"serve-failover-w{workers}"
+    try:
+        specs = []
+        for i in range(sessions):
+            rule = rules[i % len(rules)]
+            side = sizes[i % len(sizes)]
+            h, w = side, max(1, side - (i % 7))
+            status, doc = _request(
+                base, "POST", "/boards",
+                {"tenant": f"t{i % tenants}", "rule": rule,
+                 "height": h, "width": w, "seed": i},
+            )
+            assert status == 201, f"create {i} failed: {status} {doc}"
+            specs.append((doc["id"], rule, (h, w), i))
+
+        stop_load = threading.Event()
+        lock = threading.Lock()
+        fatals: list = []  # any 404 (or unexpected status) on an admitted sid
+        failover_first: dict = {}  # sid -> first 429 reason=failover time
+        promo_latency: list = []  # per-session failover -> recovery seconds
+        ok_counts = {"n": 0}
+
+        def loader(k):
+            i = 0
+            while not stop_load.is_set():
+                sid = specs[(k + i) % len(specs)][0]
+                i += 1
+                try:
+                    status, doc = _request(
+                        base, "POST", f"/boards/{sid}/step",
+                        {"steps": 1}, timeout=30,
+                    )
+                except Exception as e:  # noqa: BLE001 — recorded, asserted
+                    fatals.append((sid, "transport", repr(e)))
+                    return
+                now = time.monotonic()
+                if status == 200:
+                    with lock:
+                        ok_counts["n"] += 1
+                        t0 = failover_first.pop(sid, None)
+                        if t0 is not None:
+                            promo_latency.append(now - t0)
+                elif status == 429:
+                    if doc.get("reason") == "failover":
+                        with lock:
+                            failover_first.setdefault(sid, now)
+                    time.sleep(0.02)
+                elif status == 503:
+                    time.sleep(0.02)
+                else:
+                    # THE assertion of the drill: 404 on an admitted
+                    # session is a lost board — record it fatally.
+                    fatals.append((sid, status, doc))
+
+        pool = [
+            threading.Thread(target=loader, args=(k,))
+            for k in range(4 * workers)
+        ]
+        for t in pool:
+            t.start()
+        time.sleep(kill_at_s)
+        victim = procs[0]
+        victim.send_signal(_signal.SIGKILL)  # no drain, no goodbye
+        rc = victim.wait(timeout=30)
+        # Keep traffic flowing through the failover window, then let the
+        # promotions settle before judging.
+        deadline = time.monotonic() + run_s
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+        for _ in range(200):
+            status, doc = _request(base, "GET", "/healthz")
+            repl = doc.get("serve", {}).get("replication", {})
+            if status == 200 and repl.get("promotions_inflight") == 0:
+                break
+            time.sleep(0.05)
+        stop_load.set()
+        for t in pool:
+            t.join(30)
+        assert not any(t.is_alive() for t in pool), "a loader hung"
+        assert rc != 0, f"SIGKILLed worker exited rc {rc} (expected a kill)"
+        assert not fatals, (
+            f"admitted sessions 404ed/errored across the kill: {fatals[:5]}"
+        )
+
+        # Zero boards lost: every admitted session still listed, and the
+        # loss counter agrees.
+        status, doc = _request(base, "GET", "/boards")
+        assert status == 200
+        live = {b["id"] for b in doc["boards"]}
+        missing = [sid for sid, _, _, _ in specs if sid not in live]
+        assert not missing, f"boards lost across the kill: {missing[:5]}"
+        snap = registry.snapshot()
+        lost = snap.get("gol_serve_sessions_lost_total") or 0
+        assert lost == 0, f"gol_serve_sessions_lost_total={lost}"
+        promotions = snap.get("gol_serve_promotions_total") or 0
+        assert promotions >= 1, "the kill never promoted anything"
+
+        # Digest certification: EVERY session's served digest at its
+        # reported epoch (promoted sessions report their replicated
+        # resume point) equals the single-board oracle's.
+        issued = {}
+        for sid, rule, (h, w), seed in specs:
+            status, doc = _request(base, "GET", f"/boards/{sid}")
+            assert status == 200, (sid, status)
+            issued[sid] = int(doc["epoch"])
+        _certify_sample(base, specs, issued, sample=len(specs))
+
+        lat = sorted(promo_latency)
+        # Promotion can complete between two loader polls (it is ms-scale
+        # in-process), leaving no client-observed failover sample; the
+        # record must stay valid JSON — never a bare NaN.
+        p50 = _percentile(lat, 0.50) if lat else 0.0
+        p99 = _percentile(lat, 0.99) if lat else 0.0
+        record = {
+            "config": config,
+            "metric": (
+                f"promotion latency p50, client-observed (first failover "
+                f"429 to first 200 per session), {workers}-worker cluster,"
+                f" 1 worker SIGKILLed at t={kill_at_s}s under "
+                f"{len(pool)}-thread traffic"
+            ),
+            "value": p50,
+            "unit": "seconds",
+            "vs_baseline": p50 / REFERENCE_TICK_S,
+            "workers": workers,
+            "sessions": sessions,
+            "killed_rc": rc,
+            "promotion_p50_s": p50,
+            "promotion_p99_s": p99,
+            "promotions": promotions,
+            "failover_sessions_observed": len(lat),
+            "steps_served": ok_counts["n"],
+            "sessions_lost": 0,
+            "status_404": 0,
+            "digest_ok": True,
+            "single_copy_shards_after": snap.get(
+                "gol_serve_single_copy_shards"
+            ),
+            "replica_bytes": snap.get("gol_serve_replica_bytes_total"),
+        }
+        emit(json.dumps(record))
+        return record
+    finally:
+        fe.stop()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except Exception:  # noqa: BLE001 — teardown must complete
+                p.kill()
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     # None defaults resolve per mode: the single-process plane benches the
@@ -792,11 +1006,36 @@ def main() -> int:
         "--assert-scaling", action="store_true",
         help="fail unless the sweep meets the 1.5x@2 / 2.2x@4 gates",
     )
+    parser.add_argument(
+        "--kill-worker-at", type=float, default=None, metavar="SECONDS",
+        help="failover chaos drill: SIGKILL one worker this many seconds "
+        "into mid-traffic load on a replicated cluster (requires "
+        "--workers N, N>=3) and assert zero 404s, zero boards lost, "
+        "every promoted session digest-certified, reporting promotion "
+        "latency p50/p99",
+    )
     args = parser.parse_args()
 
     from akka_game_of_life_tpu.cli import _apply_platform
 
     _apply_platform(args.platform)
+    if args.kill_worker_at is not None:
+        n = max(
+            (int(v) for v in (args.workers or "3").split(",")), default=3
+        )
+        bench_serve_failover(
+            workers=n,
+            sessions=args.sessions or 48,
+            steps=args.steps or 4,
+            kill_at_s=args.kill_worker_at,
+            tenants=args.tenants,
+            rules=tuple(args.rules.split(",")),
+            sizes=(
+                tuple(int(v) for v in args.sizes.split(","))
+                if args.sizes else (48, 64)
+            ),
+        )
+        return 0
     if args.workers:
         bench_serve_sharded(
             workers_list=tuple(int(v) for v in args.workers.split(",")),

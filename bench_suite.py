@@ -1137,7 +1137,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", type=int, nargs="*",
-        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16],
+        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17],
     )
     parser.add_argument(
         "--scale", type=float, default=1.0,
@@ -1251,6 +1251,19 @@ def main() -> None:
         # < 1 s at scale 1.
         ff_sizes = sorted({s(4096, 32 * 8), s(16384, 32 * 8)})
         bench_fastforward(ff_sizes, headline_size=s(16384, 32 * 8))
+    if 17 in args.config:
+        # Session replication & crash failover: SIGKILL one worker of a
+        # 3-worker replicated serve cluster mid-traffic — zero 404s,
+        # zero boards lost, every promoted session digest-certified,
+        # promotion latency p50/p99 (docs/OPERATIONS.md "Session
+        # replication & failover").
+        from bench_serve import bench_serve_failover
+
+        bench_serve_failover(
+            workers=3,
+            sessions=max(12, int(32 * args.scale)),
+            kill_at_s=2.0,
+        )
 
 
 if __name__ == "__main__":

@@ -301,7 +301,12 @@ def test_drain_zero_admitted_loss_mid_traffic():
 
 
 def test_worker_crash_answers_never_hangs():
-    with serve_cluster(2) as (fe, workers, threads, registry):
+    # serve_replicate off: this test pins the HONEST-LOSS contract (the
+    # single-copy plane) — the replicated failover path has its own
+    # module, tests/test_serve_replication.py.
+    with serve_cluster(2, serve_replicate=False) as (
+        fe, workers, threads, registry,
+    ):
         plane = fe.serve_plane
         specs = [
             plane.create(height=16, width=16, seed=i, with_board=False)["id"]

@@ -1,0 +1,652 @@
+"""Session replication & crash failover: a killed worker loses zero boards.
+
+Every cluster test runs a REAL in-process serve-only frontend plus
+BackendWorker threads speaking the actual wire protocol — the same stack
+`python -m akka_game_of_life_tpu serve --serve-cluster on` runs — and
+certifies promoted sessions against single-board oracles via the digest
+plane.  The deterministic windows (a promotion held open, a migration
+frozen mid-protocol) come from holding a worker plane's inbox lock so its
+executor cannot run — the worker stays alive (heartbeats beat) while its
+serve frames queue, exactly a wedged-but-alive process.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from akka_game_of_life_tpu.obs.catalog import install
+from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+from akka_game_of_life_tpu.obs.tracing import Tracer
+from akka_game_of_life_tpu.ops import digest as odigest, stencil
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.runtime.backend import BackendWorker
+from akka_game_of_life_tpu.runtime.config import (
+    NetworkChaosConfig,
+    SimulationConfig,
+)
+from akka_game_of_life_tpu.runtime.frontend import Frontend
+from akka_game_of_life_tpu.runtime.rebalance import Rebalancer
+from akka_game_of_life_tpu.serve.sessions import AdmissionError, shard_of
+from akka_game_of_life_tpu.utils.patterns import random_grid
+
+
+def _oracle_digest(rule: str, shape, seed: int, epochs: int) -> str:
+    board0 = random_grid(shape, density=0.5, seed=seed)
+    board = (
+        np.asarray(
+            stencil.multi_step_fn(resolve_rule(rule), epochs)(
+                jnp.asarray(board0)
+            )
+        )
+        if epochs
+        else board0
+    )
+    return odigest.format_digest(odigest.value(odigest.digest_dense_np(board)))
+
+
+@contextlib.contextmanager
+def repl_cluster(n_workers: int, **cfg_kw):
+    """In-process serve-only cluster with a FAST replication cadence (the
+    tests wait on real acks, not sleeps)."""
+    cfg_kw.setdefault("serve_shards", 8)
+    cfg_kw.setdefault("rebalance_interval_s", 0.05)
+    cfg_kw.setdefault("serve_replicate_interval_s", 0.05)
+    cfg_kw.setdefault("serve_replicate_every", 1)
+    cfg = SimulationConfig(
+        role="serve", serve_cluster=True, port=0, max_epochs=None,
+        flight_dir="", **cfg_kw,
+    )
+    registry = install(MetricsRegistry())
+    tracer = Tracer(node="test-serve-repl")
+    fe = Frontend(cfg, min_backends=n_workers, registry=registry,
+                  tracer=tracer)
+    fe.start()
+    workers, threads = [], []
+
+    def add_worker(name):
+        w = BackendWorker(
+            "127.0.0.1", fe.port, name=name, engine="numpy",
+            registry=registry, tracer=tracer,
+        )
+        w.crash_hook = w.stop
+        w.connect()
+        t = threading.Thread(target=w.run, daemon=True, name=name)
+        t.start()
+        workers.append(w)
+        threads.append(t)
+        return w, t
+
+    fe.add_serve_worker = add_worker  # test hook
+    for i in range(n_workers):
+        add_worker(f"w{i}")
+    assert fe.wait_for_backends(timeout=10)
+    try:
+        yield fe, workers, threads, registry
+    finally:
+        fe.stop()
+        for w in workers:
+            w.stop()
+
+
+def _worker(workers, name):
+    return next(w for w in workers if w.name == name)
+
+
+def _wait(cond, timeout=20.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(msg)
+
+
+def _wait_replicated(fe, timeout=20.0):
+    """Block until every indexed batch session's updates are acked by its
+    shard's replica (frontend watermark clean)."""
+
+    def clean():
+        plane = fe.serve_plane
+        with plane._lock:
+            return all(
+                e.repl_dirty_since is None
+                for e in plane.sessions.values()
+                if e.shard is not None
+            ) and any(
+                r is not None for r in plane.shard_replica.values()
+            )
+
+    _wait(clean, timeout, "replication never converged (unacked updates)")
+
+
+# -- lint surface --------------------------------------------------------------
+
+
+def test_serve_replicate_lint_surface_clean():
+    """The replication knobs and protocol rows hold every bijection they
+    touch: --serve-replicate* ↔ serve_replicate* (GL-CFG08), the blanket
+    --serve-* ↔ serve_* (GL-CFG04), serve_* ↔ doc knob table (GL-DOC06),
+    protocol.py ↔ the doc protocol table (GL-DOC03), metric literals ↔
+    catalog ↔ doc (GL-DOC01), and span names (GL-DOC02)."""
+    from pathlib import Path
+
+    from tools.graftlint import bijection
+    from tools.graftlint.specs import (
+        METRICS_DOC,
+        PROTOCOL_MSGS,
+        SERVE_CONFIG,
+        SERVE_DOC,
+        SERVE_REPLICATE_CONFIG,
+        TRACE_NAMES,
+    )
+
+    repo = Path(__file__).resolve().parent.parent
+    for spec in (SERVE_REPLICATE_CONFIG, SERVE_CONFIG, SERVE_DOC,
+                 PROTOCOL_MSGS, METRICS_DOC, TRACE_NAMES):
+        problems = [f.render() for f in bijection.problems(spec, repo)]
+        assert problems == [], problems
+
+
+def test_replicate_config_validation():
+    with pytest.raises(ValueError):
+        SimulationConfig(serve_replicate_every=0)
+    with pytest.raises(ValueError):
+        SimulationConfig(serve_replicate_interval_s=0)
+    with pytest.raises(ValueError):
+        SimulationConfig(serve_replicate_max_lag_s=0)
+
+
+# -- planner unit: the placement constraint ------------------------------------
+
+
+class _M:
+    def __init__(self, name, draining=False):
+        self.name = name
+        self.alive = True
+        self.draining = draining
+        self.tiles = []
+
+
+def test_plan_shards_avoids_replica_dest_but_never_wedges_a_drain():
+    cfg = SimulationConfig(rebalance_max_inflight=8)
+    rb = Rebalancer(cfg)
+    # Spread case: shard 0's replica is the least-loaded member — the
+    # planner must not co-locate them while another destination exists.
+    owners = {s: "a" for s in range(6)}
+    replicas = {s: "b" for s in range(6)}
+    moves = rb.plan_shards(
+        owners, {}, [_M("a"), _M("b"), _M("c")], now=1e9, replicas=replicas,
+    )
+    assert moves and all(dest == "c" for _, _, dest in moves)
+    # Drain case, 2 workers: the replica IS the only destination — the
+    # move must still happen (a wedged drain is worse than a transient
+    # co-residence the serve plane re-homes at commit).
+    rb2 = Rebalancer(cfg)
+    moves = rb2.plan_shards(
+        {0: "a"}, {0: 2}, [_M("a", draining=True), _M("b")], now=1e9,
+        replicas={0: "b"},
+    )
+    assert moves == [(0, "a", "b")]
+
+
+# -- replication stream: watermarks, standby, lag ------------------------------
+
+
+def test_replication_streams_standby_and_watermarks():
+    with repl_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        specs = []
+        for i in range(8):
+            doc = plane.create(height=16, width=16, seed=i, with_board=False)
+            specs.append(doc["id"])
+        for sid in specs:
+            plane.step(sid, 3)
+        _wait_replicated(fe)
+        # Standby payloads live worker-side, OUTSIDE the router tables.
+        standby = {
+            sid: pay
+            for w in workers
+            for store in w.serve_plane._standby.values()
+            for sid, pay in store.items()
+        }
+        assert set(standby) == set(specs)
+        for i, sid in enumerate(specs):
+            assert int(standby[sid]["epoch"]) == 3
+        # The standby digest lanes certify against the oracle already.
+        for i, sid in enumerate(specs):
+            lanes = odigest.digest_payload_np(
+                standby[sid]["state"], (0, 0), 16
+            )
+            assert odigest.format_digest(odigest.value(lanes)) == (
+                _oracle_digest("conway", (16, 16), i, 3)
+            )
+        snap = registry.snapshot()
+        assert (snap.get("gol_serve_replica_bytes_total") or 0) > 0
+        doc = fe._health()["serve"]["replication"]
+        assert doc["enabled"] is True
+        assert doc["single_copy_shards"] == 0
+        assert doc["promotions_inflight"] == 0
+        assert sum(doc["replicas_by_worker"].values()) == sum(
+            1 for o in plane.shard_owner.values() if o is not None
+        )
+        # Replica assignment never co-resides with the primary.
+        with plane._lock:
+            for shard, repl in plane.shard_replica.items():
+                if repl is not None:
+                    assert repl != plane.shard_owner.get(shard)
+
+
+def test_failover_promotes_with_zero_board_loss():
+    """The headline: kill a worker mid-life, every session survives at
+    its replicated epoch, digest-certified against the oracle."""
+    with repl_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        specs = []
+        for i in range(10):
+            doc = plane.create(height=16, width=16, seed=i, with_board=False)
+            specs.append(doc["id"])
+        for sid in specs:
+            plane.step(sid, 4)
+        _wait_replicated(fe)
+        victim = workers[0]
+        owned = {
+            e["id"] for e in plane.list() if e["worker"] == victim.name
+        }
+        assert owned  # both workers held sessions
+        victim.channel.close()  # abrupt death — no drain, no goodbye
+        _wait(
+            lambda: fe._health()["serve"]["replication"][
+                "promotions_inflight"
+            ] == 0 and len(fe.membership.alive_members()) == 1,
+            msg="promotion never completed",
+        )
+        # ZERO boards lost: every session still answers, at exactly its
+        # replicated epoch, with the oracle's digest for that epoch.
+        live = {e["id"] for e in plane.list()}
+        assert live == set(specs)
+        for i, sid in enumerate(specs):
+            doc = plane.get(sid)
+            assert doc["epoch"] == 4
+            assert doc["digest"] == _oracle_digest(
+                "conway", (16, 16), i, 4
+            )
+            # And the promoted copy keeps serving.
+            epoch, digest = plane.step(sid, 1)
+            assert epoch == 5
+            assert odigest.format_digest(digest) == _oracle_digest(
+                "conway", (16, 16), i, 5
+            )
+        snap = registry.snapshot()
+        assert (snap.get("gol_serve_promotions_total") or 0) >= 1
+        assert (snap.get("gol_serve_sessions_lost_total") or 0) == 0
+
+
+def test_promotion_window_answers_429_failover_not_404():
+    """The client contract the PR exists to keep: ops on a shard whose
+    promotion is still in flight answer the retryable 429 ``failover``
+    (board provably at its replicated epoch) — GET, DELETE, and the step
+    that was in flight on the dead worker — never 404.  The window is
+    held open deterministically by blocking the replica's executor."""
+    with repl_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        sids = [
+            plane.create(height=16, width=16, seed=i, with_board=False)["id"]
+            for i in range(8)
+        ]
+        for sid in sids:
+            plane.step(sid, 2)
+        _wait_replicated(fe)
+        # Pick a victim/replica pair that actually holds a session.
+        with plane._lock:
+            sid, entry = next(
+                (s, e) for s, e in plane.sessions.items()
+                if plane.shard_replica.get(e.shard) is not None
+            )
+            shard = entry.shard
+            primary = plane.shard_owner[shard]
+            replica = plane.shard_replica[shard]
+        pw = _worker(workers, primary)
+        rw = _worker(workers, replica)
+        # Freeze BOTH executors: the primary's so a step stays in flight
+        # when it dies, the replica's so the promote op cannot complete.
+        pw.serve_plane._lock.acquire()
+        rw.serve_plane._lock.acquire()
+        released = [False, False]
+        try:
+            step_err: dict = {}
+
+            def stepper():
+                try:
+                    plane.step(sid, 1)
+                    step_err["e"] = None
+                except BaseException as e:  # noqa: BLE001 — asserted below
+                    step_err["e"] = e
+
+            t = threading.Thread(target=stepper)
+            t.start()
+            def step_pending():
+                with plane._lock:
+                    return any(
+                        p.sid == sid and p.kind == "step"
+                        for p in plane._pending.values()
+                    )
+
+            _wait(step_pending, msg="step op never became pending")
+            pw.channel.close()  # the primary dies with the step in flight
+            _wait(lambda: shard in plane._promoting,
+                  msg="promotion never started")
+            t.join(20)
+            assert not t.is_alive()
+            assert isinstance(step_err["e"], AdmissionError)
+            assert step_err["e"].reason == "failover"
+            # GET and DELETE during the window: 429 failover, not 404.
+            for op in (lambda: plane.get(sid), lambda: plane.delete(sid)):
+                with pytest.raises(AdmissionError) as exc:
+                    op()
+                assert exc.value.reason == "failover"
+            # Through the real HTTP surface: the same contract with a
+            # retry hint in the body.
+            import json
+            import urllib.error
+            import urllib.request
+
+            port = fe._metrics_server.port
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/boards/{sid}", timeout=10
+                )
+                raise AssertionError("expected HTTP 429")
+            except urllib.error.HTTPError as e:
+                assert e.code == 429
+                body = json.loads(e.read())
+                assert body["reason"] == "failover"
+                assert "retry_after_s" in body
+            # Release the replica: the promotion completes and the board
+            # is exactly where replication left it.
+            rw.serve_plane._lock.release()
+            released[1] = True
+            _wait(lambda: shard not in plane._promoting,
+                  msg="promotion never finished")
+            doc = plane.get(sid)
+            seed = sids.index(sid)
+            assert doc["epoch"] == 2
+            assert doc["digest"] == _oracle_digest(
+                "conway", (16, 16), seed, 2
+            )
+        finally:
+            if not released[1]:
+                rw.serve_plane._lock.release()
+            pw.serve_plane._lock.release()
+
+
+def test_lossy_replication_stream_retransmits_to_exact_convergence(
+    monkeypatch,
+):
+    """NetworkChaosConfig drops on the control plane: replication frames
+    (stream, relay acks) vanish at random, watermarks only advance on
+    real acks, and the primary's retransmit pass converges the replica
+    EXACTLY once traffic stops — then a clean kill proves the converged
+    copy by promoting it."""
+    from akka_game_of_life_tpu.serve import cluster as scluster
+
+    # Client ops ride the same lossy wire; bound each attempt tightly so
+    # the retry loops below pace in seconds, not JOB_TIMEOUT_S units.
+    monkeypatch.setattr(scluster, "JOB_TIMEOUT_S", 2.0)
+    monkeypatch.setattr(scluster, "JOB_GRACE_S", 1.0)
+    chaos = NetworkChaosConfig(
+        enabled=True, seed=7, drop_p=0.15, scope="control"
+    )
+    with repl_cluster(2, net_chaos=chaos) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        specs = []
+        for i in range(6):
+            # Creates/steps ride the same lossy control plane: retry like
+            # a real client until admitted.
+            doc = None
+            for _ in range(40):
+                try:
+                    doc = plane.create(
+                        height=16, width=16, seed=i, with_board=False
+                    )
+                    break
+                except (TimeoutError, AdmissionError):
+                    continue
+            assert doc is not None, "create never survived the chaos"
+            specs.append(doc["id"])
+        for sid in specs:
+            done = 0
+            tries = 0
+            while done < 3 and tries < 60:
+                tries += 1
+                try:
+                    plane.step(sid, 1)
+                    done += 1
+                except (TimeoutError, AdmissionError):
+                    # A timed-out step may still APPLY (outcome unknown
+                    # under drops) — the certification below therefore
+                    # anchors on the SERVED epoch, not a local counter.
+                    continue
+            assert done == 3
+        assert (
+            registry.snapshot().get("gol_net_chaos_dropped_total") or 0
+        ) > 0, "the chaos plane never dropped a frame — drill is vacuous"
+        # Exact convergence under loss: the watermark retransmit keeps
+        # re-streaming until every update is acked.
+        _wait_replicated(fe, timeout=60)
+        # Heal the wire, then prove the converged copy: kill a primary
+        # and certify every promoted session at its FULL epoch — the
+        # replica holds exactly the primary's last state, nothing rolls
+        # back, nothing forks.
+        fe.netchaos.config.drop_p = 0.0
+        workers[0].channel.close()
+        _wait(
+            lambda: fe._health()["serve"]["replication"][
+                "promotions_inflight"
+            ] == 0 and len(fe.membership.alive_members()) == 1,
+            msg="promotion never completed",
+        )
+        assert {e["id"] for e in plane.list()} == set(specs)
+        for i, sid in enumerate(specs):
+            doc = plane.get(sid)
+            assert doc["epoch"] >= 3  # every acknowledged step landed
+            assert doc["digest"] == _oracle_digest(
+                "conway", (16, 16), i, doc["epoch"]
+            )
+        assert (
+            registry.snapshot().get("gol_serve_sessions_lost_total") or 0
+        ) == 0
+
+
+def test_promotion_racing_shard_migration_is_safe():
+    """A primary dying MID-SHARD-MIGRATION still promotes: the drain
+    freezes migrations toward the victim's shards (its executor is
+    blocked, so prepares queue unprocessed), the victim dies, the aborts
+    run — and the sessions come back from the replica, not a 404.  The
+    op FIFO is what makes the interleave safe; this proves it end to
+    end."""
+    with repl_cluster(3) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        specs = []
+        for i in range(12):
+            doc = plane.create(height=16, width=16, seed=i, with_board=False)
+            specs.append(doc["id"])
+        for sid in specs:
+            plane.step(sid, 2)
+        _wait_replicated(fe)
+        victim = next(
+            w for w in workers
+            if any(e["worker"] == w.name for e in plane.list())
+        )
+        # Freeze the victim's executor so SHARD_PREPAREs queue unrun,
+        # then drain it: loaded-shard migrations start and STAY in flight.
+        victim.serve_plane._lock.acquire()
+        try:
+            assert victim.request_drain()
+            _wait(
+                lambda: any(
+                    m.source == victim.name
+                    for m in plane.rebalancer.inflight.values()
+                ),
+                msg="no shard migration ever started",
+            )
+        finally:
+            victim.serve_plane._lock.release()
+        # Re-freeze nothing: kill the victim with migrations in flight.
+        victim.channel.close()
+        _wait(
+            lambda: fe._health()["serve"]["replication"][
+                "promotions_inflight"
+            ] == 0
+            and not plane.rebalancer.inflight
+            and len(fe.membership.alive_members()) == 2,
+            msg="migrations/promotions never settled",
+        )
+        assert {e["id"] for e in plane.list()} == set(specs)
+        for i, sid in enumerate(specs):
+            # Retry the failover window out like a real client.
+            deadline = time.monotonic() + 20
+            while True:
+                try:
+                    doc = plane.get(sid)
+                    break
+                except AdmissionError as e:
+                    assert e.reason == "failover"
+                    assert time.monotonic() < deadline
+                    time.sleep(0.05)
+            assert doc["epoch"] == 2
+            assert doc["digest"] == _oracle_digest(
+                "conway", (16, 16), i, 2
+            )
+        assert (
+            registry.snapshot().get("gol_serve_sessions_lost_total") or 0
+        ) == 0
+
+
+def test_double_failure_loses_honestly_with_counter():
+    """Primary AND replica die: the shard's sessions are lost — 404 with
+    gol_serve_sessions_lost_total ticking, never a hang and never a
+    silent wrong answer."""
+    with repl_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        sids = [
+            plane.create(height=16, width=16, seed=i, with_board=False)["id"]
+            for i in range(8)
+        ]
+        for sid in sids:
+            plane.step(sid, 2)
+        _wait_replicated(fe)
+        # Hold the replica's executor so the promote op cannot run, kill
+        # the primary, then kill the replica mid-promotion.
+        w0, w1 = workers
+        w1.serve_plane._lock.acquire()
+        try:
+            w0.channel.close()
+            _wait(lambda: plane._promoting,
+                  msg="promotion never started")
+            w1.channel.close()
+            _wait(
+                lambda: not plane._promoting
+                and not fe.membership.alive_members(),
+                msg="double failure never settled",
+            )
+        finally:
+            w1.serve_plane._lock.release()
+        snap = registry.snapshot()
+        assert (snap.get("gol_serve_sessions_lost_total") or 0) >= 1
+        # Sessions on w0's shards died twice over: honest 404.
+        lost = [s for s in sids if s not in {e["id"] for e in plane.list()}]
+        assert lost
+        for sid in lost[:3]:
+            with pytest.raises(KeyError):
+                plane.get(sid)
+
+
+def test_single_copy_degradation_and_recovery():
+    """One worker: replication has nowhere to go — the plane says so
+    (gauge + /healthz flag) and the primary PARKS its stream instead of
+    re-shipping every board every pass.  A second worker joining flips
+    it back: replicas assigned, stream reset, standby populated."""
+    with repl_cluster(1) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        sids = [
+            plane.create(height=16, width=16, seed=i, with_board=False)["id"]
+            for i in range(6)
+        ]
+        for sid in sids:
+            plane.step(sid, 2)
+        owned = sum(1 for o in plane.shard_owner.values() if o is not None)
+        _wait(
+            lambda: fe._health()["serve"]["replication"][
+                "single_copy_shards"
+            ] == owned,
+            msg="single-copy mode never surfaced",
+        )
+        assert registry.snapshot().get(
+            "gol_serve_single_copy_shards"
+        ) == float(owned)
+        # The primary's stream parks (the frontend acked `parked`), so
+        # single-copy mode costs no standing bandwidth.
+        _wait(
+            lambda: workers[0].serve_plane._repl_parked,
+            msg="the primary never parked its stream",
+        )
+        # Recovery: a second worker joins — replicas assigned, the park
+        # resets, the stream converges, standby holds every session.
+        fe.add_serve_worker("late")
+        _wait(
+            lambda: fe._health()["serve"]["replication"][
+                "single_copy_shards"
+            ] == 0,
+            msg="replicas never assigned after the join",
+        )
+        _wait_replicated(fe)
+        standby = {
+            sid
+            for w in workers
+            for store in w.serve_plane._standby.values()
+            for sid in store
+        }
+        assert standby == set(sids)
+
+
+def test_deleted_session_never_resurrects_at_promotion():
+    """DELETE forwards a standby drop to the replica; a later promotion
+    must not bring the deleted board back from its standby copy."""
+    with repl_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        sids = [
+            plane.create(height=16, width=16, seed=i, with_board=False)["id"]
+            for i in range(8)
+        ]
+        for sid in sids:
+            plane.step(sid, 2)
+        _wait_replicated(fe)
+        doomed = sids[0]
+        plane.delete(doomed)
+        # The replica's standby copy retires with the index entry.
+        _wait(
+            lambda: all(
+                doomed not in store
+                for w in workers
+                for store in w.serve_plane._standby.values()
+            ),
+            msg="standby copy survived the delete",
+        )
+        workers[0].channel.close()
+        _wait(
+            lambda: fe._health()["serve"]["replication"][
+                "promotions_inflight"
+            ] == 0,
+            msg="promotion never completed",
+        )
+        live = {e["id"] for e in plane.list()}
+        assert doomed not in live
+        assert live == set(sids[1:])

@@ -47,6 +47,8 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
     ("GL-CFG06", "--kernel choices ↔ config KERNEL_CHOICES ↔ OPERATIONS.md"),
     ("GL-CFG07", "--ff-* flags ↔ SimulationConfig ff_* fields ↔ "
      "OPERATIONS.md knob table"),
+    ("GL-CFG08", "--serve-replicate* flags ↔ SimulationConfig "
+     "serve_replicate* fields"),
     ("GL-DOC01", "gol_* metric literals ↔ obs catalog ↔ OPERATIONS.md"),
     ("GL-DOC02", "span names ↔ SPAN_CATALOG ↔ OPERATIONS.md"),
     ("GL-DOC03", "protocol messages ↔ OPERATIONS.md table"),

@@ -82,6 +82,20 @@ SERVE_DOC = CatalogSpec(
                 "SimulationConfig"),
 )
 
+# The replication knob sub-family gets its OWN bijection beside the
+# blanket GL-CFG04: the sub-spec pins the ``--serve-replicate`` bare flag
+# to ``serve_replicate`` (the on/off gate) specifically, so the family's
+# shape — one gate plus ``serve_replicate_*`` tuning knobs — cannot drift
+# into a spelling GL-CFG04's generic strip would still accept.
+SERVE_REPLICATE_CONFIG = FlagConfigSpec(
+    name="serve_replicate_config", pass_id="GL-CFG08",
+    flag_regex=r"""["'](--serve-replicate(?:-[a-z0-9-]+)?)["']""",
+    config_class="SimulationConfig",
+    field_regex=r"^    (serve_replicate\w*)\s*:",
+    flag_strip="--serve-replicate", field_prefix="serve_replicate_",
+    bare_field="serve_replicate",
+)
+
 SPARSE_CONFIG = FlagConfigSpec(
     name="sparse_config", pass_id="GL-CFG05",
     flag_regex=r"""["'](--sparse-[a-z0-9-]+)["']""",
@@ -262,6 +276,6 @@ GRAFTLINT_DOC = CatalogSpec(
 
 SPECS = (
     CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SERVE_DOC,
-    SPARSE_CONFIG, FF_CONFIG, FF_DOC, KERNEL_CONFIG, METRICS_DOC,
-    TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
+    SERVE_REPLICATE_CONFIG, SPARSE_CONFIG, FF_CONFIG, FF_DOC,
+    KERNEL_CONFIG, METRICS_DOC, TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
 )
