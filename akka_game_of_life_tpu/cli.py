@@ -448,6 +448,30 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
         "step; each tile ships a K-wide halo per round trip (default 8)",
     )
     g.add_argument(
+        "--serve-tiled-resident",
+        choices=["on", "off"],
+        default=None,
+        help="worker-resident tiled sessions: mega-board chunks install "
+        "once on their workers and stay resident across steps, "
+        "exchanging O(perimeter) halo strips worker-to-worker per round "
+        "instead of shipping O(area) state through the frontend "
+        "(default on; off = the ship-per-round baseline)",
+    )
+    g.add_argument(
+        "--serve-tiled-resident-snapshot", type=int, default=None,
+        metavar="N",
+        help="resident-chunk snapshot cadence in rounds: every Nth "
+        "barrier each chunk retains a local snapshot and streams it to "
+        "its replica — the certified resume point after a worker loss "
+        "(default 4)",
+    )
+    g.add_argument(
+        "--serve-tiled-resident-halo-timeout-s", default=None,
+        metavar="DUR",
+        help="peer halo strips unacked past this bound retransmit "
+        "(default 1s)",
+    )
+    g.add_argument(
         "--serve-replicate",
         choices=["on", "off"],
         default=None,
@@ -499,6 +523,13 @@ def _serve_overrides(args: argparse.Namespace) -> dict:
         "serve_cluster": on_off[args.serve_cluster],
         "serve_shards": args.serve_shards,
         "serve_tile_chunk": args.serve_tile_chunk,
+        "serve_tiled_resident": on_off[args.serve_tiled_resident],
+        "serve_tiled_resident_snapshot": args.serve_tiled_resident_snapshot,
+        "serve_tiled_resident_halo_timeout_s": (
+            parse_duration(args.serve_tiled_resident_halo_timeout_s)
+            if args.serve_tiled_resident_halo_timeout_s is not None
+            else None
+        ),
         "serve_replicate": on_off[args.serve_replicate],
         "serve_replicate_every": args.serve_replicate_every,
         "serve_replicate_interval_s": (

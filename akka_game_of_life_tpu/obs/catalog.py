@@ -152,6 +152,21 @@ CATALOG = (
     ("gol_serve_tiled_sessions", "gauge",
      "Mega-board sessions admitted as tiled (above the largest size "
      "class, fanned across workers per chunk)", ()),
+    # -- worker-resident tiled sessions (serve/cluster.py + serve/worker.py) --
+    ("gol_serve_tiled_bytes_round", "histogram",
+     "Cell-state bytes moved per tiled-session step round (resident "
+     "mode: peer halo strips, O(perimeter); ship mode: full chunk "
+     "payloads through the frontend, O(area))", (),
+     (2**10, 2**12, 2**14, 2**16, 2**18, 2**20, 2**22, 2**24)),
+    ("gol_serve_tiled_halo_bytes_total", "counter",
+     "Peer-to-peer TILED_HALO strip payload bytes sent by this worker", ()),
+    ("gol_serve_tiled_halo_retx_total", "counter",
+     "TILED_HALO strips retransmitted after an ack timeout", ()),
+    ("gol_serve_tiled_resident_chunks", "gauge",
+     "Resident tiled-session chunks hosted by this worker", ()),
+    ("gol_serve_tiled_chunk_migrations_total", "counter",
+     "Resident tiled chunks re-homed digest-certified (drain/load "
+     "rebalancing)", ()),
     # -- session replication & failover (serve/cluster.py) --------------------
     ("gol_serve_replication_lag_seconds", "gauge",
      "Age of the oldest session update the shard's replica has not yet "

@@ -618,6 +618,7 @@ class BackendWorker:
         "_actor_engines": "_lock",
         "_peers": "_peer_lock",
         "_senders": "_sender_lock",
+        "_serve_peer_addrs": "_lock",
         "_pre_stop_hooks": "_pre_stop_lock",
         "_pre_stop_done": "_pre_stop_lock",
     }
@@ -791,6 +792,10 @@ class BackendWorker:
         self.owners: Dict[TileId, Tuple[str, str, int]] = {}
         self._peers: Dict[str, Channel] = {}  # dialed, by owner name
         self._peer_lock = threading.Lock()
+        # Serve-plane peer addresses (resident tiled halo exchange): the
+        # frontend names each chunk owner's peer endpoint in the step op,
+        # so serve workers can dial each other without any OWNERS wiring.
+        self._serve_peer_addrs: Dict[str, Tuple[str, int]] = {}
         # One async outbound lane per peer (bounded queue + writer thread);
         # created on first send to an owner, closed on stop/rewiring.
         self._senders: Dict[str, _PeerSender] = {}
@@ -877,6 +882,7 @@ class BackendWorker:
                 name=self.name or "",
                 registry=self.registry,
                 tracer=self.tracer,
+                peer_send=self.serve_peer_send,
             )
         self._retry_rng = random.Random(f"retry:{self.name}")
         self.breaker.node = self.name or "backend"
@@ -1094,6 +1100,12 @@ class BackendWorker:
                 epoch=items[0][1],
             ):
                 self.store.push_rings(items)
+        elif kind in (P.TILED_HALO, P.TILED_HALO_ACK):
+            # Resident tiled-session halo exchange: the frame rides the
+            # serve plane's op FIFO, so a strip orders against its
+            # session's install/step/migration ops like any other op.
+            if self.serve_plane is not None:
+                self.serve_plane.handle(msg)
         elif kind == P.PEER_PULL:
             # Serve every ring we have from the asked epoch forward, for
             # EVERY tile the peer asks about (one frame asks a whole
@@ -1192,7 +1204,20 @@ class BackendWorker:
 
     def owners_by_name(self) -> Dict[str, Tuple[str, int]]:
         with self._lock:
-            return {name: (host, port) for name, host, port in self.owners.values()}
+            out = dict(self._serve_peer_addrs)
+            out.update(
+                (name, (host, port))
+                for name, host, port in self.owners.values()
+            )
+            return out
+
+    def serve_peer_send(self, name: str, host: str, port: int, msg: dict) -> None:
+        """Queue a serve-plane frame (TILED_HALO / ..._ACK) toward a peer
+        worker named by the frontend — same async per-peer lane as ring
+        traffic, with the address learned from the op instead of OWNERS."""
+        with self._lock:
+            self._serve_peer_addrs[name] = (host, int(port))
+        self._send_peer(name, msg)
 
     def _sender(self, owner: str) -> Optional[_PeerSender]:
         """The async outbound lane to a peer, created on first use — or
@@ -1208,6 +1233,7 @@ class BackendWorker:
             if s is None:
                 with self._lock:
                     known = {name for name, _, _ in self.owners.values()}
+                    known |= set(self._serve_peer_addrs)
                 if known and owner not in known:
                     return None
                 s = self._senders[owner] = _PeerSender(self, owner)

@@ -403,6 +403,23 @@ class SimulationConfig:
     # many epochs per round trip — the exchange-width trade, serve-plane
     # edition (bigger = fewer round trips, fatter halos).
     serve_tile_chunk: int = 8
+    # Worker-resident tiled sessions (docs/OPERATIONS.md "Tiled
+    # (mega-board) sessions"): a tiled session's chunks are installed ONCE
+    # on their assigned workers and stay resident across steps; per-round
+    # traffic drops from O(chunk area) through the frontend to O(chunk
+    # perimeter) halo strips exchanged worker-to-worker (TILED_HALO
+    # frames), with the frontend orchestrating only epoch barriers and
+    # digest-lane merges.  Off = the PR 13 ship-per-round path (the board
+    # stays frontend-resident and every round ships full chunk state).
+    serve_tiled_resident: bool = True
+    # Snapshot cadence in ROUNDS (each round = serve_tile_chunk epochs):
+    # every Nth barrier each resident chunk retains a local snapshot copy
+    # and streams it to its replica — the certified resume point a worker
+    # loss rolls the whole session back to.
+    serve_tiled_resident_snapshot: int = 4
+    # Peer halo strips unacked past this bound retransmit (the loss-
+    # recovery half of the tiled_halo/tiled_halo_ack exchange).
+    serve_tiled_resident_halo_timeout_s: float = 1.0
     # Session replication & crash failover (docs/OPERATIONS.md "Session
     # replication & failover"): each session shard gets a replica worker
     # (never the primary); the primary streams shard state to it at the
@@ -636,11 +653,17 @@ class SimulationConfig:
             "serve_shards",
             "serve_tile_chunk",
             "serve_replicate_every",
+            "serve_tiled_resident_snapshot",
         ):
             if getattr(self, name) < 1:
                 raise ValueError(
                     f"{name}={getattr(self, name)} must be >= 1"
                 )
+        if self.serve_tiled_resident_halo_timeout_s <= 0:
+            raise ValueError(
+                f"serve_tiled_resident_halo_timeout_s="
+                f"{self.serve_tiled_resident_halo_timeout_s} must be > 0"
+            )
         if self.serve_replicate_interval_s <= 0:
             raise ValueError(
                 f"serve_replicate_interval_s="
@@ -716,6 +739,7 @@ _DURATION_FIELDS = {
     "serve_ttl_s",
     "serve_replicate_interval_s",
     "serve_replicate_max_lag_s",
+    "serve_tiled_resident_halo_timeout_s",
     "breaker_cooldown_s",
     "send_deadline_s",
     "delay_s",

@@ -64,6 +64,14 @@ SHARD_REPLICATE_ACK         streams dirty session snapshots (bit-packed +
                             session epoch watermark (or parks/resets the
                             stream) — promotion on worker loss resumes
                             from the last acked state
+TILED_HALO /                (new) worker-resident tiled sessions: one
+TILED_HALO_ACK              chunk's O(perimeter) edge strip for a
+                            neighbor chunk at an epoch barrier, shipped
+                            worker-to-worker over the peer data plane and
+                            enqueued onto the receiver's serve op FIFO —
+                            the frontend never touches per-round cell
+                            state; the ack clears the sender's
+                            retransmit buffer
 ==========================  ====================================================
 
 Every message constant below must appear in docs/OPERATIONS.md's
@@ -142,3 +150,8 @@ PEER_HELLO = "peer_hello"
 PEER_RING = "peer_ring"
 PEER_RING_BATCH = "peer_ring_batch"
 PEER_PULL = "peer_pull"
+# worker ↔ worker: resident tiled-session halo exchange (received frames
+# ride the serve plane's per-worker op FIFO, so halo installs order
+# against chunk installs/steps/migrations like every other serve op)
+TILED_HALO = "tiled_halo"
+TILED_HALO_ACK = "tiled_halo_ack"

@@ -28,13 +28,17 @@ This module holds only the *policy* and the in-flight bookkeeping — pure
 data structures the frontend mutates under its own lock.  All wire traffic,
 membership, and metrics stay in :mod:`runtime.frontend`.
 
-The planner knows TWO resource types: big-board *tiles* (:meth:`plan`) and
+The planner knows THREE resource types: big-board *tiles* (:meth:`plan`),
 the serving plane's *session shards* (:meth:`plan_shards` — groups of
 tenant sessions hashed to a shard id, moved between workers by the same
 freeze → transfer → certify → commit protocol at session granularity; see
-:mod:`akka_game_of_life_tpu.serve.cluster`).  The in-flight bookkeeping is
+:mod:`akka_game_of_life_tpu.serve.cluster`), and *resident tiled chunks*
+(:meth:`plan_resident` — a worker-resident mega-board session's chunks,
+re-homed digest-certified under the session's step barrier lock so a move
+can never interleave with an epoch round).  The in-flight bookkeeping is
 shared code: shard moves ride :class:`Migration` records keyed by the
-integer shard id in a serve-plane-owned Rebalancer instance.
+integer shard id, chunk moves by the (sid, (cy, cx)) tuple, each in its
+own serve-plane-owned Rebalancer instance.
 
 Failure handling follows the PR 3 discipline: an aborted migration puts its
 tile on a decorrelated-jitter cooldown (``delay = min(retry_max_s,
@@ -90,6 +94,7 @@ class Rebalancer:
         self._seq = 0
         self._next_plan_at = 0.0
         self._next_shard_plan_at = 0.0
+        self._next_resident_plan_at = 0.0
         self._cooldown: Dict[TileId, float] = {}  # tile → not-before
         self._delay: Dict[TileId, float] = {}  # tile → last chosen backoff
 
@@ -367,4 +372,94 @@ class Rebalancer:
                 planned.add(shard)
                 loads[src.name] -= 1
                 loads[dest] += 1
+        return moves
+
+    def plan_resident(
+        self,
+        owners: Dict[tuple, str],
+        members,
+        now: float,
+        drain_only: bool = False,
+        replicas: Optional[Dict[tuple, Optional[str]]] = None,
+    ) -> List[Tuple[tuple, str, str]]:
+        """(chunk key, source, dest) **resident tiled chunk** moves — the
+        planner's third resource type.  Keys are (sid, (cy, cx)) tuples;
+        every move is a real state transfer (export → certify → adopt
+        under the session's step barrier), so every move charges the
+        in-flight budget.  Same drain-always / load-cadenced policy shape
+        as :meth:`plan_shards`, with the chunk's replica avoided as a
+        destination (the no-co-residence constraint, falling back when it
+        is the last placeable member — a 2-worker drain must not wedge)."""
+        moves: List[Tuple[tuple, str, str]] = []
+        budget = self.max_inflight - len(self.inflight)
+        if budget <= 0:
+            return moves
+        placeable = [m for m in members if m.alive and not m.draining]
+        if not placeable:
+            return moves
+        loads = {m.name: 0 for m in placeable}
+        for owner in owners.values():
+            if owner in loads:
+                loads[owner] += 1
+        for mig in self.inflight.values():
+            if mig.dest in loads:
+                loads[mig.dest] += 1
+            if mig.source in loads:
+                loads[mig.source] = max(0, loads[mig.source] - 1)
+        planned: set = set()
+
+        def movable(name: str) -> List[tuple]:
+            return sorted(
+                k
+                for k, o in owners.items()
+                if o == name
+                and k not in self.inflight
+                and k not in planned
+                and self._cooldown.get(k, 0.0) <= now
+            )
+
+        def pick_dest(key: tuple, exclude=()) -> Optional[str]:
+            pool = [n for n in loads if n not in exclude]
+            if not pool:
+                return None
+            banned = (replicas or {}).get(key)
+            cands = [n for n in pool if n != banned] or pool
+            return min(cands, key=lambda n: (loads[n], n))
+
+        # 1. Drain-driven: always planned, every pass.
+        for m in members:
+            if not (m.alive and m.draining):
+                continue
+            for key in movable(m.name):
+                if budget <= 0 or not loads:
+                    break
+                dest = pick_dest(key)
+                if dest is None:
+                    continue
+                moves.append((key, m.name, dest))
+                planned.add(key)
+                loads[dest] += 1
+                budget -= 1
+
+        # 2. Load-driven spreading (chunk-count gap ≥ 2), cadenced.
+        if not drain_only and budget > 0 and now >= self._next_resident_plan_at:
+            self._next_resident_plan_at = now + self.interval_s
+            gap = max(2, self.min_gap)
+            while budget > 0 and len(loads) >= 2:
+                src = max(placeable, key=lambda m: loads.get(m.name, 0))
+                choice = None
+                for k in movable(src.name):
+                    d = pick_dest(k, exclude=(src.name,))
+                    if d is None or loads[src.name] - loads[d] < gap:
+                        continue
+                    choice = (k, d)
+                    break
+                if choice is None:
+                    break
+                key, dest = choice
+                moves.append((key, src.name, dest))
+                planned.add(key)
+                loads[src.name] -= 1
+                loads[dest] += 1
+                budget -= 1
         return moves

@@ -54,15 +54,30 @@ same lock hold that commits the move), and drains re-home replicas (a
 draining worker is released only once nothing replicates to it).
 
 **Tiled (mega-board) sessions.**  A board above the largest size class is
-no longer rejected: it is admitted as a first-class *tiled* session on
-the existing halo/digest machinery — the frontend keeps the board, splits
-it into size-class-sided tiles, and each step fans ``step_raw`` chunks
-out across ALL workers (each tile ships with a k-wide toroidal halo, the
-worker steps k epochs, the halo absorbs wrap contamination, and the
-returned interior is exactly the global evolution).  Per-tile digest
-lanes computed at global offsets merge into the session digest — the
-same certification plane as the big-board cluster.  Worker crash
-mid-chunk just replays the pure chunk elsewhere.
+no longer rejected: it is admitted as a first-class *tiled* session.
+With ``serve_tiled_resident`` on (the default) the session is
+WORKER-RESIDENT: chunks install once on their assigned workers and stay
+device-side across steps; per step request the frontend sends ONE op per
+worker naming the barrier epoch, the per-round step counts, and the
+chunk→owner aiming map — the workers then chain the rounds themselves,
+exchanging O(perimeter) halo strips worker-to-worker (``TILED_HALO``
+over the peer plane, batched and bit-packed per destination) and
+batching each round's ready chunks into one vmapped device call.  The
+frontend re-enters only at the request barrier (merged digest lanes, 16
+bytes a chunk) and on renders (``GET ?with_board=1`` pays the one
+remaining O(area) fetch).  Chunks snapshot at a barrier cadence and
+stream to per-chunk replicas through the PR 14 watermark machinery; a
+worker loss PROMOTES at the session's certified epoch (lost chunks from
+replica standby, survivors rolled back to their local snapshot — the
+whole session resumes consistent, windowed ops answer 429 ``failover``),
+and the Rebalancer re-homes resident chunks digest-certified under the
+session's steplock (a move can never interleave with an epoch barrier).
+With the gate off, the PR 13 ship-per-round path runs: the frontend
+keeps the board and each step fans ``step_raw`` chunks with full state
+across ALL workers (pure operands; a crash mid-chunk replays
+elsewhere).  Either way per-tile digest lanes computed at global offsets
+merge into the session digest — the same certification plane as the
+big-board cluster.
 """
 
 from __future__ import annotations
@@ -164,7 +179,10 @@ class _Entry:
 
 
 class _TiledSession:
-    """A frontend-resident mega-board and its tile grid."""
+    """A frontend-resident mega-board and its tile grid (the
+    ship-per-round mode: ``serve_tiled_resident`` off)."""
+
+    mode = "ship"
 
     __slots__ = ("board", "lanes", "epoch", "tiles", "steplock")
 
@@ -182,6 +200,78 @@ class _TiledSession:
         # multi-chunk read-modify-write of the resident board); different
         # sessions step fully in parallel.
         self.steplock = threading.Lock()
+
+
+class _ResidentTiled:
+    """A worker-resident mega-board session: the frontend holds only the
+    chunk grid, placement maps, and digest/watermark bookkeeping — the
+    cells live on the workers and per-round traffic is O(perimeter) peer
+    halo strips, never board state through here."""
+
+    mode = "resident"
+
+    __slots__ = (
+        "sid", "rule_s", "H", "W", "k", "ny", "nx", "tiles",
+        "owner", "replica", "acked", "epoch", "lanes", "population",
+        "steplock", "promoting", "round_idx", "parked",
+    )
+
+    def __init__(self, sid: str, rule_s: str, board: np.ndarray,
+                 tile_side: int, tile_chunk: int) -> None:
+        self.sid = sid
+        self.rule_s = rule_s
+        self.H, self.W = board.shape
+        grid_y = range(0, self.H, tile_side)
+        grid_x = range(0, self.W, tile_side)
+        self.ny = len(grid_y)
+        self.nx = len(grid_x)
+        # (cy, cx) -> (gy, gx, th, tw)
+        self.tiles: Dict[Tuple[int, int], Tuple[int, int, int, int]] = {
+            (cy, cx): (gy, gx,
+                       min(tile_side, self.H - gy),
+                       min(tile_side, self.W - gx))
+            for cy, gy in enumerate(grid_y)
+            for cx, gx in enumerate(grid_x)
+        }
+        # The halo width must fit inside every neighbor chunk (a strip is
+        # cut from ONE chunk's interior), so ragged edge tiles clamp the
+        # per-round epoch count.
+        self.k = max(1, min(
+            tile_chunk,
+            min(t[2] for t in self.tiles.values()),
+            min(t[3] for t in self.tiles.values()),
+        ))
+        self.owner: Dict[Tuple[int, int], str] = {}
+        self.replica: Dict[Tuple[int, int], Optional[str]] = {
+            c: None for c in self.tiles
+        }
+        # Per-chunk replication watermark: newest snapshot epoch the
+        # chunk's replica has acked (-1 = nothing; the session's
+        # certified resume point is the min over chunks).
+        self.acked: Dict[Tuple[int, int], int] = {
+            c: -1 for c in self.tiles
+        }
+        self.epoch = 0
+        self.lanes = odigest.digest_dense_np(board)
+        self.population = int((board == 1).sum())
+        self.steplock = threading.Lock()
+        self.promoting = False
+        self.round_idx = 0
+        self.parked = False
+
+    def certified(self, chunks=None) -> int:
+        """The epoch the session can provably resume at after losing
+        ``chunks`` (default: any chunk): every lost chunk's replica must
+        hold an acked snapshot there, and survivors' local history is
+        floor-pruned no deeper (so they hold it too)."""
+        keys = self.tiles if chunks is None else chunks
+        return min((self.acked[c] for c in keys), default=-1)
+
+    def meta(self) -> dict:
+        return {
+            "rule": self.rule_s, "H": self.H, "W": self.W,
+            "grid": [self.ny, self.nx], "k": self.k,
+        }
 
 
 class _Pending:
@@ -274,6 +364,14 @@ class ClusterServePlane:
             "gol_serve_shard_migration_aborts_total"
         )
         self._m_tiled = self.metrics.gauge("gol_serve_tiled_sessions")
+        self._m_tiled_bytes = self.metrics.histogram(
+            "gol_serve_tiled_bytes_round",
+            "Cell-state bytes moved per tiled step round",
+            buckets=(2**10, 2**12, 2**14, 2**16, 2**18, 2**20, 2**22, 2**24),
+        )
+        self._m_chunk_migrations = self.metrics.counter(
+            "gol_serve_tiled_chunk_migrations_total"
+        )
         self._m_evictions = self.metrics.counter(
             "gol_serve_session_evictions_total"
         )
@@ -308,9 +406,17 @@ class ClusterServePlane:
         # Rebalancer: same policy/backoff machinery, zero contention with
         # tile moves (budget and cooldowns are per-instance).
         self.rebalancer = Rebalancer(config)
+        # ...and the THIRD resource type (resident tiled chunks) gets its
+        # own instance too — chunk moves must not contend with shard
+        # moves for the in-flight budget.
+        self.tiled_rebalancer = Rebalancer(config)
+        self.tiled_resident = bool(config.serve_tiled_resident)
+        self.tiled_snap_rounds = int(config.serve_tiled_resident_snapshot)
 
         self._lock = threading.RLock()
-        self._work = threading.Condition(self._lock)
+        # Flusher wake signal: an Event, not the Condition — the routing
+        # fast path sets it WITHOUT holding the plane lock.
+        self._wake = threading.Event()
         self._ids = itertools.count(1)
         self._rids = itertools.count(1)
         self._rr = itertools.count()  # tiled-chunk round-robin cursor
@@ -325,6 +431,13 @@ class ClusterServePlane:
         self._held: Dict[int, List[_Pending]] = {}  # graftlint: guarded-by _lock
         self.shard_replica: Dict[int, Optional[str]] = {}  # graftlint: guarded-by _lock
         self._promoting: Dict[int, dict] = {}  # graftlint: guarded-by _lock
+        self._tiled_promoting: Dict[str, dict] = {}  # graftlint: guarded-by _lock
+        # The routing fast path's versioned immutable lookup snapshot:
+        # (owner dict, blocked frozenset), REPLACED (never mutated) under
+        # the lock whenever the shard table, in-flight move set, or
+        # promotion set changes — readers take the reference lock-free and
+        # revalidate identity under one short lock hold before enqueueing.
+        self._routes: Tuple[Dict[int, str], frozenset] = ({}, frozenset())
         self._lag_alert: set = set()  # graftlint: guarded-by _lock
         self._lag_minted: set = set()  # graftlint: guarded-by _lock
         self._lag_snapshot: Dict[int, float] = {}  # graftlint: guarded-by _lock
@@ -398,12 +511,24 @@ class ClusterServePlane:
             self._cells += height * width
         if tiled:
             board = random_grid((height, width), density=density, seed=seed)
-            t = _TiledSession(board, self.tile_side)
+            if self.tiled_resident:
+                try:
+                    t = self._install_tiled(sid, entry, board)
+                except BaseException:
+                    with self._lock:
+                        if self.sessions.get(sid) is entry:
+                            del self.sessions[sid]
+                            self._cells -= height * width
+                    raise
+            else:
+                t = _TiledSession(board, self.tile_side)
             with self._lock:
                 self.tiled[sid] = t
                 entry.digest = odigest.format_digest(odigest.value(t.lanes))
                 self._m_tiled.set(len(self.tiled))
-            doc = self._tiled_doc(sid, entry, t, with_board=with_board)
+            doc = self._tiled_doc(
+                sid, entry, t, with_board=with_board, board=board
+            )
             return doc
         op = {
             "op": "create", "rid": 0, "sid": sid, "tenant": tenant,
@@ -445,7 +570,8 @@ class ClusterServePlane:
             entry.mark_dirty(time.monotonic())
         return doc
 
-    def _tiled_doc(self, sid, entry, t, *, with_board: bool) -> dict:
+    def _tiled_doc(self, sid, entry, t, *, with_board: bool,
+                   board=None) -> dict:
         doc = {
             "id": sid,
             "tenant": entry.tenant,
@@ -455,12 +581,21 @@ class ClusterServePlane:
             "width": entry.width,
             "seed": entry.seed,
             "epoch": t.epoch,
-            "population": int((t.board == 1).sum()),
+            "population": (
+                t.population if t.mode == "resident"
+                else int((t.board == 1).sum())
+            ),
             "digest": odigest.format_digest(odigest.value(t.lanes)),
             "tiles": len(t.tiles),
+            "resident": t.mode == "resident",
         }
         if with_board:
-            doc["board"] = t.board.copy()
+            if board is not None:
+                doc["board"] = board.copy()
+            elif t.mode == "resident":
+                doc["board"] = self._fetch_tiled_board(sid, t)
+            else:
+                doc["board"] = t.board.copy()
         return doc
 
     def get(self, sid: str) -> dict:
@@ -471,6 +606,14 @@ class ClusterServePlane:
             entry.last_used = time.monotonic()
             t = self.tiled.get(sid)
         if t is not None:
+            if t.mode == "resident":
+                with self._lock:
+                    if t.promoting:
+                        self._reject(
+                            "failover",
+                            f"tiled session {sid} is mid-promotion after "
+                            f"a worker loss; retry",
+                        )
             with t.steplock:
                 return self._tiled_doc(sid, entry, t, with_board=True)
         p = self._submit(
@@ -500,10 +643,12 @@ class ClusterServePlane:
             if entry is None:
                 raise KeyError(sid)
             if entry.kind == "tiled":
-                self.tiled.pop(sid, None)
+                t = self.tiled.pop(sid, None)
                 del self.sessions[sid]
                 self._cells -= entry.height * entry.width
                 self._m_tiled.set(len(self.tiled))
+                if t is not None and t.mode == "resident":
+                    self._drop_tiled_locked(sid, t)
                 return
         p = self._submit(
             {"op": "delete", "rid": 0, "sid": sid}, sid=sid,
@@ -519,17 +664,19 @@ class ClusterServePlane:
                 self._replicate_forget_locked(entry.shard, sid)
 
     def step(self, sid: str, steps: int = 1) -> Tuple[int, int]:
+        # The steady-state hot path: session lookup and the draining gate
+        # read GIL-atomic state without the plane lock — the only lock
+        # holds left on a routed step are the (short) enqueue in _submit
+        # and the epoch write-back below.
         if steps < 1:
             raise ValueError(f"steps {steps} must be >= 1")
-        with self._lock:
-            entry = self.sessions.get(sid)
-            if entry is None:
-                raise KeyError(sid)
-            if self._draining:
-                self._reject("draining", "cluster serve plane is draining")
-            entry.last_used = time.monotonic()
-            is_tiled = entry.kind == "tiled"
-        if is_tiled:
+        entry = self.sessions.get(sid)  # graftlint: waive GL-LOCK01 -- hot-path read: a single dict.get is GIL-atomic, and every later mutation re-validates under the lock
+        if entry is None:
+            raise KeyError(sid)
+        if self._draining:  # graftlint: waive GL-LOCK01 -- monotonic one-way bool; the worst stale read admits one op that drains with the rest
+            self._reject("draining", "cluster serve plane is draining")
+        entry.last_used = time.monotonic()
+        if entry.kind == "tiled":
             return self._step_tiled(sid, entry, steps)
         p = self._submit(
             {"op": "step", "rid": 0, "sid": sid, "steps": int(steps)},
@@ -546,15 +693,45 @@ class ClusterServePlane:
 
     # -- op plumbing ----------------------------------------------------------
 
+    def _rebuild_routes_locked(self) -> None:
+        """Publish a fresh immutable routing snapshot (caller holds the
+        lock).  Called from every site that changes shard ownership, the
+        in-flight move set, or the promotion set — the fast path routes
+        entirely from this object and revalidates its identity under one
+        short lock hold, so a stale read can never enqueue onto a frozen
+        or promoted shard."""
+        self._routes = (
+            {s: o for s, o in self.shard_owner.items() if o is not None},
+            frozenset(self._promoting) | frozenset(
+                k for k in self.rebalancer.inflight if isinstance(k, int)
+            ),
+        )
+
     def _submit(self, op: dict, *, sid=None, shard=None, kind="",
                 member=None, on_done=None) -> _Pending:
+        rid = next(self._rids)  # itertools.count is GIL-atomic
+        op["rid"] = rid
+        p = _Pending(rid, op, sid=sid, shard=shard, kind=kind,
+                     member=member, on_done=on_done)
+        if member is None and shard is not None:
+            # Fast path: resolve the owner from the immutable snapshot
+            # outside the lock; one short hold enqueues, with an identity
+            # re-check so a concurrent table change falls back to the
+            # full router (which sees the new world).
+            routes = self._routes
+            owner = routes[0].get(shard)
+            if owner is not None and shard not in routes[1]:
+                p.member = owner
+                with self._lock:
+                    if self._routes is routes:
+                        self._pending[rid] = p
+                        self._outq.setdefault(owner, deque()).append(p)
+                        self._wake.set()
+                        return p
+                p.member = None  # table moved under us: route slowly
         with self._lock:
-            rid = next(self._rids)
-            op["rid"] = rid
-            p = _Pending(rid, op, sid=sid, shard=shard, kind=kind,
-                         member=member, on_done=on_done)
             self._route_locked(p)
-            self._work.notify_all()
+            self._wake.set()
         return p
 
     def _route_locked(self, p: _Pending) -> None:
@@ -607,6 +784,7 @@ class ClusterServePlane:
                 loads[owner] += 1
         name = min(loads, key=lambda n: (loads[n], n))
         self.shard_owner[shard] = name
+        self._rebuild_routes_locked()
         return name
 
     def _await(self, p: _Pending, *, grace: bool = False):
@@ -695,7 +873,7 @@ class ClusterServePlane:
                         p.sent = False
                         p.member = None
                         self._route_locked(p)
-                        self._work.notify_all()
+                        self._wake.set()
                     continue
                 except AdmissionError as e:
                     err = e
@@ -713,14 +891,14 @@ class ClusterServePlane:
         drop can never overtake the adopt it compensates."""
         p = _Pending(0, msg, kind="ctrl", member=member)
         self._outq.setdefault(member, deque()).append(p)
-        self._work.notify_all()
+        self._wake.set()
         return p
 
     def _flush_loop(self) -> None:
         while True:
+            self._wake.wait(timeout=0.25)
+            self._wake.clear()
             with self._lock:
-                while not self._stopped and not any(self._outq.values()):
-                    self._work.wait(timeout=0.25)
                 if self._stopped:
                     return
                 batches: List[Tuple[str, List[_Pending]]] = []
@@ -802,7 +980,7 @@ class ClusterServePlane:
                 err = self._reroute_unsent_locked(p, name)
                 if err is not None:
                     dead.append((p, err))
-            self._work.notify_all()
+            self._wake.set()
         for p, err in dead:
             self._resolve(p, error=err)
 
@@ -839,9 +1017,11 @@ class ClusterServePlane:
         resolutions: List[Tuple[_Pending, Optional[dict], Optional[BaseException]]] = []
         aborts: List = []
         promotions: List[Tuple[int, dict]] = []
+        tiled_plans: List[tuple] = []
         with self._lock:
             if self._stopped:
                 return  # teardown: member losses are expected, plane is done
+            tiled_plans = self._begin_tiled_promotions_locked(name)
             doomed = self.rebalancer.drop_member(name)
             for mig in doomed:
                 phase = getattr(mig, "phase", "prepare")
@@ -908,12 +1088,14 @@ class ClusterServePlane:
             # reset, so their streams start from scratch toward the new
             # replica).
             self._refresh_replicas_locked()
-            self._work.notify_all()
+            self._wake.set()
         for mig, reason, notify, lost in aborts:
             self._abort_shard(mig, reason, source_alive=notify,
                               sessions_lost=lost)
         for shard, info in promotions:
             self._launch_promotion(shard, info, lost_member=name)
+        for plan in tiled_plans:
+            self._launch_tiled_promotion(plan, lost_member=name)
         for p, result, error in resolutions:
             self._resolve(p, result=result, error=error)
         # Gauge reclaim, the heartbeat-age discipline: a dead member's
@@ -941,6 +1123,18 @@ class ClusterServePlane:
             if any(
                 name in (m.source, m.dest)
                 for m in self.rebalancer.inflight.values()
+            ):
+                return False
+            for t in self.tiled.values():
+                if t.mode != "resident":
+                    continue
+                if any(o == name for o in t.owner.values()):
+                    return False  # still hosts resident chunks
+                if any(r == name for r in t.replica.values()):
+                    return False  # still a chunk replica
+            if any(
+                name in (m.source, m.dest)
+                for m in self.tiled_rebalancer.inflight.values()
             ):
                 return False
             q = self._outq.get(name)
@@ -973,6 +1167,15 @@ class ClusterServePlane:
             # re-homed in on_member_lost, and the single-copy gauge
             # tracks the honest degradation level.
             self._refresh_replicas_locked()
+            for t in self.tiled.values():
+                if t.mode == "resident" and not t.promoting:
+                    self._assign_tiled_replicas_locked(t)
+            tiled_moves = []
+            for key, source, dest in self._plan_tiled_moves_locked(
+                now, drain_only
+            ):
+                mig = self.tiled_rebalancer.begin(key, source, dest, now)
+                tiled_moves.append((key, source, dest, mig.seq))
             lag_events = {
                 s: self._lag_snapshot.get(s, 0.0)
                 for s in self._update_lag_locked(now)
@@ -1003,8 +1206,10 @@ class ClusterServePlane:
                     # this is how a late joiner starts receiving shards
                     # the moment the planner notices it.
                     self.shard_owner[shard] = dest
+                    self._rebuild_routes_locked()
                     continue
                 mig = self.rebalancer.begin(shard, source, dest, now)
+                self._rebuild_routes_locked()
                 mig.phase = "prepare"
                 mig.sids = sids  # plan-time estimate; the WORKER's export
                 # is authoritative (it recomputes membership by hash when
@@ -1022,6 +1227,15 @@ class ClusterServePlane:
                     "seq": mig.seq,
                 })
             self._refresh_gauges_locked()
+        for key, source, dest, seq in tiled_moves:
+            # Each resident-chunk move runs on its own thread: it holds
+            # the session's steplock across export → certify → adopt, so
+            # the maintenance loop must not block behind it.
+            threading.Thread(
+                target=self._migrate_tiled_chunk,
+                args=(key, source, dest, seq),
+                daemon=True, name=f"tiled-move-{key[0]}",
+            ).start()
         if self.events is not None:
             for shard, lag in sorted(lag_events.items()):
                 # Loud, transition-edged (only shards NEWLY over the
@@ -1050,7 +1264,12 @@ class ClusterServePlane:
                 ):
                     continue
                 if e.kind == "tiled":
-                    self.tiled.pop(sid, None)
+                    t = self.tiled.pop(sid, None)
+                    if t is not None and t.mode == "resident":
+                        if t.promoting:
+                            self.tiled[sid] = t
+                            continue  # settle the promotion first
+                        self._drop_tiled_locked(sid, t)
                     del self.sessions[sid]
                     self._cells -= e.height * e.width
                     self._m_tiled.set(len(self.tiled))
@@ -1154,6 +1373,7 @@ class ClusterServePlane:
                 return
             self.rebalancer.complete(mig.tile)
             self.shard_owner[mig.tile] = mig.dest
+            self._rebuild_routes_locked()
             self._m_migrations.inc()
             if mig.span is not None:
                 mig.span.set(outcome="commit").finish()
@@ -1177,7 +1397,7 @@ class ClusterServePlane:
             # membership may have shifted) — reconcile immediately, so the
             # co-residence window is one lock hold, not one poll tick.
             self._refresh_replicas_locked()
-            self._work.notify_all()
+            self._wake.set()
         if self.events is not None:
             self.events.emit(
                 "serve_shard_migrated", shard=mig.tile,
@@ -1198,6 +1418,7 @@ class ClusterServePlane:
             if self.rebalancer.get(mig.tile, mig.seq) is not mig:
                 return
             self.rebalancer.abort(mig.tile, time.monotonic())
+            self._rebuild_routes_locked()
             self._m_migration_aborts.inc()
             # An abort racing the adopt phase (deadline mid-install, dest
             # flapping) must not strand GHOST session copies at the
@@ -1289,7 +1510,7 @@ class ClusterServePlane:
                     self._enqueue_ctrl_locked(mig.source, {
                         "type": P.SHARD_ABORT, "shard": mig.tile,
                     })
-            self._work.notify_all()
+            self._wake.set()
         if self.events is not None:
             self.events.emit(
                 "serve_shard_migration_aborted", shard=mig.tile,
@@ -1401,6 +1622,11 @@ class ClusterServePlane:
         stream when no replica is placeable."""
         if not self._replicate:
             return
+        if "tiled" in msg:
+            # Resident tiled-chunk snapshots share the frame kind but are
+            # keyed by (sid, chunk), not shard.
+            self.on_tiled_replicate(member_name, msg["tiled"])
+            return
         shard = int(msg["shard"])
         payloads = msg.get("sessions", [])
         with self._lock:
@@ -1511,6 +1737,7 @@ class ClusterServePlane:
                 kept += 1
         self.shard_owner[shard] = repl
         self.shard_replica[shard] = None
+        self._rebuild_routes_locked()
         info = {
             "dest": repl,
             "t0": time.monotonic(),
@@ -1570,6 +1797,7 @@ class ClusterServePlane:
             if info is None or info["dest"] != p.member:
                 return
             del self._promoting[shard]
+            self._rebuild_routes_locked()
             span = info["span"]
             now = time.monotonic()
             if p.error is not None or not p.result:
@@ -1623,7 +1851,7 @@ class ClusterServePlane:
                 # Appoint the next replica; the new primary streams the
                 # shard from scratch (it has no watermark state).
                 self._refresh_replicas_locked()
-            self._work.notify_all()
+            self._wake.set()
         if self.events is not None:
             self.events.emit(
                 "serve_promotion_finished", shard=shard, dest=p.member,
@@ -1688,6 +1916,8 @@ class ClusterServePlane:
             t = self.tiled.get(sid)
         if t is None:
             raise KeyError(sid)
+        if t.mode == "resident":
+            return self._step_tiled_resident(sid, entry, t, steps)
         with t.steplock:
             board = t.board
             H, W = board.shape
@@ -1696,6 +1926,7 @@ class ClusterServePlane:
             while remaining > 0:
                 k = min(remaining, self.tile_chunk)
                 pends: List[_Pending] = []
+                round_bytes = 0
                 for gy, gx, th, tw in t.tiles:
                     rows = np.arange(gy - k, gy + th + k) % H
                     cols = np.arange(gx - k, gx + tw + k) % W
@@ -1707,10 +1938,12 @@ class ClusterServePlane:
                             "no_workers",
                             "no serve workers available for tile chunks",
                         )
+                    state = pack_tile(padded)
+                    round_bytes += int(getattr(state["data"], "nbytes", 0))
                     pends.append(self._submit(
                         {
                             "op": "step_raw", "rid": 0, "rule": entry.rule_s,
-                            "k": int(k), "state": pack_tile(padded),
+                            "k": int(k), "state": state,
                             "origin": [int(gy), int(gx)], "width": int(W),
                             "interior": [int(k), int(k + th), int(k),
                                          int(k + tw)],
@@ -1728,9 +1961,13 @@ class ClusterServePlane:
                     board[gy:gy + th, gx:gx + tw] = unpack_tile(
                         result["state"]
                     )
+                    round_bytes += int(getattr(
+                        result["state"]["data"], "nbytes", 0
+                    ))
                     lanes_parts.append(
                         [int(result["digest"][0]), int(result["digest"][1])]
                     )
+                self._m_tiled_bytes.observe(round_bytes)
                 remaining -= k
                 t.epoch += k
                 # Per ROUND, not after the loop: a later round's failure
@@ -1763,6 +2000,708 @@ class ClusterServePlane:
                 op = dict(p.op)
                 p = self._submit(op, sid=p.sid, kind="tile", member=member)
         raise last if last is not None else RuntimeError("tile chunk failed")
+
+    # -- worker-resident tiled sessions ---------------------------------------
+
+    @staticmethod
+    def _ckey(c: Tuple[int, int]) -> str:
+        return f"{c[0]},{c[1]}"
+
+    def _install_tiled(self, sid: str, entry: _Entry,
+                       board: np.ndarray) -> _ResidentTiled:
+        """Create-time installation: place each chunk on a worker (round-
+        robin over the placeable set), appoint replicas, and ship every
+        chunk ONCE — the last time its full state crosses the frontend
+        until a render asks for it."""
+        t = _ResidentTiled(
+            sid, entry.rule_s, board, self.tile_side, self.tile_chunk
+        )
+        with self._lock:
+            members = self.membership.placeable_members() or (
+                self.membership.alive_members()
+            )
+            if not members:
+                self._reject(
+                    "no_workers", "no serve workers for a tiled session"
+                )
+            names = sorted(m.name for m in members)
+            for i, c in enumerate(sorted(t.tiles)):
+                t.owner[c] = names[i % len(names)]
+            self._assign_tiled_replicas_locked(t)
+        pends = []
+        for c, (gy, gx, th, tw) in sorted(t.tiles.items()):
+            pends.append(self._submit(
+                {
+                    "op": "tiled_install", "rid": 0, "sid": sid,
+                    "rule": t.rule_s, "H": t.H, "W": t.W,
+                    "grid": [t.ny, t.nx], "chunk": list(c),
+                    "origin": [gy, gx], "shape": [th, tw], "k": t.k,
+                    "state": pack_tile(board[gy:gy + th, gx:gx + tw]),
+                    "epoch": 0,
+                    "replicate": self._replicate,
+                },
+                sid=sid, kind="tile_ctl", member=t.owner[c],
+            ))
+        try:
+            for p in pends:
+                self._await(p)
+        except BaseException:
+            with self._lock:
+                self._drop_tiled_locked(sid, t)
+            raise
+        return t
+
+    def _tiled_owner_wire_locked(self, t: _ResidentTiled) -> Dict[str, list]:
+        """chunk key -> [owner name, peer host, peer port] for one round's
+        halo aiming (caller holds the lock)."""
+        out: Dict[str, list] = {}
+        for c, owner in t.owner.items():
+            m = self.membership.get(owner)
+            if m is None or not m.alive:
+                raise AdmissionError(
+                    "no_workers", f"tiled chunk owner {owner} is gone"
+                )
+            out[self._ckey(c)] = [owner, m.peer_host, int(m.peer_port)]
+        return out
+
+    def _step_tiled_resident(
+        self, sid: str, entry: _Entry, t: _ResidentTiled, steps: int
+    ) -> Tuple[int, int]:
+        """The steady-state tentpole: per round, ONE light op per worker
+        (epoch barrier + halo aiming map), O(perimeter) peer strips on the
+        workers' own wire, digest lanes only at barrier/final rounds —
+        the frontend never touches cell state."""
+        with t.steplock:
+            with self._lock:
+                if t.promoting:
+                    self._reject(
+                        "failover",
+                        f"tiled session {sid} is mid-promotion; retry",
+                    )
+                owners_wire = self._tiled_owner_wire_locked(t)
+                floor = t.certified()
+                by_member: Dict[str, List[list]] = {}
+                for c, owner in t.owner.items():
+                    by_member.setdefault(owner, []).append(list(c))
+            # ONE op per worker for the WHOLE request: the per-round step
+            # counts and the absolute snapshot epochs ride along, and the
+            # workers chain the intermediate rounds peer-to-peer — the
+            # frontend re-enters only at the request barrier.
+            ks: List[int] = []
+            snap_epochs: List[int] = []
+            e = t.epoch
+            remaining = steps
+            while remaining > 0:
+                k = min(remaining, t.k)
+                ks.append(int(k))
+                e += k
+                remaining -= k
+                t.round_idx += 1
+                if (
+                    self._replicate
+                    and t.round_idx % self.tiled_snap_rounds == 0
+                ):
+                    snap_epochs.append(int(e))
+            pends = [
+                self._submit(
+                    {
+                        "op": "tiled_step", "rid": 0, "sid": sid,
+                        "epoch": t.epoch, "ks": ks, "chunks": chunks,
+                        "owners": owners_wire, "digest": True,
+                        "snap_epochs": snap_epochs, "floor": floor,
+                    },
+                    sid=sid, kind="tile_ctl", member=member,
+                )
+                for member, chunks in sorted(by_member.items())
+            ]
+            try:
+                results = [self._await(p, grace=True) for p in pends]
+            except BaseException as e:
+                with self._lock:
+                    promoting = t.promoting
+                if promoting:
+                    self._reject(
+                        "failover",
+                        f"tiled session {sid} lost a worker mid-step; "
+                        f"it resumes at its last certified epoch — retry",
+                    )
+                # A request that failed WITHOUT a worker loss (one op
+                # timing out on a slow worker, a halo batch exhausting
+                # its retries) may have advanced SOME workers' chunks:
+                # the frontend epoch and the worker epochs must never
+                # drift apart silently, or every later request errors
+                # forever.  Resync the whole session to its certified
+                # snapshot — the same consistent-rollback machinery a
+                # promotion uses, with no chunks to promote.
+                self._begin_tiled_resync(sid, t)
+                self._reject(
+                    "failover",
+                    f"tiled session {sid} step failed mid-request "
+                    f"({e!r}); the session resyncs to its last "
+                    f"certified epoch — retry",
+                )
+            request_bytes = sum(
+                int(r.get("halo_bytes", 0)) for r in results
+            )
+            for _ in ks:
+                self._m_tiled_bytes.observe(request_bytes / len(ks))
+            t.epoch += steps
+            lanes_parts: List[list] = []
+            pop = 0
+            for r in results:
+                lanes_parts.extend(r.get("lanes", {}).values())
+                pop += sum(int(v) for v in r.get("pop", {}).values())
+            t.lanes = odigest.merge_lanes(lanes_parts)
+            t.population = pop
+            epoch, digest = t.epoch, odigest.value(t.lanes)
+        with self._lock:
+            if self.sessions.get(sid) is entry:
+                entry.epoch = epoch
+                entry.digest = odigest.format_digest(digest)
+        return epoch, digest
+
+    def _fetch_tiled_board(self, sid: str, t: _ResidentTiled) -> np.ndarray:
+        """Render pull (GET ?with_board=1 only): gather the resident
+        chunks and assemble the full board — the one remaining O(area)
+        path, paid exactly when a tenant asks to SEE the board."""
+        with self._lock:
+            by_member: Dict[str, List[list]] = {}
+            for c, owner in t.owner.items():
+                by_member.setdefault(owner, []).append(list(c))
+        pends = [
+            self._submit(
+                {"op": "tiled_fetch", "rid": 0, "sid": sid, "chunks": chunks},
+                sid=sid, kind="tile_ctl", member=member,
+            )
+            for member, chunks in sorted(by_member.items())
+        ]
+        board = np.zeros((t.H, t.W), dtype=np.uint8)
+        try:
+            for p in pends:
+                res = self._await(p)
+                for row in res["states"]:
+                    if int(row["epoch"]) != t.epoch:
+                        # Never serve a torn board: a chunk off the
+                        # session epoch means a failed request left the
+                        # workers desynchronized (the resync path owns
+                        # recovery; this render answers retryably).
+                        raise RuntimeError(
+                            f"tiled chunk {row['chunk']} at epoch "
+                            f"{row['epoch']}, session at {t.epoch}"
+                        )
+                    gy, gx = (int(v) for v in row["origin"])
+                    th, tw = (int(v) for v in row["shape"])
+                    board[gy:gy + th, gx:gx + tw] = unpack_tile(row["state"])
+        except BaseException:
+            with self._lock:
+                promoting = t.promoting
+            if promoting:
+                self._reject(
+                    "failover",
+                    f"tiled session {sid} is mid-promotion; retry",
+                )
+            raise
+        return board
+
+    def _drop_tiled_locked(self, sid: str, t: _ResidentTiled) -> None:
+        """Release a resident session's worker-side state (delete, evict,
+        honest loss, failed install) — best-effort ops to every live
+        owner and replica (caller holds the lock)."""
+        for name in {
+            n for n in list(t.owner.values()) + list(t.replica.values())
+            if n is not None
+        }:
+            m = self.membership.get(name)
+            if m is None or not m.alive:
+                continue
+            op = (
+                {"op": "tiled_drop", "rid": 0, "sid": sid}
+                if name in t.owner.values()
+                else {"op": "tiled_replica_drop", "rid": 0, "sid": sid}
+            )
+            try:
+                self._submit(op, sid=sid, kind="tile_ctl", member=name,
+                             on_done=lambda _p: None)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+
+    # -- resident tiled: replication relay ------------------------------------
+
+    def _tiled_replica_for_locked(
+        self, sid: str, c: Tuple[int, int], owner: Optional[str],
+        names: List[str], current: Optional[str],
+    ) -> Optional[str]:
+        """Sticky-first, rendezvous-second, never the chunk's owner —
+        the shard-replica policy at chunk granularity."""
+        import zlib
+
+        if not self._replicate or owner is None:
+            return None
+        if current is not None and current != owner and current in names:
+            return current
+        pool = [n for n in names if n != owner]
+        if not pool:
+            return None
+        return max(
+            pool,
+            key=lambda n: (
+                zlib.crc32(f"{sid}:{c[0]},{c[1]}:{n}".encode()), n
+            ),
+        )
+
+    def _assign_tiled_replicas_locked(self, t: _ResidentTiled) -> None:
+        """Reconcile one resident session's replica map with the current
+        membership (caller holds the lock): re-homed chunks reset their
+        watermark and tell the primary to restart its stream; a session
+        with no possible replica parks its primaries' streams."""
+        names = sorted(
+            m.name for m in self.membership.placeable_members()
+        )
+        resets: Dict[str, List[str]] = {}
+        drops: List[Tuple[str, list]] = []
+        for c, owner in t.owner.items():
+            desired = self._tiled_replica_for_locked(
+                t.sid, c, owner, names, t.replica.get(c)
+            )
+            cur = t.replica.get(c)
+            if desired == cur:
+                continue
+            t.replica[c] = desired
+            t.acked[c] = -1
+            if cur is not None:
+                m = self.membership.get(cur)
+                if m is not None and m.alive:
+                    drops.append((cur, list(c)))
+            if owner is not None:
+                resets.setdefault(owner, []).append(self._ckey(c))
+        was_parked = t.parked
+        t.parked = self._replicate and all(
+            r is None for r in t.replica.values()
+        )
+        for cur, chunk in drops:
+            try:
+                self._submit(
+                    {"op": "tiled_replica_drop", "rid": 0, "sid": t.sid,
+                     "chunks": [chunk]},
+                    kind="tile_ctl", member=cur, on_done=lambda _p: None,
+                )
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+        if t.parked and not was_parked:
+            for owner in set(t.owner.values()):
+                m = self.membership.get(owner)
+                if m is not None and m.alive:
+                    self._enqueue_ctrl_locked(owner, {
+                        "type": P.SHARD_REPLICATE_ACK, "shard": -1,
+                        "tiled_parked": [t.sid],
+                    })
+            return
+        for owner, keys in resets.items():
+            m = self.membership.get(owner)
+            if m is not None and m.alive:
+                self._enqueue_ctrl_locked(owner, {
+                    "type": P.SHARD_REPLICATE_ACK, "shard": -1,
+                    "tiled_reset": {t.sid: keys},
+                })
+
+    def on_tiled_replicate(self, member_name: str, payloads: list) -> None:
+        """A primary's resident-chunk snapshot stream: relay each payload
+        to its chunk's replica through the replica's op FIFO; acks flow
+        back to the primary with the per-chunk watermark and the
+        session's certified floor."""
+        by_replica: Dict[Tuple[str, str], List[dict]] = {}
+        with self._lock:
+            if self._stopped:
+                return
+            for pay in payloads:
+                sid = str(pay.get("sid"))
+                t = self.tiled.get(sid)
+                if (
+                    t is None or t.mode != "resident" or t.promoting
+                    or sid in self._tiled_promoting
+                ):
+                    continue
+                c = tuple(int(v) for v in pay["chunk"])
+                if t.owner.get(c) != member_name:
+                    continue  # stale stream from a former owner
+                repl = t.replica.get(c)
+                m = self.membership.get(repl) if repl is not None else None
+                if m is None or not m.alive:
+                    continue  # parked / re-homing; the refresh pass acks
+                by_replica.setdefault((sid, repl), []).append(pay)
+                self._m_repl_bytes.inc(
+                    int(getattr(pay.get("state", {}).get("data"), "nbytes", 0))
+                )
+            for (sid, repl), chunk_pays in by_replica.items():
+                t = self.tiled.get(sid)
+                self._submit(
+                    {"op": "tiled_replicate", "rid": 0, "sid": sid,
+                     "chunks": chunk_pays, "floor": t.certified()},
+                    kind="replicate", member=repl,
+                    on_done=lambda p, sid=sid, primary=member_name: (
+                        self._on_tiled_replicated(sid, primary, p)
+                    ),
+                )
+
+    def _on_tiled_replicated(self, sid: str, primary: str,
+                             p: _Pending) -> None:
+        """A replica acked resident-chunk snapshots: advance per-chunk
+        watermarks and relay the ack (plus the new certified floor) to
+        the primary's op FIFO.  A failed install is simply not acked —
+        the primary's next pass retransmits."""
+        if p.error is not None or not p.result:
+            return
+        acked = dict(p.result.get("acked", {}))
+        if not acked:
+            return
+        with self._lock:
+            t = self.tiled.get(sid)
+            if t is None or t.mode != "resident":
+                return
+            wire_acked: Dict[str, int] = {}
+            for ck, epoch in acked.items():
+                c = tuple(int(v) for v in ck.split(","))
+                if t.replica.get(c) != p.member:
+                    continue  # re-homed since: stale ack
+                if int(epoch) > t.acked.get(c, -1):
+                    t.acked[c] = int(epoch)
+                wire_acked[ck] = t.acked[c]
+            if not wire_acked:
+                return
+            pm = self.membership.get(primary)
+            if pm is not None and pm.alive:
+                self._enqueue_ctrl_locked(primary, {
+                    "type": P.SHARD_REPLICATE_ACK, "shard": -1,
+                    "tiled_acked": {sid: wire_acked},
+                    "tiled_floor": {sid: t.certified()},
+                })
+
+    # -- resident tiled: promotion on worker loss ------------------------------
+
+    def _begin_tiled_resync(self, sid: str, t: _ResidentTiled) -> None:
+        """A step request failed without a member loss (timeout on a slow
+        worker, halo retry exhaustion): some workers' chunks may have
+        advanced past the frontend's epoch.  Roll the WHOLE session back
+        to its certified snapshot — promotion with zero lost chunks —
+        so frontend and workers agree again; no certified state = honest
+        loss (the session could otherwise serve torn state forever).
+        Caller holds the steplock (the failed request's own hold)."""
+        with self._lock:
+            if t.promoting or self.tiled.get(sid) is not t:
+                return
+            C = t.certified() if self._replicate else -1
+            if C < 0:
+                e = self.sessions.pop(sid, None)
+                self.tiled.pop(sid, None)
+                if e is not None:
+                    self._cells -= e.height * e.width
+                self._m_sessions_lost.inc()
+                self._m_tiled.set(len(self.tiled))
+                self._drop_tiled_locked(sid, t)
+                return
+            t.promoting = True
+            survivors = sorted(set(t.owner.values()))
+            info = {
+                "t0": time.monotonic(),
+                "span": self.tracer.start(
+                    "serve.promote", node="frontend", sid=sid,
+                    kind="tiled_resync", epoch=C,
+                ),
+            }
+            self._tiled_promoting[sid] = info
+        self._launch_tiled_promotion(
+            (sid, t, C, {}, survivors, info), lost_member=""
+        )
+
+    def _begin_tiled_promotions_locked(self, name: str) -> List[tuple]:
+        """Worker ``name`` died.  For every resident session touched:
+        chunks it OWNED promote from their replicas at the session's
+        certified epoch (survivor chunks roll back to it — the whole
+        session resumes consistent); chunks it replicated re-home.
+        Sessions with no certified resume point are lost honestly.
+        Returns promotion plans for _launch_tiled_promotion (caller holds
+        the lock)."""
+        plans: List[tuple] = []
+        for sid, t in list(self.tiled.items()):
+            if t.mode != "resident":
+                continue
+            lost = [c for c, o in t.owner.items() if o == name]
+            for c, r in list(t.replica.items()):
+                if r == name:
+                    # The dead member was a REPLICA here: the standby
+                    # state died with it; the refresh pass re-homes.
+                    t.replica[c] = None
+                    t.acked[c] = -1
+            if not lost:
+                continue
+            C = t.certified(lost) if self._replicate else -1
+            live_repl = all(
+                t.replica.get(c) is not None
+                and (m := self.membership.get(t.replica[c])) is not None
+                and m.alive
+                for c in lost
+            )
+            if t.promoting or C < 0 or not live_repl:
+                # Honest loss: no certified resume point (or a double
+                # failure mid-promotion).
+                e = self.sessions.pop(sid, None)
+                self.tiled.pop(sid, None)
+                if e is not None:
+                    self._cells -= e.height * e.width
+                self._m_sessions_lost.inc()
+                self._m_tiled.set(len(self.tiled))
+                self._drop_tiled_locked(sid, t)
+                continue
+            t.promoting = True
+            lost_set = set(lost)
+            # Every member still owning a SURVIVING chunk rolls it back
+            # to C (rollback first on each FIFO, so a member that both
+            # survives and promotes orders correctly).
+            survivors = sorted({
+                o for c, o in t.owner.items()
+                if c not in lost_set and o != name
+            })
+            by_replica: Dict[str, List[list]] = {}
+            for c in lost:
+                by_replica.setdefault(t.replica[c], []).append(list(c))
+                t.owner[c] = t.replica[c]
+                t.replica[c] = None
+                t.acked[c] = -1
+            info = {
+                "t0": time.monotonic(),
+                "span": self.tracer.start(
+                    "serve.promote", node="frontend", sid=sid,
+                    kind="tiled", chunks=len(lost), epoch=C,
+                ),
+            }
+            self._tiled_promoting[sid] = info
+            plans.append((sid, t, C, by_replica, survivors, info))
+        return plans
+
+    def _launch_tiled_promotion(self, plan: tuple, lost_member: str) -> None:
+        """Fire one resident-session promotion on its own thread (the
+        caller is a frontend reader/maintenance thread and must not block
+        on worker round-trips)."""
+        threading.Thread(
+            target=self._run_tiled_promotion, args=(plan, lost_member),
+            daemon=True, name=f"tiled-promote-{plan[0]}",
+        ).start()
+
+    def _run_tiled_promotion(self, plan: tuple, lost_member: str) -> None:
+        sid, t, C, by_replica, survivors, info = plan
+        flight = getattr(self.tracer, "flight", None)
+        if flight is not None:
+            flight.dump("serve_promote", node="frontend")
+        if self.events is not None:
+            self.events.emit(
+                "serve_promotion_started", sid=sid, kind="tiled",
+                lost=lost_member, epoch=C,
+                chunks=sum(len(v) for v in by_replica.values()),
+            )
+        lanes_parts: List[list] = []
+        pop = 0
+        ok = True
+        try:
+            # Survivors FIRST: the rollback cancels any round stalled on
+            # halos from the dead worker, so an in-flight step fails fast
+            # (its caller answers 429 failover) instead of waiting out
+            # the barrier timeout.
+            pends = [
+                self._submit(
+                    {"op": "tiled_rollback", "rid": 0, "sid": sid,
+                     "epoch": int(C)},
+                    sid=sid, kind="tile_ctl", member=m,
+                )
+                for m in survivors
+            ]
+            pends += [
+                self._submit(
+                    {"op": "tiled_promote", "rid": 0, "sid": sid,
+                     "epoch": int(C), "chunks": chunks, "meta": t.meta()},
+                    sid=sid, kind="tile_ctl", member=m,
+                )
+                for m, chunks in sorted(by_replica.items())
+            ]
+            for p in pends:
+                res = self._await(p)
+                rows = res.get("restored", []) + res.get("installed", [])
+                if res.get("missing") or res.get("failed"):
+                    ok = False
+                for row in rows:
+                    lanes_parts.append([int(v) for v in row["digest"]])
+                    pop += int(row.get("pop", 0))
+            if len(lanes_parts) != len(t.tiles):
+                ok = False
+        except BaseException:  # noqa: BLE001 — resolved below, honestly
+            ok = False
+        with self._lock:
+            self._tiled_promoting.pop(sid, None)
+            entry = self.sessions.get(sid)
+            if not ok or entry is None:
+                t.promoting = False
+                if entry is not None:
+                    del self.sessions[sid]
+                    self._cells -= entry.height * entry.width
+                    self._m_sessions_lost.inc()
+                self.tiled.pop(sid, None)
+                self._m_tiled.set(len(self.tiled))
+                self._drop_tiled_locked(sid, t)
+                if info["span"] is not None:
+                    info["span"].set(outcome="lost").finish()
+            else:
+                t.epoch = int(C)
+                t.lanes = odigest.merge_lanes(lanes_parts)
+                t.population = pop
+                t.round_idx = 0
+                entry.epoch = int(C)
+                entry.digest = odigest.format_digest(
+                    odigest.value(t.lanes)
+                )
+                t.promoting = False
+                self._assign_tiled_replicas_locked(t)
+                self._m_promotions.inc()
+                if info["span"] is not None:
+                    info["span"].set(
+                        outcome="promoted", epoch=int(C),
+                        latency_s=round(
+                            time.monotonic() - info["t0"], 6
+                        ),
+                    ).finish()
+        if self.events is not None:
+            self.events.emit(
+                "serve_promotion_finished", sid=sid, kind="tiled",
+                outcome="promoted" if ok else "lost", epoch=int(C),
+            )
+
+    # -- resident tiled: chunk migration (drain / load rebalancing) -----------
+
+    def _plan_tiled_moves_locked(self, now: float,
+                                 drain_only: bool) -> List[tuple]:
+        """Ask the chunk-plane Rebalancer for (key, source, dest) moves
+        over every resident, non-promoting session (caller holds the
+        lock)."""
+        owners: Dict[tuple, str] = {}
+        replicas: Dict[tuple, Optional[str]] = {}
+        for sid, t in self.tiled.items():
+            if t.mode != "resident" or t.promoting:
+                continue
+            for c, o in t.owner.items():
+                owners[(sid, c)] = o
+                replicas[(sid, c)] = t.replica.get(c)
+        if not owners:
+            return []
+        return self.tiled_rebalancer.plan_resident(
+            owners, self.membership.alive_members(), now,
+            drain_only=drain_only, replicas=replicas,
+        )
+
+    def _migrate_tiled_chunk(self, key: tuple, source: str, dest: str,
+                             seq: int) -> None:
+        """Move one resident chunk, digest-certified, under the session's
+        steplock — a move can never interleave with an epoch barrier, so
+        a torn halo is unrepresentable (the next round's op simply aims
+        at the new owner)."""
+        sid, c = key
+        with self._lock:
+            t = self.tiled.get(sid)
+        aborted = "setup"
+        if t is not None and t.steplock.acquire(
+            timeout=self.tiled_rebalancer.deadline_s
+        ):
+            try:
+                aborted = self._migrate_tiled_chunk_held(
+                    t, key, source, dest, seq
+                )
+            except BaseException as e:  # noqa: BLE001 — the in-flight
+                # record MUST resolve (abort), whatever broke
+                aborted = repr(e)
+            finally:
+                t.steplock.release()
+        now = time.monotonic()
+        with self._lock:
+            if aborted is None:
+                self.tiled_rebalancer.complete(key)
+                self._m_chunk_migrations.inc()
+            else:
+                self.tiled_rebalancer.abort(key, now)
+        if self.events is not None:
+            if aborted is None:
+                self.events.emit(
+                    "serve_tiled_chunk_migrated", sid=sid,
+                    chunk=list(c), source=source, dest=dest,
+                )
+            else:
+                self.events.emit(
+                    "serve_tiled_chunk_migration_aborted", sid=sid,
+                    chunk=list(c), source=source, dest=dest,
+                    reason=aborted,
+                )
+
+    def _migrate_tiled_chunk_held(self, t, key, source, dest,
+                                  seq) -> Optional[str]:
+        """The move body (steplock held).  Returns None on commit, else
+        the abort reason."""
+        sid, c = key
+        with self._lock:
+            if (
+                t.promoting
+                or self.tiled.get(sid) is not t
+                or t.owner.get(c) != source
+            ):
+                return "stale"
+            dm = self.membership.get(dest)
+            if dm is None or not dm.alive:
+                return "dest_lost"
+        try:
+            p = self._submit(
+                {"op": "tiled_export", "rid": 0, "sid": sid,
+                 "chunks": [list(c)]},
+                sid=sid, kind="tile_ctl", member=source,
+            )
+            pay = self._await(p)["chunks"][0]
+        except BaseException as e:  # noqa: BLE001 — abort, never raise
+            return f"export: {e!r}"
+        lanes = odigest.digest_payload_np(
+            pay["state"], tuple(int(v) for v in pay["origin"]),
+            int(pay["width"]),
+        )
+        self._m_digest_checks.inc()
+        if [int(lanes[0]), int(lanes[1])] != [int(v) for v in pay["digest"]]:
+            self._m_digest_mismatches.inc()
+            if self.events is not None:
+                self.events.emit(
+                    "serve_tiled_digest_mismatch", sid=sid,
+                    chunk=list(c), source=source,
+                )
+            return "digest_mismatch"
+        try:
+            p = self._submit(
+                {"op": "tiled_adopt", "rid": 0, "sid": sid,
+                 "meta": t.meta(), "chunks": [pay]},
+                sid=sid, kind="tile_ctl", member=dest,
+            )
+            self._await(p)
+        except BaseException as e:  # noqa: BLE001 — abort, never raise
+            return f"adopt: {e!r}"
+        with self._lock:
+            if self.tiled.get(sid) is not t or t.promoting:
+                return "stale"
+            t.owner[c] = dest
+            t.acked[c] = -1
+            self._assign_tiled_replicas_locked(t)
+            sm = self.membership.get(source)
+            src_alive = sm is not None and sm.alive
+        if src_alive:
+            try:
+                self._submit(
+                    {"op": "tiled_chunk_drop", "rid": 0, "sid": sid,
+                     "chunks": [list(c)]},
+                    sid=sid, kind="tile_ctl", member=source,
+                    on_done=lambda _p: None,
+                )
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+        return None
 
     # -- stats / health / lifecycle -------------------------------------------
 
@@ -1811,10 +2750,23 @@ class ClusterServePlane:
                     replicas[r] = replicas.get(r, 0) + 1
                 elif self._replicate and shard not in self._promoting:
                     single += 1
+            chunks_by_worker: Dict[str, int] = {}
+            for t in self.tiled.values():
+                if t.mode == "resident":
+                    for o in t.owner.values():
+                        chunks_by_worker[o] = chunks_by_worker.get(o, 0) + 1
             return {
                 "sessions": len(self.sessions),
                 "cells": self._cells,
                 "tiled_sessions": len(self.tiled),
+                "tiled_resident": {
+                    "enabled": self.tiled_resident,
+                    "chunks_by_worker": chunks_by_worker,
+                    "chunk_migrations_inflight": len(
+                        self.tiled_rebalancer.inflight
+                    ),
+                    "promotions_inflight": len(self._tiled_promoting),
+                },
                 "shards_total": self.n_shards,
                 "shards_by_worker": dict(snap["shards"]),
                 "sessions_by_worker": dict(snap["sessions"]),
@@ -1882,7 +2834,11 @@ class ClusterServePlane:
                 if info.get("span") is not None:
                     info["span"].set(outcome="shutdown").finish()
             self._promoting.clear()
-            self._work.notify_all()
+            for info in self._tiled_promoting.values():
+                if info.get("span") is not None:
+                    info["span"].set(outcome="shutdown").finish()
+            self._tiled_promoting.clear()
+            self._wake.set()
         for p in doomed:
             self._resolve(p, error=RuntimeError("router is closed"))
         self._flusher.join(timeout=5)

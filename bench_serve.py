@@ -973,6 +973,207 @@ def bench_serve_failover(
                 p.kill()
 
 
+def _route_plane_microbench(n_ops: int = 4000) -> dict:
+    """The frontend op plane in isolation: one in-process
+    ClusterServePlane wired to an ECHO member (the send callable answers
+    every op instantly from the flusher thread), driven with sequential
+    1-step ops.  No worker, no compute, no wire — pure routing: submit →
+    fast-path enqueue → flusher coalesce → resolve.  This is the
+    PR 13 ~ms/op GIL-bound residue the versioned route snapshot +
+    lock-scope shrink attack."""
+    import types
+
+    from akka_game_of_life_tpu.obs.catalog import install
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.obs.tracing import Tracer
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.serve.cluster import ClusterServePlane
+
+    member = types.SimpleNamespace(
+        name="echo", alive=True, draining=False,
+        peer_host="127.0.0.1", peer_port=0,
+    )
+    membership = types.SimpleNamespace(
+        get=lambda name: member if name == "echo" else None,
+        alive_members=lambda: [member],
+        placeable_members=lambda: [member],
+    )
+    plane_box: list = []
+
+    def send(m, frame):
+        if frame.get("type") != "serve_ops":
+            return
+        results = []
+        for op in frame["ops"]:
+            kind = op.get("op")
+            if kind == "create":
+                results.append({
+                    "rid": op["rid"], "ok": 1,
+                    "doc": {"id": op["sid"], "epoch": 0, "digest": None},
+                })
+            elif kind == "step":
+                results.append({
+                    "rid": op["rid"], "ok": 1, "epoch": 1, "digest": 0,
+                })
+            else:
+                results.append({"rid": op["rid"], "ok": 1})
+        plane_box[0].on_result("echo", {"results": results})
+
+    cfg = SimulationConfig(
+        role="serve", serve_cluster=True, max_epochs=None,
+        serve_replicate=False, flight_dir="",
+    )
+    plane = ClusterServePlane(
+        cfg, membership, send,
+        registry=install(MetricsRegistry()), tracer=Tracer(node="rt"),
+    )
+    plane_box.append(plane)
+    try:
+        sid = plane.create(height=64, width=64, with_board=False)["id"]
+        for _ in range(200):
+            plane.step(sid, 1)  # warmup
+        t0 = time.perf_counter()
+        for _ in range(n_ops):
+            plane.step(sid, 1)
+        wall = time.perf_counter() - t0
+    finally:
+        plane.close()
+    return {
+        "ops_per_sec": n_ops / wall,
+        "ms_per_op": wall / n_ops * 1e3,
+    }
+
+
+def bench_serve_tiled(
+    workers: int = 4,
+    side: int = 1024,
+    steps: int = 64,
+    requests: int = 4,
+    emit=print,
+) -> dict:
+    """``--tiled-steady-state``: the worker-resident tiled A/B.
+
+    Spins the SAME cluster twice — resident mode on, then the
+    ship-per-round baseline — over one over-class board, separating the
+    one-time install cost (the create) from the steady-state per-step
+    cost, and prices per-round traffic from the new
+    ``gol_serve_tiled_bytes_round`` histogram.  Both trajectories are
+    digest-certified against the dense oracle, so the speedup can never
+    come from computing a different board.  Also runs the frontend
+    routing micro-bench (sequential 1-step ops on one tiny batch
+    session → ms/op through the op plane, the PR 13 GIL-bound residue
+    the routing fast path attacks)."""
+    import jax.numpy as jnp
+
+    from akka_game_of_life_tpu.obs.catalog import install
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.obs.tracing import Tracer
+    from akka_game_of_life_tpu.ops import digest as odigest, stencil
+    from akka_game_of_life_tpu.ops.rules import resolve_rule
+    from akka_game_of_life_tpu.runtime.config import SimulationConfig
+    from akka_game_of_life_tpu.utils.patterns import random_grid
+
+    total_epochs = steps * (requests + 1)  # +1 warmup request
+    board0 = random_grid((side, side), density=0.5, seed=424)
+    oracle = np.asarray(
+        stencil.multi_step_fn(resolve_rule("conway"), total_epochs)(
+            jnp.asarray(board0)
+        )
+    )
+    want = odigest.format_digest(
+        odigest.value(odigest.digest_dense_np(oracle))
+    )
+    modes: dict = {}
+    route_ms = None
+    for resident in (True, False):
+        registry = install(MetricsRegistry())
+        tracer = Tracer(node="bench-serve-tiled")
+        cfg = SimulationConfig(
+            role="serve",
+            serve_cluster=True,
+            port=0,
+            max_epochs=None,
+            serve_max_cells=max(16_777_216, 2 * side * side),
+            serve_max_steps=max(1024, steps),
+            serve_tiled_resident=resident,
+            rebalance_interval_s=3600.0,  # steady state: no re-homing
+            flight_dir="",
+        )
+        fe, procs = _spin_cluster(cfg, workers, registry, tracer)
+        plane = fe.serve_plane
+        try:
+            t0 = time.perf_counter()
+            doc = plane.create(
+                rule="conway", height=side, width=side, seed=424,
+                with_board=False,
+            )
+            install_s = time.perf_counter() - t0
+            sid = doc["id"]
+            plane.step(sid, steps)  # warmup: workers pay the jit compiles
+            t0 = time.perf_counter()
+            for _ in range(requests):
+                epoch, digest = plane.step(sid, steps)
+            wall = time.perf_counter() - t0
+            assert epoch == total_epochs
+            got = odigest.format_digest(digest)
+            assert got == want, f"tiled digest {got} != oracle {want}"
+            snap = registry.snapshot()
+            hist = snap.get("gol_serve_tiled_bytes_round") or {}
+            rounds = hist.get("count") or 1
+            modes[resident] = {
+                "install_s": install_s,
+                "steady_s": wall,
+                "cell_updates_per_sec": side * side * steps * requests / wall,
+                "bytes_per_round": (hist.get("sum") or 0.0) / rounds,
+                "rounds": rounds,
+                "digest_certified": True,
+            }
+            if resident:
+                # Routing micro-bench on the live cluster: tiny batch
+                # session, sequential 1-step ops — pure op-plane latency.
+                rsid = plane.create(
+                    height=64, width=64, seed=1, with_board=False
+                )["id"]
+                plane.step(rsid, 1)  # warmup
+                n = 300
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    plane.step(rsid, 1)
+                route_ms = (time.perf_counter() - t0) / n * 1e3
+        finally:
+            fe.stop()
+            for p in procs:
+                try:
+                    p.wait(timeout=15)
+                except Exception:  # noqa: BLE001 — teardown must complete
+                    p.kill()
+    route = _route_plane_microbench()
+    res, ship = modes[True], modes[False]
+    record = {
+        "config": "serve-tiled-resident",
+        "metric": (
+            f"worker-resident tiled steady state, {workers} workers, "
+            f"{side}^2 board, {requests}x{steps}-step requests, vs the "
+            f"ship-per-round baseline"
+        ),
+        "value": res["cell_updates_per_sec"] / ship["cell_updates_per_sec"],
+        "unit": "x",
+        "workers": workers,
+        "side": side,
+        "steps_per_request": steps,
+        "resident": res,
+        "ship": ship,
+        "bytes_round_ratio": (
+            ship["bytes_per_round"] / max(1.0, res["bytes_per_round"])
+        ),
+        "route_ms_per_op": route_ms,
+        "route_plane": route,
+        "digest_certified": True,
+    }
+    emit(json.dumps(record))
+    return record
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     # None defaults resolve per mode: the single-process plane benches the
@@ -999,12 +1200,22 @@ def main() -> int:
         "scaling ratio vs 1 worker; omitted = the single-process plane",
     )
     parser.add_argument(
-        "--mega-side", type=int, default=384,
-        help="tiled (mega-board) drill side, above the largest size class",
+        "--mega-side", type=int, default=None,
+        help="tiled (mega-board) drill side, above the largest size "
+        "class (default: 384 in the --workers sweep, 1024 in "
+        "--tiled-steady-state)",
     )
     parser.add_argument(
         "--assert-scaling", action="store_true",
         help="fail unless the sweep meets the 1.5x@2 / 2.2x@4 gates",
+    )
+    parser.add_argument(
+        "--tiled-steady-state", action="store_true",
+        help="worker-resident tiled A/B: install cost vs steady-state "
+        "per-step cost on one over-class board, resident vs "
+        "ship-per-round, bytes/round from gol_serve_tiled_bytes_round, "
+        "both digest-certified (uses --workers' max, --mega-side, "
+        "--steps, --rounds)",
     )
     parser.add_argument(
         "--kill-worker-at", type=float, default=None, metavar="SECONDS",
@@ -1019,6 +1230,17 @@ def main() -> int:
     from akka_game_of_life_tpu.cli import _apply_platform
 
     _apply_platform(args.platform)
+    if args.tiled_steady_state:
+        n = max(
+            (int(v) for v in (args.workers or "4").split(",")), default=4
+        )
+        bench_serve_tiled(
+            workers=n,
+            side=args.mega_side or 1024,
+            steps=args.steps or 64,
+            requests=args.rounds or 4,
+        )
+        return 0
     if args.kill_worker_at is not None:
         n = max(
             (int(v) for v in (args.workers or "3").split(",")), default=3
@@ -1050,7 +1272,7 @@ def main() -> int:
                 tuple(int(v) for v in args.sizes.split(","))
                 if args.sizes else SHARD_SIZES
             ),
-            mega_side=args.mega_side,
+            mega_side=args.mega_side or 384,
             assert_scaling=args.assert_scaling,
         )
         return 0

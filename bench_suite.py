@@ -81,6 +81,25 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
                          and the separable-kernel banded GF(2) matmul
                          (MXU lane) functional A/B (docs/OPERATIONS.md
                          "Logarithmic fast-forward").
+ 17. serve-failover      session replication & crash failover
+                         (bench_serve.py --kill-worker-at): SIGKILL one
+                         worker of a 3-worker replicated serve cluster
+                         mid-traffic — zero 404s, zero boards lost, every
+                         promoted session digest-certified, promotion
+                         latency p50/p99 (docs/OPERATIONS.md "Session
+                         replication & failover").
+ 18. serve-tiled         worker-resident tiled sessions
+                         (bench_serve.py --tiled-steady-state): one
+                         over-class board on a 4-worker cluster, resident
+                         (peer halo strips, O(perimeter)/round) vs the
+                         ship-per-round baseline (full chunk state through
+                         the frontend, O(area)/round) — steady-state
+                         cell-updates/s with install cost separated,
+                         bytes/round from gol_serve_tiled_bytes_round,
+                         both trajectories digest-certified, plus the
+                         frontend route-plane ms/op micro-bench
+                         (docs/OPERATIONS.md "Tiled (mega-board)
+                         sessions").
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -1137,7 +1156,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", type=int, nargs="*",
-        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17],
+        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18],
     )
     parser.add_argument(
         "--scale", type=float, default=1.0,
@@ -1263,6 +1282,21 @@ def main() -> None:
             workers=3,
             sessions=max(12, int(32 * args.scale)),
             kill_at_s=2.0,
+        )
+    if 18 in args.config:
+        # Worker-resident tiled sessions: the steady-state A/B (resident
+        # peer-halo rounds vs ship-per-round through the frontend) on a
+        # 4-worker cluster, install cost separated, bytes/round priced,
+        # both digest-certified (docs/OPERATIONS.md "Tiled (mega-board)
+        # sessions").  Scale parameterizes the board side; the recorded
+        # headline (BENCH_r10) runs --mega-side 4096.
+        from bench_serve import bench_serve_tiled
+
+        bench_serve_tiled(
+            workers=4,
+            side=s(1024, 256),
+            steps=64,
+            requests=3,
         )
 
 

@@ -395,9 +395,14 @@ def test_mega_board_admitted_as_tiled_session_and_certifies():
 
 def test_mega_board_survives_worker_crash_mid_step():
     """Tile chunks are pure: a dead worker's chunk replays elsewhere and
-    the step still certifies — frontend-resident state loses nothing."""
+    the step still certifies — frontend-resident state loses nothing.
+    Pinned to ship mode (serve_tiled_resident off): this is the
+    ship-per-round contract specifically — the worker-resident default
+    instead rolls the session back to its certified snapshot (see
+    tests/test_serve_tiled_resident.py)."""
     with serve_cluster(2, serve_size_classes="16,32",
-                       serve_tile_chunk=2) as (
+                       serve_tile_chunk=2,
+                       serve_tiled_resident=False) as (
         fe, workers, threads, registry,
     ):
         plane = fe.serve_plane

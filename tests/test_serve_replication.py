@@ -650,3 +650,87 @@ def test_deleted_session_never_resurrects_at_promotion():
         live = {e["id"] for e in plane.list()}
         assert doomed not in live
         assert live == set(sids[1:])
+
+
+def test_tiled_resident_worker_kill_resumes_at_certified_epoch():
+    """The tiled×replication drill: SIGKILL a worker holding resident
+    mega-board chunks mid-traffic — promotion restores its chunks from
+    replica snapshots (digest-certified), survivors roll back to the same
+    barrier, the session resumes at its last certified epoch, and every
+    op in the window answers retryably (zero 404s)."""
+    with repl_cluster(
+        3, serve_size_classes="16,32", serve_tiled_resident_snapshot=1,
+    ) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        sid = plane.create(rule="conway", height=64, width=64, seed=13,
+                           with_board=False)["id"]
+        t = plane.tiled[sid]
+        assert len(set(t.owner.values())) == 3
+        epoch, _ = plane.step(sid, 3 * t.k)
+
+        def certified_to(e):
+            with plane._lock:
+                return t.certified() == e
+
+        _wait(lambda: certified_to(epoch),
+              msg="tiled snapshots never fully acked")
+        stop = threading.Event()
+        not_found: list = []
+        retried: list = []
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    plane.step(sid, t.k)
+                except KeyError as e:
+                    not_found.append(repr(e))  # the one forbidden answer
+                except AdmissionError as e:
+                    retried.append(e.reason)  # retryable: the contract
+                except Exception:  # noqa: BLE001 — timeouts are retryable
+                    retried.append("timeout")
+
+        pumps = [threading.Thread(target=pump, daemon=True) for _ in range(3)]
+        for th in pumps:
+            th.start()
+        time.sleep(0.15)
+        victim = workers[0]
+        victim.channel.close()  # SIGKILL-shaped: no drain, no goodbye
+        _wait(
+            lambda: fe._health()["serve"]["tiled_resident"][
+                "promotions_inflight"
+            ] == 0 and len(fe.membership.alive_members()) == 2
+            and not t.promoting,
+            msg="tiled promotion never completed",
+        )
+        time.sleep(0.3)
+        stop.set()
+        for th in pumps:
+            th.join(30)
+        # ZERO boards lost, ZERO 404s: the session is still listed and
+        # every windowed op answered retryably.
+        assert not not_found, not_found[:3]
+        assert sid in {e["id"] for e in plane.list()}
+        doc = plane.get(sid)
+        # Resumed at a certified barrier epoch and bit-exact there.
+        oracle = _oracle_board("conway", (64, 64), 13, doc["epoch"])
+        assert np.array_equal(doc["board"], oracle)
+        # ...and keeps serving from that state, still oracle-exact.
+        epoch2, digest2 = plane.step(sid, t.k)
+        oracle2 = _oracle_board("conway", (64, 64), 13, epoch2)
+        assert odigest.format_digest(digest2) == odigest.format_digest(
+            odigest.value(odigest.digest_dense_np(oracle2))
+        )
+        snap = registry.snapshot()
+        assert (snap.get("gol_serve_promotions_total") or 0) >= 1
+        assert (snap.get("gol_serve_sessions_lost_total") or 0) == 0
+
+
+def _oracle_board(rule: str, shape, seed: int, epochs: int):
+    board = random_grid(shape, density=0.5, seed=seed)
+    if epochs:
+        board = np.asarray(
+            stencil.multi_step_fn(resolve_rule(rule), epochs)(
+                jnp.asarray(board)
+            )
+        )
+    return board

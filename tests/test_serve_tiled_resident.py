@@ -1,0 +1,400 @@
+"""Worker-resident tiled sessions: halo-exchange correctness vs the dense
+oracle, O(perimeter) bytes/round, digest-certified chunk re-homing under
+drain, and the migration-vs-epoch-barrier torn-halo exclusion.
+
+Every cluster test runs a REAL in-process serve-only frontend plus
+BackendWorker threads speaking the actual wire protocol — peer halo
+strips travel over real sockets between the workers' peer listeners.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from akka_game_of_life_tpu.obs.catalog import install
+from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+from akka_game_of_life_tpu.obs.tracing import Tracer
+from akka_game_of_life_tpu.ops import digest as odigest, stencil
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.runtime.backend import BackendWorker
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.frontend import Frontend
+from akka_game_of_life_tpu.utils.patterns import random_grid
+
+
+def _oracle(rule: str, shape, seed: int, epochs: int) -> np.ndarray:
+    board = random_grid(shape, density=0.5, seed=seed)
+    if epochs:
+        board = np.asarray(
+            stencil.multi_step_fn(resolve_rule(rule), epochs)(
+                jnp.asarray(board)
+            )
+        )
+    return board
+
+
+def _digest_of(board: np.ndarray) -> str:
+    return odigest.format_digest(
+        odigest.value(odigest.digest_dense_np(board))
+    )
+
+
+@contextlib.contextmanager
+def tiled_cluster(n_workers: int, **cfg_kw):
+    cfg_kw.setdefault("serve_shards", 8)
+    cfg_kw.setdefault("serve_size_classes", "16,32")
+    cfg_kw.setdefault("rebalance_interval_s", 0.05)
+    cfg_kw.setdefault("serve_replicate_interval_s", 0.05)
+    cfg_kw.setdefault("serve_replicate_every", 1)
+    cfg_kw.setdefault("serve_tiled_resident_snapshot", 2)
+    cfg = SimulationConfig(
+        role="serve", serve_cluster=True, port=0, max_epochs=None,
+        flight_dir="", **cfg_kw,
+    )
+    registry = install(MetricsRegistry())
+    tracer = Tracer(node="test-tiled-resident")
+    fe = Frontend(cfg, min_backends=n_workers, registry=registry,
+                  tracer=tracer)
+    fe.start()
+    workers, threads = [], []
+    for i in range(n_workers):
+        w = BackendWorker(
+            "127.0.0.1", fe.port, name=f"w{i}", engine="numpy",
+            registry=registry, tracer=tracer,
+        )
+        w.crash_hook = w.stop
+        w.connect()
+        t = threading.Thread(target=w.run, daemon=True, name=f"w{i}")
+        t.start()
+        workers.append(w)
+        threads.append(t)
+    assert fe.wait_for_backends(timeout=10)
+    try:
+        yield fe, workers, threads, registry
+    finally:
+        fe.stop()
+        for w in workers:
+            w.stop()
+
+
+def _wait(cond, timeout=20.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(msg)
+
+
+# -- lint surface --------------------------------------------------------------
+
+
+def test_tiled_resident_lint_surface_clean():
+    """GL-CFG09 (--serve-tiled-resident* ↔ serve_tiled_resident*), the
+    serve knob-table bijection, and the protocol table (tiled_halo rows)
+    all hold two-way."""
+    from pathlib import Path
+
+    from tools.graftlint import bijection
+    from tools.graftlint.specs import (
+        PROTOCOL_MSGS,
+        SERVE_DOC,
+        SERVE_TILED_RESIDENT_CONFIG,
+    )
+
+    repo = Path(__file__).resolve().parent.parent
+    for spec in (SERVE_TILED_RESIDENT_CONFIG, SERVE_DOC, PROTOCOL_MSGS):
+        problems = [f.render() for f in bijection.problems(spec, repo)]
+        assert problems == [], problems
+
+
+def test_tiled_resident_config_validation():
+    with pytest.raises(ValueError, match="serve_tiled_resident_snapshot"):
+        SimulationConfig(serve_tiled_resident_snapshot=0)
+    with pytest.raises(
+        ValueError, match="serve_tiled_resident_halo_timeout_s"
+    ):
+        SimulationConfig(serve_tiled_resident_halo_timeout_s=0)
+
+
+# -- steady-state correctness --------------------------------------------------
+
+
+def test_resident_session_certifies_vs_oracle():
+    """The tentpole's exactness claim: a worker-resident mega-board —
+    chunks installed once, per-round traffic peer halo strips only — is
+    bit-identical to the dense oracle, across full and PARTIAL rounds
+    (steps that don't divide the halo width)."""
+    with tiled_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        doc = plane.create(rule="conway", height=80, width=80, seed=7,
+                           with_board=False)
+        sid = doc["id"]
+        assert doc["resident"] and doc["tiles"] == 9
+        t = plane.tiled[sid]
+        total = 0
+        for steps in (t.k, 2 * t.k, 3, 5):  # full rounds + ragged tails
+            epoch, digest = plane.step(sid, steps)
+            total += steps
+            assert epoch == total
+        oracle = _oracle("conway", (80, 80), 7, total)
+        assert odigest.format_digest(digest) == _digest_of(oracle)
+        # The render pull assembles the exact board from the workers.
+        got = plane.get(sid)
+        assert np.array_equal(got["board"], oracle)
+        assert got["population"] == int((oracle == 1).sum())
+        # Halo strips actually crossed the wire (2 workers share every
+        # session's chunk grid), and were acked (no give-ups needed).
+        snap = registry.snapshot()
+        assert (snap.get("gol_serve_tiled_halo_bytes_total") or 0) > 0
+
+
+def test_resident_bytes_per_round_perimeter_not_area():
+    """The economics claim: resident rounds move O(chunk perimeter)
+    bytes; the ship-per-round baseline moves O(area) through the
+    frontend.  Same board, same rounds, both digest-certified — the
+    per-round byte histogram must separate them by a wide margin."""
+    sums = {}
+    for resident in (True, False):
+        with tiled_cluster(2, serve_tiled_resident=resident) as (
+            fe, workers, threads, registry,
+        ):
+            plane = fe.serve_plane
+            doc = plane.create(rule="conway", height=64, width=64, seed=9,
+                               with_board=False)
+            sid = doc["id"]
+            t = plane.tiled[sid]
+            k = t.k if resident else plane.tile_chunk
+            epoch, digest = plane.step(sid, 4 * k)
+            oracle = _oracle("conway", (64, 64), 9, epoch)
+            assert odigest.format_digest(digest) == _digest_of(oracle)
+            snap = registry.snapshot()
+            hist = snap.get("gol_serve_tiled_bytes_round") or {}
+            count = hist.get("count") or 0
+            assert count >= 4
+            sums[resident] = hist.get("sum", 0.0) / count
+    # 64² board: area payload ≥ 2·(64·64)/8 B/round packed; perimeter
+    # strips are a small fraction.  3× is a deliberately loose floor —
+    # the bench measures the real ratio.
+    assert sums[True] < sums[False] / 3, sums
+
+
+def test_get_without_board_skips_worker_roundtrip():
+    """Steady-state GET answers from the frontend index — only
+    ?with_board=1 pays the O(area) fetch."""
+    with tiled_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        sid = plane.create(height=64, width=64, seed=1,
+                           with_board=False)["id"]
+        plane.step(sid, 4)
+        before = (registry.snapshot().get("gol_serve_ops_total") or 0)
+        listed = plane.list()
+        assert any(e["id"] == sid and e["epoch"] == 4 for e in listed)
+        after = (registry.snapshot().get("gol_serve_ops_total") or 0)
+        assert after == before  # list() is index-only
+
+
+# -- rebalancing ---------------------------------------------------------------
+
+
+def test_drain_rehomes_resident_chunks_digest_certified():
+    """A drain re-homes every resident chunk digest-certified with zero
+    lost epochs, under live traffic, and the drained worker is released
+    only once nothing resident points at it."""
+    with tiled_cluster(3) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        sid = plane.create(rule="conway", height=64, width=64, seed=5,
+                           with_board=False)["id"]
+        t = plane.tiled[sid]
+        assert "w0" in set(t.owner.values())  # round-robin over 3 workers
+        stop = threading.Event()
+        errors: list = []
+        epochs: list = [0]
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    epoch, _ = plane.step(sid, t.k)
+                    assert epoch > epochs[-1], "epoch regressed"
+                    epochs.append(epoch)
+                except Exception as e:  # noqa: BLE001 — recorded, asserted
+                    errors.append(repr(e))
+
+        th = threading.Thread(target=pump, daemon=True)
+        th.start()
+        time.sleep(0.2)
+        assert workers[0].request_drain()
+        _wait(
+            lambda: "w0" not in set(t.owner.values())
+            and len(fe.membership.alive_members()) == 2,
+            timeout=30, msg="drain never re-homed the resident chunks",
+        )
+        time.sleep(0.2)
+        stop.set()
+        th.join(30)
+        assert not errors, errors[:3]
+        doc = plane.get(sid)
+        oracle = _oracle("conway", (64, 64), 5, doc["epoch"])
+        assert np.array_equal(doc["board"], oracle), (
+            "torn state after drain re-homing"
+        )
+        snap = registry.snapshot()
+        assert (
+            snap.get("gol_serve_tiled_chunk_migrations_total") or 0
+        ) >= 2
+        assert (snap.get("gol_digest_mismatches_total") or 0) == 0
+
+
+def test_chunk_migration_racing_barrier_cannot_tear_halo():
+    """A chunk move holds the session's steplock across export → certify
+    → adopt, so it can never interleave with an epoch barrier: forced
+    migrations fired DURING sustained stepping commit between rounds and
+    the trajectory stays bit-exact."""
+    with tiled_cluster(2, serve_replicate=False) as (
+        fe, workers, threads, registry,
+    ):
+        plane = fe.serve_plane
+        sid = plane.create(rule="conway", height=64, width=64, seed=11,
+                           with_board=False)["id"]
+        t = plane.tiled[sid]
+        stop = threading.Event()
+        errors: list = []
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    plane.step(sid, t.k)
+                except Exception as e:  # noqa: BLE001 — recorded, asserted
+                    errors.append(repr(e))
+
+        th = threading.Thread(target=pump, daemon=True)
+        th.start()
+        moved = 0
+        for _ in range(6):
+            with plane._lock:
+                c, source = next(iter(sorted(t.owner.items())))
+                dest = next(
+                    m.name for m in fe.membership.alive_members()
+                    if m.name != source
+                )
+                mig = plane.tiled_rebalancer.begin(
+                    (sid, c), source, dest, time.monotonic()
+                )
+            plane._migrate_tiled_chunk((sid, c), source, dest, mig.seq)
+            with plane._lock:
+                if t.owner[c] == dest:
+                    moved += 1
+            time.sleep(0.05)
+        stop.set()
+        th.join(30)
+        assert not errors, errors[:3]
+        assert moved >= 4, f"only {moved} forced moves committed"
+        doc = plane.get(sid)
+        oracle = _oracle("conway", (64, 64), 11, doc["epoch"])
+        assert np.array_equal(doc["board"], oracle), "torn halo"
+        snap = registry.snapshot()
+        assert (snap.get("gol_digest_mismatches_total") or 0) == 0
+
+
+def test_resident_off_keeps_ship_mode():
+    """The gate: serve_tiled_resident off runs the PR 13 ship-per-round
+    path (frontend-resident board), still digest-certified."""
+    with tiled_cluster(2, serve_tiled_resident=False) as (
+        fe, workers, threads, registry,
+    ):
+        plane = fe.serve_plane
+        doc = plane.create(height=48, width=48, seed=2, with_board=False)
+        assert doc["resident"] is False
+        sid = doc["id"]
+        epoch, digest = plane.step(sid, 6)
+        oracle = _oracle("conway", (48, 48), 2, 6)
+        assert odigest.format_digest(digest) == _digest_of(oracle)
+        snap = registry.snapshot()
+        assert (snap.get("gol_serve_tiled_resident_chunks") or 0) == 0
+
+
+def test_delete_clears_standby_on_owner_replica_worker():
+    """A worker is routinely BOTH an owner and a replica of one session:
+    the single tiled_drop cleanup a delete sends it must also retire its
+    standby snapshot history (review finding: the standby dict leaked)."""
+    with tiled_cluster(2) as (fe, workers, threads, registry):
+        plane = fe.serve_plane
+        sid = plane.create(height=64, width=64, seed=21,
+                           with_board=False)["id"]
+        t = plane.tiled[sid]
+        plane.step(sid, 2 * t.k)
+        _wait(
+            lambda: any(
+                sid in w.serve_plane._tiled_standby for w in workers
+            ),
+            msg="no standby history ever replicated",
+        )
+        plane.delete(sid)
+        _wait(
+            lambda: all(
+                sid not in w.serve_plane._tiled_standby
+                and not any(k[0] == sid for k in w.serve_plane._resident)
+                for w in workers
+            ),
+            msg="delete left resident chunks or standby history behind",
+        )
+
+
+def test_resync_rolls_desynced_session_back_to_certified_epoch():
+    """The no-member-loss failure arm: a step request that dies without
+    a worker death (timeout, halo give-up) may leave worker epochs ahead
+    of the frontend — the resync path rolls the WHOLE session back to
+    its certified snapshot and serving resumes oracle-exact."""
+    with tiled_cluster(2, serve_tiled_resident_snapshot=1) as (
+        fe, workers, threads, registry,
+    ):
+        plane = fe.serve_plane
+        sid = plane.create(rule="conway", height=64, width=64, seed=23,
+                           with_board=False)["id"]
+        t = plane.tiled[sid]
+        epoch, _ = plane.step(sid, 2 * t.k)
+        _wait(
+            lambda: t.certified() == epoch,
+            msg="snapshots never fully acked",
+        )
+        # Desync deliberately: advance the workers one round the frontend
+        # never learns about (the shape a mid-request failure leaves).
+        with plane._lock:
+            owners_wire = plane._tiled_owner_wire_locked(t)
+            by_member = {}
+            for c, o in t.owner.items():
+                by_member.setdefault(o, []).append(list(c))
+        pends = [
+            plane._submit(
+                {"op": "tiled_step", "rid": 0, "sid": sid,
+                 "epoch": t.epoch, "ks": [t.k], "chunks": chunks,
+                 "owners": owners_wire, "digest": True,
+                 "snap_epochs": [], "floor": t.certified()},
+                sid=sid, kind="tile_ctl", member=m,
+            )
+            for m, chunks in sorted(by_member.items())
+        ]
+        for p in pends:
+            plane._await(p)
+        # Frontend still believes `epoch`; workers are at epoch + k.
+        with t.steplock:
+            plane._begin_tiled_resync(sid, t)
+        _wait(
+            lambda: not t.promoting and sid not in plane._tiled_promoting,
+            msg="resync never completed",
+        )
+        doc = plane.get(sid)
+        assert doc["epoch"] == epoch  # rolled back to the certified barrier
+        oracle = _oracle("conway", (64, 64), 23, epoch)
+        assert np.array_equal(doc["board"], oracle)
+        e2, digest2 = plane.step(sid, t.k)
+        oracle2 = _oracle("conway", (64, 64), 23, e2)
+        assert odigest.format_digest(digest2) == _digest_of(oracle2)

@@ -49,6 +49,8 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
      "OPERATIONS.md knob table"),
     ("GL-CFG08", "--serve-replicate* flags ↔ SimulationConfig "
      "serve_replicate* fields"),
+    ("GL-CFG09", "--serve-tiled-resident* flags ↔ SimulationConfig "
+     "serve_tiled_resident* fields"),
     ("GL-DOC01", "gol_* metric literals ↔ obs catalog ↔ OPERATIONS.md"),
     ("GL-DOC02", "span names ↔ SPAN_CATALOG ↔ OPERATIONS.md"),
     ("GL-DOC03", "protocol messages ↔ OPERATIONS.md table"),

@@ -96,6 +96,21 @@ SERVE_REPLICATE_CONFIG = FlagConfigSpec(
     bare_field="serve_replicate",
 )
 
+# The worker-resident tiled-session knob family mirrors GL-CFG08's
+# shape: one gate (``--serve-tiled-resident`` ↔ ``serve_tiled_resident``)
+# plus ``serve_tiled_resident_*`` tuning knobs, pinned as its own
+# bijection beside the blanket GL-CFG04 so the family cannot drift into
+# a spelling the generic strip would still accept.
+SERVE_TILED_RESIDENT_CONFIG = FlagConfigSpec(
+    name="serve_tiled_resident_config", pass_id="GL-CFG09",
+    flag_regex=r"""["'](--serve-tiled-resident(?:-[a-z0-9-]+)?)["']""",
+    config_class="SimulationConfig",
+    field_regex=r"^    (serve_tiled_resident\w*)\s*:",
+    flag_strip="--serve-tiled-resident",
+    field_prefix="serve_tiled_resident_",
+    bare_field="serve_tiled_resident",
+)
+
 SPARSE_CONFIG = FlagConfigSpec(
     name="sparse_config", pass_id="GL-CFG05",
     flag_regex=r"""["'](--sparse-[a-z0-9-]+)["']""",
@@ -276,6 +291,7 @@ GRAFTLINT_DOC = CatalogSpec(
 
 SPECS = (
     CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SERVE_DOC,
-    SERVE_REPLICATE_CONFIG, SPARSE_CONFIG, FF_CONFIG, FF_DOC,
-    KERNEL_CONFIG, METRICS_DOC, TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
+    SERVE_REPLICATE_CONFIG, SERVE_TILED_RESIDENT_CONFIG, SPARSE_CONFIG,
+    FF_CONFIG, FF_DOC, KERNEL_CONFIG, METRICS_DOC, TRACE_NAMES,
+    PROTOCOL_MSGS, GRAFTLINT_DOC,
 )
