@@ -498,6 +498,63 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
         help="replication lag bound: lag past this is surfaced loudly "
         "(event + /healthz lag_alert_shards; default 30s)",
     )
+    g.add_argument(
+        "--serve-trace",
+        choices=["on", "off"],
+        default=None,
+        help="per-request serve-plane tracing: mint/adopt a trace id per "
+        "HTTP request and propagate it through every serve frame it "
+        "causes, so /trace shows serve.request → worker serve.batch "
+        "(default on)",
+    )
+    g.add_argument(
+        "--serve-slo-log", default=None, metavar="PATH",
+        help="structured JSONL access log: one line per request with "
+        "trace id, tenant, route, sid, outcome, queue-wait, latency "
+        "(default off; /slo and RED metrics run regardless)",
+    )
+    g.add_argument(
+        "--serve-slo-availability", type=float, default=None, metavar="F",
+        help="availability objective the burn-rate tracker scores "
+        "against, in (0, 1) (default 0.999)",
+    )
+    g.add_argument(
+        "--serve-slo-latency-ms", type=float, default=None, metavar="MS",
+        help="latency objective: requests slower than this are SLO-bad "
+        "for the latency objective (default 250)",
+    )
+    g.add_argument(
+        "--serve-slo-fast-window-s", default=None, metavar="DUR",
+        help="fast burn-rate window (default 5m)",
+    )
+    g.add_argument(
+        "--serve-slo-slow-window-s", default=None, metavar="DUR",
+        help="slow burn-rate window; the alert fires only when BOTH "
+        "windows burn (default 1h)",
+    )
+    g.add_argument(
+        "--serve-slo-max-tenants", type=int, default=None, metavar="N",
+        help="per-tenant label-cardinality cap: beyond it the least-"
+        "recently-seen tenant's series are reclaimed and fold into "
+        "tenant=\"~overflow\" (default 64)",
+    )
+    g.add_argument(
+        "--serve-canary",
+        choices=["on", "off"],
+        default=None,
+        help="digest-certified canary prober: a background synthetic "
+        "tenant pins one known-orbit session per worker and steps it at "
+        "cadence through the real HTTP surface, certifying every answer "
+        "against a precomputed oracle (default off)",
+    )
+    g.add_argument(
+        "--serve-canary-interval-s", default=None, metavar="DUR",
+        help="canary probe cadence (default 2s)",
+    )
+    g.add_argument(
+        "--serve-canary-side", type=int, default=None, metavar="N",
+        help="canary board side, square (default 32)",
+    )
 
 
 def _serve_overrides(args: argparse.Namespace) -> dict:
@@ -542,6 +599,28 @@ def _serve_overrides(args: argparse.Namespace) -> dict:
             if args.serve_replicate_max_lag_s is not None
             else None
         ),
+        "serve_trace": on_off[args.serve_trace],
+        "serve_slo_log": args.serve_slo_log,
+        "serve_slo_availability": args.serve_slo_availability,
+        "serve_slo_latency_ms": args.serve_slo_latency_ms,
+        "serve_slo_fast_window_s": (
+            parse_duration(args.serve_slo_fast_window_s)
+            if args.serve_slo_fast_window_s is not None
+            else None
+        ),
+        "serve_slo_slow_window_s": (
+            parse_duration(args.serve_slo_slow_window_s)
+            if args.serve_slo_slow_window_s is not None
+            else None
+        ),
+        "serve_slo_max_tenants": args.serve_slo_max_tenants,
+        "serve_canary": on_off[args.serve_canary],
+        "serve_canary_interval_s": (
+            parse_duration(args.serve_canary_interval_s)
+            if args.serve_canary_interval_s is not None
+            else None
+        ),
+        "serve_canary_side": args.serve_canary_side,
     }
 
 

@@ -184,6 +184,52 @@ CATALOG = (
     ("gol_serve_sessions_lost_total", "counter",
      "Sessions lost to worker failure (no replica, never-acked, or a "
      "double failure) — each one is a tenant-visible 404", ()),
+    # -- per-tenant SLO plane (obs/slo.py, served at /slo) --------------------
+    ("gol_serve_slo_requests_total", "counter",
+     "HTTP requests against the serve surface, per tenant/route/outcome "
+     "(ok | rejected | client_error | error) — the SLO plane's R+E",
+     ("tenant", "route", "outcome")),
+    ("gol_serve_slo_latency_seconds", "histogram",
+     "End-to-end request latency per tenant (trace-id exemplars ride the "
+     "buckets: a p99 spike clicks through to a concrete trace via /slo)",
+     ("tenant",)),
+    ("gol_serve_slo_queue_wait_seconds", "histogram",
+     "Worker-side queue wait per step request (relayed to the edge; "
+     "latency minus this is compute + wire)", ()),
+    ("gol_serve_slo_burn_rate", "gauge",
+     "Error-budget burn rate per objective (availability | latency) and "
+     "window (fast | slow); 1.0 = burning exactly the budget",
+     ("objective", "window")),
+    ("gol_serve_slo_burn_alert", "gauge",
+     "1 while the multi-window burn alert is firing for an objective "
+     "(both windows past threshold), else 0", ("objective",)),
+    ("gol_serve_slo_alerts_total", "counter",
+     "Burn-alert firing edges per objective (transition-edged: one per "
+     "incident, not per scrape)", ("objective",)),
+    ("gol_serve_slo_tenants", "gauge",
+     "Tenants currently tracked by the SLO plane (LRU-bounded by "
+     "serve_slo_max_tenants; evictees fold into the ~overflow tenant)",
+     ()),
+    # -- digest-certified canary prober (serve/canary.py) ---------------------
+    ("gol_canary_probes_total", "counter",
+     "Canary probes by outcome (ok | mismatch | rejected | lost | error "
+     "| pin_failed) — the black-box availability numerator/denominator",
+     ("outcome",)),
+    ("gol_canary_failures_total", "counter",
+     "Canary probes that PAGED: digest mismatch against the numpy "
+     "oracle, or a wedged/errored worker (flight dump reason="
+     "canary_fail carries the failing trace)", ()),
+    ("gol_canary_latency_seconds", "histogram",
+     "Canary probe latency through the real HTTP surface (black-box; "
+     "compare with gol_serve_slo_latency_seconds{tenant=\"canary\"})",
+     ()),
+    ("gol_canary_staleness_seconds", "gauge",
+     "Seconds since the LEAST-recently-certified pinned session last "
+     "certified ok (grows past the cadence = a worker is wedged or the "
+     "surface is down)", ()),
+    ("gol_canary_sessions", "gauge",
+     "Canary sessions currently pinned (one per serving worker on the "
+     "cluster plane)", ()),
     # -- logarithmic fast-forward (ops/fastforward.py) ------------------------
     ("gol_ff_jumps_total", "counter",
      "Fast-forward jumps committed by Simulation.fast_forward", ()),

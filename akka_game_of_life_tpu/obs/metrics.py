@@ -125,7 +125,7 @@ class _GaugeChild(_Child):
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count", "exemplars")
 
     def __init__(self, buckets: Tuple[float, ...]) -> None:
         self._lock = threading.Lock()
@@ -133,13 +133,19 @@ class _HistogramChild:
         self.counts = [0] * (len(buckets) + 1)  # graftlint: guarded-by _lock
         self.sum = 0.0  # graftlint: guarded-by _lock
         self.count = 0  # graftlint: guarded-by _lock
+        # Last exemplar per bucket index: (value, labels dict) — the click-
+        # through from a latency bucket to a concrete trace.  Sparse: only
+        # observes that pass an exemplar populate it.
+        self.exemplars: Dict[int, Tuple[float, Dict[str, str]]] = {}  # graftlint: guarded-by _lock
 
     @property
     def touched(self) -> bool:
         # graftlint: waive GL-LOCK01 -- GIL-atomic read of a monotonic int used only as the exposition filter; a stale read under-reports one scrape and the next corrects it
         return self.count > 0
 
-    def observe(self, value: float) -> None:
+    def observe(
+        self, value: float, exemplar: Optional[Mapping[str, str]] = None
+    ) -> None:
         with self._lock:
             i = 0
             for i, le in enumerate(self.buckets):  # noqa: B007
@@ -150,6 +156,20 @@ class _HistogramChild:
             self.counts[i] += 1
             self.sum += value
             self.count += 1
+            if exemplar:
+                self.exemplars[i] = (value, dict(exemplar))
+
+    def exemplar_snapshot(self) -> list:
+        """Per-bucket exemplars as ``[{"le", "value", "labels"}]`` (newest
+        per bucket), keyed by the bucket's upper bound — what ``/slo``
+        serves so a p99 spike clicks through to its trace id."""
+        with self._lock:
+            items = sorted(self.exemplars.items())
+        bounds = list(self.buckets) + [math.inf]
+        return [
+            {"le": format_value(bounds[i]), "value": v, "labels": labels}
+            for i, (v, labels) in items
+        ]
 
     def snapshot(self) -> dict:
         """Cumulative bucket counts keyed by upper bound, plus sum/count."""
@@ -232,8 +252,10 @@ class _Instrument:
     def set(self, value: float) -> None:
         self._default().set(value)
 
-    def observe(self, value: float) -> None:
-        self._default().observe(value)
+    def observe(
+        self, value: float, exemplar: Optional[Mapping[str, str]] = None
+    ) -> None:
+        self._default().observe(value, exemplar)
 
     @property
     def value(self) -> float:

@@ -86,6 +86,15 @@ SPAN_CATALOG = (
     ("serve.promote", "one shard replica promoted to primary after a "
      "worker loss (digest-certified; sessions resume at their "
      "replicated epoch)"),
+    ("serve.request", "one HTTP request against the /boards surface, "
+     "minted (or adopted) at the edge — the root every serve-plane span "
+     "for that request links under"),
+    ("serve.batch", "one step job executed on a serving worker, op "
+     "arrival to result push (queue wait + its slice of the vmapped "
+     "batch), child of the serve.request that caused it"),
+    ("serve.canary", "one synthetic canary probe round: step the pinned "
+     "known-orbit session over real HTTP and digest-certify the answer "
+     "against the precomputed oracle trajectory"),
     # -- durability -----------------------------------------------------------
     ("checkpoint.save", "one checkpoint save made durable"),
     ("checkpoint.restore", "one checkpoint load"),

@@ -439,6 +439,47 @@ class SimulationConfig:
     # Replication lag past this bound is surfaced LOUDLY (event + the
     # /healthz lag_alert_shards field) — never silently unbounded.
     serve_replicate_max_lag_s: float = 30.0
+    # Serve-plane observability (docs/OPERATIONS.md "Serve observability &
+    # SLOs"): request tracing, per-tenant SLO accounting, canary probing.
+    # Every field maps to a --serve-X flag (graftlint GL-CFG10 enforces
+    # the bijection).  serve_trace: mint/adopt a trace id per HTTP request
+    # and ride it through every serve_ops/serve_result/shard_*/replicate/
+    # tiled_* frame the request causes, so /trace shows serve.request →
+    # worker serve.batch per round.  Off drops the per-request span mint
+    # AND the wire propagation (the engine-level serve.tick spans stay).
+    serve_trace: bool = True
+    # Structured JSONL access-log path ("" = no access log; the /slo
+    # summary and RED metrics run regardless).  One line per request:
+    # trace id, tenant, route, sid, outcome, queue-wait, latency.
+    serve_slo_log: str = ""
+    # Availability objective (good requests / all requests) the burn-rate
+    # tracker scores against, e.g. 0.999 = "three nines".
+    serve_slo_availability: float = 0.999
+    # Latency objective: a request slower than this is an SLO-bad request
+    # for the latency objective (availability counts only 5xx/timeouts;
+    # 429 backpressure is a correct answer, not a burn).
+    serve_slo_latency_ms: float = 250.0
+    # Multi-window burn-rate windows (fast catches a cliff, slow confirms
+    # a sustained burn; the alert fires only when BOTH windows burn past
+    # their thresholds — the standard multiwindow page discipline).
+    serve_slo_fast_window_s: float = 300.0
+    serve_slo_slow_window_s: float = 3600.0
+    # Per-tenant label-cardinality cap: beyond this many live tenants the
+    # least-recently-seen tenant's series are reclaimed (the PR 7
+    # remove() hygiene) and its traffic folds into tenant="~overflow".
+    serve_slo_max_tenants: int = 64
+    # Canary prober (serve/canary.py): a background synthetic tenant pins
+    # one small known-orbit session per worker (the sid= override aims
+    # the crc32 shard hash), steps it at cadence through the REAL HTTP
+    # surface, and digest-certifies each answer against a precomputed
+    # oracle trajectory — silent corruption or a wedged worker becomes a
+    # paged gol_canary_* signal within one cadence.
+    serve_canary: bool = False
+    # Probe cadence (each round steps every pinned canary session once).
+    serve_canary_interval_s: float = 2.0
+    # Canary board side (square); small on purpose — the probe prices the
+    # serving path, not device throughput.
+    serve_canary_side: int = 32
     # -- logarithmic fast-forward (docs/OPERATIONS.md "Logarithmic
     # fast-forward").  XOR-linear (odd-rule) boards jump T epochs in
     # O(log T) device programs (ops/fastforward.py); non-linear rules are
@@ -685,6 +726,41 @@ class SimulationConfig:
                 f"evict)"
             )
         parse_size_classes(self.serve_size_classes)
+        if not 0.0 < self.serve_slo_availability < 1.0:
+            raise ValueError(
+                f"serve_slo_availability={self.serve_slo_availability} "
+                f"must be in (0, 1)"
+            )
+        if self.serve_slo_latency_ms <= 0:
+            raise ValueError(
+                f"serve_slo_latency_ms={self.serve_slo_latency_ms} must "
+                f"be > 0"
+            )
+        if self.serve_slo_fast_window_s <= 0:
+            raise ValueError(
+                f"serve_slo_fast_window_s={self.serve_slo_fast_window_s} "
+                f"must be > 0"
+            )
+        if self.serve_slo_slow_window_s < self.serve_slo_fast_window_s:
+            raise ValueError(
+                f"serve_slo_slow_window_s={self.serve_slo_slow_window_s} "
+                f"must be >= serve_slo_fast_window_s="
+                f"{self.serve_slo_fast_window_s}"
+            )
+        if self.serve_slo_max_tenants < 1:
+            raise ValueError(
+                f"serve_slo_max_tenants={self.serve_slo_max_tenants} "
+                f"must be >= 1"
+            )
+        if self.serve_canary_interval_s <= 0:
+            raise ValueError(
+                f"serve_canary_interval_s={self.serve_canary_interval_s} "
+                f"must be > 0"
+            )
+        if self.serve_canary_side < 1:
+            raise ValueError(
+                f"serve_canary_side={self.serve_canary_side} must be >= 1"
+            )
         if self.ff_certify_steps < 0:
             raise ValueError(
                 f"ff_certify_steps={self.ff_certify_steps} must be >= 0 "
@@ -740,6 +816,9 @@ _DURATION_FIELDS = {
     "serve_replicate_interval_s",
     "serve_replicate_max_lag_s",
     "serve_tiled_resident_halo_timeout_s",
+    "serve_slo_fast_window_s",
+    "serve_slo_slow_window_s",
+    "serve_canary_interval_s",
     "breaker_cooldown_s",
     "send_deadline_s",
     "delay_s",

@@ -292,6 +292,7 @@ class Frontend:
         )
         self._m_drains = self.metrics.counter("gol_drains_total")
         self._metrics_server: Optional[MetricsServer] = None
+        self._serve_slo = None  # SloTracker when serve_cluster is on
         # Wire-fault policy (config/CLI --chaos-net-*): one seeded instance
         # per process; the in-process harness hands this same instance to
         # its workers so partition sides are consistent cluster-wide.
@@ -459,12 +460,22 @@ class Frontend:
         if self.config.metrics_port or self.serve_plane is not None:
             routes = None
             if self.serve_plane is not None:
+                from akka_game_of_life_tpu.obs import slo as slo_mod
                 from akka_game_of_life_tpu.serve.api import board_routes
 
                 # The tenant surface rides the obs endpoint, exactly like
                 # the single-process serve role (ephemeral port when no
                 # metrics_port was configured — printed by the role body).
-                routes = board_routes(self.serve_plane)
+                # The SLO tracker gets the frontend's event log so burn
+                # alerts land in the same stream as promotions.
+                self._serve_slo = slo_mod.SloTracker(
+                    self.config, registry=self.metrics, tracer=self.tracer,
+                    events=self.events, node="frontend",
+                )
+                routes = board_routes(
+                    self.serve_plane, tracer=self.tracer,
+                    slo=self._serve_slo,
+                )
             self._metrics_server = MetricsServer(
                 self.metrics,
                 port=self.config.metrics_port,
@@ -899,6 +910,9 @@ class Frontend:
         if self._metrics_server is not None:
             self._metrics_server.close()
             self._metrics_server = None
+        if self._serve_slo is not None:
+            self._serve_slo.close()
+            self._serve_slo = None
         with self._lock:
             err = self.error
         self.events.emit(

@@ -89,6 +89,16 @@ CRASH_TILE) may carry the sender's span context under
 children of the frontend epoch span that caused them.  The key is
 underscored — it can never collide with a payload field — and decoders
 that ignore it lose nothing but causality.
+
+The serve plane extends the same discipline to its protocol
+(``serve_trace``, on by default): each op inside a ``SERVE_OPS`` frame
+carries the ``serve.request`` ctx of the HTTP request that caused it
+(the frame itself carries the first traced op's ctx — one frame
+coalesces many requests), the worker opens its ``serve.batch`` span as
+that ctx's child and echoes the ctx on the matching ``serve_result``
+entry, and ``shard_*``/``replicate`` control frames join whatever span
+is active at enqueue time (a promotion, a migration) so failover
+machinery traces under the event that triggered it.
 """
 
 from __future__ import annotations

@@ -91,7 +91,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from akka_game_of_life_tpu.obs import get_registry
-from akka_game_of_life_tpu.obs.tracing import get_tracer
+from akka_game_of_life_tpu.obs import slo as _slo
+from akka_game_of_life_tpu.obs.tracing import TRACE_KEY, current, get_tracer
 from akka_game_of_life_tpu.ops import digest as odigest
 from akka_game_of_life_tpu.runtime import protocol as P
 from akka_game_of_life_tpu.runtime.rebalance import Rebalancer
@@ -412,6 +413,9 @@ class ClusterServePlane:
         self.tiled_rebalancer = Rebalancer(config)
         self.tiled_resident = bool(config.serve_tiled_resident)
         self.tiled_snap_rounds = int(config.serve_tiled_resident_snapshot)
+        # Request-trace propagation gate (one bool read on the hot path;
+        # the attach itself is a thread-local peek + dict store).
+        self._trace = bool(getattr(config, "serve_trace", True))
 
         self._lock = threading.RLock()
         # Flusher wake signal: an Event, not the Condition — the routing
@@ -453,9 +457,23 @@ class ClusterServePlane:
 
     # -- admission ------------------------------------------------------------
 
-    def _reject(self, reason: str, detail: str) -> None:
+    def _reject(self, reason: str, detail: str, link=None) -> None:
+        """Refuse one op.  ``link`` (a span or its ctx dict) ties the
+        refusal to the event that CAUSED it — a failover 429 carries the
+        ``serve.promote`` span's trace so the tenant's trace clicks
+        through to the promotion that bounced it."""
         self._m_rejects.labels(reason=reason).inc()
-        raise AdmissionError(reason, detail)
+        if link is not None and hasattr(link, "ctx"):
+            link = link.ctx
+        raise AdmissionError(reason, detail, trace_link=link)
+
+    def _tiled_link_locked(self, sid: str):
+        """The in-flight tiled promotion/resync span ctx for ``sid`` (or
+        None) — the link a tiled failover 429 carries (caller holds the
+        lock)."""
+        info = self._tiled_promoting.get(sid)
+        span = info.get("span") if info is not None else None
+        return span.ctx if span is not None else None
 
     def _admit_locked(self, height: int, width: int) -> None:
         """Cluster-wide admission — the budget the frontend owns (worker
@@ -493,13 +511,24 @@ class ClusterServePlane:
         seed: int = 0,
         density: float = 0.5,
         with_board: bool = True,
+        sid: Optional[str] = None,
     ) -> dict:
         tenant = str(tenant)
         rule_r = validate_create(tenant, rule, height, width, density)
+        if sid is not None:
+            # Caller-chosen id (the canary prober aims crc32 at a specific
+            # shard with it): same contract as the worker router — refuse
+            # collisions, never silently replace a tenant's board.
+            sid = str(sid)
+            if not sid or len(sid) > 128:
+                raise ValueError(f"session id {sid!r} must be 1-128 chars")
         tiled = sbatch.size_class(height, width, self.size_classes) is None
         with self._lock:
             self._admit_locked(height, width)
-            sid = f"s{next(self._ids):08x}"
+            if sid is None:
+                sid = f"s{next(self._ids):08x}"
+            elif sid in self.sessions:
+                raise ValueError(f"session id {sid!r} already exists")
             entry = _Entry(
                 sid, tenant, "tiled" if tiled else "batch",
                 rule_r.rulestring(), height, width, seed, density,
@@ -613,6 +642,7 @@ class ClusterServePlane:
                             "failover",
                             f"tiled session {sid} is mid-promotion after "
                             f"a worker loss; retry",
+                            link=self._tiled_link_locked(sid),
                         )
             with t.steplock:
                 return self._tiled_doc(sid, entry, t, with_board=True)
@@ -636,6 +666,38 @@ class ClusterServePlane:
                 )
                 for e in self.sessions.values()
             ]
+
+    def tenant_of(self, sid: str) -> Optional[str]:
+        """Tenant attribution for the SLO access log (None = unknown sid;
+        the edge falls back to the default tenant)."""
+        with self._lock:
+            e = self.sessions.get(sid)
+            return e.tenant if e is not None else None
+
+    def canary_targets(self) -> Dict[str, int]:
+        """worker name -> one shard it owns, covering every placeable
+        member — the canary prober pins one known-orbit session per worker
+        by mining a sid whose crc32 hash lands on that shard.  Members
+        owning nothing yet get a shard assigned (round-robin through the
+        unowned pool), so a fresh cluster is probe-covered immediately."""
+        with self._lock:
+            targets: Dict[str, int] = {}
+            for shard, owner in self.shard_owner.items():
+                if owner is not None and owner not in targets:
+                    targets[owner] = shard
+            members = [
+                m.name for m in self.membership.placeable_members()
+                if m.name not in targets
+            ]
+            free = [s for s, o in self.shard_owner.items() if o is None]
+            assigned = False
+            for name, shard in zip(sorted(members), free):
+                self.shard_owner[shard] = name
+                targets[name] = shard
+                assigned = True
+            if assigned:
+                self._rebuild_routes_locked()
+            return targets
 
     def delete(self, sid: str) -> None:
         with self._lock:
@@ -683,6 +745,11 @@ class ClusterServePlane:
             sid=sid, shard=entry.shard, kind="step",
         )
         self._await(p, grace=True)
+        qw = p.result.get("qw")
+        if qw is not None:
+            # Relay the worker-side queue wait to the HTTP edge (the SLO
+            # access log separates queueing from compute on this thread).
+            _slo.note_queue_wait(float(qw))
         epoch, digest = int(p.result["epoch"]), int(p.result["digest"])
         with self._lock:
             if self.sessions.get(sid) is entry and epoch >= entry.epoch:
@@ -711,6 +778,14 @@ class ClusterServePlane:
                 member=None, on_done=None) -> _Pending:
         rid = next(self._rids)  # itertools.count is GIL-atomic
         op["rid"] = rid
+        if self._trace:
+            # Stamp the caller's active span (the HTTP thread's
+            # serve.request) onto the op: the worker opens its serve.batch
+            # span as a CHILD of this ctx, so one trace spans processes.
+            # Cost with no active span: one thread-local read.
+            sp = current()
+            if sp is not None:
+                op[TRACE_KEY] = sp.ctx
         p = _Pending(rid, op, sid=sid, shard=shard, kind=kind,
                      member=member, on_done=on_done)
         if member is None and shard is not None:
@@ -754,6 +829,7 @@ class ClusterServePlane:
                 "failover",
                 f"shard {p.shard} is mid-promotion after a worker loss; "
                 f"the board resumes at its last replicated epoch — retry",
+                link=self._promoting[p.shard].get("span"),
             )
         if p.shard in self.rebalancer.inflight:
             self._held.setdefault(p.shard, []).append(p)
@@ -889,6 +965,13 @@ class ClusterServePlane:
         began reaches it before the freeze; an abort can never overtake
         its own prepare and leave sessions frozen forever; a ghost-cleanup
         drop can never overtake the adopt it compensates."""
+        if self._trace and TRACE_KEY not in msg:
+            # shard_*/replicate control frames join the active trace (a
+            # promotion's acks, a migration's prepare/commit) when one is
+            # open on this thread — ambient plumbing stays unlinked.
+            sp = current()
+            if sp is not None:
+                msg[TRACE_KEY] = sp.ctx
         p = _Pending(0, msg, kind="ctrl", member=member)
         self._outq.setdefault(member, deque()).append(p)
         self._wake.set()
@@ -925,10 +1008,20 @@ class ClusterServePlane:
                     if run:
                         self._m_frames.inc()
                         self._m_ops.inc(len(run))
-                        self._send_to(member, {
+                        frame = {
                             "type": P.SERVE_OPS,
                             "ops": [p.op for p in run],
-                        })
+                        }
+                        # The PR 2 wire discipline, serve edition: the
+                        # frame itself carries the FIRST traced op's ctx
+                        # (each op still carries its own — a coalesced
+                        # frame spans many requests).
+                        for p in run:
+                            ctx = p.op.get(TRACE_KEY)
+                            if ctx is not None:
+                                frame[TRACE_KEY] = dict(ctx)
+                                break
+                        self._send_to(member, frame)
                         run.clear()
 
                 for p in entries:
@@ -1061,11 +1154,15 @@ class ClusterServePlane:
                 self._pending.pop(p.rid, None)
                 if p.shard in promoting:
                     # The board provably resumes at its replicated epoch:
-                    # retryable, never an unknown-outcome shrug.
+                    # retryable, never an unknown-outcome shrug.  The 429
+                    # links to the promotion span that caused it.
+                    info = self._promoting.get(p.shard)
+                    span = info.get("span") if info is not None else None
                     resolutions.append((p, None, AdmissionError(
                         "failover",
                         f"serve worker {name} lost mid-op; the shard's "
                         f"replica is being promoted — retry",
+                        trace_link=span.ctx if span is not None else None,
                     )))
                 elif p.sent:
                     resolutions.append((p, None, TimeoutError(
@@ -1481,10 +1578,12 @@ class ClusterServePlane:
                 if lost and promotion is not None:
                     # Mid-promotion: the retryable contract, never a 404
                     # for a board that provably survives.
+                    pspan = promotion.get("span")
                     resolutions.append((p, AdmissionError(
                         "failover",
                         f"shard {mig.tile} is being promoted after its "
                         f"worker died mid-migration; retry",
+                        trace_link=pspan.ctx if pspan is not None else None,
                     )))
                 elif lost and p.kind != "create":
                     resolutions.append((p, KeyError(p.sid)))
@@ -2077,6 +2176,7 @@ class ClusterServePlane:
                     self._reject(
                         "failover",
                         f"tiled session {sid} is mid-promotion; retry",
+                        link=self._tiled_link_locked(sid),
                     )
                 owners_wire = self._tiled_owner_wire_locked(t)
                 floor = t.certified()
@@ -2119,11 +2219,13 @@ class ClusterServePlane:
             except BaseException as e:
                 with self._lock:
                     promoting = t.promoting
+                    link = self._tiled_link_locked(sid)
                 if promoting:
                     self._reject(
                         "failover",
                         f"tiled session {sid} lost a worker mid-step; "
                         f"it resumes at its last certified epoch — retry",
+                        link=link,
                     )
                 # A request that failed WITHOUT a worker loss (one op
                 # timing out on a slow worker, a halo batch exhausting
@@ -2134,11 +2236,14 @@ class ClusterServePlane:
                 # snapshot — the same consistent-rollback machinery a
                 # promotion uses, with no chunks to promote.
                 self._begin_tiled_resync(sid, t)
+                with self._lock:
+                    link = self._tiled_link_locked(sid)
                 self._reject(
                     "failover",
                     f"tiled session {sid} step failed mid-request "
                     f"({e!r}); the session resyncs to its last "
                     f"certified epoch — retry",
+                    link=link,
                 )
             request_bytes = sum(
                 int(r.get("halo_bytes", 0)) for r in results
@@ -2195,10 +2300,12 @@ class ClusterServePlane:
         except BaseException:
             with self._lock:
                 promoting = t.promoting
+                link = self._tiled_link_locked(sid)
             if promoting:
                 self._reject(
                     "failover",
                     f"tiled session {sid} is mid-promotion; retry",
+                    link=link,
                 )
             raise
         return board
@@ -2409,6 +2516,13 @@ class ClusterServePlane:
                 ),
             }
             self._tiled_promoting[sid] = info
+        # A resync means a request failed in a way that may have torn the
+        # session's epoch consensus — exactly the moment a post-mortem
+        # wants the ring buffers (the promotion path dumps separately;
+        # this reason marks the no-member-loss variant).
+        flight = getattr(self.tracer, "flight", None)
+        if flight is not None:
+            flight.dump("serve_resync", node="frontend")
         self._launch_tiled_promotion(
             (sid, t, C, {}, survivors, info), lost_member=""
         )
@@ -2852,6 +2966,7 @@ def run_serve_cluster(config, *, min_backends: int = 1) -> int:
     from akka_game_of_life_tpu.runtime.signals import mask_interrupts
 
     fe = Frontend(config, min_backends=min_backends)
+    canary = None
     fe.start()
     print(
         f"serve frontend listening on {config.host}:{fe.port} "
@@ -2870,16 +2985,29 @@ def run_serve_cluster(config, *, min_backends: int = 1) -> int:
             return 1
         port = fe._metrics_server.port if fe._metrics_server else None
         print(
-            f"cluster serving /boards (+/metrics,/healthz,/trace) on "
+            f"cluster serving /boards (+/metrics,/healthz,/trace,/slo) on "
             f":{port} — {fe.serve_plane.max_sessions} sessions / "
             f"{fe.serve_plane.max_cells} cells cluster-wide, "
             f"{len(fe.membership.alive_members())} worker(s)",
             flush=True,
         )
+        if config.serve_canary and port:
+            from akka_game_of_life_tpu.serve.canary import CanaryProber
+
+            # Probes the REAL tenant surface (loopback HTTP), pinned one
+            # session per worker via the plane's shard map.
+            canary = CanaryProber(
+                config, base=f"http://127.0.0.1:{port}",
+                registry=fe.metrics, tracer=fe.tracer, events=fe.events,
+                plane=fe.serve_plane,
+            )
+            canary.start()
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         print("serve: interrupted; draining", flush=True)
+        if canary is not None:
+            canary.close()
         drained = fe.serve_plane.drain()
         print(
             "serve: drained" if drained

@@ -89,9 +89,14 @@ class AdmissionError(Exception):
     retryable: the board provably resumes at its last replicated epoch,
     and the retry lands at the promoted replica)."""
 
-    def __init__(self, reason: str, detail: str) -> None:
+    def __init__(self, reason: str, detail: str, trace_link=None) -> None:
         super().__init__(detail)
         self.reason = reason
+        # Optional causal pointer: the trace ctx (trace_id/span_id dict) of
+        # the span that CAUSED this rejection — a failover 429 links to the
+        # serve.promote span it is waiting on, so the 429'd request's trace
+        # clicks through to the promotion.
+        self.trace_link = trace_link
 
 
 def shard_of(sid: str, n_shards: int) -> int:
@@ -185,6 +190,11 @@ class _Job:
     # thread per job).  Fired exactly once, after result/error is set and
     # ``done`` fires, never under the router lock.
     on_done: Optional[Callable[["_Job"], None]] = None
+    # Queue accounting for the SLO plane: enqueue time (monotonic) stamped
+    # at submit, queue wait stamped when the ticker takes the job for a
+    # batch — the "how long did admission hold this" half of latency.
+    t_enq: float = 0.0
+    queue_wait_s: float = 0.0
 
 
 class SessionRouter:
@@ -497,7 +507,10 @@ class SessionRouter:
                         f"step queue depth {self.queue_depth} reached",
                     )
                 sess.last_used = self._clock()
-                job = _Job(sid=sid, steps=steps, on_done=on_done)
+                job = _Job(
+                    sid=sid, steps=steps, on_done=on_done,
+                    t_enq=self._clock(),
+                )
                 self._queue.append(job)
                 self._m_queue.set(len(self._queue))
                 self._wake.notify_all()
@@ -557,7 +570,19 @@ class SessionRouter:
         if job.error is not None:
             raise job.error
         self._m_req.observe(time.perf_counter() - t0)
+        # Hand the measured queue wait up to the HTTP edge's SLO line
+        # (same thread: step() blocks the request thread on the job).
+        from akka_game_of_life_tpu.obs import slo as _slo
+
+        _slo.note_queue_wait(job.queue_wait_s if job.t_enq else None)
         return job.result
+
+    def tenant_of(self, sid: str) -> Optional[str]:
+        """The owning tenant, or None for an unknown id — the cheap
+        attribution lookup the SLO access log uses (never raises)."""
+        with self._lock:
+            sess = self._sessions.get(sid)
+            return sess.tenant if sess is not None else None
 
     def _finish(self, job: _Job) -> None:
         """Fire a job's completion — the done event, then the async
@@ -813,6 +838,7 @@ class SessionRouter:
         dead: List[_Job] = []
         rest: deque = deque()
         seen = set()
+        now = self._clock()
         while self._queue:
             job = self._queue.popleft()
             if job.sid not in self._sessions:
@@ -823,6 +849,8 @@ class SessionRouter:
                 rest.append(job)
                 continue
             seen.add(job.sid)
+            if job.t_enq:
+                job.queue_wait_s = max(0.0, now - job.t_enq)
             taken.append(job)
         self._queue = rest
         self._m_queue.set(len(self._queue))

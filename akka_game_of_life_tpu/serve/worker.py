@@ -61,7 +61,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from akka_game_of_life_tpu.obs import get_registry
-from akka_game_of_life_tpu.obs.tracing import get_tracer
+from akka_game_of_life_tpu.obs.tracing import TRACE_KEY, get_tracer
 from akka_game_of_life_tpu.ops import digest as odigest
 from akka_game_of_life_tpu.runtime import protocol as P
 from akka_game_of_life_tpu.runtime.wire import pack_tile, unpack_tile
@@ -90,6 +90,7 @@ SERVE_POLICY_KEYS = (
     "serve_tiled_resident",
     "serve_tiled_resident_snapshot",
     "serve_tiled_resident_halo_timeout_s",
+    "serve_trace",
     "ff_enabled",
     "ff_certify_steps",
 )
@@ -330,6 +331,10 @@ class ServeWorkerPlane:
             cfg, registry=self.metrics, tracer=self.tracer
         )
         self.n_shards = int(cfg.serve_shards)
+        # Per-request tracing (serve_trace through the WELCOME bundle):
+        # when an op carries frontend trace ctx, its execution becomes a
+        # serve.batch span under the originating serve.request.
+        self._trace = bool(getattr(cfg, "serve_trace", True))
         # shard → the sid set THIS worker froze at prepare (executor-thread
         # only, so unlocked): commit/abort without explicit sids act on it.
         self._shard_frozen: Dict[int, List[str]] = {}
@@ -458,6 +463,9 @@ class ServeWorkerPlane:
     def _run_op(self, op: dict) -> None:
         rid = int(op["rid"])
         kind = op.get("op")
+        ctx = op.get(TRACE_KEY)  # the originating serve.request's ctx
+        if not isinstance(ctx, dict):
+            ctx = None
         try:
             if kind == "create":
                 doc = self.router.create(
@@ -475,20 +483,59 @@ class ServeWorkerPlane:
                 # Async: the job's on_done callback pushes the result when
                 # its batch lands — the executor moves straight on to the
                 # next op, so every step of a frame rides the same tick.
-                self.router.submit(
-                    str(op["sid"]),
-                    int(op.get("steps", 1)),
-                    on_done=lambda job, rid=rid: self._push(
-                        _err_entry(rid, job.error)
-                        if job.error is not None
-                        else {
+                # With trace ctx riding the op, the whole execution (queue
+                # wait + its slice of the vmapped batch) is a serve.batch
+                # span under the originating serve.request, and the result
+                # entry echoes the ctx back across the serve_result frame.
+                span = None
+                if self._trace and ctx is not None:
+                    span = self.tracer.start(
+                        "serve.batch",
+                        parent=ctx,
+                        node=self.name or None,
+                        sid=str(op["sid"]),
+                        steps=int(op.get("steps", 1)),
+                    )
+
+                def _step_done(job, rid=rid, span=span, ctx=ctx):
+                    qw = job.queue_wait_s if job.t_enq else None
+                    if span is not None:
+                        span.set(
+                            outcome="error" if job.error is not None
+                            else "ok"
+                        )
+                        if qw is not None:
+                            span.set(queue_wait_s=round(qw, 6))
+                        span.finish()
+                    if job.error is not None:
+                        entry = _err_entry(rid, job.error)
+                    else:
+                        entry = {
                             "rid": rid,
                             "ok": 1,
                             "epoch": job.result[0],
                             "digest": job.result[1],
                         }
-                    ),
-                )
+                        if qw is not None:
+                            entry["qw"] = round(qw, 6)
+                    if ctx is not None:
+                        entry[TRACE_KEY] = ctx
+                    self._push(entry)
+
+                try:
+                    self.router.submit(
+                        str(op["sid"]),
+                        int(op.get("steps", 1)),
+                        on_done=_step_done,
+                    )
+                except BaseException:
+                    if span is not None:
+                        # Refused at admission: the job never existed, so
+                        # the callback will never fire — close the span
+                        # here and let the outer handler answer the op.
+                        span.set(outcome="rejected")
+                        span.finish()
+                    raise
             elif kind == "get":
                 self._push(
                     {"rid": rid, "ok": 1, "doc": self.router.get(str(op["sid"]))}

@@ -109,6 +109,32 @@ def _percentile(sorted_vals, q: float) -> float:
     return sorted_vals[i]
 
 
+
+def _obs_block(snap: dict, base: str) -> dict:
+    """The observability block every BENCH record embeds: the serve/canary
+    slice of ``registry.snapshot()`` plus the live ``/slo`` per-tenant
+    summary (availability, latency quantiles, burn alerts) — so a bench
+    artifact carries the same flight-deck view an operator would read."""
+    block = {
+        "metrics": {
+            k: v for k, v in sorted(snap.items())
+            if k.startswith(("gol_serve", "gol_canary"))
+        },
+    }
+    try:
+        status, doc = _request(base, "GET", "/slo", timeout=10)
+    except Exception:  # noqa: BLE001 — obs block must never fail a bench
+        status, doc = 0, {}
+    if status == 200:
+        block["slo"] = {
+            "objectives": doc.get("objectives"),
+            "burn": doc.get("burn"),
+            "alerting": doc.get("alerting"),
+            "tenants": doc.get("tenants"),
+        }
+    return block
+
+
 def bench_serve(
     sessions: int = 256,
     steps: int = 8,
@@ -354,9 +380,7 @@ def bench_serve(
         "rejected_step_429": 1,
         "digest_ok": True,
         "sampled": len(sampled),
-        "metrics": {
-            k: v for k, v in snap.items() if k.startswith("gol_serve")
-        },
+        **_obs_block(snap, base),
     }
     emit(json.dumps(record))
     server.close()
@@ -731,6 +755,7 @@ def bench_serve_sharded(
                     / max(1.0, snap.get("gol_serve_op_frames_total") or 1)
                 ),
                 **drill,
+                **_obs_block(snap, base),
             }
             if n == 1:
                 base_boards_per_sec = boards_per_sec
@@ -919,6 +944,15 @@ def bench_serve_failover(
         promotions = snap.get("gol_serve_promotions_total") or 0
         assert promotions >= 1, "the kill never promoted anything"
 
+        # The frontend's trace export must carry the promotion spans: the
+        # kill is only debuggable if /trace shows WHY sessions 429ed.
+        promote_spans = [
+            s for s in tracer.finished() if s["name"] == "serve.promote"
+        ]
+        assert promote_spans, (
+            "no serve.promote span in the frontend trace export"
+        )
+
         # Digest certification: EVERY session's served digest at its
         # reported epoch (promoted sessions report their replicated
         # resume point) equals the single-board oracle's.
@@ -961,6 +995,10 @@ def bench_serve_failover(
                 "gol_serve_single_copy_shards"
             ),
             "replica_bytes": snap.get("gol_serve_replica_bytes_total"),
+            "promote_traces": sorted(
+                {s["trace_id"] for s in promote_spans}
+            ),
+            **_obs_block(snap, base),
         }
         emit(json.dumps(record))
         return record

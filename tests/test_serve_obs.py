@@ -1,0 +1,428 @@
+"""Serve-plane flight deck: end-to-end request tracing, the per-tenant
+SLO plane, and the digest-certified canary prober.
+
+The cluster tests run the REAL in-process serve-only stack (frontend +
+BackendWorker threads on the actual wire protocol, HTTP through the
+mounted route table) with ONE shared tracer, so `tracer.finished()` is
+the cluster-wide trace export the assertions read — the same document
+`/trace` serves.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from akka_game_of_life_tpu.obs.catalog import install
+from akka_game_of_life_tpu.obs.events import EventLog
+from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+from akka_game_of_life_tpu.obs.slo import (
+    BURN_THRESHOLD,
+    SloTracker,
+    fold_report,
+    read_access_log,
+)
+from akka_game_of_life_tpu.obs.tracing import Tracer
+from akka_game_of_life_tpu.runtime.backend import BackendWorker
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.frontend import Frontend
+from akka_game_of_life_tpu.serve.canary import CanaryProber
+
+
+def _http(base, method, path, doc=None, timeout=20):
+    data = json.dumps(doc).encode("utf-8") if doc is not None else None
+    req = urllib.request.Request(base + path, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@contextlib.contextmanager
+def obs_cluster(n_workers: int, **cfg_kw):
+    """In-process serve cluster with the obs endpoint mounted (HTTP on a
+    real socket) and one shared tracer across frontend + workers."""
+    cfg_kw.setdefault("serve_shards", 16)
+    cfg_kw.setdefault("rebalance_interval_s", 0.05)
+    cfg_kw.setdefault("flight_dir", "")
+    cfg = SimulationConfig(
+        role="serve", serve_cluster=True, port=0, max_epochs=None, **cfg_kw,
+    )
+    registry = install(MetricsRegistry())
+    tracer = Tracer(node="test-serve-obs")
+    fe = Frontend(cfg, min_backends=n_workers, registry=registry,
+                  tracer=tracer)
+    fe.start()
+    workers = []
+    for i in range(n_workers):
+        w = BackendWorker(
+            "127.0.0.1", fe.port, name=f"w{i}", engine="numpy",
+            registry=registry, tracer=tracer,
+        )
+        w.crash_hook = w.stop
+        w.connect()
+        threading.Thread(target=w.run, daemon=True, name=f"w{i}").start()
+        workers.append(w)
+    assert fe.wait_for_backends(timeout=10)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        by = fe._health()["serve"]["shards_by_worker"]
+        if len(by) == n_workers:
+            break
+        time.sleep(0.02)
+    try:
+        yield fe, workers, registry, tracer
+    finally:
+        fe.stop()
+        for w in workers:
+            w.stop()
+
+
+def _wait(cond, timeout=20.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(msg)
+
+
+def _spans(tracer, name):
+    return [s for s in tracer.finished() if s["name"] == name]
+
+
+# -- tentpole: end-to-end request tracing --------------------------------------
+
+
+def test_http_step_trace_reaches_worker_batch():
+    """The headline continuity: one HTTP step request's trace id appears
+    on the edge `serve.request` span AND on the owning worker's
+    `serve.batch` span — across the serve wire protocol — with the batch
+    span a descendant of the request span."""
+    with obs_cluster(2) as (fe, workers, registry, tracer):
+        base = f"http://127.0.0.1:{fe._metrics_server.port}"
+        status, doc = _http(
+            base, "POST", "/boards", {"height": 16, "width": 16, "seed": 1},
+        )
+        assert status == 201, (status, doc)
+        sid = doc["id"]
+        status, doc = _http(base, "POST", f"/boards/{sid}/step", {"steps": 2})
+        assert status == 200, (status, doc)
+
+        def step_traced():
+            reqs = [
+                s for s in _spans(tracer, "serve.request")
+                if s["attrs"].get("route") == "step"
+            ]
+            return reqs and _spans(tracer, "serve.batch")
+
+        _wait(step_traced, msg="request/batch spans never landed")
+        req = next(
+            s for s in _spans(tracer, "serve.request")
+            if s["attrs"].get("route") == "step"
+        )
+        assert req["attrs"]["sid"] == sid
+        assert req["attrs"]["outcome"] == "ok"
+        batch = [
+            s for s in _spans(tracer, "serve.batch")
+            if s["attrs"].get("sid") == sid
+        ]
+        assert batch, "no serve.batch span for the stepped session"
+        for s in batch:
+            # Same trace, worker-side node label, request-rooted ancestry.
+            assert s["trace_id"] == req["trace_id"]
+            assert s["parent_id"] == req["span_id"]
+            assert s["node"] in {w.name for w in workers}
+            assert s["attrs"]["outcome"] == "ok"
+        # The create traced too (its own trace — a different request).
+        creates = [
+            s for s in _spans(tracer, "serve.request")
+            if s["attrs"].get("route") == "create"
+        ]
+        assert creates and creates[0]["trace_id"] != req["trace_id"]
+
+
+def test_client_adopted_trace_rides_to_the_worker():
+    """A client-minted ctx under the `_trace` body key becomes the
+    request's trace id, end to end — the canary's linkage mechanism."""
+    with obs_cluster(1) as (fe, workers, registry, tracer):
+        base = f"http://127.0.0.1:{fe._metrics_server.port}"
+        status, doc = _http(
+            base, "POST", "/boards", {"height": 16, "width": 16, "seed": 2},
+        )
+        assert status == 201
+        sid = doc["id"]
+        mine = tracer.start("serve.canary", node="test")
+        status, _ = _http(
+            base, "POST", f"/boards/{sid}/step",
+            {"steps": 1, "_trace": mine.ctx},
+        )
+        assert status == 200
+        mine.finish()
+        _wait(
+            lambda: any(
+                s["trace_id"] == mine.trace_id
+                for s in _spans(tracer, "serve.batch")
+            ),
+            msg="adopted trace never reached the worker batch span",
+        )
+        req = [
+            s for s in _spans(tracer, "serve.request")
+            if s["trace_id"] == mine.trace_id
+        ]
+        assert req and req[0]["parent_id"] == mine.span_id
+
+
+def test_failover_429_trace_links_to_promote_span():
+    """The failure-path linkage: a 429 `failover` body carries both the
+    refused request's `trace_id` and the `trace_link` of the
+    `serve.promote` span that caused it — held open deterministically by
+    freezing the replica's executor mid-promotion."""
+    with obs_cluster(
+        2, serve_replicate_every=1, serve_replicate_interval_s=0.05,
+    ) as (fe, workers, registry, tracer):
+        base = f"http://127.0.0.1:{fe._metrics_server.port}"
+        plane = fe.serve_plane
+        sids = [
+            plane.create(height=16, width=16, seed=i, with_board=False)["id"]
+            for i in range(8)
+        ]
+        for sid in sids:
+            plane.step(sid, 2)
+
+        def replicated():
+            with plane._lock:
+                return all(
+                    e.repl_dirty_since is None
+                    for e in plane.sessions.values()
+                    if e.shard is not None
+                ) and any(
+                    r is not None for r in plane.shard_replica.values()
+                )
+
+        _wait(replicated, msg="replication never caught up")
+        with plane._lock:
+            sid, entry = next(
+                (s, e) for s, e in plane.sessions.items()
+                if plane.shard_replica.get(e.shard) is not None
+            )
+            shard = entry.shard
+            primary = plane.shard_owner[shard]
+            replica = plane.shard_replica[shard]
+        pw = next(w for w in workers if w.name == primary)
+        rw = next(w for w in workers if w.name == replica)
+        rw.serve_plane._lock.acquire()  # promotion cannot complete
+        try:
+            pw.channel.close()  # abrupt primary death
+            _wait(lambda: shard in plane._promoting,
+                  msg="promotion never started")
+            with plane._lock:
+                pspan = plane._promoting[shard]["span"]
+            status, body = _http(base, "GET", f"/boards/{sid}")
+            assert status == 429 and body["reason"] == "failover", body
+            assert "trace_id" in body  # the refused request's own trace
+            link = body["trace_link"]
+            assert link["trace_id"] == pspan.trace_id
+            assert link["span_id"] == pspan.span_id
+        finally:
+            rw.serve_plane._lock.release()
+        _wait(lambda: shard not in plane._promoting,
+              msg="promotion never finished")
+        promotes = _spans(tracer, "serve.promote")
+        assert any(s["trace_id"] == pspan.trace_id for s in promotes)
+
+
+# -- per-tenant SLO plane ------------------------------------------------------
+
+
+def test_slo_endpoint_access_log_and_report(tmp_path):
+    """/slo scores per tenant with exemplars; the JSONL access log folds
+    into the same availability table via tools/slo_report.py."""
+    log = tmp_path / "access.log"
+    with obs_cluster(1, serve_slo_log=str(log)) as (
+        fe, workers, registry, tracer,
+    ):
+        base = f"http://127.0.0.1:{fe._metrics_server.port}"
+        status, doc = _http(
+            base, "POST", "/boards",
+            {"tenant": "acme", "height": 16, "width": 16, "seed": 3},
+        )
+        assert status == 201
+        sid = doc["id"]
+        for _ in range(3):
+            status, _ = _http(base, "POST", f"/boards/{sid}/step", {})
+            assert status == 200
+        status, _ = _http(base, "GET", "/boards/nope")
+        assert status == 404
+        status, doc = _http(base, "GET", "/slo")
+        assert status == 200
+        assert doc["objectives"]["burn_threshold"] == BURN_THRESHOLD
+        acme = doc["tenants"]["acme"]
+        assert acme["requests"] >= 4 and acme["availability"] == 1.0
+        # Latency exemplars carry trace ids for the click-through.
+        assert any(
+            (e.get("labels") or {}).get("trace_id")
+            for e in acme["exemplars"]
+        )
+        # RED metrics landed with tenant labels.
+        assert registry.value(
+            "gol_serve_slo_requests_total",
+            tenant="acme", route="step", outcome="ok",
+        ) == 3
+    records = read_access_log(str(log))
+    assert len(records) >= 5
+    step = next(r for r in records if r["route"] == "step")
+    assert step["tenant"] == "acme" and step["outcome"] == "ok"
+    assert step["trace"] and step["sid"] == sid
+    folded = fold_report(records)
+    assert folded["acme"]["ok"] >= 4 and folded["acme"]["errors"] == 0
+    # The CLI wrapper renders the same fold (tier-1 smoke).
+    import tools.slo_report as slo_report
+
+    assert slo_report.main([str(log)]) == 0
+    assert slo_report.main([str(log), "--json"]) == 0
+    assert slo_report.main([str(tmp_path / "missing.log")]) == 2
+
+
+def test_slo_burn_alert_fires_on_injected_latency(tmp_path):
+    """Multi-window burn: sustained over-objective latency fires exactly
+    one transition-edged alert (event + gauge + flight dump), and
+    recovery resolves it — driven on an injected clock."""
+    now = [1000.0]
+    flight_dir = tmp_path / "flight"
+    tracer = Tracer(node="slo-test")
+    tracer.flight.configure(directory=str(flight_dir), node="slo-test")
+    events: list = []
+    log = EventLog(None, node="slo-test")
+    log.emit = lambda event, **f: events.append((event, f))
+    registry = install(MetricsRegistry())
+    cfg = SimulationConfig(
+        role="serve", serve_slo_fast_window_s=5.0, serve_slo_slow_window_s=20.0,
+        flight_dir=str(flight_dir),
+    )
+    slo = SloTracker(
+        cfg, registry=registry, tracer=tracer, events=log,
+        clock=lambda: now[0],
+    )
+    # Sustained slow-but-ok traffic across both windows: every request
+    # over the 250ms objective burns the latency budget at rate 1000.
+    for _ in range(25):
+        slo.record(route="step", tenant="t", latency_s=0.9, trace_id="abc")
+        now[0] += 1.0
+    fired = [f for e, f in events if e == "slo_burn_alert"
+             and f["state"] == "firing"]
+    assert [f["objective"] for f in fired] == ["latency"]
+    assert fired[0]["burn_fast"] > BURN_THRESHOLD
+    assert fired[0]["trace"] == "abc"
+    assert registry.value(
+        "gol_serve_slo_burn_alert", objective="latency"
+    ) == 1
+    assert registry.value(
+        "gol_serve_slo_alerts_total", objective="latency"
+    ) == 1
+    # The alert carried a flight dump for the post-mortem.
+    dumps = list(flight_dir.glob("flightrec-*.json"))
+    assert dumps and any(
+        json.loads(p.read_text())["reason"] == "slo_burn" for p in dumps
+    )
+    # Availability stayed quiet: slow-but-ok spends no availability budget.
+    assert registry.value(
+        "gol_serve_slo_burn_alert", objective="availability"
+    ) in (0, None)
+    # Recovery: fast traffic drains both windows; the edge resolves once.
+    for _ in range(30):
+        slo.record(route="step", tenant="t", latency_s=0.001)
+        now[0] += 1.0
+    resolved = [f for e, f in events if e == "slo_burn_alert"
+                and f["state"] == "resolved"]
+    assert [f["objective"] for f in resolved] == ["latency"]
+    assert registry.value(
+        "gol_serve_slo_burn_alert", objective="latency"
+    ) == 0
+    slo.close()
+
+
+# -- canary prober -------------------------------------------------------------
+
+
+def test_canary_certifies_then_pages_on_injected_corruption(tmp_path):
+    """The sabotage drill: healthy probes certify every worker's answer;
+    one worker-side board corrupted behind the digest pipeline turns the
+    NEXT probe into a paged mismatch — failures counter, canary_fail
+    event, flight dump — and the pin re-seeds."""
+    flight_dir = tmp_path / "flight"
+    with obs_cluster(2, flight_dir=str(flight_dir)) as (
+        fe, workers, registry, tracer,
+    ):
+        base = f"http://127.0.0.1:{fe._metrics_server.port}"
+        cfg = SimulationConfig(role="serve", serve_canary=True)
+        canary = CanaryProber(
+            cfg, base=base, registry=registry, tracer=tracer,
+            events=fe.events, plane=fe.serve_plane,
+        )
+        outcomes = canary.probe_now()  # pins one session per worker
+        assert set(outcomes.values()) == {"ok"}
+        assert set(outcomes) == {w.name for w in workers}
+        assert registry.value("gol_canary_sessions") == 2
+        outcomes = canary.probe_now()
+        assert set(outcomes.values()) == {"ok"}
+        assert (registry.value("gol_canary_failures_total") or 0) == 0
+
+        # Sabotage: flip cells in one worker's resident canary board.
+        # The worker will keep serving confidently-wrong digests — only
+        # the black-box oracle can notice.
+        victim = workers[0]
+        pin = next(p for p in canary._pins.values()
+                   if p.worker == victim.name)
+        router = victim.serve_plane.router
+        with router._lock:
+            router._sessions[pin.sid].board[:4, :4] ^= 1
+        outcomes = canary.probe_now()
+        assert outcomes[victim.name] == "mismatch", outcomes
+        assert outcomes[workers[1].name] == "ok"
+        assert registry.value("gol_canary_failures_total") == 1
+        dumps = [
+            p for p in flight_dir.glob("flightrec-*.json")
+            if json.loads(p.read_text())["reason"] == "canary_fail"
+        ]
+        assert dumps, "corruption never dumped the flight recorder"
+        # The failing probe's serve.canary span carries the verdict.
+        bad = [
+            s for s in _spans(tracer, "serve.canary")
+            if s["attrs"].get("outcome") == "mismatch"
+        ]
+        assert bad and bad[0]["attrs"]["worker"] == victim.name
+        # Next round re-pins the victim and goes green again.
+        outcomes = canary.probe_now()
+        assert set(outcomes.values()) == {"ok"}, outcomes
+        canary.close()
+
+
+def test_canary_survives_honest_loss_as_repin_not_failure():
+    """A 404 (session dropped out from under the canary) re-pins without
+    counting corruption — loss is the serve plane's own loud signal."""
+    with obs_cluster(1) as (fe, workers, registry, tracer):
+        base = f"http://127.0.0.1:{fe._metrics_server.port}"
+        cfg = SimulationConfig(role="serve", serve_canary=True)
+        canary = CanaryProber(
+            cfg, base=base, registry=registry, tracer=tracer,
+            plane=fe.serve_plane,
+        )
+        assert set(canary.probe_now().values()) == {"ok"}
+        pin = next(iter(canary._pins.values()))
+        fe.serve_plane.delete(pin.sid)
+        outcomes = canary.probe_now()
+        assert outcomes[pin.worker] == "lost"
+        assert (registry.value("gol_canary_failures_total") or 0) == 0
+        assert set(canary.probe_now().values()) == {"ok"}
+        canary.close()

@@ -51,6 +51,8 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
      "serve_replicate* fields"),
     ("GL-CFG09", "--serve-tiled-resident* flags ↔ SimulationConfig "
      "serve_tiled_resident* fields"),
+    ("GL-CFG10", "--serve-trace/--serve-slo-*/--serve-canary* flags ↔ "
+     "SimulationConfig observability fields"),
     ("GL-DOC01", "gol_* metric literals ↔ obs catalog ↔ OPERATIONS.md"),
     ("GL-DOC02", "span names ↔ SPAN_CATALOG ↔ OPERATIONS.md"),
     ("GL-DOC03", "protocol messages ↔ OPERATIONS.md table"),

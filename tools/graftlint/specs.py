@@ -111,6 +111,21 @@ SERVE_TILED_RESIDENT_CONFIG = FlagConfigSpec(
     bare_field="serve_tiled_resident",
 )
 
+# The serve-observability knob family (request tracing gate, per-tenant
+# SLO plane, canary prober) pinned as its own bijection beside the
+# blanket GL-CFG04, mirroring GL-CFG08/09: the family's shape — the
+# ``--serve-trace`` gate, ``--serve-slo-*`` objectives/windows, and the
+# ``--serve-canary`` gate plus its tuning knobs — cannot drift into a
+# spelling the generic strip would still accept.
+SERVE_OBS_CONFIG = FlagConfigSpec(
+    name="serve_obs_config", pass_id="GL-CFG10",
+    flag_regex=r"""["'](--serve-(?:trace|slo-[a-z0-9-]+"""
+    r"""|canary(?:-[a-z0-9-]+)?))["']""",
+    config_class="SimulationConfig",
+    field_regex=r"^    (serve_(?:trace|slo_\w+|canary\w*))\s*:",
+    flag_strip="--serve", field_prefix="serve_",
+)
+
 SPARSE_CONFIG = FlagConfigSpec(
     name="sparse_config", pass_id="GL-CFG05",
     flag_regex=r"""["'](--sparse-[a-z0-9-]+)["']""",
@@ -291,7 +306,7 @@ GRAFTLINT_DOC = CatalogSpec(
 
 SPECS = (
     CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SERVE_DOC,
-    SERVE_REPLICATE_CONFIG, SERVE_TILED_RESIDENT_CONFIG, SPARSE_CONFIG,
-    FF_CONFIG, FF_DOC, KERNEL_CONFIG, METRICS_DOC, TRACE_NAMES,
-    PROTOCOL_MSGS, GRAFTLINT_DOC,
+    SERVE_REPLICATE_CONFIG, SERVE_TILED_RESIDENT_CONFIG, SERVE_OBS_CONFIG,
+    SPARSE_CONFIG, FF_CONFIG, FF_DOC, KERNEL_CONFIG, METRICS_DOC,
+    TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
 )

@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Fold a serve-plane SLO access log into a per-tenant report.
+
+The serve surface (``--serve-slo-log PATH``) writes one JSON line per
+HTTP request — trace id, tenant, route, sid, outcome, queue wait,
+latency (docs/OPERATIONS.md "Serve observability & SLOs").  This tool
+turns that log into the table an incident review starts from::
+
+    python tools/slo_report.py artifacts/serve-access.log
+    python tools/slo_report.py artifacts/serve-access.log --json
+
+Folding lives in :func:`akka_game_of_life_tpu.obs.slo.fold_report` (the
+same engine ``/slo`` quotes), so the offline report can never disagree
+with the live endpoint about what "availability" means: ok / (ok +
+errors) — rejected (429) spends no error budget, it is the admission
+contract working.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from akka_game_of_life_tpu.obs.slo import (  # noqa: E402
+    fold_report,
+    read_access_log,
+)
+
+_COLS = (
+    ("tenant", "{}"), ("requests", "{}"), ("ok", "{}"), ("errors", "{}"),
+    ("rejected", "{}"), ("availability", "{:.5f}"), ("p50_s", "{:.4f}"),
+    ("p99_s", "{:.4f}"),
+)
+
+
+def render_table(table: dict) -> str:
+    rows = [[
+        head.format(tenant) if i == 0 else head.format(stats[key])
+        for i, (key, head) in enumerate(_COLS)
+    ] for tenant, stats in sorted(table.items())]
+    header = [key for key, _ in _COLS]
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows
+        else len(header[i])
+        for i in range(len(header))
+    ]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(header, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="slo_report",
+        description="Fold a serve SLO access log into a per-tenant table",
+    )
+    ap.add_argument("log", help="JSONL access log (--serve-slo-log output)")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the folded table as JSON instead of aligned text",
+    )
+    args = ap.parse_args(argv)
+    try:
+        records = read_access_log(args.log)
+    except OSError as e:
+        print(f"slo_report: cannot read {args.log}: {e}", file=sys.stderr)
+        return 2
+    table = fold_report(records)
+    if args.json:
+        print(json.dumps(table, indent=2, sort_keys=True))
+    elif not table:
+        print(f"slo_report: no records in {args.log}")
+    else:
+        print(render_table(table))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
