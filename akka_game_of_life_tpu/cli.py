@@ -176,6 +176,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "frontend role, workers digest tiles locally and the frontend "
         "merges them (see docs/OPERATIONS.md \"Digest certification\")",
     )
+    _add_obs_programs(p)
     g = p.add_argument_group(
         "activity-gated sparse stepping",
         "skip the dead parts of the board: O(activity) throughput on "
@@ -228,6 +229,63 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--coordinator", metavar="HOST:PORT")
     p.add_argument("--num-processes", type=int)
     p.add_argument("--process-id", type=int)
+
+
+def _add_obs_programs(p: argparse.ArgumentParser) -> None:
+    """The compile & device-cost observatory knobs — shared by every role
+    that mounts /programs, /cost, and POST /profile (run, frontend, serve).
+    Every ``--obs-X`` flag maps 1:1 onto ``SimulationConfig.obs_X``
+    (graftlint ``GL-CFG11``)."""
+    p.add_argument(
+        "--obs-programs",
+        choices=["on", "off"],
+        default=None,
+        help="compile & device-cost observatory (obs/programs.py): the "
+        "jit-program ledger behind /programs, /cost, compile-storm alerts, "
+        "and workers' COST frames (default: on; off makes registered_jit "
+        "a pass-through)",
+    )
+    p.add_argument(
+        "--obs-cost-interval-s",
+        metavar="DUR",
+        help="cadence of worker COST frames and local device-memory gauge "
+        "refreshes (default: 5s)",
+    )
+    p.add_argument(
+        "--obs-profile-max-s",
+        metavar="DUR",
+        help="longest POST /profile capture window; longer requests are "
+        "clamped (default: 30s)",
+    )
+    p.add_argument(
+        "--obs-profile-min-interval-s",
+        metavar="DUR",
+        help="minimum gap between POST /profile captures; requests inside "
+        "it get HTTP 429 (default: 60s; 0 disables the rate limit)",
+    )
+
+
+def _obs_programs_overrides(args: argparse.Namespace) -> dict:
+    return {
+        "obs_programs": {"on": True, "off": False, None: None}[
+            args.obs_programs
+        ],
+        "obs_cost_interval_s": (
+            parse_duration(args.obs_cost_interval_s)
+            if args.obs_cost_interval_s is not None
+            else None
+        ),
+        "obs_profile_max_s": (
+            parse_duration(args.obs_profile_max_s)
+            if args.obs_profile_max_s is not None
+            else None
+        ),
+        "obs_profile_min_interval_s": (
+            parse_duration(args.obs_profile_min_interval_s)
+            if args.obs_profile_min_interval_s is not None
+            else None
+        ),
+    }
 
 
 def _add_ff(p: argparse.ArgumentParser) -> None:
@@ -778,6 +836,7 @@ def _overrides(args: argparse.Namespace) -> dict:
         "flight_dir": args.flight_dir,
         "obs_defer": args.obs_defer,
         "obs_digest": args.obs_digest,
+        **_obs_programs_overrides(args),
         "sparse_cluster": {"on": True, "off": False, None: None}[
             args.sparse_cluster
         ],
@@ -911,6 +970,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     _add_serve(sv_p)
     _add_ff(sv_p)
+    _add_obs_programs(sv_p)
 
     st_p = sub.add_parser(
         "selftest",
@@ -1122,6 +1182,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "port": args.port,
                 **_serve_overrides(args),
                 **_ff_overrides(args),
+                **_obs_programs_overrides(args),
             },
         )
         from akka_game_of_life_tpu.obs import get_tracer
@@ -1167,14 +1228,32 @@ def _metrics_endpoint(cfg, sim):
         yield
         return
     from akka_game_of_life_tpu.obs import MetricsServer
+    from akka_game_of_life_tpu.obs.programs import get_programs, http_routes
+    from akka_game_of_life_tpu.runtime.profiling import ProfilerCapture
 
+    programs = get_programs().configure(
+        node="standalone",
+        metrics=sim.metrics,
+        enabled=cfg.obs_programs,
+    )
+    profiler = ProfilerCapture(
+        cfg.flight_dir or "artifacts",
+        node="standalone",
+        max_seconds=cfg.obs_profile_max_s,
+        min_interval_s=cfg.obs_profile_min_interval_s,
+    )
     server = MetricsServer(
         sim.metrics,
         port=cfg.metrics_port,
         health=lambda: {"ok": True, "epoch": sim.epoch},
         tracer=sim.tracer,
+        routes=http_routes(registry=programs, profile=profiler.capture),
     )
-    print(f"metrics on :{server.port}/metrics (+/healthz,/trace)", flush=True)
+    print(
+        f"metrics on :{server.port}/metrics "
+        f"(+/healthz,/trace,/programs,/cost,/profile)",
+        flush=True,
+    )
     try:
         yield
     finally:
@@ -1263,8 +1342,18 @@ def _run_simulation(args, cfg, sim) -> int:
                 file=sim.observer.out,
                 flush=True,
             )
+    # End-of-run device-memory watermarks: exported as the cataloged
+    # per-device gauges (so a --metrics-file final dump carries them even
+    # when the run never hit a metrics cadence), printed under --trace-dir
+    # as before.
+    from akka_game_of_life_tpu.obs.programs import get_programs
+
+    try:
+        final_dev_stats = get_programs().refresh_device_gauges()
+    except Exception:  # noqa: BLE001 — observability must not fail the run
+        final_dev_stats = {}
     if args.trace_dir:
-        for dev, stats in profiling.device_memory_stats().items():
+        for dev, stats in final_dev_stats.items():
             print(f"[profile] {dev}: {stats}", flush=True)
     # board_host() is an O(board) collective in multi-host runs — every
     # rank calls it, at most once, shared by the dump and the fallback

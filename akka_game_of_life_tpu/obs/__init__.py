@@ -19,6 +19,9 @@ before that worker died" — without grepping stdout:
   HTTP endpoint (``--metrics-port``);
 - :class:`MetricsDumper` — the shared ``--metrics-file`` dump policy
   (atomic writes, warn-once failure containment) every role uses;
+- :class:`ProgramRegistry` (:mod:`.programs`) — the jit-program ledger:
+  compile bills, per-family throughput/roofline pricing, compile-storm
+  alerts, and the cluster-merged ``/programs`` + ``/cost`` endpoints;
 - :mod:`.catalog` — every exported metric, declared once, pre-registered
   into the default registry and lint-checked against the operations doc
   (span names get the same treatment via ``tracing.SPAN_CATALOG`` and
@@ -40,6 +43,11 @@ from akka_game_of_life_tpu.obs.metrics import (
     escape_label_value,
     get_registry,
 )
+from akka_game_of_life_tpu.obs.programs import (
+    ProgramRegistry,
+    get_programs,
+    registered_jit,
+)
 from akka_game_of_life_tpu.obs.tracing import (
     SPAN_CATALOG,
     TRACE_KEY,
@@ -57,14 +65,17 @@ __all__ = [
     "MetricsRegistry",
     "MetricsServer",
     "NULL_EVENTS",
+    "ProgramRegistry",
     "SPAN_CATALOG",
     "Span",
     "TRACE_KEY",
     "Tracer",
     "escape_label_value",
+    "get_programs",
     "get_registry",
     "get_tracer",
     "install",
+    "registered_jit",
     "read_events",
     "read_flight",
 ]

@@ -26,6 +26,11 @@ from akka_game_of_life_tpu.obs.metrics import DEFAULT_BUCKETS, MetricsRegistry
 # everything into one bucket.
 RING_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 
+# First-call (compile) seconds for gol_compile_seconds: XLA compiles run
+# milliseconds to minutes, far past the request-latency buckets.
+COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                   60.0, 120.0)
+
 # (name, kind, help, labelnames[, buckets]) — histograms use DEFAULT_BUCKETS
 # unless an entry carries its own.
 CATALOG = (
@@ -318,6 +323,31 @@ CATALOG = (
     # -- profiling spans -----------------------------------------------------
     ("gol_span_seconds", "histogram",
      "profiling.timed() span wall seconds", ("span",)),
+    # -- compile & device-cost observatory (obs/programs.py) ------------------
+    ("gol_compile_seconds", "histogram",
+     "First-call (compile) wall seconds per registered jitted program, "
+     "per kernel family", ("family",), COMPILE_BUCKETS),
+    ("gol_programs_live", "gauge",
+     "Jitted programs on the ledger, per family (cluster-merged on the "
+     "frontend; reclaimed with their last contributing member)",
+     ("family",)),
+    ("gol_program_invocations_total", "counter",
+     "Invocations of registered jitted programs, per family", ("family",)),
+    ("gol_program_device_seconds_total", "counter",
+     "Host-observed seconds inside registered jitted programs, per "
+     "family (async dispatch makes this a throughput lower bound)",
+     ("family",)),
+    ("gol_compile_storms_total", "counter",
+     "Compile storms: NEW programs that compiled after warmup (each one "
+     "stalled a live batch; an event + flight dump marks each)", ()),
+    ("gol_device_bytes_in_use", "gauge",
+     "Device memory currently allocated, per device (cluster members "
+     "namespaced member:device; reclaimed on loss)", ("device",)),
+    ("gol_device_peak_bytes_in_use", "gauge",
+     "Device memory high-water mark since process start, per device",
+     ("device",)),
+    ("gol_profile_captures_total", "counter",
+     "On-demand jax.profiler captures taken (POST /profile)", ()),
 )
 
 

@@ -20,7 +20,10 @@ of growing a second listener or an if/elif chain here.
 A route handler is ``handler(method, path, body) -> (status, content_type,
 body_bytes)``; it must render its response fully (taking whatever locks it
 needs) before returning.  Raising maps to a 500 with the error repr; a
-method the handler rejects should return 405 itself.
+method the handler rejects should return 405 itself.  ``path`` is the RAW
+request path — query string included (``POST /profile?seconds=3`` reads
+its parameter from it); routing matches on the query-stripped path, and
+handlers that parse path segments use :func:`strip_query` first.
 
 Stdlib-only (``http.server``), threaded, daemonized: a scrape can never
 block the simulation loop, and an abandoned server cannot hold the process
@@ -64,6 +67,13 @@ RouteHandler = Callable[[str, str, bytes], Tuple[int, str, bytes]]
 def json_response(status: int, doc: dict) -> Tuple[int, str, bytes]:
     """The common route-handler return shape for JSON documents."""
     return status, JSON_TYPE, (json.dumps(doc) + "\n").encode("utf-8")
+
+
+def strip_query(path: str) -> str:
+    """The request path without its query string.  Handlers receive the
+    raw path (query included, so parameterized routes can read it); any
+    handler that parses path *segments* strips first."""
+    return path.split("?", 1)[0]
 
 
 class MetricsServer:
@@ -150,7 +160,12 @@ class MetricsServer:
                     return
                 body = self.rfile.read(length) if length else b""
                 try:
-                    status, ctype, payload = handler(method, path, body)
+                    # Handlers get the RAW request path — query string
+                    # included — so routes like POST /profile?seconds=N
+                    # can read parameters; routing above matched on the
+                    # stripped path.  Handlers that parse path segments
+                    # must split off "?" themselves (see strip_query).
+                    status, ctype, payload = handler(method, self.path, body)
                 except Exception as e:  # noqa: BLE001 — a route bug must
                     # not kill the connection thread silently
                     status, ctype, payload = json_response(

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from akka_game_of_life_tpu.obs.programs import registered_jit
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 
 LANE_BITS = 32
@@ -187,6 +188,17 @@ def step_packed(x: jax.Array, rule) -> jax.Array:
     )
 
 
+def _packed_cost(x, steps: int) -> dict:
+    """Plan-priced per-call cost of a packed-word kernel: 1 bit/cell on
+    the wire, ~2 word-ops per cell-update in the adder tree."""
+    cells = float(x.size) * x.dtype.itemsize * 8 * steps
+    return {
+        "cells": cells,
+        "bytes": 2.0 * x.size * x.dtype.itemsize * steps,
+        "flops": 2.0 * cells,
+    }
+
+
 @functools.lru_cache(maxsize=None)
 def packed_step_fn(rule_key: Rule) -> Callable[[jax.Array], jax.Array]:
     rule = resolve_rule(rule_key)
@@ -195,7 +207,10 @@ def packed_step_fn(rule_key: Rule) -> Callable[[jax.Array], jax.Array]:
     def _step(x: jax.Array) -> jax.Array:
         return step_packed(x, rule)
 
-    return _step
+    return registered_jit(
+        "bitpack", ("step", rule.name), _step,
+        cost=lambda x: _packed_cost(x, 1),
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -210,7 +225,10 @@ def packed_multi_step_fn(rule_key: Rule, n_steps: int) -> Callable[[jax.Array], 
         out, _ = jax.lax.scan(body, x, None, length=n_steps)
         return out
 
-    return _run
+    return registered_jit(
+        "bitpack", ("multi_step", rule.name, n_steps), _run,
+        cost=lambda x: _packed_cost(x, n_steps),
+    )
 
 
 def pack_np(grid: np.ndarray) -> np.ndarray:

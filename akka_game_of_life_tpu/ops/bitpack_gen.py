@@ -53,6 +53,7 @@ from akka_game_of_life_tpu.ops.bitpack import (
     pack,
     unpack,
 )
+from akka_game_of_life_tpu.obs.programs import registered_jit
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 
 
@@ -257,4 +258,14 @@ def gen_multi_step_fn(rule_key, n_steps: int) -> Callable[[jax.Array], jax.Array
         out, _ = jax.lax.scan(body, planes, None, length=n_steps)
         return out
 
-    return _run
+    return registered_jit(
+        "bitpack_gen", ("multi_step", rule.name, n_steps), _run,
+        # One board's worth of cells per step; the plane stack (planes.size)
+        # is the byte traffic.
+        cost=lambda planes: {
+            "cells": float(planes.shape[-2])
+            * planes.shape[-1] * planes.dtype.itemsize * 8 * n_steps,
+            "bytes": 2.0 * planes.size * planes.dtype.itemsize * n_steps,
+            "flops": 4.0 * planes.size * planes.dtype.itemsize * 8 * n_steps,
+        },
+    )

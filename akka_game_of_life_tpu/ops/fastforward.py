@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from akka_game_of_life_tpu.obs.programs import registered_jit
 from akka_game_of_life_tpu.ops import guard
 from akka_game_of_life_tpu.ops.rules import linear_kernel, resolve_rule
 
@@ -230,7 +231,18 @@ def _jump_pow2_fn(rule_key, k: int, shape: Tuple[int, int]) -> Callable:
     def _run(board: jax.Array) -> jax.Array:
         return apply_offsets(board, np.asarray(shifts).reshape(-1, 2))
 
-    return _run
+    h, w = int(shape[-2]), int(shape[-1])
+    return registered_jit(
+        "fastforward", ("jump_pow2", rule.name, k, shape), _run,
+        # Effective work: one program advances 2^k epochs (the O(log T)
+        # headline the /cost roofline is meant to surface); actual device
+        # traffic is |shifts| rolls + XORs over one board.
+        cost={
+            "cells": float(h) * w * (2 ** k),
+            "bytes": float(len(shifts) + 1) * h * w,
+            "flops": float(len(shifts)) * h * w,
+        },
+    )
 
 
 def fast_forward(board: jax.Array, rule, t: int) -> jax.Array:
@@ -406,7 +418,16 @@ def jump_matmul_fn(rule_key, t: int, shape: Tuple[int, int], mode: str = "auto")
         out = jnp.concatenate(cols, axis=1).astype(jnp.int32) & 1
         return out.astype(board.dtype)
 
-    return _run
+    return registered_jit(
+        "fastforward", ("jump_matmul", rule.name, t, shape, mode), _run,
+        # Effective cells: t epochs in one program; bytes from the guard-
+        # priced plane estimate; flops from the two banded GEMM passes.
+        cost={
+            "cells": float(h) * w * t,
+            "bytes": float(est),
+            "flops": 2.0 * h * w * ((kr + 2 * sr) + (kc + 2 * sc)),
+        },
+    )
 
 
 def jump_plan(rule, t: int, shape: Tuple[int, int]) -> dict:

@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from akka_game_of_life_tpu.obs.programs import registered_jit, stencil_cost
 from akka_game_of_life_tpu.ops import guard
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 
@@ -199,7 +200,15 @@ def ltl_multi_step_fn(
         out, _ = jax.lax.scan(body, state, None, length=n_steps)
         return out
 
-    return _run
+    return registered_jit(
+        "ltl", ("multi_step", rule.name, engine, n_steps), _run,
+        # Shift-add visits the (2R+1)-wide window per cell: 2(2R+1) adds
+        # via the separable row/col pass.
+        cost=lambda state: stencil_cost(
+            state.shape[-2], state.shape[-1], n_steps,
+            flops_per_cell=4.0 * rule.radius + 4.0,
+        ),
+    )
 
 
 def step_padded_ltl_np(padded: np.ndarray, rule) -> np.ndarray:

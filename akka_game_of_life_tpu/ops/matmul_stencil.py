@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from akka_game_of_life_tpu.obs.programs import registered_jit
 from akka_game_of_life_tpu.ops import guard
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 from akka_game_of_life_tpu.ops.stencil import STATE_DTYPE, alive_mask, apply_rule
@@ -400,4 +401,19 @@ def matmul_multi_step_fn(
         out, _ = jax.lax.scan(body, state, None, length=n_steps)
         return out
 
-    return _run
+    def _cost(state):
+        h, w = int(state.shape[-2]), int(state.shape[-1])
+        # The plan priced these intermediates at closure-build time;
+        # lru_cache makes the re-ask free after the first call.
+        plan = plan_matmul((h, w), rule.radius, mode)
+        return {
+            "cells": float(h) * w * n_steps,
+            "bytes": float(plan.est_bytes) * n_steps,
+            # Two banded GEMM passes per step over the packed operand.
+            "flops": 4.0 * h * plan.packed_width
+            * (2 * rule.radius + 1) * n_steps,
+        }
+
+    return registered_jit(
+        "matmul", ("multi_step", rule.name, mode, n_steps), _run, cost=_cost
+    )

@@ -113,4 +113,17 @@ def gen_pallas_multi_step_fn(
         )
         return jnp.stack(out)
 
-    return run
+    from akka_game_of_life_tpu.obs.programs import registered_jit
+
+    return registered_jit(
+        "pallas_gen", ("multi_step", rule.name, n_steps, block_rows), run,
+        # m packed planes encode one board: one board of cells per step,
+        # m planes of byte traffic per sweep.
+        cost=lambda planes: {
+            "cells": float(planes.shape[-2])
+            * planes.shape[-1] * planes.dtype.itemsize * 8 * n_steps,
+            "bytes": 2.0 * planes.size * planes.dtype.itemsize
+            * (n_steps // steps_per_sweep),
+            "flops": 4.0 * planes.size * planes.dtype.itemsize * 8 * n_steps,
+        },
+    )

@@ -181,4 +181,12 @@ def ltl_pallas_multi_step_fn(
         out, _ = jax.lax.scan(body, x, None, length=n_steps)
         return out
 
-    return run
+    from akka_game_of_life_tpu.obs.programs import registered_jit, stencil_cost
+
+    return registered_jit(
+        "pallas_ltl", ("multi_step", rule.name, n_steps, block_rows), run,
+        cost=lambda x: stencil_cost(
+            x.shape[-2], x.shape[-1], n_steps,
+            flops_per_cell=4.0 * rule.radius + 4.0,
+        ),
+    )

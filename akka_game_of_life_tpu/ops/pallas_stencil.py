@@ -303,4 +303,16 @@ def packed_multi_step_fn(
         out, _ = jax.lax.scan(body, x, None, length=n_steps // steps_per_sweep)
         return out
 
-    return run
+    from akka_game_of_life_tpu.obs.programs import registered_jit
+
+    return registered_jit(
+        "pallas", ("packed_multi_step", rule.name, n_steps, block_rows), run,
+        # Packed words: 32 cells/element; the temporal blocking re-reads
+        # each block once per sweep, not per step.
+        cost=lambda x: {
+            "cells": float(x.size) * x.dtype.itemsize * 8 * n_steps,
+            "bytes": 2.0 * x.size * x.dtype.itemsize
+            * (n_steps // steps_per_sweep),
+            "flops": 2.0 * x.size * x.dtype.itemsize * 8 * n_steps,
+        },
+    )

@@ -179,7 +179,20 @@ class SparseStepper:
             changed = jnp.any(interior != slab[steps : steps + b, steps : steps + b])
             return interior, changed
 
-        fn = self._fns[("block", steps)] = jax.jit(jax.vmap(chunk))
+        from akka_game_of_life_tpu.obs.programs import registered_jit
+
+        fn = self._fns[("block", steps)] = registered_jit(
+            "sparse",
+            ("block", self.rule.name, steps, self.block),
+            jax.jit(jax.vmap(chunk)),
+            # slabs: (n, B+2k, B+2k); the gated win is that n is the
+            # ACTIVE block count, not the board's.
+            cost=lambda slabs: {
+                "cells": float(slabs.shape[0]) * b * b * steps,
+                "bytes": 2.0 * slabs.size * slabs.dtype.itemsize,
+                "flops": 18.0 * slabs.shape[0] * b * b * steps,
+            },
+        )
         return fn
 
     def _dense_fn(self, steps: int):
@@ -204,7 +217,16 @@ class SparseStepper:
             bitmap = diff.reshape(nbh, b, nbw, b).any(axis=(1, 3))
             return out, bitmap
 
-        self._fns[("dense", steps)] = run
+        from akka_game_of_life_tpu.obs.programs import registered_jit, stencil_cost
+
+        run = self._fns[("dense", steps)] = registered_jit(
+            "sparse",
+            ("dense", self.rule.name, steps, self.shape),
+            run,
+            cost=lambda board: stencil_cost(
+                board.shape[-2], board.shape[-1], steps
+            ),
+        )
         return run
 
     def _dense_plain_fn(self, steps: int):

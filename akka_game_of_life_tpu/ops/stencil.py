@@ -21,6 +21,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from akka_game_of_life_tpu.obs.programs import registered_jit, stencil_cost
 from akka_game_of_life_tpu.ops.rules import Rule, resolve_rule
 
 STATE_DTYPE = jnp.uint8
@@ -143,7 +144,10 @@ def step_fn(rule_key: Rule) -> Callable[[jax.Array], jax.Array]:
     def _step(state: jax.Array) -> jax.Array:
         return step(state, rule)
 
-    return _step
+    return registered_jit(
+        "stencil", ("step", rule.name), _step,
+        cost=lambda state: stencil_cost(state.shape[-2], state.shape[-1]),
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -156,7 +160,12 @@ def step_fn_padded(rule_key: Rule) -> Callable[[jax.Array], jax.Array]:
     def _step(padded: jax.Array) -> jax.Array:
         return step_padded(padded, rule)
 
-    return _step
+    return registered_jit(
+        "stencil", ("step_padded", rule.name), _step,
+        cost=lambda padded: stencil_cost(
+            padded.shape[-2] - 2, padded.shape[-1] - 2
+        ),
+    )
 
 
 def multi_step(state: jax.Array, rule, n_steps: int) -> jax.Array:
@@ -183,4 +192,9 @@ def multi_step_fn(rule_key: Rule, n_steps: int) -> Callable[[jax.Array], jax.Arr
     def _run(state: jax.Array) -> jax.Array:
         return multi_step(state, rule, n_steps)
 
-    return _run
+    return registered_jit(
+        "stencil", ("multi_step", rule.name, n_steps), _run,
+        cost=lambda state: stencil_cost(
+            state.shape[-2], state.shape[-1], n_steps
+        ),
+    )

@@ -278,7 +278,18 @@ def _jax_engine(
             key = (steps, _col_pad(padded.shape[1], steps), row_pad)
             fn = compiled.get(key)
             if fn is None:
-                fn = compiled[key] = jax.jit(_chunk_fn(*key))
+                from akka_game_of_life_tpu.obs.programs import (
+                    registered_jit,
+                    stencil_cost,
+                )
+
+                fn = compiled[key] = registered_jit(
+                    "worker_chunk", ("single", rule.name, key),
+                    jax.jit(_chunk_fn(*key)),
+                    cost=lambda p, _s=steps: stencil_cost(
+                        p.shape[-2], p.shape[-1], _s
+                    ),
+                )
             try:
                 out = fn(jnp.asarray(padded))
                 return np.asarray(out[halo:-halo, halo:-halo])
@@ -326,7 +337,18 @@ def _jax_engine(
         key = (steps, _col_pad(padded.shape[1], steps))
         fn = compiled.get(key)
         if fn is None:
-            fn = compiled[key] = jax.jit(_chunk_fn(*key), in_shardings=rows)
+            from akka_game_of_life_tpu.obs.programs import (
+                registered_jit,
+                stencil_cost,
+            )
+
+            fn = compiled[key] = registered_jit(
+                "worker_chunk", ("meshed", rule.name, n, key),
+                jax.jit(_chunk_fn(*key), in_shardings=rows),
+                cost=lambda p, _s=steps: stencil_cost(
+                    p.shape[-2], p.shape[-1], _s
+                ),
+            )
         out = fn(jax.device_put(padded, rows))
         return np.asarray(out)[halo : halo + h_out, halo:-halo]
 
@@ -687,6 +709,16 @@ class BackendWorker:
         # whose chunk input (state + halo) repeats (period 1 or 2).  Actor
         # engines are stateful and never skip regardless.
         self.sparse_cluster = False
+        # Compile & cost observatory (cluster config, shipped in WELCOME's
+        # "obs" bundle): cadence of the P.COST frames carrying this worker's
+        # program-ledger summary (0 disables the loop) and the shared
+        # profiler-capture policy for P.PROFILE fan-outs.  ``profile_dir``
+        # is role wiring — run_backend points it at flight_dir so captures
+        # land beside the crash dumps.
+        self.cost_interval_s = 5.0
+        self.profile_dir = "artifacts"
+        self._obs_profile: Dict[str, float] = {}
+        self._profiler = None
         # Decorrelated-jitter draws; reseeded per worker name in connect()
         # so a seeded cluster run's retry timing is reproducible per node.
         self._retry_rng = random.Random(f"retry:{name}")
@@ -870,6 +902,26 @@ class BackendWorker:
             self.obs_digest = bool(welcome["obs_digest"])
         if "sparse_cluster" in welcome:
             self.sparse_cluster = bool(welcome["sparse_cluster"])
+        if "obs" in welcome:
+            # Compile & cost observatory bundle: ledger on/off, COST frame
+            # cadence, and the profiler-capture policy — one policy source
+            # of truth (the frontend's SimulationConfig), like the retry
+            # and wire bundles above.
+            from akka_game_of_life_tpu.obs.programs import get_programs
+
+            _obs = welcome.get("obs") or {}
+            self.cost_interval_s = float(
+                _obs.get("cost_interval_s", self.cost_interval_s)
+            )
+            self._obs_profile = {
+                k: float(_obs[k])
+                for k in ("max_s", "min_interval_s")
+                if k in _obs
+            }
+            get_programs().configure(
+                node=welcome.get("name") or self.name,
+                enabled=bool(_obs.get("programs", True)),
+            )
         if welcome.get("serve_cluster"):
             from akka_game_of_life_tpu.serve.worker import ServeWorkerPlane
 
@@ -895,6 +947,11 @@ class BackendWorker:
             target=self._heartbeat_loop, args=(heartbeat_s,), daemon=True
         ).start()
         threading.Thread(target=self._retry_loop, daemon=True).start()
+        if self.cost_interval_s > 0:
+            threading.Thread(
+                target=self._cost_loop, args=(self.cost_interval_s,),
+                daemon=True,
+            ).start()
 
     def run(self) -> int:
         """Blocking serve loop; returns when shut down or disconnected.
@@ -1259,6 +1316,47 @@ class BackendWorker:
             except OSError:
                 return
 
+    def _cost_loop(self, interval: float) -> None:
+        """Low-cadence P.COST shipping: this worker's program-ledger
+        summary (compile counts, per-family throughput, device memory
+        watermarks) rides to the frontend, which merges every member into
+        one cluster ``/cost`` view.  The local device gauges refresh here
+        too, so the worker's own /metrics exposition carries live
+        watermarks between metric dumps."""
+        from akka_game_of_life_tpu.obs.programs import get_programs
+
+        while not self._stop.wait(interval):
+            programs = get_programs()
+            try:
+                programs.refresh_device_gauges()
+            except Exception:
+                pass
+            try:
+                self.channel.send({"type": P.COST, **programs.summary()})
+            except OSError:
+                return
+
+    def _profile_capture(self, prof, seconds) -> None:
+        try:
+            want = float(seconds) if seconds is not None else None
+        except (TypeError, ValueError):
+            want = None
+        result = prof.capture(want)
+        if result.get("ok"):
+            print(
+                f"profiler capture: {result.get('artifact')} "
+                f"({result.get('seconds')}s)",
+                flush=True,
+            )
+        else:
+            # A fanned capture has no HTTP response to carry the error —
+            # the worker log is the only place the operator can see it.
+            print(
+                f"profiler capture failed: {result.get('error')} "
+                f"(status {result.get('status')})",
+                flush=True,
+            )
+
     def _retry_loop(self) -> None:
         """The gatherer's Retry timer: re-ask the owners of missing rings.
 
@@ -1412,6 +1510,30 @@ class BackendWorker:
             # heartbeat-adjacent control traffic.
             if self.serve_plane is not None:
                 self.serve_plane.handle(msg)
+        elif kind == P.PROFILE:
+            # Cluster profiler fan-out: the capture runs on a daemon
+            # thread — a multi-second jax.profiler window must never block
+            # this control reader.  Built lazily here (the dispatch loop is
+            # single-threaded, so no lock): in-process test harnesses never
+            # pay for a profiler they don't poke.
+            if self._profiler is None:
+                from akka_game_of_life_tpu.runtime.profiling import (
+                    ProfilerCapture,
+                )
+
+                self._profiler = ProfilerCapture(
+                    self.profile_dir,
+                    node=self.name or "backend",
+                    max_seconds=float(self._obs_profile.get("max_s", 30.0)),
+                    min_interval_s=float(
+                        self._obs_profile.get("min_interval_s", 60.0)
+                    ),
+                )
+            threading.Thread(
+                target=self._profile_capture,
+                args=(self._profiler, msg.get("seconds")),
+                daemon=True,
+            ).start()
         elif kind == P.DRAIN_COMPLETE:
             # The frontend released us: either every tile migrated off
             # (drained=True → rc 0) or the drain was refused (no placeable
@@ -2324,6 +2446,14 @@ def run_backend(
     tracer.flight.configure(directory=flight_dir, node=node)
     events = EventLog(log_events, node=node, recorder=tracer.flight)
     events.emit("backend_joined", frontend=f"{host}:{port}", engine=engine)
+    # Program ledger: storm alerts fire through this worker's event log and
+    # flight recorder; profiler captures land beside the crash dumps.
+    from akka_game_of_life_tpu.obs.programs import get_programs
+
+    worker.profile_dir = flight_dir
+    get_programs().configure(
+        node=node, events=events, flight=tracer.flight, metrics=registry
+    )
     server = None
     if metrics_port:
         server = MetricsServer(

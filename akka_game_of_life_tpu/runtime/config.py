@@ -595,6 +595,20 @@ class SimulationConfig:
     # epochs; the frontend merges them in O(tiles) bytes and records the
     # merged digest in finalized checkpoint metadata.
     obs_digest: bool = False
+    # Compile & device-cost observatory (obs/programs.py): the jit-program
+    # ledger behind /programs, /cost, compile-storm alerts, and workers'
+    # COST frames.  Off makes registered_jit a pass-through for programs
+    # built afterward (zero wrapper overhead; the HTTP routes stay mounted
+    # and report an empty ledger).
+    obs_programs: bool = True
+    # Cadence of the worker→frontend COST frames (and of the local
+    # device-memory gauge refresh on cluster roles).
+    obs_cost_interval_s: float = 5.0
+    # POST /profile guard rails: longest admissible capture window, and the
+    # minimum gap between captures (429 inside the gap) — the obs port is
+    # unauthenticated, so the profiler must not be a DoS lever.
+    obs_profile_max_s: float = 30.0
+    obs_profile_min_interval_s: float = 60.0
 
     fault_injection: FaultInjectionConfig = dataclasses.field(
         default_factory=FaultInjectionConfig
@@ -637,6 +651,20 @@ class SimulationConfig:
             raise ValueError(
                 f"metrics_port={self.metrics_port} must be 0 (off) or a "
                 f"valid TCP port"
+            )
+        if self.obs_cost_interval_s <= 0:
+            raise ValueError(
+                f"obs_cost_interval_s={self.obs_cost_interval_s} must be > 0"
+            )
+        if self.obs_profile_max_s <= 0:
+            raise ValueError(
+                f"obs_profile_max_s={self.obs_profile_max_s} must be > 0"
+            )
+        if self.obs_profile_min_interval_s < 0:
+            raise ValueError(
+                f"obs_profile_min_interval_s="
+                f"{self.obs_profile_min_interval_s} must be >= 0 (0 = no "
+                f"rate limit)"
             )
         if self.checkpoint_format not in ("npz", "orbax"):
             raise ValueError(f"unknown checkpoint format {self.checkpoint_format!r}")
@@ -819,6 +847,9 @@ _DURATION_FIELDS = {
     "serve_slo_fast_window_s",
     "serve_slo_slow_window_s",
     "serve_canary_interval_s",
+    "obs_cost_interval_s",
+    "obs_profile_max_s",
+    "obs_profile_min_interval_s",
     "breaker_cooldown_s",
     "send_deadline_s",
     "delay_s",

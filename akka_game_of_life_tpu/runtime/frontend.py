@@ -37,6 +37,8 @@ from akka_game_of_life_tpu.obs import (
     MetricsServer,
     get_registry,
 )
+from akka_game_of_life_tpu.obs.programs import get_programs
+from akka_game_of_life_tpu.obs.programs import http_routes as program_routes
 from akka_game_of_life_tpu.obs.tracing import get_tracer
 from akka_game_of_life_tpu.ops.rules import resolve_rule
 from akka_game_of_life_tpu.runtime import protocol as P
@@ -242,6 +244,27 @@ class Frontend:
         )
         self.events = EventLog(
             config.log_events, node="frontend", recorder=self.tracer.flight
+        )
+        # Compile & cost observatory: the frontend is the cluster merge
+        # point — its process registry gets the role identity and alert
+        # sinks (storms fire into the same event log as promotions), and
+        # every worker COST frame folds in through merge_remote.  The
+        # profiler powers POST /profile; the rate limiter lives HERE (one
+        # cluster knob), workers just obey the fan-out.
+        self.programs = get_programs().configure(
+            node="frontend",
+            events=self.events,
+            flight=self.tracer.flight,
+            metrics=self.metrics,
+            enabled=config.obs_programs,
+        )
+        from akka_game_of_life_tpu.runtime.profiling import ProfilerCapture
+
+        self._profiler = ProfilerCapture(
+            config.flight_dir or "artifacts",
+            node="frontend",
+            max_seconds=config.obs_profile_max_s,
+            min_interval_s=config.obs_profile_min_interval_s,
         )
         # cluster.run is the whole simulation; epoch is one epoch-target
         # announcement (the whole run in free-running mode, one tick in
@@ -458,7 +481,14 @@ class Frontend:
 
     def start(self) -> None:
         if self.config.metrics_port or self.serve_plane is not None:
-            routes = None
+            # Observatory surface on every frontend: cluster-merged
+            # /programs + /cost, and POST /profile fanning a capture to
+            # the workers.
+            routes = dict(
+                program_routes(
+                    registry=self.programs, profile=self._cluster_profile
+                )
+            )
             if self.serve_plane is not None:
                 from akka_game_of_life_tpu.obs import slo as slo_mod
                 from akka_game_of_life_tpu.serve.api import board_routes
@@ -472,9 +502,11 @@ class Frontend:
                     self.config, registry=self.metrics, tracer=self.tracer,
                     events=self.events, node="frontend",
                 )
-                routes = board_routes(
-                    self.serve_plane, tracer=self.tracer,
-                    slo=self._serve_slo,
+                routes.update(
+                    board_routes(
+                        self.serve_plane, tracer=self.tracer,
+                        slo=self._serve_slo,
+                    )
                 )
             self._metrics_server = MetricsServer(
                 self.metrics,
@@ -518,7 +550,30 @@ class Frontend:
             # Outside the frontend lock (frontend → plane is the one
             # permitted nesting order, and health() takes the plane lock).
             doc["serve"] = self.serve_plane.health()
+        # Cost observatory digest (registry takes its own lock): program
+        # counts, compile bill, storms, per-member warmth.
+        doc["programs"] = self.programs.health_summary()
         return doc
+
+    def _cluster_profile(self, seconds: Optional[float]) -> dict:
+        """POST /profile: capture locally first — the rate limiter lives
+        here, one knob for the whole cluster — then fan the same window to
+        every live worker fire-and-forget (each lands its own artifact
+        beside its crash dumps)."""
+        result = self._profiler.capture(seconds)
+        if not result.get("ok"):
+            return result
+        fanned = []
+        for m in self.membership.alive_members():
+            try:
+                m.channel.send(
+                    {"type": P.PROFILE, "seconds": result["seconds"]}
+                )
+                fanned.append(m.name)
+            except OSError:
+                pass
+        result["members"] = sorted(fanned)
+        return result
 
     def _io_loop(self) -> None:
         while True:
@@ -1039,6 +1094,15 @@ class Frontend:
                     # workers skip provably-repeating chunks and publish
                     # O(1)-byte same-ring markers when on.
                     "sparse_cluster": self.config.sparse_cluster,
+                    # Compile & cost observatory: ledger on/off, COST frame
+                    # cadence, profiler-capture policy — one source of
+                    # truth for every member's program accounting.
+                    "obs": {
+                        "programs": self.config.obs_programs,
+                        "cost_interval_s": self.config.obs_cost_interval_s,
+                        "max_s": self.config.obs_profile_max_s,
+                        "min_interval_s": self.config.obs_profile_min_interval_s,
+                    },
                 }
             )
             engine = hello.get("engine", "?")
@@ -1131,6 +1195,10 @@ class Frontend:
             spans = msg.get("spans")
             if isinstance(spans, list):
                 self.tracer.ingest(spans)
+        elif kind == P.COST:
+            # Worker program-ledger summary: fold into the cluster-merged
+            # /programs + /cost view and the member-labeled device gauges.
+            self.programs.merge_remote(member.name, msg)
         elif kind == P.PROGRESS:
             # Control-plane ping only — ring bytes ride worker-to-worker
             # (PEER_RING); the frontend just tracks lag for the prune floor
@@ -1694,6 +1762,9 @@ class Frontend:
         # record, and the normal checkpoint redeploy below recovers its
         # tiles, the frozen one included.
         self._m_hb_age.labels(member=name).set(0)
+        # Cost-observatory hygiene: the member's ledger contribution and
+        # every member:device gauge child it owned go with it.
+        self.programs.forget_remote(name)
         with self._lock:
             span = self._drain_spans.pop(name, None)
             if span is not None:

@@ -113,3 +113,108 @@ def device_memory_stats() -> dict:
                 "bytes_limit": stats.get("bytes_limit"),
             }
     return out
+
+
+class ProfilerCapture:
+    """On-demand, rate-limited jax.profiler capture — ``POST /profile``'s
+    engine on every role.
+
+    One capture at a time per process (a second request while one runs
+    gets ``status: 409``), at most one per ``min_interval_s`` (``status:
+    429`` with ``retry_after_s``), each clamped to ``max_seconds`` — an
+    unauthenticated scraper poking the obs port must not be able to turn
+    the profiler into a DoS.  Artifacts land under
+    ``<artifacts_dir>/profile-<node>-<stamp>/`` in the standard
+    TensorBoard/Perfetto layout :func:`trace` produces.
+
+    ``start``/``stop``/``sleep``/``clock`` are injectable so tests drive
+    captures without a real profiler or wall time.
+    """
+
+    def __init__(
+        self,
+        artifacts_dir: str = "artifacts",
+        *,
+        node: Optional[str] = None,
+        max_seconds: float = 30.0,
+        min_interval_s: float = 60.0,
+        default_seconds: float = 3.0,
+        clock=time.monotonic,
+        sleep=time.sleep,
+        start=None,
+        stop=None,
+    ) -> None:
+        import threading
+
+        self.artifacts_dir = artifacts_dir
+        self.node = node or "local"
+        self.max_seconds = float(max_seconds)
+        self.min_interval_s = float(min_interval_s)
+        self.default_seconds = float(default_seconds)
+        self._clock = clock
+        self._sleep = sleep
+        self._start = start if start is not None else jax.profiler.start_trace
+        self._stop = stop if stop is not None else jax.profiler.stop_trace
+        self._lock = threading.Lock()
+        self._running = False  # graftlint: guarded-by _lock
+        self._last: Optional[float] = None  # graftlint: guarded-by _lock
+        self._seq = 0  # graftlint: guarded-by _lock
+
+    def capture(self, seconds: Optional[float] = None) -> dict:
+        """Run one capture window, blocking for its duration.  Returns a
+        JSON-ready result: ``{"ok": True, "artifact", "seconds"}`` or
+        ``{"ok": False, "error", "status"}`` (409 busy, 429 rate-limited,
+        500 profiler failure)."""
+        want = self.default_seconds if seconds is None else float(seconds)
+        want = min(max(want, 0.1), self.max_seconds)
+        with self._lock:
+            if self._running:
+                return {
+                    "ok": False,
+                    "status": 409,
+                    "error": "a profiler capture is already running",
+                }
+            now = self._clock()
+            if self._last is not None and now - self._last < self.min_interval_s:
+                return {
+                    "ok": False,
+                    "status": 429,
+                    "error": "profiler capture rate-limited",
+                    "retry_after_s": round(
+                        self.min_interval_s - (now - self._last), 3
+                    ),
+                }
+            self._running = True
+            self._last = now
+            self._seq += 1
+            seq = self._seq
+        import os
+
+        path = os.path.join(
+            self.artifacts_dir, f"profile-{self.node}-{seq:04d}"
+        )
+        try:
+            os.makedirs(path, exist_ok=True)
+            self._start(path)
+            try:
+                self._sleep(want)
+            finally:
+                self._stop()
+        except Exception as e:  # noqa: BLE001 — report, never kill the route
+            return {"ok": False, "status": 500, "error": repr(e)}
+        finally:
+            with self._lock:
+                self._running = False
+        from akka_game_of_life_tpu.obs.metrics import get_registry
+
+        get_registry().counter(
+            "gol_profile_captures_total",
+            "On-demand jax.profiler captures taken (POST /profile)",
+        ).inc()
+        return {
+            "ok": True,
+            "node": self.node,
+            "artifact": path,
+            "seconds": want,
+            "devices": device_memory_stats(),
+        }

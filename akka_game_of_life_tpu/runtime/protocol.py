@@ -64,6 +64,16 @@ SHARD_REPLICATE_ACK         streams dirty session snapshots (bit-packed +
                             session epoch watermark (or parks/resets the
                             stream) — promotion on worker loss resumes
                             from the last acked state
+COST                        (new) compile & device-cost observatory: a
+                            worker's low-cadence ledger frame — per-
+                            family program counts/compile bill/priced
+                            throughput plus device-memory watermarks —
+                            merged by the frontend into /programs,
+                            /cost, and /healthz (obs/programs.py)
+PROFILE                     (new) on-demand profiler fan-out: the
+                            frontend relays one POST /profile capture
+                            request to every worker so a single call
+                            profiles the whole cluster window
 TILED_HALO /                (new) worker-resident tiled sessions: one
 TILED_HALO_ACK              chunk's O(perimeter) edge strip for a
                             neighbor chunk at an epoch barrier, shipped
@@ -116,6 +126,9 @@ GOODBYE = "goodbye"
 # multi-process CLI roles forward; the in-process harness shares a tracer
 # and never needs to)
 SPANS = "spans"
+# (new) compile & device-cost observatory: the worker's low-cadence
+# program-ledger + device-watermark frame (obs/programs.py summary())
+COST = "cost"
 
 # frontend → backend
 WELCOME = "welcome"
@@ -128,6 +141,8 @@ CRASH_TILE = "crash_tile"
 PAUSE = "pause"
 RESUME = "resume"
 SHUTDOWN = "shutdown"
+# (new) on-demand cluster profiler capture fan-out (POST /profile)
+PROFILE = "profile"
 
 # elastic plane: live tile migration + graceful drain
 # frontend → backend

@@ -1152,6 +1152,15 @@ class Simulation:
         :class:`~akka_game_of_life_tpu.obs.dump.MetricsDumper`."""
         if self._metrics_dumper is None or jax.process_index() != 0:
             return
+        # Device-memory watermarks ride the same cadence: the end-of-run
+        # print promoted to cataloged gauges (gol_device_bytes_in_use /
+        # _peak_), so the exposition carries them all run long.
+        from akka_game_of_life_tpu.obs.programs import get_programs
+
+        try:
+            get_programs().refresh_device_gauges()
+        except Exception:  # noqa: BLE001 — observability must not abort the run
+            pass
         self._metrics_dumper.dump()
 
     # -- observation (device-side: nothing here is O(board) on host) ---------

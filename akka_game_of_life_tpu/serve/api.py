@@ -55,7 +55,11 @@ from typing import Optional, Tuple
 import numpy as np
 
 from akka_game_of_life_tpu.obs import slo as slo_mod
-from akka_game_of_life_tpu.obs.httpd import JSON_TYPE, json_response
+from akka_game_of_life_tpu.obs.httpd import (
+    JSON_TYPE,
+    json_response,
+    strip_query,
+)
 from akka_game_of_life_tpu.obs.tracing import TRACE_KEY
 from akka_game_of_life_tpu.serve.sessions import AdmissionError, SessionRouter
 
@@ -109,6 +113,9 @@ class BoardsRoute:
         self.trace = trace
 
     def __call__(self, method: str, path: str, body: bytes):
+        # The server hands over the RAW path (query included); this route
+        # dispatches on path segments, so normalize once at the door.
+        path = strip_query(path)
         if not self.trace or self.tracer is None:
             return self._respond(method, path, body, None)
         with self.tracer.start(
@@ -352,16 +359,41 @@ def run_serve(config, *, registry=None, tracer=None) -> int:
     slo = slo_mod.SloTracker(
         config, registry=registry, tracer=tracer, events=events,
     )
+    # Compile & cost observatory: the serve role is the storm detector's
+    # prime customer — a novel (class, length) program compiling after
+    # warmup is a latency cliff for live tenants.  Storm alerts fire into
+    # this role's event log + flight recorder; /profile captures land
+    # beside the flight dumps.
+    from akka_game_of_life_tpu.obs.programs import get_programs, http_routes
+    from akka_game_of_life_tpu.runtime.profiling import ProfilerCapture
+
+    programs = get_programs().configure(
+        node="serve",
+        events=events,
+        flight=tracer.flight,
+        metrics=registry,
+        enabled=config.obs_programs,
+    )
+    profiler = ProfilerCapture(
+        config.flight_dir or "artifacts",
+        node="serve",
+        max_seconds=config.obs_profile_max_s,
+        min_interval_s=config.obs_profile_min_interval_s,
+    )
 
     def health() -> dict:
-        return {"ok": True, "role": "serve", **router.stats()}
+        doc = {"ok": True, "role": "serve", **router.stats()}
+        doc["programs"] = programs.health_summary()
+        return doc
 
+    routes = dict(http_routes(registry=programs, profile=profiler.capture))
+    routes.update(board_routes(router, tracer=tracer, slo=slo))
     server = MetricsServer(
         registry,
         port=config.metrics_port,
         health=health,
         tracer=tracer,
-        routes=board_routes(router, tracer=tracer, slo=slo),
+        routes=routes,
     )
     canary = None
     if config.serve_canary:
@@ -376,7 +408,8 @@ def run_serve(config, *, registry=None, tracer=None) -> int:
         )
         canary.start()
     print(
-        f"serving /boards (+/metrics,/healthz,/trace,/slo) on "
+        f"serving /boards (+/metrics,/healthz,/trace,/slo,"
+        f"/programs,/cost,/profile) on "
         f":{server.port} — "
         f"max {router.max_sessions} sessions, {router.max_cells} cells, "
         f"size classes {list(router.size_classes)}",

@@ -184,4 +184,13 @@ def batch_step_fn(class_side: int, length: int):
         lanes = odigest.digest_dense_batch(stepped, w)
         return stepped, lanes
 
-    return run
+    from akka_game_of_life_tpu.obs.programs import registered_jit, stencil_cost
+
+    return registered_jit(
+        "serve_batch", (class_side, length), run,
+        # Every board in the batch scans `length` iterations (identity past
+        # its own n) — the padded cost is what the device actually runs.
+        cost=lambda boards, *rest: stencil_cost(
+            class_side, class_side, length, boards=boards.shape[0]
+        ),
+    )

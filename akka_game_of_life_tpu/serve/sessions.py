@@ -913,6 +913,10 @@ class SessionRouter:
                 )
         for job in dead:
             self._finish(job)
+        from akka_game_of_life_tpu.obs.programs import get_programs
+
+        programs = get_programs()
+        before = programs.programs_total
         for cls, entries in sorted(groups.items()):
             try:
                 self._run_class_batch(cls, entries)
@@ -920,6 +924,12 @@ class SessionRouter:
                 for job, _, _, _ in entries:
                     job.error = e
                     self._finish(job)
+        if groups and not programs.warm and programs.programs_total == before:
+            # A full tick advanced real jobs without compiling any new
+            # program: the router's program set is its steady state.  Arm
+            # the storm detector — from here on, a novel (class, length)
+            # compile is a latency cliff worth an alert + flight dump.
+            programs.mark_warm()
 
     def _run_class_batch(
         self, cls: int, entries: List[Tuple[_Job, Session, np.ndarray, int]]

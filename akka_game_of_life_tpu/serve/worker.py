@@ -152,7 +152,14 @@ def _batched_step_fn(rule, n_steps: int):
             lambda s: stencil.multi_step(s, rule, n_steps)
         )(stack)
 
-    return run
+    from akka_game_of_life_tpu.obs.programs import registered_jit, stencil_cost
+
+    return registered_jit(
+        "serve_tiled", (str(rule), n_steps), run,
+        cost=lambda stack: stencil_cost(
+            stack.shape[-2], stack.shape[-1], n_steps, boards=stack.shape[0]
+        ),
+    )
 
 
 def _next_pow2(n: int) -> int:

@@ -494,11 +494,16 @@ def main() -> None:
         # Observability context rides with the scored number (halo bytes,
         # span latencies — whatever non-zero series this process touched),
         # so the BENCH_*.json trajectory carries its own attribution.
-        from bench_suite import registry_snapshot
+        from bench_suite import programs_snapshot, registry_snapshot
 
         snap = registry_snapshot()
         if snap:
             headline_line["metrics"] = snap
+        progs = programs_snapshot()
+        if progs:
+            # The jit-program ledger beside the metrics: compile bill and
+            # per-family priced work behind the headline number.
+            headline_line["programs"] = progs
     print(json.dumps(headline_line), flush=True)
 
     if not args.headline_only:

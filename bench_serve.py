@@ -132,6 +132,16 @@ def _obs_block(snap: dict, base: str) -> dict:
             "alerting": doc.get("alerting"),
             "tenants": doc.get("tenants"),
         }
+    try:
+        # The jit-program ledger beside the metrics slice: which batch
+        # programs this traffic compiled and ran, and what they cost.
+        from bench_suite import programs_snapshot
+
+        progs = programs_snapshot()
+        if progs:
+            block["programs"] = progs
+    except Exception:  # noqa: BLE001
+        pass
     return block
 
 

@@ -142,6 +142,21 @@ def registry_snapshot() -> dict:
         return {}
 
 
+def programs_snapshot() -> dict:
+    """The jit-program ledger's summary (per-family compile bill, calls,
+    priced work — obs/programs.py), for embedding beside the metrics
+    snapshot: a BENCH record then shows which programs its number paid to
+    compile and run.  Never raises, same contract as
+    :func:`registry_snapshot`."""
+    try:
+        from akka_game_of_life_tpu.obs.programs import get_programs
+
+        summary = get_programs().summary()
+        return summary if summary.get("families") else {}
+    except Exception:  # noqa: BLE001 — context, not the measurement
+        return {}
+
+
 def _emit(
     config: str,
     metric: str,
@@ -171,6 +186,9 @@ def _emit(
         # configs move gol_peer_*/gol_ring_bytes_total; jit-only configs
         # stay lean because snapshot() drops zero series).
         line["metrics"] = snap
+    progs = programs_snapshot()
+    if progs:
+        line["programs"] = progs
     print(json.dumps(line), flush=True)
 
 
@@ -1152,6 +1170,67 @@ def bench_cluster_exchange(size: int, epochs: int = 64) -> None:
     print(json.dumps(ratio_line), flush=True)
 
 
+class _Tee:
+    """Mirror writes to the real stdout while keeping every completed line
+    — the in-process capture ``--regress-check`` judges (bench_cluster /
+    bench_serve emit through the same stream, so their lines ride too)."""
+
+    def __init__(self, stream) -> None:
+        self.stream = stream
+        self.lines: list[str] = []
+        self._buf = ""
+
+    def write(self, text: str) -> int:
+        n = self.stream.write(text)
+        self._buf += text
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            self.lines.append(line)
+        return n
+
+    def flush(self) -> None:
+        self.stream.flush()
+
+
+def _regress_check(lines, threshold: float, min_rounds: int) -> int:
+    """Fold this run's fresh bench lines into the BENCH_r* trajectory and
+    fail (rc 1) if any config regressed vs its history median.  The fresh
+    round is labeled one past the newest recorded round."""
+    import sys as _sys
+    from pathlib import Path
+
+    _sys.path.insert(0, str(Path(__file__).resolve().parent / "tools"))
+    from bench_regress import RegressPolicy, check_trend, gather_pairs
+    from bench_trend import _bench_lines, build_trend
+
+    root = Path(__file__).resolve().parent
+    pairs = gather_pairs(root, [])
+    fresh_round = 1 + max(
+        (r for r, _ in pairs if isinstance(r, int)), default=0
+    )
+    fresh = list(_bench_lines("\n".join(lines)))
+    pairs.extend((fresh_round, rec) for rec in fresh)
+    verdict = check_trend(
+        build_trend(pairs),
+        RegressPolicy(threshold=threshold, min_rounds=min_rounds),
+    )
+    print(
+        f"bench_suite: regress-check vs r{fresh_round - 1} history — "
+        f"{len(verdict['checked'])} checked, "
+        f"{len(verdict['regressions'])} regression(s)",
+        flush=True,
+    )
+    for r in verdict["regressions"]:
+        print(
+            f"bench_suite: REGRESSION {r['config']}: {r['latest']:.4g} "
+            f"{r['unit']} vs trajectory median {r['median']:.4g} "
+            f"(x{r['ratio']:.2f})",
+            file=_sys.stderr,
+            flush=True,
+        )
+    return 0 if verdict["ok"] else 1
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -1163,11 +1242,39 @@ def main() -> None:
         help="multiply grid sides by this (e.g. 0.125 for CPU smoke runs)",
     )
     parser.add_argument("--platform", default=None, help="pin jax platform (e.g. cpu)")
+    parser.add_argument(
+        "--regress-check", action="store_true", default=None,
+        help="after the run, gate this output against the BENCH_r* "
+        "trajectory (tools/bench_regress.py) and exit 1 on a regression. "
+        "Default: ON at --scale 1.0 (config labels don't encode scale, so "
+        "scaled smoke numbers must not be judged against full-size "
+        "history), off otherwise.",
+    )
+    parser.add_argument(
+        "--bench-regress-threshold", type=float, default=0.25,
+        help="fractional drop from the trajectory median that fails "
+        "(RegressPolicy.threshold; default %(default)s)",
+    )
+    parser.add_argument(
+        "--bench-regress-min-rounds", type=int, default=2,
+        help="rounds (latest included) a config needs before it gates "
+        "(RegressPolicy.min_rounds; default %(default)s)",
+    )
     args = parser.parse_args()
 
     from akka_game_of_life_tpu.cli import _apply_platform
 
     _apply_platform(args.platform)
+
+    regress = args.regress_check
+    if regress is None:
+        regress = args.scale == 1.0
+    tee = None
+    if regress:
+        import sys as _sys
+
+        tee = _Tee(_sys.stdout)
+        _sys.stdout = tee
 
     def s(n: int, quantum: int = 32) -> int:
         return max(quantum, int(n * args.scale) // quantum * quantum)
@@ -1298,6 +1405,18 @@ def main() -> None:
             steps=64,
             requests=3,
         )
+
+    if tee is not None:
+        import sys as _sys
+
+        _sys.stdout = tee.stream
+        rc = _regress_check(
+            tee.lines,
+            args.bench_regress_threshold,
+            args.bench_regress_min_rounds,
+        )
+        if rc:
+            raise SystemExit(rc)
 
 
 if __name__ == "__main__":

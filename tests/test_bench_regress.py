@@ -1,0 +1,238 @@
+"""tools/bench_regress.py — the bench-trajectory regression gate (tier-1).
+
+Three layers:
+
+- **policy** (`check_trend` on synthetic trends): direction mapping per
+  unit, the trajectory-median comparison (one historical outlier cannot
+  fake or mask a regression), the min-rounds floor, threshold validation;
+- **the real records** (acceptance criterion): the shipped
+  ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` trajectory passes the gate,
+  and a synthetic degraded round against the same records fails it,
+  naming the config, in both the human and ``--json`` outputs;
+- **bench_suite wiring**: `--regress-check`'s in-process fold
+  (`bench_suite._regress_check`) judges fresh lines against the shipped
+  history, and the record-embedding helpers never raise.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+for p in (str(REPO), str(REPO / "tools")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+from bench_regress import (  # noqa: E402
+    RegressPolicy,
+    check_trend,
+    gather_pairs,
+    main,
+)
+from bench_trend import build_trend  # noqa: E402
+
+
+def _trend(unit, rounds):
+    return {"cfg": {"unit": unit, "rounds": rounds}}
+
+
+# -- policy on synthetic trends ------------------------------------------------
+
+
+def test_higher_is_better_regression_detected():
+    verdict = check_trend(
+        _trend("cell-updates/sec", {1: 100.0, 2: 104.0, 3: 60.0}),
+        RegressPolicy(),
+    )
+    assert not verdict["ok"]
+    (r,) = verdict["regressions"]
+    assert r["config"] == "cfg" and r["latest_round"] == 3
+    assert r["median"] == pytest.approx(102.0)
+    assert r["ratio"] == pytest.approx(60.0 / 102.0)
+    assert r["history_rounds"] == [1, 2]
+
+
+def test_improvement_and_noise_pass():
+    ok = check_trend(
+        _trend("boards/sec", {1: 100.0, 2: 140.0}), RegressPolicy()
+    )
+    assert ok["ok"] and ok["checked"] == ["cfg"]
+    noise = check_trend(
+        _trend("x", {1: 100.0, 2: 80.0}), RegressPolicy(threshold=0.25)
+    )
+    assert noise["ok"]  # 20% off is inside the 25% band
+
+
+def test_seconds_gate_is_inverted():
+    slow = check_trend(
+        _trend("seconds", {1: 1.0, 2: 1.5}), RegressPolicy(threshold=0.25)
+    )
+    assert not slow["ok"]
+    fast = check_trend(
+        _trend("seconds", {1: 1.0, 2: 0.4}), RegressPolicy(threshold=0.25)
+    )
+    assert fast["ok"]
+
+
+def test_median_not_previous_point_is_the_reference():
+    """One historically inflated round must not flag a steady config —
+    the median absorbs the outlier where a latest-vs-previous gate
+    would not."""
+    verdict = check_trend(
+        _trend("x", {1: 10.0, 2: 100.0, 3: 10.5, 4: 10.2}),
+        RegressPolicy(),
+    )
+    assert verdict["ok"]  # median(10, 100, 10.5) = 10.5; 10.2 is steady
+
+
+def test_unmapped_units_and_thin_history_are_skipped():
+    verdict = check_trend(
+        {
+            "cap": {"unit": "radius", "rounds": {1: 2, 2: 1}},
+            "thin": {"unit": "x", "rounds": {5: 3.0}},
+            "nulls": {"unit": "x", "rounds": {1: None, 2: 3.0}},
+        },
+        RegressPolicy(),
+    )
+    assert verdict["ok"] and verdict["checked"] == []
+    assert "not direction-mapped" in verdict["skipped"]["cap"]
+    assert "min_rounds" in verdict["skipped"]["thin"]
+    assert "min_rounds" in verdict["skipped"]["nulls"]
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        RegressPolicy(threshold=0.0)
+    with pytest.raises(ValueError):
+        RegressPolicy(threshold=1.0)
+    with pytest.raises(ValueError):
+        RegressPolicy(min_rounds=1)
+
+
+# -- the real shipped records --------------------------------------------------
+
+
+def test_shipped_trajectory_passes_the_gate(capsys):
+    rc = main(["--dir", str(REPO)])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "0 regression(s)" in out.out
+    # The parse is real: every shipped config made it into the verdict.
+    trend = build_trend(gather_pairs(REPO, []))
+    assert "conway-8192" in trend and "serve-shard-w4" in trend
+    assert len(trend) >= 19
+
+
+def test_degraded_round_fails_naming_the_config(tmp_path, capsys):
+    """A fresh round 50% off conway-8192's recorded trajectory exits 1
+    and names the config — the loud-failure acceptance drill."""
+    trend = build_trend(gather_pairs(REPO, []))
+    entry = trend["conway-8192"]
+    (good,) = [v for v in entry["rounds"].values() if v is not None]
+    fresh = tmp_path / "fresh.jsonl"
+    fresh.write_text(
+        json.dumps(
+            {
+                "config": "conway-8192",
+                "metric": "throughput",
+                "value": good * 0.5,
+                "unit": entry["unit"],
+            }
+        )
+        + "\n"
+    )
+    rc = main(["--dir", str(REPO), str(fresh), "--round", "99"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "REGRESSION conway-8192" in err and "r99" in err
+
+    # The same degradation inside the threshold band passes.
+    fresh.write_text(
+        json.dumps(
+            {
+                "config": "conway-8192",
+                "metric": "throughput",
+                "value": good * 0.9,
+                "unit": entry["unit"],
+            }
+        )
+        + "\n"
+    )
+    assert main(["--dir", str(REPO), str(fresh), "--round", "99"]) == 0
+
+
+def test_json_verdict_is_machine_readable(tmp_path, capsys):
+    trend = build_trend(gather_pairs(REPO, []))
+    entry = trend["serve-shard-w4"]
+    (good,) = [v for v in entry["rounds"].values() if v is not None]
+    fresh = tmp_path / "fresh.jsonl"
+    fresh.write_text(
+        json.dumps(
+            {
+                "config": "serve-shard-w4",
+                "metric": "throughput",
+                "value": good * 0.1,
+                "unit": entry["unit"],
+            }
+        )
+        + "\n"
+    )
+    rc = main(["--dir", str(REPO), str(fresh), "--round", "42", "--json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is False and doc["threshold"] == 0.25
+    (r,) = doc["regressions"]
+    assert r["config"] == "serve-shard-w4" and r["latest_round"] == 42
+
+
+def test_missing_extra_file_is_usage_error(capsys):
+    assert main(["--dir", str(REPO), "no/such/file.jsonl"]) == 2
+    assert "no such file" in capsys.readouterr().err
+
+
+# -- bench_suite wiring --------------------------------------------------------
+
+
+def test_bench_suite_regress_check_folds_fresh_lines(capsys):
+    import bench_suite
+
+    lines = [
+        "noise: not json",
+        json.dumps(
+            {
+                "config": "conway-8192",
+                "metric": "throughput",
+                "value": 1.0,  # catastrophically off the recorded round
+                "unit": "cell-updates/sec",
+            }
+        ),
+    ]
+    rc = bench_suite._regress_check(lines, threshold=0.25, min_rounds=2)
+    assert rc == 1
+    cap = capsys.readouterr()
+    assert "REGRESSION conway-8192" in cap.err
+    assert "regress-check" in cap.out
+    # An empty fresh run has nothing to judge and must not fail the round.
+    assert bench_suite._regress_check([], 0.25, 2) == 0
+
+
+def test_bench_suite_snapshot_helpers_never_raise():
+    import bench_suite
+    from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+    from akka_game_of_life_tpu.obs.programs import get_programs
+
+    programs = get_programs()
+    programs.reset()
+    try:
+        assert bench_suite.programs_snapshot() == {}  # empty ledger: no block
+        programs.configure(metrics=MetricsRegistry())
+        wrapped = programs.wrap("stencil", "k", lambda: None)
+        wrapped()
+        snap = bench_suite.programs_snapshot()
+        assert snap["families"]["stencil"]["calls"] == 1
+    finally:
+        programs.reset()

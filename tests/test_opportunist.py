@@ -16,7 +16,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[1]
 
 ALL_STAGES = (
-    "prewarm headline bench-full bench-sharded tpu-tests-auto "
+    "prewarm headline profile-headline bench-full bench-sharded tpu-tests-auto "
     "product-run product-run-defer-obs tune-65536 tune-8192 "
     "tune-gen-8192 tune-ltl-8192 selftest product-run-sparse-obs "
     "product-run-60 tune-65536-vmem"
@@ -49,6 +49,10 @@ def test_priority_order_and_stamps(tmp_path):
     # Stamping the head of the queue advances to the next priority.
     (tmp_path / "done" / "prewarm").touch()
     (tmp_path / "done" / "headline").touch()
+    out = _bash(tmp_path, "next_stage")
+    # The profiler capture rides directly behind the headline it traces.
+    assert out.strip() == "profile-headline"
+    (tmp_path / "done" / "profile-headline").touch()
     out = _bash(tmp_path, "next_stage")
     assert out.strip() == "bench-full"
     # All stamped -> empty (loop would exit).
@@ -88,6 +92,7 @@ def test_next_stage_skips_parked(tmp_path):
     (tmp_path / "done").mkdir()
     (tmp_path / "done" / "prewarm").touch()
     (tmp_path / "done" / "headline.parked").touch()
+    (tmp_path / "done" / "profile-headline").touch()
     assert _bash(tmp_path, "next_stage").strip() == "bench-full"
 
 
@@ -184,7 +189,7 @@ def test_main_loop_runs_queue_and_unparks_on_fresh_window(tmp_path):
     (tmp_path / "done").mkdir()
     (tmp_path / "done" / "headline.parked").write_text("9999999999")
     # Pre-stamp everything after bench-full so the loop stays short.
-    for s in ALL_STAGES[3:]:
+    for s in ALL_STAGES[4:]:
         (tmp_path / "done" / s).touch()
     body = """
 WEDGE_SLEEP_S=0  # the env override is read at source time; set the var
@@ -200,5 +205,8 @@ main
     assert "all stages done" in out
     order = (tmp_path / "order").read_text().split()
     # The parked headline came back (fresh window) and priority held.
-    assert order == ["ran", "prewarm", "ran", "headline", "ran", "bench-full"]
+    assert order == [
+        "ran", "prewarm", "ran", "headline",
+        "ran", "profile-headline", "ran", "bench-full",
+    ]
     assert not (tmp_path / "done" / "headline.parked").exists()

@@ -1,0 +1,448 @@
+"""Compile & device-cost observatory (obs/programs.py) — tier-1.
+
+Four layers, matching the subsystem:
+
+- **ledger** (`ProgramRegistry`): wrap/cost accounting against an isolated
+  metrics registry, the disable pass-through, warm/storm edge semantics
+  (one alert per novel post-warm program, event + flight dump);
+- **federation**: `merge_remote`/`forget_remote` — family gauges merge
+  across members and every label a departed member contributed is
+  reclaimed, devices namespaced ``member:device``;
+- **the drill** (acceptance criterion): a real `SessionRouter` warms
+  itself after one steady-state tick, then a session admitted in a NEW
+  size class fires exactly one compile storm on its first batch;
+- **HTTP surface**: `/programs` + `/cost` + `/profile` over a live
+  `MetricsServer` — 200s, 405 on wrong methods, seconds via query string
+  AND JSON body, 409/429 mapping straight from `ProfilerCapture`'s
+  single-flight/rate-limit contract on an injected clock.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from akka_game_of_life_tpu.obs.catalog import install
+from akka_game_of_life_tpu.obs.httpd import MetricsServer
+from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+from akka_game_of_life_tpu.obs.programs import (
+    ProgramRegistry,
+    get_programs,
+    http_routes,
+    registered_jit,
+    stencil_cost,
+)
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.profiling import ProfilerCapture
+from akka_game_of_life_tpu.serve import SessionRouter
+from akka_game_of_life_tpu.serve import batch as sbatch
+
+
+def _registry():
+    return install(MetricsRegistry())
+
+
+def _fresh(**kw):
+    reg = ProgramRegistry(node=kw.pop("node", "test"))
+    reg.configure(metrics=_registry(), **kw)
+    return reg
+
+
+class _RecEvents:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, name, **fields):
+        self.events.append((name, fields))
+
+
+class _RecFlight:
+    def __init__(self):
+        self.dumps = []
+
+    def dump(self, reason, **fields):
+        self.dumps.append(reason)
+        return f"/tmp/{reason}"
+
+
+# -- ledger --------------------------------------------------------------------
+
+
+def test_wrap_times_counts_and_prices():
+    reg = _fresh()
+    calls = []
+
+    def fn(x):
+        calls.append(x)
+        return x * 2
+
+    wrapped = reg.wrap(
+        "stencil", ("step", "B3/S23", 64), fn,
+        cost=stencil_cost(64, 64, steps=4),
+    )
+    assert wrapped is not fn and wrapped.__wrapped__ is fn
+    assert wrapped(3) == 6 and wrapped(5) == 10
+    assert calls == [3, 5]
+
+    snap = reg.snapshot()
+    assert snap["node"] == "test" and not snap["warm"]
+    (rec,) = snap["programs"]
+    assert rec["family"] == "stencil"
+    assert rec["key"] == repr(("step", "B3/S23", 64))
+    assert rec["calls"] == 2
+    assert rec["compile_seconds"] is not None
+    assert rec["seconds"] >= rec["compile_seconds"] >= 0.0
+    # Static cost dict: every call adds one plan-priced invocation.
+    want = stencil_cost(64, 64, steps=4)
+    assert rec["cells"] == pytest.approx(2 * want["cells"])
+    assert rec["bytes"] == pytest.approx(2 * want["bytes"])
+    assert rec["flops"] == pytest.approx(2 * want["flops"])
+
+    fams = reg.family_summary()
+    assert fams["stencil"]["programs"] == 1
+    assert fams["stencil"]["calls"] == 2
+
+    cost = reg.cost_doc()
+    st = cost["families"]["stencil"]
+    assert st["cell_updates_per_s"] > 0
+    assert st["arithmetic_intensity"] == pytest.approx(
+        want["flops"] / want["bytes"]
+    )
+    assert 0 <= st["vs_r3b_headline"] == (
+        st["cell_updates_per_s"] / cost["headline_cells_per_s"]
+    )
+
+
+def test_wrap_callable_cost_prices_from_call_args():
+    reg = _fresh()
+
+    class Board:
+        shape = (8, 16, 16)
+
+    wrapped = reg.wrap(
+        "serve_batch", (16, 4), lambda b: b,
+        cost=lambda b: stencil_cost(16, 16, 4, boards=b.shape[0]),
+    )
+    wrapped(Board())
+    (rec,) = reg.snapshot()["programs"]
+    assert rec["cells"] == pytest.approx(8 * 16 * 16 * 4)
+
+
+def test_disabled_registry_is_passthrough():
+    reg = _fresh()
+    reg.configure(enabled=False)
+
+    def fn():
+        return 1
+
+    assert reg.wrap("stencil", "k", fn) is fn
+    assert reg.snapshot()["programs"] == []
+
+
+def test_storm_fires_once_per_novel_post_warm_program():
+    events, flight = _RecEvents(), _RecFlight()
+    reg = _fresh(node="stormy", events=events, flight=flight)
+
+    pre = reg.wrap("serve_batch", (16, 2), lambda: "pre")
+    pre()
+    reg.mark_warm()
+    assert reg.warm and reg.storms == 0
+    pre()  # a warmed program re-running is steady state, not a storm
+    assert reg.storms == 0
+
+    post = reg.wrap("serve_batch", (64, 2), lambda: "post")
+    assert reg.storms == 0  # registration alone is not a compile
+    post()
+    assert reg.storms == 1
+    post()  # second call of the same program: still one storm
+    assert reg.storms == 1
+
+    (name, fields), = events.events
+    assert name == "compile_storm"
+    assert fields["family"] == "serve_batch"
+    assert fields["node"] == "stormy"
+    assert fields["compile_seconds"] is not None
+    assert flight.dumps == ["compile_storm"]
+
+    summary = reg.summary()
+    assert summary["storms"] == 1 and summary["warm"]
+    assert summary["families"]["serve_batch"]["programs"] == 2
+
+
+# -- cluster federation --------------------------------------------------------
+
+
+def _cost_frame(**kw):
+    frame = {
+        "node": "w1",
+        "warm": True,
+        "storms": 2,
+        "families": {
+            "bitpack": {
+                "programs": 3, "compile_seconds": 1.5, "calls": 10,
+                "seconds": 2.0, "cells": 4.0e9, "bytes": 1.0e9,
+                "flops": 7.2e10,
+            }
+        },
+        "devices": {"TPU_0": {"bytes_in_use": 512, "peak_bytes_in_use": 640}},
+    }
+    frame.update(kw)
+    return frame
+
+
+def test_merge_and_forget_remote_reclaims_every_label():
+    reg = _fresh()
+    metrics = reg._metrics  # noqa: SLF001 — asserting the exported surface
+    local = reg.wrap("stencil", "k", lambda: None)
+    local()
+
+    reg.merge_remote("w1", _cost_frame())
+    live = metrics.gauge("gol_programs_live", "", ("family",))
+    by_family = {
+        labels["family"]: child.value for labels, child in live.series()
+    }
+    assert by_family == {"stencil": 1, "bitpack": 3}
+    devs = metrics.gauge("gol_device_bytes_in_use", "", ("device",))
+    dev_labels = {labels["device"] for labels, _ in devs.series()}
+    assert "w1:TPU_0" in dev_labels
+
+    merged = reg.cost_doc()
+    assert merged["families"]["bitpack"]["cell_updates_per_s"] == (
+        pytest.approx(4.0e9 / 2.0)
+    )
+    assert merged["storms"] == 2  # remote storms fold into the cluster view
+    assert "w1:TPU_0" in merged["devices"]
+
+    health = reg.health_summary()
+    assert health["members"]["w1"] == {
+        "warm": True, "storms": 2, "programs": 3,
+    }
+    assert health["programs"] == 4  # 1 local + 3 remote
+
+    # /programs carries the member's raw frame for drill-down.
+    assert reg.snapshot()["members"]["w1"]["families"]["bitpack"]["calls"] == 10
+
+    reg.forget_remote("w1")
+    by_family = {
+        labels["family"]: child.value
+        for labels, child in live.series()
+    }
+    assert by_family == {"stencil": 1}  # bitpack reclaimed, not zeroed
+    dev_labels = {labels["device"] for labels, _ in devs.series()}
+    assert "w1:TPU_0" not in dev_labels
+    assert reg.health_summary()["members"] == {}
+
+
+def test_refresh_device_gauges_reclaims_stale_devices():
+    reg = _fresh()
+    metrics = reg._metrics  # noqa: SLF001
+    reg.refresh_device_gauges(
+        {"TPU_0": {"bytes_in_use": 1}, "TPU_1": {"bytes_in_use": 2}}
+    )
+    gauge = metrics.gauge("gol_device_bytes_in_use", "", ("device",))
+    assert {l["device"] for l, _ in gauge.series()} == {"TPU_0", "TPU_1"}
+    reg.refresh_device_gauges({"TPU_0": {"bytes_in_use": 3}})
+    assert {l["device"] for l, _ in gauge.series()} == {"TPU_0"}
+
+
+# -- the compile-storm drill on a real router ---------------------------------
+
+
+def _cfg(**kw):
+    kw.setdefault("role", "serve")
+    kw.setdefault("flight_dir", "")
+    return SimulationConfig(**kw)
+
+
+def _wait_for(cond, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def test_compile_storm_drill_through_warmed_router():
+    """The acceptance drill: admit one size class, let the ticker warm,
+    then admit a NEW size class — its first batch is a compile storm
+    (event + flight dump + counter), exactly once."""
+    programs = get_programs()
+    events, flight = _RecEvents(), _RecFlight()
+    programs.reset()
+    sbatch.batch_step_fn.cache_clear()  # force real re-registration
+    programs.configure(
+        node="drill", events=events, flight=flight, metrics=_registry()
+    )
+    try:
+        with SessionRouter(_cfg(), registry=_registry()) as router:
+            doc = router.create(
+                tenant="t", rule="conway", height=16, width=16, seed=1
+            )
+            router.step(doc["id"], steps=2)  # tick compiles → not steady
+            router.step(doc["id"], steps=2)  # steady tick → warm
+            assert _wait_for(lambda: programs.warm)
+            assert programs.storms == 0
+
+            doc2 = router.create(
+                tenant="t", rule="conway", height=48, width=48, seed=2
+            )
+            router.step(doc2["id"], steps=2)  # NEW size class post-warm
+            assert programs.storms == 1
+            names = [n for n, _ in events.events]
+            assert names.count("compile_storm") == 1
+            assert flight.dumps == ["compile_storm"]
+
+            # The same class again is now part of the working set.
+            router.step(doc2["id"], steps=2)
+            assert programs.storms == 1
+    finally:
+        programs.reset()
+        sbatch.batch_step_fn.cache_clear()
+
+
+# -- ProfilerCapture contract --------------------------------------------------
+
+
+def _capture(tmp_path, **kw):
+    taken = []
+    kw.setdefault("clock", lambda: kw["_now"][0])
+    return taken, ProfilerCapture(
+        str(tmp_path),
+        node=kw.pop("node", "t"),
+        max_seconds=kw.pop("max_seconds", 5.0),
+        min_interval_s=kw.pop("min_interval_s", 60.0),
+        clock=kw.pop("clock"),
+        sleep=lambda s: taken.append(s),
+        start=lambda path: None,
+        stop=lambda: None,
+    )
+
+
+def test_profiler_capture_clamps_rate_limits_and_sequences(tmp_path):
+    now = [1000.0]
+    taken, cap = _capture(tmp_path, _now=now)
+
+    res = cap.capture(99.0)  # clamped to max_seconds
+    assert res["ok"] and res["seconds"] == 5.0 and taken == [5.0]
+    assert res["artifact"].endswith("profile-t-0001")
+
+    res2 = cap.capture(1.0)  # same instant: rate-limited
+    assert not res2["ok"] and res2["status"] == 429
+    assert res2["retry_after_s"] == pytest.approx(60.0)
+
+    now[0] += 61.0
+    res3 = cap.capture(None)  # default window, fresh sequence number
+    assert res3["ok"] and res3["seconds"] == 3.0
+    assert res3["artifact"].endswith("profile-t-0002")
+
+    now[0] += 61.0
+    res4 = cap.capture(0.0)  # floor: a zero-length capture is 0.1 s
+    assert res4["ok"] and res4["seconds"] == 0.1
+
+
+def test_profiler_capture_single_flight(tmp_path):
+    import threading
+
+    now = [0.0]
+    started, release = threading.Event(), threading.Event()
+
+    def slow_sleep(_s):
+        started.set()
+        release.wait(30)
+
+    cap = ProfilerCapture(
+        str(tmp_path), node="t", min_interval_s=0.0, clock=lambda: now[0],
+        sleep=slow_sleep, start=lambda path: None, stop=lambda: None,
+    )
+    t = threading.Thread(target=cap.capture, args=(1.0,), daemon=True)
+    t.start()
+    assert started.wait(30)
+    busy = cap.capture(1.0)
+    assert not busy["ok"] and busy["status"] == 409
+    release.set()
+    t.join(30)
+
+
+# -- HTTP surface --------------------------------------------------------------
+
+
+def _http(base, method, path, doc=None, raw=None):
+    data = raw if raw is not None else (
+        json.dumps(doc).encode() if doc is not None else None
+    )
+    req = urllib.request.Request(base + path, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_programs_cost_profile_contract(tmp_path):
+    reg = _fresh(node="edge")
+    wrapped = reg.wrap("stencil", "k", lambda: None)
+    wrapped()
+    now = [0.0]
+    cap = ProfilerCapture(
+        str(tmp_path), node="edge", max_seconds=5.0, min_interval_s=60.0,
+        clock=lambda: now[0], sleep=lambda s: None,
+        start=lambda path: None, stop=lambda: None,
+    )
+    metrics = _registry()
+    server = MetricsServer(
+        metrics, port=0, host="127.0.0.1",
+        routes=http_routes(registry=reg, profile=cap.capture),
+    )
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        status, doc = _http(base, "GET", "/programs")
+        assert status == 200 and doc["node"] == "edge"
+        assert doc["programs"][0]["family"] == "stencil"
+
+        status, doc = _http(base, "GET", "/cost?window=ignored")
+        assert status == 200 and "stencil" in doc["families"]
+        assert doc["headline_cells_per_s"] == pytest.approx(1.56e12)
+
+        # Wrong methods are 405, never a silent 200.
+        assert _http(base, "POST", "/programs", {})[0] == 405
+        assert _http(base, "POST", "/cost", {})[0] == 405
+        assert _http(base, "GET", "/profile")[0] == 405
+
+        # seconds via query string…
+        status, doc = _http(base, "POST", "/profile?seconds=2", raw=b"")
+        assert status == 200 and doc["seconds"] == 2.0
+
+        # …is rate-limited on the second ask (429 + retry_after_s)…
+        status, doc = _http(base, "POST", "/profile?seconds=2", raw=b"")
+        assert status == 429 and doc["retry_after_s"] > 0
+
+        # …and via JSON body once the interval passes.
+        now[0] += 61.0
+        status, doc = _http(base, "POST", "/profile", {"seconds": 1.5})
+        assert status == 200 and doc["seconds"] == 1.5
+
+        now[0] += 61.0
+        assert _http(base, "POST", "/profile", raw=b"not json")[0] == 400
+        assert _http(
+            base, "POST", "/profile?seconds=bogus", raw=b""
+        )[0] == 400
+    finally:
+        server.close()
+
+
+def test_registered_jit_routes_through_global_registry():
+    programs = get_programs()
+    programs.reset()
+    programs.configure(node="g", metrics=_registry())
+    try:
+        wrapped = registered_jit("ltl", ("r", 7), lambda x: x)
+        assert wrapped(4) == 4
+        snap = programs.snapshot()
+        assert [p["family"] for p in snap["programs"]] == ["ltl"]
+    finally:
+        programs.reset()

@@ -1,0 +1,238 @@
+#!/usr/bin/env python3
+"""Regression gate over the repo's bench trajectory: fail loudly when a
+config's LATEST round falls off its own history.
+
+Builds on :mod:`tools.bench_trend` (same record parsing: driver
+``BENCH_r*.json`` / ``MULTICHIP_r*.json`` records plus fresh
+``bench_suite.py`` JSONL via positional args).  For every config with at
+least ``--min-rounds`` measured rounds, the newest round is compared
+against the MEDIAN of the earlier rounds — the trajectory, not just the
+previous point, so one historical outlier can't mask (or fake) a
+regression:
+
+- higher-is-better units (``cell-updates/sec``, ``boards/sec``, ``x``,
+  ``steps/sec``): regressed when ``latest < median * (1 - threshold)``;
+- lower-is-better units (``seconds``): regressed when
+  ``latest > median * (1 + threshold)``;
+- other units (capability records like ``radius``) are informational and
+  never gate.
+
+Exit status: 0 = no config regressed (including "nothing had enough
+history"), 1 = at least one regression, each named on stderr and in the
+``--json`` document.  Exit 2 = usage errors (missing files), matching
+bench_trend.
+
+Usage:
+    python tools/bench_regress.py                      # repo-root records
+    python tools/bench_regress.py fresh.jsonl --round 11
+    python tools/bench_regress.py --threshold 0.4 --json
+
+Driven by ``tests/test_bench_regress.py`` (tier-1) against the real
+shipped records.  No third-party imports.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import statistics
+import sys
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parent
+sys.path.insert(0, str(_HERE))
+
+from bench_trend import _RECORD_GLOBS, build_trend, scan_record_file  # noqa: E402
+
+# Unit → direction.  A unit absent here is a capability/latency-free
+# record the gate reports as "skipped", never judges.
+_HIGHER_IS_BETTER = ("cell-updates/sec", "boards/sec", "x", "steps/sec")
+_LOWER_IS_BETTER = ("seconds",)
+
+
+@dataclasses.dataclass
+class RegressPolicy:
+    """The gate's two knobs — mirrored 1:1 by the ``--bench-regress-*``
+    flag family (graftlint GL-CFG11 checks the bijection).
+
+    ``threshold``: fractional drop from the trajectory median that fails
+    a config (0.25 = a quarter off its own history).
+    ``min_rounds``: measured rounds (latest included) a config needs
+    before it gates at all — below this there is no trajectory to
+    regress from, only noise.
+    """
+
+    threshold: float = 0.25
+    min_rounds: int = 2
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.threshold < 1.0:
+            raise ValueError(
+                f"threshold must be in (0, 1), got {self.threshold}"
+            )
+        if self.min_rounds < 2:
+            raise ValueError(
+                f"min_rounds needs latest + history, got {self.min_rounds}"
+            )
+
+
+def check_trend(trend: dict, policy: RegressPolicy) -> dict:
+    """Judge one :func:`bench_trend.build_trend` table.  Returns the
+    machine-readable verdict document::
+
+        {"ok": bool, "threshold": f, "min_rounds": n,
+         "regressions": [{config, unit, latest_round, latest, median,
+                          ratio, history_rounds}],
+         "checked": [config...], "skipped": {config: reason}}
+    """
+    regressions = []
+    checked = []
+    skipped = {}
+    for config in sorted(trend):
+        entry = trend[config]
+        unit = entry.get("unit")
+        points = sorted(
+            (
+                (rnd, float(v))
+                for rnd, v in entry["rounds"].items()
+                if rnd is not None and isinstance(v, (int, float))
+            ),
+            key=lambda p: p[0],
+        )
+        if unit in _HIGHER_IS_BETTER:
+            higher = True
+        elif unit in _LOWER_IS_BETTER:
+            higher = False
+        else:
+            skipped[config] = f"unit {unit!r} not direction-mapped"
+            continue
+        if len(points) < policy.min_rounds:
+            skipped[config] = (
+                f"{len(points)} round(s) < min_rounds={policy.min_rounds}"
+            )
+            continue
+        latest_round, latest = points[-1]
+        median = statistics.median(v for _, v in points[:-1])
+        if median == 0:
+            skipped[config] = "zero trajectory median"
+            continue
+        ratio = latest / median
+        bad = (
+            ratio < 1.0 - policy.threshold
+            if higher
+            else ratio > 1.0 + policy.threshold
+        )
+        checked.append(config)
+        if bad:
+            regressions.append(
+                {
+                    "config": config,
+                    "unit": unit,
+                    "latest_round": latest_round,
+                    "latest": latest,
+                    "median": median,
+                    "ratio": ratio,
+                    "history_rounds": [r for r, _ in points[:-1]],
+                }
+            )
+    return {
+        "ok": not regressions,
+        "threshold": policy.threshold,
+        "min_rounds": policy.min_rounds,
+        "regressions": regressions,
+        "checked": checked,
+        "skipped": skipped,
+    }
+
+
+def gather_pairs(root: Path, extra, extra_round=None):
+    """All (round, bench-line) pairs: repo records first, then fresh
+    files (optionally relabeled to ``extra_round``)."""
+    pairs = []
+    for pattern in _RECORD_GLOBS:
+        for path in sorted(root.glob(pattern)):
+            pairs.extend(scan_record_file(path))
+    for name in extra:
+        path = Path(name)
+        if not path.exists():
+            raise FileNotFoundError(name)
+        for rnd, rec in scan_record_file(path):
+            pairs.append((extra_round if extra_round is not None else rnd, rec))
+    return pairs
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "extra", nargs="*",
+        help="fresh bench output files (JSONL) judged as the latest round",
+    )
+    parser.add_argument(
+        "--dir", default=None,
+        help="directory holding the BENCH_r*/MULTICHIP_r* records "
+        "(default: the repo root above this tool)",
+    )
+    parser.add_argument(
+        "--round", type=int, default=None,
+        help="round label for the extra files (default: parsed from each "
+        "filename's _rN)",
+    )
+    # The --bench-regress-* spellings are the flag family bench_suite.py
+    # forwards; bare spellings here since the tool IS the bench-regress
+    # namespace.
+    parser.add_argument(
+        "--threshold", type=float, default=RegressPolicy.threshold,
+        help="fractional drop from the trajectory median that fails "
+        "(default %(default)s)",
+    )
+    parser.add_argument(
+        "--min-rounds", type=int, default=RegressPolicy.min_rounds,
+        help="measured rounds (latest included) a config needs before it "
+        "gates (default %(default)s)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the verdict as one JSON document on stdout",
+    )
+    args = parser.parse_args(argv)
+    try:
+        policy = RegressPolicy(
+            threshold=args.threshold, min_rounds=args.min_rounds
+        )
+    except ValueError as e:
+        parser.error(str(e))
+    root = Path(args.dir) if args.dir else _HERE.parent
+    try:
+        pairs = gather_pairs(root, args.extra, args.round)
+    except FileNotFoundError as e:
+        print(f"bench_regress: no such file: {e.args[0]}", file=sys.stderr)
+        return 2
+    if not pairs:
+        print(
+            f"bench_regress: no BENCH-format lines found under {root}",
+            file=sys.stderr,
+        )
+        return 2
+    verdict = check_trend(build_trend(pairs), policy)
+    if args.json:
+        print(json.dumps(verdict, indent=2))
+    else:
+        print(
+            f"bench_regress: {len(verdict['checked'])} config(s) checked, "
+            f"{len(verdict['skipped'])} skipped, "
+            f"{len(verdict['regressions'])} regression(s) "
+            f"(threshold {policy.threshold:.0%})"
+        )
+    for r in verdict["regressions"]:
+        print(
+            f"bench_regress: REGRESSION {r['config']}: r{r['latest_round']} "
+            f"= {r['latest']:.4g} {r['unit']} vs trajectory median "
+            f"{r['median']:.4g} (x{r['ratio']:.2f})",
+            file=sys.stderr,
+        )
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

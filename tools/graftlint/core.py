@@ -38,6 +38,7 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
     ("GL-HAZ02", "64-bit jnp dtype in x64-disabled kernel code"),
     ("GL-HAZ03", "device compute / block_until_ready under a lock"),
     ("GL-HAZ04", "bare wall clock inside an injectable-clock class"),
+    ("GL-HAZ05", "cached jit factory not routed through registered_jit"),
     ("GL-META01", "waiver without a reason"),
     ("GL-CFG01", "--chaos-net-* flags ↔ NetworkChaosConfig fields"),
     ("GL-CFG02", "--ring-* flags ↔ SimulationConfig ring_* fields"),
@@ -53,6 +54,8 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
      "serve_tiled_resident* fields"),
     ("GL-CFG10", "--serve-trace/--serve-slo-*/--serve-canary* flags ↔ "
      "SimulationConfig observability fields"),
+    ("GL-CFG11", "--obs-* flags ↔ SimulationConfig obs_* fields and "
+     "--bench-regress-* flags ↔ RegressPolicy fields"),
     ("GL-DOC01", "gol_* metric literals ↔ obs catalog ↔ OPERATIONS.md"),
     ("GL-DOC02", "span names ↔ SPAN_CATALOG ↔ OPERATIONS.md"),
     ("GL-DOC03", "protocol messages ↔ OPERATIONS.md table"),

@@ -1,6 +1,6 @@
 """GL-HAZ: JAX / threading hazard pass.
 
-Four checks, each a mechanical version of a bug this repo actually shipped
+Five checks, each a mechanical version of a bug this repo actually shipped
 or reviewed out by luck:
 
 - **GL-HAZ01** — ``functools.lru_cache``/``cache`` decorating an instance
@@ -23,6 +23,11 @@ or reviewed out by luck:
   parameter.  The injection point exists so tests control time; a bare
   call re-couples the class to the wall clock (the drift the
   SessionRouter's TTL tests exist to prevent).
+- **GL-HAZ05** — a module-level ``lru_cache``/``cache``-decorated factory
+  whose body compiles via ``jax.jit`` but never routes the result through
+  ``obs.programs.registered_jit``.  Every cached jit site is a program the
+  ledger must price: an unrouted factory is invisible to ``/programs`` and
+  ``/cost``, and its recompiles can never trip the compile-storm alarm.
 """
 
 from __future__ import annotations
@@ -128,7 +133,7 @@ class _Checker(ast.NodeVisitor):
 
     visit_AsyncWith = visit_With
 
-    # -- GL-HAZ01 ------------------------------------------------------------
+    # -- GL-HAZ01 / GL-HAZ05 -------------------------------------------------
 
     def _visit_func(self, node) -> None:
         if self.cls_stack:
@@ -144,6 +149,32 @@ class _Checker(ast.NodeVisitor):
                             f"class-level cache for the process lifetime — "
                             f"cache on the instance or a module function",
                         )
+        else:
+            cache_dec = next(
+                (d for d in node.decorator_list if _is_cache_decorator(d)),
+                None,
+            )
+            if cache_dec is not None:
+                uses_jit = False
+                registered = False
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id == "registered_jit":
+                        registered = True
+                    elif (
+                        isinstance(sub, ast.Attribute)
+                        and sub.attr == "jit"
+                        and _root_name(sub) == "jax"
+                    ):
+                        uses_jit = True
+                if uses_jit and not registered:
+                    self._flag(
+                        cache_dec, "GL-HAZ05",
+                        f"cached jit factory {node.name} compiles via "
+                        f"jax.jit but never routes through "
+                        f"obs.programs.registered_jit — the program ledger "
+                        f"(/programs, /cost) cannot price it and its "
+                        f"recompiles can never trip the compile-storm alarm",
+                    )
         self.generic_visit(node)
 
     visit_FunctionDef = visit_AsyncFunctionDef = _visit_func

@@ -126,6 +126,29 @@ SERVE_OBS_CONFIG = FlagConfigSpec(
     flag_strip="--serve", field_prefix="serve_",
 )
 
+# The compile-&-cost observatory's knob surface is split across two
+# processes, so GL-CFG11 is two specs under one pass id: the ``--obs-*``
+# flag family ↔ SimulationConfig ``obs_*`` fields (program ledger gate,
+# cost-frame cadence, profiler clamps — plus the pre-existing obs_defer/
+# obs_digest pair the same strip covers), and the ``--bench-regress-*``
+# flag family in bench_suite.py ↔ the RegressPolicy dataclass in
+# tools/bench_regress.py (the regression gate's two knobs).  Either half
+# drifting means an operator knob that sets nothing.
+OBS_PROGRAMS_CONFIG = FlagConfigSpec(
+    name="obs_programs_config", pass_id="GL-CFG11",
+    flag_regex=r"""["'](--obs-[a-z0-9-]+)["']""",
+    config_class="SimulationConfig", field_regex=r"^    (obs_\w+)\s*:",
+    flag_strip="--obs", field_prefix="obs_",
+)
+
+BENCH_REGRESS_CONFIG = FlagConfigSpec(
+    name="bench_regress_config", pass_id="GL-CFG11",
+    flag_regex=r"""["'](--bench-regress-[a-z0-9-]+)["']""",
+    config_class="RegressPolicy", field_regex=r"^    (\w+)\s*:",
+    flag_strip="--bench-regress",
+    cli_path="bench_suite.py", config_path="tools/bench_regress.py",
+)
+
 SPARSE_CONFIG = FlagConfigSpec(
     name="sparse_config", pass_id="GL-CFG05",
     flag_regex=r"""["'](--sparse-[a-z0-9-]+)["']""",
@@ -307,6 +330,7 @@ GRAFTLINT_DOC = CatalogSpec(
 SPECS = (
     CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SERVE_DOC,
     SERVE_REPLICATE_CONFIG, SERVE_TILED_RESIDENT_CONFIG, SERVE_OBS_CONFIG,
+    OBS_PROGRAMS_CONFIG, BENCH_REGRESS_CONFIG,
     SPARSE_CONFIG, FF_CONFIG, FF_DOC, KERNEL_CONFIG, METRICS_DOC,
     TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
 )

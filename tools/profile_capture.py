@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""One on-demand profiler capture around the headline-shaped program.
+
+The TPU-campaign counterpart of ``POST /profile``: build the bit-packed
+multi-step program at a given size, warm it OUTSIDE the trace (the
+compile is priced by the program ledger, not re-profiled every campaign),
+then run timed sweeps under ``jax.profiler`` via
+:class:`runtime.profiling.ProfilerCapture` — the loadable artifact
+(trace + memory viewer) lands under ``artifacts/`` beside the flight
+dumps, and the emitted JSON line carries the artifact path, the device
+memory watermarks, and the program-ledger summary so the campaign record
+is self-contained.
+
+Usage:
+    python tools/profile_capture.py                  # 8192², 64 steps, 3 s
+    python tools/profile_capture.py --size 65536 --seconds 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--size", type=int, default=8192)
+    parser.add_argument("--steps", type=int, default=64)
+    parser.add_argument("--seconds", type=float, default=3.0)
+    parser.add_argument("--out", default="artifacts")
+    parser.add_argument("--node", default="tpu-campaign")
+    args = parser.parse_args(argv)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from akka_game_of_life_tpu.ops import bitpack
+    from akka_game_of_life_tpu.ops.rules import CONWAY
+    from akka_game_of_life_tpu.obs.programs import get_programs
+    from akka_game_of_life_tpu.runtime.profiling import ProfilerCapture
+
+    run = bitpack.packed_multi_step_fn(CONWAY, args.steps)
+    rng = np.random.default_rng(0)
+    words = jnp.asarray(
+        rng.integers(
+            0, 2**32, size=(args.size, args.size // 32), dtype=np.uint32
+        )
+    )
+    words = run(words)
+    words.block_until_ready()  # warm: compile stays out of the trace
+
+    stop = threading.Event()
+
+    def churn() -> None:
+        w = words
+        while not stop.is_set():
+            w = run(w)
+            w.block_until_ready()
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    cap = ProfilerCapture(
+        args.out, node=args.node, max_seconds=60.0, min_interval_s=0.0
+    )
+    result = cap.capture(args.seconds)
+    stop.set()
+    t.join(timeout=30)
+    result["programs"] = get_programs().summary()
+    print(json.dumps(result), flush=True)
+    return 0 if result.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
