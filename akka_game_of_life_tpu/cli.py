@@ -613,6 +613,44 @@ def _add_serve(p: argparse.ArgumentParser) -> None:
         "--serve-canary-side", type=int, default=None, metavar="N",
         help="canary board side, square (default 32)",
     )
+    g.add_argument(
+        "--serve-memo",
+        choices=["on", "off"],
+        default=None,
+        help="cross-tenant memoized macro-stepping: content-addressed "
+        "(rule, block) → center-after-steps cache shared across every "
+        "session, with sampled digest certification against direct "
+        "iteration (default off)",
+    )
+    g.add_argument(
+        "--serve-memo-block", type=int, default=None, metavar="B",
+        help="macro-cell context block side (power of two >= 16); each "
+        "macro-round advances B/4 epochs (default 64)",
+    )
+    g.add_argument(
+        "--serve-memo-max-mb", type=int, default=None, metavar="MB",
+        help="memo cache byte budget, LRU beyond it (default 256)",
+    )
+    g.add_argument(
+        "--serve-memo-hit-floor", type=float, default=None, metavar="F",
+        help="post-warmup per-round tile hit-rate floor below which a "
+        "session's round aborts to the dense path (default 0.25)",
+    )
+    g.add_argument(
+        "--serve-memo-warmup", type=int, default=None, metavar="N",
+        help="ungated probe macro-rounds per session before the hit "
+        "floor applies (default 16)",
+    )
+    g.add_argument(
+        "--serve-memo-disable-after", type=int, default=None, metavar="N",
+        help="consecutive below-floor rounds that disable memoization "
+        "for the session (default 3)",
+    )
+    g.add_argument(
+        "--serve-memo-certify-every", type=int, default=None, metavar="N",
+        help="certify every Nth macro-round per session against the "
+        "dense kernel by digest (0 = never; default 64)",
+    )
 
 
 def _serve_overrides(args: argparse.Namespace) -> dict:
@@ -679,6 +717,13 @@ def _serve_overrides(args: argparse.Namespace) -> dict:
             else None
         ),
         "serve_canary_side": args.serve_canary_side,
+        "serve_memo": on_off[args.serve_memo],
+        "serve_memo_block": args.serve_memo_block,
+        "serve_memo_max_mb": args.serve_memo_max_mb,
+        "serve_memo_hit_floor": args.serve_memo_hit_floor,
+        "serve_memo_warmup": args.serve_memo_warmup,
+        "serve_memo_disable_after": args.serve_memo_disable_after,
+        "serve_memo_certify_every": args.serve_memo_certify_every,
     }
 
 

@@ -235,6 +235,39 @@ CATALOG = (
     ("gol_canary_sessions", "gauge",
      "Canary sessions currently pinned (one per serving worker on the "
      "cluster plane)", ()),
+    # -- cross-tenant memoized macro-stepping (serve/memo.py) -----------------
+    ("gol_serve_memo_hits_total", "counter",
+     "Macro-cell cache hits per tenant (zero-block shortcuts included: "
+     "a dead tile is a free hit)", ("tenant",)),
+    ("gol_serve_memo_misses_total", "counter",
+     "Macro-cell cache misses per tenant (each unique miss costs one "
+     "slot in the round's batched device call)", ("tenant",)),
+    ("gol_serve_memo_epochs_total", "counter",
+     "Epochs advanced through memoized macro-rounds per tenant (the "
+     "fast-path share of gol_serve_steps_total)", ("tenant",)),
+    ("gol_serve_memo_entries", "gauge",
+     "Macro-cell cache entries resident (shared across all tenants)",
+     ()),
+    ("gol_serve_memo_bytes", "gauge",
+     "Macro-cell cache bytes resident (bounded by serve_memo_max_mb)",
+     ()),
+    ("gol_serve_memo_evictions_total", "counter",
+     "Macro-cell cache LRU evictions (byte budget pressure; an evicted "
+     "block recomputes on next miss)", ()),
+    ("gol_serve_memo_hit_rate", "gauge",
+     "Global macro-cell cache hit rate since start (hits / probes); the "
+     "cross-tenant sharing signal the runbook watches", ()),
+    ("gol_serve_memo_disables_total", "counter",
+     "Sessions adaptively retired from the memo plane (hit rate below "
+     "serve_memo_hit_floor for serve_memo_disable_after rounds, or a "
+     "certification mismatch)", ()),
+    ("gol_memo_certify_total", "counter",
+     "Sampled memo-vs-direct certifications run (every "
+     "serve_memo_certify_every-th macro-round per session)", ()),
+    ("gol_memo_certify_mismatches_total", "counter",
+     "Memo-vs-direct digest mismatches — a kernel/cache bug signal: "
+     "event + flight dump reason=memo_certify_mismatch, the direct "
+     "board wins, the session leaves the memo plane", ()),
     # -- logarithmic fast-forward (ops/fastforward.py) ------------------------
     ("gol_ff_jumps_total", "counter",
      "Fast-forward jumps committed by Simulation.fast_forward", ()),

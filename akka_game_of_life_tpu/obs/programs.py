@@ -163,6 +163,10 @@ class ProgramRegistry:
         self._events = None
         self._flight = None
         self._metrics = None
+        # Named cost sections: auxiliary planes (the serve memo cache)
+        # publish their economics into /cost and COST frames through a
+        # provider callable instead of the ledger knowing their shape.
+        self._sections: Dict[str, Callable[[], dict]] = {}
 
     def _reg(self):
         return self._metrics if self._metrics is not None else get_registry()
@@ -208,6 +212,7 @@ class ProgramRegistry:
             self._events = None
             self._flight = None
             self._metrics = None
+            self._sections.clear()
 
     # -- the one integration surface -----------------------------------------
 
@@ -308,6 +313,55 @@ class ProgramRegistry:
                 flight.dump("compile_storm", node=self.node)
             except Exception:  # noqa: BLE001
                 pass
+
+    # -- named cost sections -------------------------------------------------
+
+    def register_section(
+        self, name: str, provider: Callable[[], dict]
+    ) -> None:
+        """Attach a named cost section: ``provider()`` returns a flat dict
+        of numbers that rides :meth:`summary` (so workers federate it in
+        COST frames) and lands merged in :meth:`cost_doc`.  Re-registering
+        a name replaces the provider (routers restart in-process under
+        tests); :meth:`reset` clears them."""
+        with self._lock:
+            self._sections[name] = provider
+
+    def sections_doc(self) -> Dict[str, dict]:
+        """Every local section's current numbers.  A provider that raises
+        reports an empty section — /cost must render whatever else it has."""
+        with self._lock:
+            providers = dict(self._sections)
+        out: Dict[str, dict] = {}
+        for name, provider in providers.items():
+            try:
+                out[name] = dict(provider())
+            except Exception:  # noqa: BLE001 — reporting must never raise
+                out[name] = {}
+        return out
+
+    def _merged_sections(self) -> Dict[str, dict]:
+        """Cluster-merged sections: numeric fields sum across the local
+        doc and every member's COST frame; ``hit_rate`` is recomputed from
+        the merged hits/misses (a mean of ratios would weight a cold
+        worker's 0.0 the same as a hot one's 0.9)."""
+        merged: Dict[str, dict] = {}
+        with self._lock:
+            remotes = list(self._remote.values())
+        docs = [self.sections_doc()] + [
+            doc.get("sections") or {} for doc in remotes
+        ]
+        for sections in docs:
+            for name, fields in sections.items():
+                tot = merged.setdefault(name, {})
+                for k, v in fields.items():
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        tot[k] = tot.get(k, 0) + v
+        for tot in merged.values():
+            if "hits" in tot and "misses" in tot:
+                probes = tot["hits"] + tot["misses"]
+                tot["hit_rate"] = tot["hits"] / probes if probes else 0.0
+        return merged
 
     # -- warmup / storm state ------------------------------------------------
 
@@ -450,6 +504,7 @@ class ProgramRegistry:
             "storms": storms,
             "families": self.family_summary(),
             "devices": devices,
+            "sections": self.sections_doc(),
         }
 
     def snapshot(self) -> dict:
@@ -534,6 +589,7 @@ class ProgramRegistry:
             "storms": storms,
             "families": families,
             "devices": devices,
+            "sections": self._merged_sections(),
         }
 
     def health_summary(self) -> dict:
@@ -578,6 +634,11 @@ def registered_jit(family: str, key, fn: Callable, *, cost=None) -> Callable:
     integration every cached jit-factory site uses (GL-HAZ05 enforces
     that they do)."""
     return _GLOBAL.wrap(family, key, fn, cost=cost)
+
+
+def register_section(name: str, provider: Callable[[], dict]) -> None:
+    """Module-level sugar for ``get_programs().register_section(...)``."""
+    _GLOBAL.register_section(name, provider)
 
 
 # -- HTTP surface -------------------------------------------------------------

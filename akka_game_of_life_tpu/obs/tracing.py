@@ -81,6 +81,9 @@ SPAN_CATALOG = (
     # -- multi-tenant serving plane -------------------------------------------
     ("serve.tick", "one serving-plane engine tick (batched device programs "
      "over this tick's step jobs)"),
+    ("serve.memo", "one tick's memoized macro-step phase: lockstep "
+     "macro-rounds over the tick's eligible jobs, one batched device "
+     "call of deduplicated cache misses per round (child of serve.tick)"),
     ("serve.shard_migrate", "one session-shard migration, PREPARE to "
      "COMMIT or abort (cluster-sharded serving)"),
     ("serve.promote", "one shard replica promoted to primary after a "

@@ -274,3 +274,58 @@ def format_digest(v: int) -> str:
     """Canonical text form: 16 hex digits (what metrics lines, checkpoint
     meta, and the ``checkpoints`` CLI print)."""
     return f"{v:016x}"
+
+
+# -- block-granular lane reuse -------------------------------------------------
+
+
+class BlockLaneCache:
+    """Memoized per-block lane contributions for tiled re-digesting.
+
+    The digest is a sum over cells, so a board tiled into disjoint blocks
+    digests as the lane-wise sum of per-block contributions — and a block's
+    contribution depends only on (content, origin, board width).  Boards
+    that evolve by block substitution (the serve memo plane: most tiles of
+    a structured board are static or cycling between a few contents) keep
+    re-presenting the same (content, origin) pairs, so their whole-board
+    lanes reduce to dict hits plus one :func:`merge_lanes` fold instead of
+    an O(board) re-mix every epoch.
+
+    Keys are the caller's canonical content payloads (``ops/macroblock``
+    codec bytes) plus origin/width; values are (2,) uint32 lanes.  Bounded
+    LRU (``max_entries``) — ~70 bytes/entry of lanes + key overhead, and a
+    miss just recomputes, so tightness costs speed, never correctness."""
+
+    def __init__(self, max_entries: int = 1 << 16) -> None:
+        from collections import OrderedDict
+
+        self.max_entries = int(max_entries)
+        self._entries: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def block_lanes(
+        self,
+        payload: bytes,
+        block: np.ndarray,
+        origin: Tuple[int, int],
+        width: int,
+    ) -> np.ndarray:
+        """The block's lane contribution at ``origin`` of a ``width``-wide
+        board: cached by (payload, origin, width), computed via
+        :func:`digest_dense_np` on miss."""
+        key = (payload, origin[0], origin[1], width)
+        lanes = self._entries.get(key)
+        if lanes is not None:
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return lanes
+        self.misses += 1
+        lanes = digest_dense_np(block, origin, width)
+        self._entries[key] = lanes
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+        return lanes

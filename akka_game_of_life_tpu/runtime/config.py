@@ -480,6 +480,35 @@ class SimulationConfig:
     # Canary board side (square); small on purpose — the probe prices the
     # serving path, not device throughput.
     serve_canary_side: int = 32
+    # Cross-tenant memoized macro-stepping (serve/memo.py, docs/
+    # OPERATIONS.md "Macro-step memoization"): content-addressed
+    # (rule, block) → center-after-S-epochs cache shared across every
+    # session of the process — the Hashlife-grade fast path for the
+    # nonlinear rules fast-forward cannot touch.  Off by default: the
+    # memo plane pays per-tick hashing for cache hits, a trade only
+    # repetitive traffic wins.
+    serve_memo: bool = False
+    # Context block side B (power of two, >= 16): result tiles are B/2,
+    # each macro-round advances B/4 epochs.  Bigger blocks amortize more
+    # epochs per hit but hash more bytes and repeat less often.
+    serve_memo_block: int = 64
+    # Cache byte budget (MiB) across all sessions; LRU beyond it.
+    serve_memo_max_mb: int = 256
+    # Per-session adaptive gate: after warmup, a macro-round whose tile
+    # hit rate falls below this floor aborts the task to the dense path
+    # (misses unpaid — hashing is the only cost a hostile board forces).
+    serve_memo_hit_floor: float = 0.25
+    # Ungated probe rounds per session before the floor applies (a cold
+    # cache misses everything; warmup is what populates it).
+    serve_memo_warmup: int = 16
+    # Consecutive below-floor rounds that disable memoization for the
+    # session outright (it re-enters only by session recreation).
+    serve_memo_disable_after: int = 3
+    # Sampled certification cadence: every Nth macro-round of a session
+    # (and always its first) is ALSO advanced by the dense batched kernel
+    # and digest-compared (gol_memo_certify_*).  0 disables sampling —
+    # benchmark configs only; production keeps a nonzero cadence.
+    serve_memo_certify_every: int = 64
     # -- logarithmic fast-forward (docs/OPERATIONS.md "Logarithmic
     # fast-forward").  XOR-linear (odd-rule) boards jump T epochs in
     # O(log T) device programs (ops/fastforward.py); non-linear rules are
@@ -788,6 +817,40 @@ class SimulationConfig:
         if self.serve_canary_side < 1:
             raise ValueError(
                 f"serve_canary_side={self.serve_canary_side} must be >= 1"
+            )
+        from akka_game_of_life_tpu.ops.macroblock import MIN_BLOCK
+
+        if (
+            self.serve_memo_block < MIN_BLOCK
+            or self.serve_memo_block & (self.serve_memo_block - 1) != 0
+        ):
+            raise ValueError(
+                f"serve_memo_block={self.serve_memo_block} must be a "
+                f"power of two >= {MIN_BLOCK} (the macro-cell theorem "
+                f"needs B/4 halo epochs)"
+            )
+        if self.serve_memo_max_mb < 1:
+            raise ValueError(
+                f"serve_memo_max_mb={self.serve_memo_max_mb} must be >= 1"
+            )
+        if not 0.0 <= self.serve_memo_hit_floor <= 1.0:
+            raise ValueError(
+                f"serve_memo_hit_floor={self.serve_memo_hit_floor} must "
+                f"be in [0, 1]"
+            )
+        if self.serve_memo_warmup < 0:
+            raise ValueError(
+                f"serve_memo_warmup={self.serve_memo_warmup} must be >= 0"
+            )
+        if self.serve_memo_disable_after < 1:
+            raise ValueError(
+                f"serve_memo_disable_after={self.serve_memo_disable_after} "
+                f"must be >= 1"
+            )
+        if self.serve_memo_certify_every < 0:
+            raise ValueError(
+                f"serve_memo_certify_every={self.serve_memo_certify_every} "
+                f"must be >= 0 (0 = no sampled certification)"
             )
         if self.ff_certify_steps < 0:
             raise ValueError(

@@ -348,19 +348,13 @@ class Frontend:
             log_file=config.log_file,
             registry=self.metrics,
         )
-        if config.fault_injection.enabled and config.fault_injection.epoch_indexed:
-            # The cluster injector is the reference's wall-clock killer
-            # (BoardCreator.scala:97-102): crashes are per-worker events on a
-            # clock, not lockstep simulation-time events.  The epoch-indexed
-            # schedule exists for SPMD multi-host runs (Simulation
-            # distributed=True); accepting it here would silently never fire
-            # (the maintenance loop polls the wall-clock schedule).
-            raise ValueError(
-                "epoch-indexed fault injection (first_after_epochs/"
-                "every_epochs) is a distributed-Simulation feature; the "
-                "cluster frontend injects on the wall-clock schedule "
-                "(first_after_s/every_s)"
-            )
+        # Fault schedules: the wall-clock killer (BoardCreator.scala:97-102)
+        # polls from the maintenance loop; the epoch-indexed schedule is
+        # anchored to cluster progress instead — it fires from the PROGRESS
+        # handler once the slowest tile reaches first_after_epochs (then
+        # every every_epochs).  Epoch anchoring is what makes chaos drills
+        # deterministic: a fast run cannot outrace the injector, because the
+        # schedule is indexed by the very epochs the run must produce.
         self.membership = Membership(config.failure_timeout_s)
         # The elastic plane (docs/OPERATIONS.md "Elastic rebalancing"):
         # live tile migration, mid-run scale-out, graceful drain.  Always
@@ -1205,6 +1199,7 @@ class Frontend:
             # and the stuck detector.
             tile = tuple(msg["tile"])
             epoch = int(msg["epoch"])
+            inject_due = False
             with self._lock:
                 if self.tile_owner.get(tile) != member.name:
                     return  # stale ping from an evicted owner
@@ -1224,6 +1219,24 @@ class Frontend:
                     self._m_tiles_skipped.inc(skipped)
                 if "digest" in msg:
                     self._note_tile_digest_locked(tile, epoch, msg["digest"])
+                if (
+                    self.injector is not None
+                    and self.injector.config.epoch_indexed
+                    and self._started.is_set()
+                    and self.layout is not None
+                ):
+                    # Epoch-anchored chaos: the schedule is indexed by the
+                    # slowest tile's progress, so a crash due at epoch E
+                    # fires before the run can complete past E — no race
+                    # against the wall clock.  Evaluated under the lock so
+                    # concurrent member threads cannot double-fire one slot.
+                    floor = min(
+                        (self.tile_epochs.get(t, 0) for t in self.layout.tile_ids),
+                        default=0,
+                    )
+                    inject_due = self.injector.should_crash_at_epoch(floor)
+            if inject_due:
+                self._inject_crash()
         elif kind == P.TILE_STATE:
             self._on_tile_state(member, msg)
         elif kind == P.REDEPLOY_REQUEST:

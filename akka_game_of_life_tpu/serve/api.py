@@ -350,11 +350,13 @@ def run_serve(config, *, registry=None, tracer=None) -> int:
 
     registry = registry if registry is not None else get_registry()
     tracer = tracer if tracer is not None else get_tracer()
-    router = SessionRouter(config, registry=registry, tracer=tracer)
     events = (
         EventLog(config.log_events, node="serve", recorder=tracer.flight)
         if getattr(config, "log_events", None)
         else NULL_EVENTS
+    )
+    router = SessionRouter(
+        config, registry=registry, tracer=tracer, events=events
     )
     slo = slo_mod.SloTracker(
         config, registry=registry, tracer=tracer, events=events,

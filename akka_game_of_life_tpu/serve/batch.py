@@ -45,6 +45,7 @@ from akka_game_of_life_tpu.runtime.config import parse_size_classes
 __all__ = [
     "DEFAULT_SIZE_CLASSES",
     "batch_step_fn",
+    "memo_block_step_fn",
     "next_pow2",
     "parse_size_classes",  # canonical home: runtime.config (validation)
     "rule_operands",
@@ -192,5 +193,58 @@ def batch_step_fn(class_side: int, length: int):
         # its own n) — the padded cost is what the device actually runs.
         cost=lambda boards, *rest: stencil_cost(
             class_side, class_side, length, boards=boards.shape[0]
+        ),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def memo_block_step_fn(block: int):
+    """The macro-cell miss program (``serve/memo.py``): advance a batch of
+    B-sided context blocks exactly S = B/4 toroidal epochs and return their
+    T-sided centers (T = B/2) — the payload a memo cache entry stores.
+
+    One program per block size, for EVERY rule and EVERY session: the rule
+    masks ride as traced per-block operands exactly like
+    :func:`batch_step_fn`, and blocks are always full B×B (no live-extent
+    masks — the codec only emits exact blocks), so the whole memo plane
+    compiles O(1) programs.  The caller pads the batch dim to a power of
+    two (zero blocks under a zero rule are inert).
+
+    Signature of the returned callable::
+
+        centers [N,T,T]u8 = run(
+            blocks  [N,B,B]u8,  # toroidal context blocks
+            birth   [N]u32,     # per-block Rule.birth_mask
+            survive [N]u32,     # per-block Rule.survive_mask
+            states  [N]i32,     # per-block state count (2 = binary)
+        )
+
+    Correctness of the toroidal shortcut is argued in
+    ``ops/macroblock.py``: wrap corruption travels inward one cell per
+    step and never reaches the center within S steps."""
+    tile = block // 2
+    steps = block // 4
+    h = jnp.asarray(block, _I)
+    w = jnp.asarray(block, _I)
+
+    def one(blk, birth, survive, states):
+        def body(s, _):
+            return _step_once(s, birth, survive, states, h, w), None
+
+        out, _ = jax.lax.scan(body, blk, None, length=steps)
+        return jax.lax.dynamic_slice(
+            out, (steps, steps), (tile, tile)
+        )
+
+    @jax.jit
+    def run(blocks, birth, survive, states):
+        return jax.vmap(one)(blocks, birth, survive, states)
+
+    from akka_game_of_life_tpu.obs.programs import registered_jit, stencil_cost
+
+    return registered_jit(
+        "serve_memo", (block, steps), run,
+        cost=lambda blocks, *rest: stencil_cost(
+            block, block, steps, boards=blocks.shape[0]
         ),
     )

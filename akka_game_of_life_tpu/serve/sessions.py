@@ -150,6 +150,11 @@ class Session:
     epoch: int = 0
     created: float = 0.0
     last_used: float = 0.0
+    # Per-session memo-plane state (serve/memo.py), lazily attached by the
+    # engine; None when the plane is off or the session never qualified.
+    # Deliberately NOT exported/imported: a migrated or promoted session
+    # restarts with fresh adaptive state against its new router's cache.
+    memo: Optional[object] = None
 
     @property
     def digest(self) -> int:
@@ -213,6 +218,7 @@ class SessionRouter:
         *,
         registry=None,
         tracer=None,
+        events=None,
         clock=time.monotonic,
     ) -> None:
         if config is None:
@@ -269,6 +275,20 @@ class SessionRouter:
         )
         self._m_tick = self.metrics.histogram("gol_serve_tick_seconds")
         self._m_req = self.metrics.histogram("gol_serve_step_seconds")
+
+        # Cross-tenant memoized macro-stepping (serve/memo.py): one engine
+        # + content-addressed cache per router, feeding every tenant.
+        self._memo = None
+        if getattr(config, "serve_memo", False):
+            from akka_game_of_life_tpu.serve.memo import MemoEngine
+
+            self._memo = MemoEngine(
+                config,
+                registry=self.metrics,
+                tracer=self.tracer,
+                events=events,
+                size_classes=self.size_classes,
+            )
 
         # Drill hook (None in production): called between a fast-forward
         # jump's compute and its commit attempt, so tests can provoke the
@@ -409,7 +429,12 @@ class SessionRouter:
             # Last session of this tenant: reclaim its metric children, or
             # a create/delete loop over fresh tenant strings would grow
             # the exposition without bound.
-            for inst in (self._m_sessions, self._m_creates, self._m_steps):
+            memo_insts = (
+                self._memo.tenant_instruments if self._memo is not None else ()
+            )
+            for inst in (
+                self._m_sessions, self._m_creates, self._m_steps,
+            ) + memo_insts:
                 inst.remove(tenant=sess.tenant)
         if evicted:
             self._m_evictions.inc()
@@ -888,10 +913,12 @@ class SessionRouter:
             self._drop_locked(sid, evicted=True)
 
     def _run_tick(self, jobs: List[_Job]) -> None:
-        """Group this tick's jobs by size class, advance each group in one
-        device program, scatter results back.  A failed batch fails its
-        jobs, never the ticker."""
-        groups: Dict[int, List[Tuple[_Job, Session, np.ndarray, int]]] = {}
+        """Advance this tick's jobs: the memo phase first (macro-rounds of
+        the Hashlife fast path for eligible jobs — serve/memo.py), then
+        every job's dense remainder grouped by size class, one device
+        program per group, results scattered back.  A failed batch fails
+        its jobs, never the ticker."""
+        snapshots: List[Tuple[_Job, Session, np.ndarray, int]] = []
         dead: List[_Job] = []
         with self._lock:
             for job in jobs:
@@ -900,42 +927,130 @@ class SessionRouter:
                     job.error = KeyError(job.sid)
                     dead.append(job)
                     continue
-                cls = sbatch.size_class(
-                    sess.height, sess.width, self.size_classes
-                )
                 # Snapshot the board reference AND epoch: writers only
                 # ever REPLACE session boards, so the references are
                 # stable outside the lock — and the scatter-back commits
                 # only if this exact snapshot is still the session state
                 # (a fast-forward jump may land mid-batch).
-                groups.setdefault(cls, []).append(
-                    (job, sess, sess.board, sess.epoch)
-                )
+                snapshots.append((job, sess, sess.board, sess.epoch))
         for job in dead:
             self._finish(job)
+        if self._memo is not None:
+            entries = self._memo_phase(snapshots)
+        else:
+            entries = [
+                (job, sess, board, epoch0, job.steps)
+                for job, sess, board, epoch0 in snapshots
+            ]
+        groups: Dict[
+            int, List[Tuple[_Job, Session, np.ndarray, int, int]]
+        ] = {}
+        for entry in entries:
+            sess = entry[1]
+            cls = sbatch.size_class(
+                sess.height, sess.width, self.size_classes
+            )
+            groups.setdefault(cls, []).append(entry)
         from akka_game_of_life_tpu.obs.programs import get_programs
 
         programs = get_programs()
         before = programs.programs_total
-        for cls, entries in sorted(groups.items()):
+        for cls, centries in sorted(groups.items()):
             try:
-                self._run_class_batch(cls, entries)
+                self._run_class_batch(cls, centries)
             except Exception as e:  # noqa: BLE001 — jobs fail, ticker lives
-                for job, _, _, _ in entries:
+                for job, _, _, _, _ in centries:
                     job.error = e
                     self._finish(job)
-        if groups and not programs.warm and programs.programs_total == before:
+        if (
+            snapshots
+            and not programs.warm
+            and programs.programs_total == before
+        ):
             # A full tick advanced real jobs without compiling any new
             # program: the router's program set is its steady state.  Arm
             # the storm detector — from here on, a novel (class, length)
             # compile is a latency cliff worth an alert + flight dump.
             programs.mark_warm()
 
+    def _memo_phase(
+        self, snapshots: List[Tuple[_Job, Session, np.ndarray, int]]
+    ) -> List[Tuple[_Job, Session, np.ndarray, int, int]]:
+        """Run the tick's memo-eligible jobs through macro-rounds
+        (serve/memo.py), commit what memoization carried, and return the
+        dense entries — ``(job, sess, board, epoch0, nsteps)`` — that
+        remain: passthroughs, remainders (steps % S), and the full jobs
+        of tasks that advanced nothing.
+
+        Commit discipline mirrors the batch scatter-back: a memoized
+        board writes back only if the planned snapshot is still the
+        session state; a raced task (a fast-forward jump landed, or the
+        session was deleted mid-phase) keeps its memo progress for the
+        CLIENT — its remainder entry carries the memoized board relative
+        to the original snapshot — but the table write is skipped (the
+        board-identity check in the dense scatter-back can never pass
+        for it, since the memoized array reference was never published).
+        """
+        tasks, passthrough = self._memo.plan_tasks(snapshots)
+        dense: List[Tuple[_Job, Session, np.ndarray, int, int]] = [
+            (job, sess, board, epoch0, job.steps)
+            for job, sess, board, epoch0 in passthrough
+        ]
+        if not tasks:
+            return dense
+        try:
+            with self.tracer.span("serve.memo", tasks=len(tasks)):
+                self._memo.run(tasks)
+        except Exception:  # noqa: BLE001 — an engine bug degrades to dense
+            return dense + [
+                (t.job, t.sess, t.board0, t.epoch0, t.job.steps)
+                for t in tasks
+            ]
+        s_macro = self._memo.steps
+        finished: List[_Job] = []
+        with self._lock:
+            for t in tasks:
+                advanced = t.rounds_done * s_macro
+                if advanced == 0:
+                    dense.append(
+                        (t.job, t.sess, t.board0, t.epoch0, t.job.steps)
+                    )
+                    continue
+                sess = t.sess
+                if (
+                    self._sessions.get(t.job.sid) is sess
+                    and sess.board is t.board0
+                    and sess.epoch == t.epoch0
+                ):
+                    sess.board = t.board
+                    sess.lanes = t.lanes
+                    sess.population = t.pop
+                    sess.epoch = t.epoch0 + advanced
+                    sess.last_used = self._clock()
+                    self._m_steps.labels(tenant=sess.tenant).inc(advanced)
+                rem = t.job.steps - advanced
+                if rem == 0:
+                    t.job.result = (
+                        t.epoch0 + advanced, odigest.value(t.lanes)
+                    )
+                    finished.append(t.job)
+                else:
+                    dense.append(
+                        (t.job, sess, t.board, t.epoch0 + advanced, rem)
+                    )
+        for job in finished:
+            self._finish(job)
+        return dense
+
     def _run_class_batch(
-        self, cls: int, entries: List[Tuple[_Job, Session, np.ndarray, int]]
+        self,
+        cls: int,
+        entries: List[Tuple[_Job, Session, np.ndarray, int, int]],
     ) -> None:
         b_real = len(entries)
-        length = sbatch.next_pow2(max(job.steps for job, _, _, _ in entries))
+        length = sbatch.next_pow2(
+            max(nsteps for _, _, _, _, nsteps in entries)
+        )
         b_pad = sbatch.next_pow2(b_real)
         boards = np.zeros((b_pad, cls, cls), dtype=np.uint8)
         birth = np.zeros(b_pad, dtype=np.uint32)
@@ -944,11 +1059,11 @@ class SessionRouter:
         hs = np.ones(b_pad, dtype=np.int32)
         ws = np.ones(b_pad, dtype=np.int32)
         ns = np.zeros(b_pad, dtype=np.int32)
-        for i, (job, sess, board, _) in enumerate(entries):
+        for i, (job, sess, board, _, nsteps) in enumerate(entries):
             boards[i, : sess.height, : sess.width] = board
             birth[i], survive[i], states[i] = sbatch.rule_operands(sess.rule)
             hs[i], ws[i] = sess.height, sess.width
-            ns[i] = job.steps
+            ns[i] = nsteps
         out, lanes = sbatch.batch_step_fn(cls, length)(
             boards, birth, survive, states, hs, ws, ns
         )
@@ -962,13 +1077,13 @@ class SessionRouter:
                 out[i, : sess.height, : sess.width].copy(),
                 lanes[i],
             )
-            for i, (_, sess, _, _) in enumerate(entries)
+            for i, (_, sess, _, _, _) in enumerate(entries)
         ]
         pops = [int((board == 1).sum()) for board, _ in results]
         with self._lock:
-            for (job, sess, board0, epoch0), (new_board, new_lanes), pop in zip(
-                entries, results, pops
-            ):
+            for (job, sess, board0, epoch0, nsteps), (
+                new_board, new_lanes,
+            ), pop in zip(entries, results, pops):
                 if (
                     self._sessions.get(job.sid) is sess
                     and sess.board is board0
@@ -977,9 +1092,9 @@ class SessionRouter:
                     sess.board = new_board
                     sess.lanes = new_lanes
                     sess.population = pop
-                    sess.epoch = epoch0 + job.steps
+                    sess.epoch = epoch0 + nsteps
                     sess.last_used = self._clock()
-                    self._m_steps.labels(tenant=sess.tenant).inc(job.steps)
+                    self._m_steps.labels(tenant=sess.tenant).inc(nsteps)
                 else:
                     # Deleted mid-batch — or a fast-forward jump committed
                     # between this batch's gather and scatter-back (the
@@ -992,10 +1107,10 @@ class SessionRouter:
                     # incrementing here would re-mint a leaked child for a
                     # gone tenant.
                     pass
-                job.result = (epoch0 + job.steps, odigest.value(new_lanes))
+                job.result = (epoch0 + nsteps, odigest.value(new_lanes))
         # Completions fire after the table writes are released: callbacks
         # (the cluster plane's wire replies) must never run under the lock.
-        for job, _, _, _ in entries:
+        for job, _, _, _, _ in entries:
             self._finish(job)
 
     def drain(self, timeout: float = 30.0) -> bool:

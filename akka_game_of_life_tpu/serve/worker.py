@@ -91,6 +91,13 @@ SERVE_POLICY_KEYS = (
     "serve_tiled_resident_snapshot",
     "serve_tiled_resident_halo_timeout_s",
     "serve_trace",
+    "serve_memo",
+    "serve_memo_block",
+    "serve_memo_max_mb",
+    "serve_memo_hit_floor",
+    "serve_memo_warmup",
+    "serve_memo_disable_after",
+    "serve_memo_certify_every",
     "ff_enabled",
     "ff_certify_steps",
 )

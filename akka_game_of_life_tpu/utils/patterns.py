@@ -39,6 +39,10 @@ RLE_PATTERNS: Dict[str, str] = {
     "pentadecathlon": "2bo4bo$2ob4ob2o$2bo4bo!",  # period-15 oscillator
     "diehard": "6bob$2o6b$bo3b3o!",  # vanishes after exactly 130 generations
     "acorn": "bo5b$3bo3b$2o2b3o!",  # 5206-gen methuselah (pop 633 stable)
+    # Eater-1 (fishhook), in the orientation that absorbs the Gosper
+    # gun's glider stream when anchored down-stream of the gun — the
+    # periodic gun+eater board is the serve-memo bench's headline shape.
+    "eater": "2o2b$o3b$b3o$3bo!",
     "gosper-glider-gun": (
         "24bo$22bobo$12b2o6b2o12b2o$11bo3bo4b2o12b2o$2o8bo5bo3b2o$2o8bo3bob2o4b"
         "obo$10bo5bo7bo$11bo3bo$12b2o!"

@@ -100,6 +100,19 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
                          frontend route-plane ms/op micro-bench
                          (docs/OPERATIONS.md "Tiled (mega-board)
                          sessions").
+ 19. serve-memo          cross-tenant memoized macro-stepping
+                         (bench_serve.py --memo): a twin fleet on
+                         overlapping seeds driven memo on/off in
+                         lockstep waves (cross-tenant hit rate >50%,
+                         board-epochs/s lift), the adversarial
+                         high-entropy leg (every memo session
+                         self-disables, walls within 5%), and the
+                         Gosper-gun+eater periodic board to T=1e6
+                         through the whole-board chain cache (>=100x
+                         over the extrapolated dense cost) — every leg
+                         digest-certified against the dense oracle,
+                         sampled in-run certification live
+                         (docs/OPERATIONS.md "Macro-step memoization").
 
 Usage:
   python bench_suite.py                 # all configs, default sizes
@@ -1235,7 +1248,10 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config", type=int, nargs="*",
-        default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18],
+        default=[
+            1, 2, 3, 4, 5, 6, 7, 8, 9, 10,
+            11, 12, 13, 14, 15, 16, 17, 18, 19,
+        ],
     )
     parser.add_argument(
         "--scale", type=float, default=1.0,
@@ -1404,6 +1420,22 @@ def main() -> None:
             side=s(1024, 256),
             steps=64,
             requests=3,
+        )
+    if 19 in args.config:
+        # Cross-tenant memoized macro-stepping: the twin-fleet A/B
+        # (overlapping seeds, memo on/off — hit rate + board-epochs/s
+        # lift), the adversarial high-entropy within-5% gate, and the
+        # gun+eater T=1e6 >=100x headline, all digest-certified
+        # (docs/OPERATIONS.md "Macro-step memoization").  Scale trims
+        # the tenant count and the headline horizon together — the
+        # speedup gate scales with the horizon, so smoke runs stay
+        # meaningful without judging a short warm-up-bound run against
+        # the full-length bar.
+        from bench_serve import bench_serve_memo
+
+        bench_serve_memo(
+            tenants=max(16, int(64 * args.scale)),
+            gun_epochs=max(65_536, int(1_000_000 * args.scale)),
         )
 
     if tee is not None:

@@ -104,6 +104,24 @@ def test_unmapped_units_and_thin_history_are_skipped():
     assert "min_rounds" in verdict["skipped"]["nulls"]
 
 
+def test_serve_memo_record_is_gated():
+    """The serve-memo config (bench_serve.py --memo, suite config 19)
+    emits unit "x" — direction-mapped, so its trajectory GATES: a
+    collapsed memo lift is a regression the suite's --regress-check must
+    catch, not skip."""
+    ok = check_trend(
+        {"serve-memo": {"unit": "x", "rounds": {19: 3.6, 20: 3.4}}},
+        RegressPolicy(),
+    )
+    assert ok["ok"] and ok["checked"] == ["serve-memo"]
+    bad = check_trend(
+        {"serve-memo": {"unit": "x", "rounds": {19: 3.6, 20: 3.4, 21: 1.0}}},
+        RegressPolicy(),
+    )
+    assert not bad["ok"]
+    assert bad["regressions"][0]["config"] == "serve-memo"
+
+
 def test_policy_validation():
     with pytest.raises(ValueError):
         RegressPolicy(threshold=0.0)

@@ -129,6 +129,39 @@ def test_trend_folds_serve_shard_sweep_records(tmp_path):
     assert "serve-shard-w2" in table and "r13" in table
 
 
+def test_trend_folds_serve_memo_record(tmp_path):
+    """The serve-memo record (bench_serve.py --memo, suite config 19)
+    folds into the trajectory table: its headline value is the fleet
+    board-epochs/s lift (unit "x"), and the memo-specific payload —
+    hit_rate, the adversarial leg, the gun headline sub-dict — rides
+    along without confusing the parser."""
+    out = tmp_path / "memo_r19.jsonl"
+    out.write_text(
+        "warmup noise line\n"
+        + json.dumps({
+            "config": "serve-memo",
+            "metric": "cross-tenant memoized macro-stepping",
+            "value": 3.6,
+            "unit": "x",
+            "tenants": 64,
+            "seeds": 8,
+            "hit_rate": 0.87,
+            "memo": {"wall_s": 2.6, "certify_mismatches": 0},
+            "dense": {"wall_s": 9.4},
+            "adversarial": {"ratio": 0.97, "disables": 16},
+            "gun": {"epochs": 1_000_000, "speedup_x": 117.3,
+                    "certify_mismatches": 0},
+        })
+        + "\n",
+        encoding="utf-8",
+    )
+    pairs = list(bench_trend.scan_record_file(out))
+    trend = bench_trend.build_trend(pairs)
+    assert trend["serve-memo"]["rounds"][19] == 3.6
+    assert trend["serve-memo"]["unit"] == "x"
+    assert "serve-memo" in bench_trend.render_table(trend)
+
+
 def test_trend_on_real_repo_records():
     """The actual BENCH_r*/MULTICHIP_r* records at the repo root parse
     (they exist on this tree; their tails mix tracebacks with records)."""

@@ -384,7 +384,11 @@ def test_cluster_digest_under_chaos_and_redeploy(tmp_path):
     """Merged per-tile digests equal the dense oracle under injected tile
     crashes plus an explicit mid-run redeploy — the recovery machinery
     replays through digest-due epochs and the floor logic dedupes the
-    re-reports."""
+    re-reports.
+
+    The injector schedule is epoch-anchored (first_after_epochs/every_epochs),
+    not wall-clock: a fast run cannot complete before the crashes fire,
+    because the crashes are due at epochs the run must pass through."""
     import time
 
     from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
@@ -394,8 +398,8 @@ def test_cluster_digest_under_chaos_and_redeploy(tmp_path):
         checkpoint_dir=str(tmp_path), checkpoint_every=8, metrics_every=8,
         obs_digest=True,
         fault_injection=FaultInjectionConfig(
-            enabled=True, first_after_s=0.05, every_s=0.2, max_crashes=2,
-            mode="tile",
+            enabled=True, first_after_epochs=8, every_epochs=16,
+            max_crashes=2, mode="tile",
         ),
     )
     with cluster(cfg, 2, observer=BoardObserver(out=io.StringIO())) as h:

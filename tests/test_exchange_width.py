@@ -171,22 +171,27 @@ def test_widek_rejects_actor_engine_workers():
         fe.stop()
 
 
-def test_frontend_rejects_epoch_indexed_injection():
-    """Cluster chaos is the reference's wall-clock killer; the epoch-indexed
-    schedule (a distributed-Simulation feature) must error loudly here, not
-    silently never fire."""
+def test_frontend_epoch_anchored_injection_fires_deterministically(tmp_path):
+    """The epoch-indexed schedule is anchored to cluster progress (the
+    PROGRESS floor), not the wall clock: the run cannot complete without
+    passing the epochs the crashes are due at, so chaos fires on every run
+    and the trajectory still matches the dense oracle.  (The old behavior
+    rejected epoch-indexed config on the cluster frontend, which forced
+    chaos drills onto a wall-clock schedule a fast run could outrace.)"""
     from akka_game_of_life_tpu.runtime.config import FaultInjectionConfig
-    from akka_game_of_life_tpu.runtime.frontend import Frontend
 
     cfg = SimulationConfig(
-        height=16, width=16, max_epochs=4,
+        height=16, width=16, seed=9, max_epochs=12,
+        checkpoint_dir=str(tmp_path), checkpoint_every=4,
         fault_injection=FaultInjectionConfig(
-            enabled=True, first_after_epochs=2, every_epochs=2
+            enabled=True, first_after_epochs=4, every_epochs=4,
+            max_crashes=2, mode="tile",
         ),
     )
-    cfg.port = 0
-    with pytest.raises(ValueError, match="epoch-indexed"):
-        Frontend(cfg, min_backends=1)
+    with cluster(cfg, 2) as h:
+        final = h.run_to_completion()
+        assert len(h.frontend.crash_events) == 2, "chaos never fired"
+    assert np.array_equal(final, dense_oracle(initial_board(cfg), "conway", 12))
 
 
 def test_widek_four_workers_2d_grid():

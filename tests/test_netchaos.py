@@ -483,7 +483,25 @@ def test_partition_soak_converges_bit_identical(tmp_path):
             lambda: min(h.frontend.tile_epochs.values(), default=0) >= 9,
             30, "pre-partition progress",
         )
-        h.netchaos.start_partition(("w0",), ("w1",), heal_s=1.0)
+        # Hold the partition until every side effect the post-conditions
+        # assert on has been OBSERVED, then heal manually — a fixed heal_s
+        # window is a wall-clock bet that a loaded machine loses (starved
+        # tick threads can attempt zero sends inside the window).  heal_s
+        # here is only the safety net against a wedged drill.
+        h.netchaos.start_partition(("w0",), ("w1",), heal_s=30.0)
+
+        def _soak_observed():
+            backoff = reg.snapshot().get("gol_retry_backoff_seconds")
+            return (
+                reg.value("gol_net_chaos_dropped_total") >= 1
+                and reg.value("gol_breaker_open_total") >= 1
+                and reg.value("gol_breaker_skipped_sends_total") >= 1
+                and backoff is not None
+                and backoff["count"] >= 1
+            )
+
+        _wait(_soak_observed, 25, "partition side effects")
+        h.netchaos.heal()
         _wait(lambda: not h.netchaos.partitioned(), 30, "heal")
 
         assert h.frontend.done.wait(60), "cluster did not finish after heal"

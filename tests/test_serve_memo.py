@@ -1,0 +1,456 @@
+"""Cross-tenant memoized macro-stepping tests (serve/memo.py + ops/macroblock.py).
+
+Four layers, matching the subsystem:
+
+- **codec** (`ops/macroblock.py`): the canonical payload encoding is a
+  bijection on valid blocks (binary bit-pack AND multi-state raw bytes),
+  and the tiling geometry (extract → assemble) is exact;
+- **cache** (`serve.memo.MemoCache`): byte-bounded LRU semantics, and —
+  the collision contract — a degenerate bucket hash may cost memcmps but
+  can never return the wrong entry;
+- **engine through the router** (`serve/sessions.py _memo_phase`):
+  memoized trajectories are bit-identical to the dense oracle for binary
+  and Generations rules, including dense remainder epochs, cross-tenant
+  hits, and the all-dead shortcut; adversarial high-entropy traffic
+  disables itself after the warmup; a corrupted cache entry is CAUGHT by
+  sampled certification and the direct board wins;
+- **lifecycle**: migrated/imported sessions arrive memo-cold (the cache
+  is process state, never replicated) and re-warm correctly.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from akka_game_of_life_tpu.obs.catalog import install
+from akka_game_of_life_tpu.obs.events import EventLog
+from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+from akka_game_of_life_tpu.ops import digest as odigest
+from akka_game_of_life_tpu.ops import macroblock as mblock
+from akka_game_of_life_tpu.ops import stencil
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.serve import SessionRouter
+from akka_game_of_life_tpu.serve.memo import MemoCache
+from akka_game_of_life_tpu.utils.patterns import random_grid
+
+import jax.numpy as jnp
+
+
+def _registry():
+    return install(MetricsRegistry())
+
+
+def _cfg(**kw):
+    kw.setdefault("role", "serve")
+    kw.setdefault("flight_dir", "")
+    kw.setdefault("serve_memo", True)
+    kw.setdefault("serve_memo_block", 32)
+    return SimulationConfig(**kw)
+
+
+def _oracle(rule, board0, steps):
+    if steps == 0:
+        return np.asarray(board0, dtype=np.uint8)
+    return np.asarray(
+        stencil.multi_step_fn(resolve_rule(rule), steps)(jnp.asarray(board0))
+    )
+
+
+# -- codec ---------------------------------------------------------------------
+
+
+def test_codec_round_trip_bijection():
+    """encode_blocks/decode_block invert each other for binary AND
+    multi-state stacks, and payload equality tracks block equality."""
+    rng = np.random.default_rng(7)
+    for states in (2, 3, 5):
+        blocks = rng.integers(0, states, size=(9, 16, 16), dtype=np.uint8)
+        blocks[3] = 0  # all-dead block must encode too
+        blocks[4] = blocks[5]  # a duplicate pair
+        payloads = mblock.encode_blocks(blocks, states)
+        assert len(payloads) == 9
+        for i, p in enumerate(payloads):
+            np.testing.assert_array_equal(
+                mblock.decode_block(p, 16, states), blocks[i]
+            )
+        # Bijection: equal payloads ⟺ equal blocks.
+        assert payloads[4] == payloads[5]
+        for i in (0, 1, 2):
+            assert payloads[i] != payloads[4] or np.array_equal(
+                blocks[i], blocks[4]
+            )
+    # Binary payloads bit-pack: 8 cells per byte.
+    p = mblock.encode_blocks(np.ones((1, 16, 16), np.uint8), 2)[0]
+    assert len(p) == 16 * 16 // 8
+    # block_key is deterministic content hashing.
+    assert mblock.block_key(p) == mblock.block_key(bytes(p))
+
+
+def test_macro_plan_geometry_and_assembly():
+    """extract_contexts centers invert through assemble, wrap maps are
+    toroidal, and ineligible shapes yield no plan."""
+    p = mblock.plan(32, 48, 32)
+    assert p is not None and p.tile == 16 and p.steps == 8
+    assert p.n_tiles == 2 * 3
+    rng = np.random.default_rng(3)
+    board = rng.integers(0, 2, size=(32, 48), dtype=np.uint8)
+    ctx = mblock.extract_contexts(board, p)
+    assert ctx.shape == (6, 32, 32)
+    s = p.steps
+    centers = ctx[:, s : s + p.tile, s : s + p.tile]
+    np.testing.assert_array_equal(p.assemble(centers), board)
+    # The context of tile (0, 0) wraps: its top-left corner is
+    # board[-S:, -S:] (toroidal gather, not zero padding).
+    np.testing.assert_array_equal(ctx[0][:s, :s], board[-s:, -s:])
+    # Ineligibility: non-multiple sides, tiny blocks, non-pow2 blocks.
+    assert mblock.plan(33, 48, 32) is None
+    assert mblock.plan(32, 48, 8) is None
+    assert mblock.plan(32, 48, 24) is None
+
+
+# -- cache ---------------------------------------------------------------------
+
+
+def _entry_key(payload, rule_ops=(8, 12, 2)):
+    return (rule_ops, mblock.block_key(payload), payload)
+
+
+def test_memo_cache_lru_byte_bound_and_eviction():
+    rng = np.random.default_rng(11)
+    centers = rng.integers(0, 2, size=(64, 16, 16), dtype=np.uint8)
+    payloads = mblock.encode_blocks(
+        rng.integers(0, 2, size=(64, 32, 32), dtype=np.uint8), 2
+    )
+    probe = MemoCache(1 << 30)
+    e0 = probe.insert(_entry_key(payloads[0]), centers[0], 2)
+    cache = MemoCache(e0.nbytes * 8)  # room for ~8 entries
+    for p, c in zip(payloads, centers):
+        cache.insert(_entry_key(p), c, 2)
+        assert cache.bytes <= cache.max_bytes
+    assert cache.evictions > 0 and len(cache) >= 1
+    stats = cache.stats()
+    assert stats["entries"] == len(cache)
+    assert stats["bytes"] == cache.bytes <= stats["max_bytes"]
+    # The newest entries survived (LRU evicts the cold end) and resolve
+    # to THEIR center; the oldest were evicted and miss.
+    got = cache.lookup(_entry_key(payloads[-1]))
+    np.testing.assert_array_equal(got.center, centers[-1])
+    assert cache.lookup(_entry_key(payloads[0])) is None
+    # Re-inserting an existing key replaces, never double-counts bytes.
+    before = cache.bytes
+    cache.insert(_entry_key(payloads[-1]), centers[-1], 2)
+    assert cache.bytes == before
+    # Lookup refreshes recency: touch the coldest survivor, insert one
+    # more, and the touched entry must still be resident.
+    resident = [
+        p for p in payloads if cache.lookup(_entry_key(p)) is not None
+    ]
+    new_p = mblock.encode_blocks(
+        rng.integers(0, 2, size=(1, 32, 32), dtype=np.uint8), 2
+    )[0]
+    cache.lookup(_entry_key(resident[0]))
+    cache.insert(_entry_key(new_p), centers[0], 2)
+    assert cache.lookup(_entry_key(resident[0])) is not None
+
+
+def test_cache_collision_resolved_by_payload_compare():
+    """With the bucket hash forced DEGENERATE (every payload → bucket 0),
+    distinct blocks coexist and every lookup still returns its own entry —
+    collisions cost a compare, never a wrong answer."""
+    rng = np.random.default_rng(13)
+    cache = MemoCache(1 << 30)
+    centers = rng.integers(0, 2, size=(16, 16, 16), dtype=np.uint8)
+    payloads = mblock.encode_blocks(
+        rng.integers(0, 2, size=(16, 32, 32), dtype=np.uint8), 2
+    )
+    rule_ops = (8, 12, 2)
+    for p, c in zip(payloads, centers):
+        cache.insert((rule_ops, 0, p), c, 2)
+    assert len(cache) == 16
+    for p, c in zip(payloads, centers):
+        np.testing.assert_array_equal(
+            cache.lookup((rule_ops, 0, p)).center, c
+        )
+    # The same payload under a DIFFERENT rule is a different key: a
+    # B3/S23 future must never answer a B36/S23 probe.
+    assert cache.lookup(((1 << 6 | 1 << 3, 12, 2), 0, payloads[0])) is None
+
+
+# -- engine through the router -------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "rule,steps",
+    [
+        ("conway", 100),       # 12 macro-rounds of 8 + 4 dense remainder
+        ("highlife", 64),      # exact multiple: no remainder
+        ("brians-brain", 50),  # Generations, 3 states, raw-byte codec
+    ],
+)
+def test_memoized_trajectory_bit_identical(rule, steps):
+    registry = _registry()
+    with SessionRouter(
+        _cfg(serve_memo_certify_every=4), registry=registry
+    ) as router:
+        doc = router.create(tenant="t1", rule=rule, height=64, width=64,
+                            seed=9)
+        sid = doc["id"]
+        epoch, digest = router.step(sid, steps=steps)
+        assert epoch == steps
+        want = _oracle(rule, random_grid((64, 64), density=0.5, seed=9),
+                       steps)
+        assert digest == odigest.value(odigest.digest_dense_np(want))
+        np.testing.assert_array_equal(router.get(sid)["board"], want)
+        # The fast path actually carried epochs (not a silent dense run),
+        # and every sampled certification agreed.
+        assert registry.value("gol_serve_memo_epochs_total", tenant="t1") > 0
+        assert registry.value("gol_memo_certify_total") > 0
+        assert registry.value("gol_memo_certify_mismatches_total") == 0
+
+
+def test_cross_tenant_sharing_second_tenant_all_hits():
+    """The cache key is content-addressed: a second tenant replaying the
+    same seed under the same rule rides entirely on the first tenant's
+    entries — zero new misses."""
+    registry = _registry()
+    with SessionRouter(_cfg(), registry=registry) as router:
+        a = router.create(tenant="alice", height=64, width=64, seed=21)["id"]
+        router.step(a, steps=64)
+        misses_after_warm = registry.value(
+            "gol_serve_memo_misses_total", tenant="alice"
+        )
+        assert misses_after_warm > 0
+        b = router.create(tenant="bob", height=64, width=64, seed=21)["id"]
+        epoch, _ = router.step(b, steps=64)
+        assert epoch == 64
+        np.testing.assert_array_equal(
+            router.get(b)["board"], router.get(a)["board"]
+        )
+        assert registry.value("gol_serve_memo_hits_total", tenant="bob") > 0
+        assert registry.value(
+            "gol_serve_memo_misses_total", tenant="bob"
+        ) == 0
+        assert registry.value("gol_serve_memo_hit_rate") > 0.4
+
+
+def test_all_dead_board_short_circuits_free():
+    """Dead space under a birth-quiet rule is the degenerate best case:
+    every block short-circuits as a free hit, nothing ever misses."""
+    registry = _registry()
+    with SessionRouter(_cfg(), registry=registry) as router:
+        sid = router.create(tenant="t1", height=32, width=32, seed=0,
+                            density=0.0)["id"]
+        epoch, _ = router.step(sid, steps=64)
+        assert epoch == 64
+        assert int(router.get(sid)["board"].sum()) == 0
+        assert registry.value("gol_serve_memo_hits_total", tenant="t1") > 0
+        assert registry.value("gol_serve_memo_misses_total", tenant="t1") == 0
+
+
+def test_forced_collision_trajectory_still_exact(monkeypatch):
+    """End-to-end belt and braces for the collision contract: run a real
+    memoized trajectory with EVERY block hashing to the same bucket."""
+    monkeypatch.setattr(mblock, "block_key", lambda payload: 0)
+    with SessionRouter(_cfg(), registry=_registry()) as router:
+        sid = router.create(tenant="t1", height=64, width=64, seed=5)["id"]
+        epoch, digest = router.step(sid, steps=40)
+        want = _oracle("conway", random_grid((64, 64), density=0.5, seed=5),
+                       40)
+        assert epoch == 40
+        assert digest == odigest.value(odigest.digest_dense_np(want))
+        np.testing.assert_array_equal(router.get(sid)["board"], want)
+
+
+def test_tight_byte_budget_thrashes_but_stays_exact():
+    """An undersized cache evicts constantly; the memo plane pays device
+    time for it, never correctness."""
+    registry = _registry()
+    with SessionRouter(_cfg(), registry=registry) as router:
+        # Shrink the live cache far below one round's working set.
+        router._memo.cache = MemoCache(16 << 10)
+        sid = router.create(tenant="t1", height=64, width=64, seed=31)["id"]
+        epoch, digest = router.step(sid, steps=64)
+        want = _oracle("conway", random_grid((64, 64), density=0.5, seed=31),
+                       64)
+        assert epoch == 64
+        assert digest == odigest.value(odigest.digest_dense_np(want))
+        assert router._memo.cache.evictions > 0
+        assert router._memo.cache.bytes <= 16 << 10
+        assert registry.value("gol_serve_memo_evictions_total") > 0
+
+
+def test_high_entropy_traffic_disables_itself():
+    """Chaotic dense boards never repeat blocks: after the warmup the
+    per-round hit-rate gate falls the session back BEFORE paying misses,
+    and a streak disables its memo path outright — with the answers still
+    exact through the dense remainder."""
+    registry = _registry()
+    events = io.StringIO()
+    with SessionRouter(
+        _cfg(serve_memo_warmup=0, serve_memo_disable_after=2),
+        registry=registry,
+        events=EventLog(stream=events),
+    ) as router:
+        sid = router.create(tenant="t1", rule="day-and-night", height=64,
+                            width=64, seed=77)["id"]
+        board = random_grid((64, 64), density=0.5, seed=77)
+        total = 0
+        for _ in range(3):
+            epoch, digest = router.step(sid, steps=16)
+            total += 16
+            assert epoch == total
+        want = _oracle("day-and-night", board, total)
+        assert digest == odigest.value(odigest.digest_dense_np(want))
+        np.testing.assert_array_equal(router.get(sid)["board"], want)
+        sess = router._sessions[sid]
+        assert sess.memo is not None and sess.memo.disabled
+        assert registry.value("gol_serve_memo_disables_total") >= 1
+        names = [json.loads(l)["event"] for l in
+                 events.getvalue().splitlines()]
+        assert "memo_disabled" in names
+        # Disabled = not even hashed anymore: further steps add no probes.
+        hits = registry.value("gol_serve_memo_hits_total", tenant="t1")
+        misses = registry.value("gol_serve_memo_misses_total", tenant="t1")
+        router.step(sid, steps=16)
+        assert registry.value(
+            "gol_serve_memo_hits_total", tenant="t1"
+        ) == hits
+        assert registry.value(
+            "gol_serve_memo_misses_total", tenant="t1"
+        ) == misses
+
+
+def test_certification_catches_corrupted_cache_entry():
+    """The sampled-certification drill: poison a cache entry, step a still
+    life through it, and the digest plane must page — mismatch counters,
+    loud event — while the DIRECT board wins the commit."""
+    registry = _registry()
+    events = io.StringIO()
+    with SessionRouter(
+        _cfg(serve_memo_certify_every=1),
+        registry=registry,
+        events=EventLog(stream=events),
+    ) as router:
+        board = np.zeros((32, 32), dtype=np.uint8)
+        board[8:10, 8:10] = 1  # block still life: every round re-probes
+        sid = router.create(tenant="t1", height=32, width=32, seed=0,
+                            density=0.0)["id"]
+        with router._lock:
+            sess = router._sessions[sid]
+            sess.board = board
+            sess.lanes = odigest.digest_dense_np(board)
+            sess.population = 4
+        router.step(sid, steps=8)  # one warm round, certified clean
+        assert registry.value("gol_memo_certify_mismatches_total") == 0
+        # Poison every resident entry: flip the corner cell of each
+        # center and re-encode so its digest lanes re-derive corrupt too.
+        # The board-chain level would serve this still life whole (its
+        # round is a fixed point) — clear it so the next round goes
+        # through the poisoned block path.
+        router._memo.board_cache._entries.clear()
+        router._memo.board_cache.bytes = 0
+        cache = router._memo.cache
+        assert len(cache) > 0
+        for e in cache._entries.values():
+            bad = e.center.copy()
+            bad[0, 0] ^= 1
+            bad.setflags(write=False)
+            e.center = bad
+            e.center_payload = mblock.encode_blocks(bad[None], 2)[0]
+            e.pop = int((bad == 1).sum())
+        epoch, digest = router.step(sid, steps=8)
+        assert registry.value("gol_memo_certify_total") >= 2
+        assert registry.value("gol_memo_certify_mismatches_total") >= 1
+        # The trusted direct board won: the still life is intact and the
+        # client's digest matches the oracle despite the poisoned cache.
+        assert epoch == 16
+        want = _oracle("conway", board, 16)
+        assert digest == odigest.value(odigest.digest_dense_np(want))
+        np.testing.assert_array_equal(router.get(sid)["board"], want)
+        # The session left the memo plane for good, loudly.
+        assert router._sessions[sid].memo.disabled
+        assert registry.value("gol_serve_memo_disables_total") >= 1
+        names = [json.loads(l)["event"] for l in
+                 events.getvalue().splitlines()]
+        assert "memo_certify_mismatch" in names
+
+
+def test_board_chain_level_carries_periodic_orbits():
+    """The whole-board chain cache: a board whose macro-round is a fixed
+    point (oscillator periods dividing S) advances on board hits alone
+    after the first round — and stays bit-exact."""
+    registry = _registry()
+    with SessionRouter(_cfg(), registry=registry) as router:
+        board = np.zeros((32, 32), dtype=np.uint8)
+        board[4:6, 4:6] = 1        # block still life
+        board[20, 10:13] = 1       # blinker, period 2 (divides S=8)
+        sid = router.create(tenant="t1", height=32, width=32, seed=0,
+                            density=0.0)["id"]
+        with router._lock:
+            sess = router._sessions[sid]
+            sess.board = board
+            sess.lanes = odigest.digest_dense_np(board)
+            sess.population = int(board.sum())
+        epoch, digest = router.step(sid, steps=80)  # 10 macro-rounds
+        assert epoch == 80
+        want = _oracle("conway", board, 80)
+        assert digest == odigest.value(odigest.digest_dense_np(want))
+        np.testing.assert_array_equal(router.get(sid)["board"], want)
+        bc = router._memo.board_cache
+        assert bc.hits >= 8  # rounds 2..10 rode the chain level
+        assert bc.stats()["board_entries"] >= 1
+
+
+def test_imported_session_arrives_memo_cold_and_rewarms():
+    """The cache is process state: a migrated/promoted session ships NO
+    memo state, lands cold (memo=None), and re-warms against the
+    destination's cache with exact results."""
+    reg_a, reg_b = _registry(), _registry()
+    with SessionRouter(_cfg(), registry=reg_a) as src, SessionRouter(
+        _cfg(), registry=reg_b
+    ) as dst:
+        sid = src.create(tenant="t1", height=64, width=64, seed=55)["id"]
+        src.step(sid, steps=32)
+        assert src._sessions[sid].memo is not None  # warmed at the source
+        dst.import_sessions(src.export_sessions([sid]))
+        moved = dst._sessions[sid]
+        assert moved.memo is None  # arrived cold — nothing replicated
+        epoch, digest = dst.step(sid, steps=32)
+        assert epoch == 64
+        want = _oracle("conway", random_grid((64, 64), density=0.5, seed=55),
+                       64)
+        assert digest == odigest.value(odigest.digest_dense_np(want))
+        # It re-warmed: the destination's memo plane carried epochs.
+        assert moved.memo is not None
+        assert reg_b.value("gol_serve_memo_epochs_total", tenant="t1") > 0
+
+
+def test_memo_tenant_metric_children_reclaimed_on_last_delete():
+    """The memo plane's tenant-labelled counters honor the same
+    exposition-growth contract as the rest of the serve surface."""
+    registry = _registry()
+    with SessionRouter(_cfg(), registry=registry) as router:
+        sid = router.create(tenant="burst", height=64, width=64, seed=1)["id"]
+        router.step(sid, steps=16)
+        assert 'tenant="burst"' in registry.render()
+        router.delete(sid)
+        assert "burst" not in registry.render()
+
+
+def test_cost_doc_grows_serve_memo_section():
+    """Cache economics federate into the cost observatory: the engine
+    registers a serve_memo section that /cost merges and reports."""
+    from akka_game_of_life_tpu.obs.programs import get_programs
+
+    registry = _registry()
+    with SessionRouter(_cfg(), registry=registry) as router:
+        sid = router.create(tenant="t1", height=64, width=64, seed=2)["id"]
+        router.step(sid, steps=32)
+        sec = get_programs().summary()["sections"]["serve_memo"]
+        assert sec["hits"] + sec["misses"] > 0
+        assert get_programs().cost_doc()["sections"]["serve_memo"][
+            "entries"
+        ] > 0

@@ -56,6 +56,8 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
      "SimulationConfig observability fields"),
     ("GL-CFG11", "--obs-* flags ↔ SimulationConfig obs_* fields and "
      "--bench-regress-* flags ↔ RegressPolicy fields"),
+    ("GL-CFG12", "--serve-memo* flags ↔ SimulationConfig serve_memo* "
+     "fields"),
     ("GL-DOC01", "gol_* metric literals ↔ obs catalog ↔ OPERATIONS.md"),
     ("GL-DOC02", "span names ↔ SPAN_CATALOG ↔ OPERATIONS.md"),
     ("GL-DOC03", "protocol messages ↔ OPERATIONS.md table"),

@@ -149,6 +149,20 @@ BENCH_REGRESS_CONFIG = FlagConfigSpec(
     cli_path="bench_suite.py", config_path="tools/bench_regress.py",
 )
 
+# The memoized macro-stepping knob family mirrors GL-CFG08/09's shape:
+# one gate (``--serve-memo`` ↔ ``serve_memo``) plus ``serve_memo_*``
+# tuning knobs, pinned as its own bijection beside the blanket GL-CFG04
+# so the family cannot drift into a spelling the generic strip would
+# still accept.
+SERVE_MEMO_CONFIG = FlagConfigSpec(
+    name="serve_memo_config", pass_id="GL-CFG12",
+    flag_regex=r"""["'](--serve-memo(?:-[a-z0-9-]+)?)["']""",
+    config_class="SimulationConfig",
+    field_regex=r"^    (serve_memo\w*)\s*:",
+    flag_strip="--serve-memo", field_prefix="serve_memo_",
+    bare_field="serve_memo",
+)
+
 SPARSE_CONFIG = FlagConfigSpec(
     name="sparse_config", pass_id="GL-CFG05",
     flag_regex=r"""["'](--sparse-[a-z0-9-]+)["']""",
@@ -330,7 +344,7 @@ GRAFTLINT_DOC = CatalogSpec(
 SPECS = (
     CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SERVE_DOC,
     SERVE_REPLICATE_CONFIG, SERVE_TILED_RESIDENT_CONFIG, SERVE_OBS_CONFIG,
-    OBS_PROGRAMS_CONFIG, BENCH_REGRESS_CONFIG,
+    SERVE_MEMO_CONFIG, OBS_PROGRAMS_CONFIG, BENCH_REGRESS_CONFIG,
     SPARSE_CONFIG, FF_CONFIG, FF_DOC, KERNEL_CONFIG, METRICS_DOC,
     TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
 )
