@@ -727,6 +727,76 @@ def _serve_overrides(args: argparse.Namespace) -> dict:
     }
 
 
+def _add_frontend_federation(p: argparse.ArgumentParser) -> None:
+    """The frontend-federation knobs (``serve/federation.py``).  Every
+    ``--frontend-X`` flag maps 1:1 onto ``SimulationConfig.frontend_X``
+    (dashes to underscores) — graftlint GL-CFG13 enforces the bijection."""
+    g = p.add_argument_group(
+        "frontend federation",
+        "horizontal frontend scale-out: N frontends gossip membership and "
+        "slice ownership, forward foreign-slice ops peer-to-peer, and "
+        "replicate control state for HA (see docs/OPERATIONS.md "
+        "\"Frontend scale-out & HA\")",
+    )
+    g.add_argument(
+        "--frontend-seeds", default=None, metavar="H1:P1,H2:P2,...",
+        help="comma-separated peer-plane seed addresses of any live "
+        "frontends; arming this is the federation master switch (a node "
+        "may seed itself harmlessly; default off)",
+    )
+    g.add_argument(
+        "--frontend-advertise", default=None, metavar="HOST:PORT",
+        help="peer address this frontend advertises to the federation "
+        "(default: the bound host + an ephemeral peer port)",
+    )
+    g.add_argument(
+        "--frontend-gossip-interval-s", default=None, metavar="DUR",
+        help="gossip cadence: membership + slice-table deltas + budget "
+        "shares to every live peer per tick (default 0.5s)",
+    )
+    g.add_argument(
+        "--frontend-gossip-timeout-s", default=None, metavar="DUR",
+        help="heartbeat age past which a peer is suspect — its slices "
+        "park writes (429) until the link closes (promotion) or gossip "
+        "resumes (default 3s)",
+    )
+    g.add_argument(
+        "--frontend-replicate-every", type=int, default=None, metavar="N",
+        help="flush the control-state dirty-row buffer to the standby "
+        "peer once it holds N rows (interval flushes any remainder; "
+        "default 16)",
+    )
+    g.add_argument(
+        "--frontend-replicate-interval-s", default=None, metavar="DUR",
+        help="control-state replication stream-pass cadence (default "
+        "0.25s)",
+    )
+
+
+def _frontend_overrides(args: argparse.Namespace) -> dict:
+    """``--frontend-*`` flags → SimulationConfig override kwargs."""
+    return {
+        "frontend_seeds": args.frontend_seeds,
+        "frontend_advertise": args.frontend_advertise,
+        "frontend_gossip_interval_s": (
+            parse_duration(args.frontend_gossip_interval_s)
+            if args.frontend_gossip_interval_s is not None
+            else None
+        ),
+        "frontend_gossip_timeout_s": (
+            parse_duration(args.frontend_gossip_timeout_s)
+            if args.frontend_gossip_timeout_s is not None
+            else None
+        ),
+        "frontend_replicate_every": args.frontend_replicate_every,
+        "frontend_replicate_interval_s": (
+            parse_duration(args.frontend_replicate_interval_s)
+            if args.frontend_replicate_interval_s is not None
+            else None
+        ),
+    }
+
+
 def _add_chaos_net(p: argparse.ArgumentParser) -> None:
     """The network chaos plane's knobs (``runtime/netchaos.py``).  Every
     ``--chaos-net-X`` flag maps 1:1 onto ``NetworkChaosConfig.X`` (dashes to
@@ -987,6 +1057,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # The simulation frontend can ALSO host the serve plane (one cluster,
     # both products): --serve-cluster on mounts /boards on its obs port.
     _add_serve(fe_p)
+    _add_frontend_federation(fe_p)
     _add_chaos_net(fe_p)
 
     sv_p = sub.add_parser(
@@ -1014,6 +1085,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="workers to wait for before serving (--serve-cluster on)",
     )
     _add_serve(sv_p)
+    _add_frontend_federation(sv_p)
     _add_ff(sv_p)
     _add_obs_programs(sv_p)
 
@@ -1191,6 +1263,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             **_ring_plane_overrides(args),
             **_rebalance_overrides(args),
             **_serve_overrides(args),
+            **_frontend_overrides(args),
             wait_for_backends_s=(
                 parse_duration(args.wait_for_backends)
                 if args.wait_for_backends is not None
@@ -1226,6 +1299,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "host": args.host,
                 "port": args.port,
                 **_serve_overrides(args),
+                **_frontend_overrides(args),
                 **_ff_overrides(args),
                 **_obs_programs_overrides(args),
             },

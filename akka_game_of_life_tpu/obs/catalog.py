@@ -189,6 +189,30 @@ CATALOG = (
     ("gol_serve_sessions_lost_total", "counter",
      "Sessions lost to worker failure (no replica, never-acked, or a "
      "double failure) — each one is a tenant-visible 404", ()),
+    # -- frontend federation (serve/federation.py) ----------------------------
+    ("gol_frontend_peers", "gauge",
+     "Live federation peer frontends (connected AND gossip-fresh)", ()),
+    ("gol_frontend_gossip_age_seconds", "gauge",
+     "Seconds since the last frame from each peer frontend (label "
+     "reclaimed when the peer is confirmed dead)", ("peer",)),
+    ("gol_frontend_forwarded_ops_total", "counter",
+     "Serve ops forwarded to the owning peer frontend over the peer "
+     "link (P_FWD_OPS)", ()),
+    ("gol_frontend_forward_redirects_total", "counter",
+     "Fat-payload requests answered with a 307 to the owning frontend "
+     "instead of proxied (GET /boards/<id>)", ()),
+    ("gol_frontend_slice_promotions_total", "counter",
+     "Slices adopted from a confirmed-dead peer frontend by its "
+     "rendezvous standby", ()),
+    ("gol_frontend_slices_owned", "gauge",
+     "Serve-keyspace slices this frontend currently owns", ()),
+    ("gol_frontend_parked_ops_total", "counter",
+     "Ops parked with retryable 429 'partitioned' because the owning "
+     "frontend is suspect but not provably dead (the split-brain guard)",
+     ()),
+    ("gol_frontend_replicated_rows_total", "counter",
+     "Control-state rows streamed to this frontend's standby peer "
+     "(P_REPLICATE)", ()),
     # -- per-tenant SLO plane (obs/slo.py, served at /slo) --------------------
     ("gol_serve_slo_requests_total", "counter",
      "HTTP requests against the serve surface, per tenant/route/outcome "

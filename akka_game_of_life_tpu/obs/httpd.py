@@ -115,13 +115,19 @@ class MetricsServer:
             # client stepping a board per tick would otherwise pay a TCP
             # setup per request.
             protocol_version = "HTTP/1.1"
-            def _respond(self, code: int, ctype: str, body: bytes) -> None:
+            def _respond(
+                self, code: int, ctype: str, body: bytes, headers=None
+            ) -> None:
                 # Headers + body only AFTER the body is a finished byte
                 # string: rendering (and its locks) never overlaps the
                 # socket write, and Content-Length is always exact.
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    # Optional extra headers (a 307's Location) from
+                    # 4-tuple route returns.
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -165,13 +171,14 @@ class MetricsServer:
                     # can read parameters; routing above matched on the
                     # stripped path.  Handlers that parse path segments
                     # must split off "?" themselves (see strip_query).
-                    status, ctype, payload = handler(method, self.path, body)
+                    # Returns are (status, ctype, body) or, for routes
+                    # that set extra headers (the federation's 307
+                    # Location), (status, ctype, body, headers).
+                    result = handler(method, self.path, body)
                 except Exception as e:  # noqa: BLE001 — a route bug must
                     # not kill the connection thread silently
-                    status, ctype, payload = json_response(
-                        500, {"error": repr(e)}
-                    )
-                self._respond(status, ctype, payload)
+                    result = json_response(500, {"error": repr(e)})
+                self._respond(*result[:3], result[3] if len(result) > 3 else None)
 
             def do_GET(self):  # noqa: N802 — http.server API
                 self._dispatch("GET")

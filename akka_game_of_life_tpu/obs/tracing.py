@@ -89,6 +89,10 @@ SPAN_CATALOG = (
     ("serve.promote", "one shard replica promoted to primary after a "
      "worker loss (digest-certified; sessions resume at their "
      "replicated epoch)"),
+    ("serve.fed_promote", "one federation promotion window: a dead "
+     "frontend's slice adopted from replicated control rows, open until "
+     "the orphaned worker's shard_home announcement (or expiry = honest "
+     "session loss)"),
     ("serve.request", "one HTTP request against the /boards surface, "
      "minted (or adopted) at the edge — the root every serve-plane span "
      "for that request links under"),

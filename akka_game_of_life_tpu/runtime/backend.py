@@ -787,6 +787,9 @@ class BackendWorker:
         # frontend's serve plane is on — this worker then hosts session
         # shards in its own vmapped batch engine (serve/worker.py).
         self.serve_plane = None
+        # Federation re-home targets (peer frontends' worker listeners),
+        # installed from WELCOME and refreshed by FED_PEERS pushes.
+        self._federation_fallbacks: List[Tuple[str, int]] = []
         self.rule: Optional[Rule] = None
         self.target = 0
         self.final_epoch = 0
@@ -922,15 +925,26 @@ class BackendWorker:
                 node=welcome.get("name") or self.name,
                 enabled=bool(_obs.get("programs", True)),
             )
+        # Federation fallbacks: peer frontends' worker listeners, the
+        # re-home targets if THIS frontend dies (kept current via
+        # FED_PEERS pushes).  Empty outside a federated cluster.
+        self._federation_fallbacks = [
+            (str(a[0]), int(a[1]))
+            for a in welcome.get("federation") or []
+            if isinstance(a, (list, tuple)) and len(a) == 2
+        ]
         if welcome.get("serve_cluster"):
             from akka_game_of_life_tpu.serve.worker import ServeWorkerPlane
 
             # The serve knobs arrive in WELCOME like every other cluster
             # policy bundle; the plane owns a local SessionRouter (the PR 7
-            # batch engine, unchanged) plus the op/shard wire glue.
+            # batch engine, unchanged) plus the op/shard wire glue.  The
+            # plane sends through _control_send — a late-bound wrapper, so
+            # a control-channel re-home after a frontend loss redirects
+            # its frames without rebuilding the plane (sessions intact).
             self.serve_plane = ServeWorkerPlane(
                 welcome.get("serve", {}),
-                self.channel.send,
+                self._control_send,
                 name=self.name or "",
                 registry=self.registry,
                 tracer=self.tracer,
@@ -966,8 +980,19 @@ class BackendWorker:
             self.connect()
         try:
             while not self._stop.is_set():
-                msg = self.channel.recv()
+                try:
+                    msg = self.channel.recv()
+                except (OSError, ValueError):
+                    # Wire failure mid-read: in a federated cluster the
+                    # frontend may have died while this worker's sessions
+                    # live on — re-home the control channel instead of
+                    # tearing the worker down.
+                    if self._rehome():
+                        continue
+                    raise
                 if msg is None:
+                    if self._rehome():
+                        continue
                     self.stopped_reason = self.stopped_reason or "disconnected"
                     break
                 self._dispatch(msg)
@@ -990,6 +1015,81 @@ class BackendWorker:
             self._stop.set()
             raise
         return 0 if self.stopped_reason in ("shutdown", "drained") else 1
+
+    def _control_send(self, msg: dict) -> None:
+        """Late-bound control-channel send: reads ``self.channel`` at call
+        time, so the serve plane's bound sender follows a re-home instead
+        of writing into a dead socket forever."""
+        self.channel.send(msg)
+
+    def _rehome(self) -> bool:
+        """Control channel lost in a FEDERATED cluster: dial a surviving
+        peer frontend from the FED_PEERS fallback list, re-REGISTER under
+        the SAME name (sessions live in this process — nothing is lost),
+        and announce the hosted session truth with ``SHARD_HOME`` so the
+        adopting frontend closes its failover window.  Returns True when
+        the worker is homed on a new frontend; False means a normal
+        disconnect (not federated, stopping, or no fallback answered)."""
+        if (
+            self._stop.is_set()
+            or self.serve_plane is None
+            or not self._federation_fallbacks
+        ):
+            return False
+        deadline = time.monotonic() + 15.0  # graftlint: waive GL-HAZ04 -- real-time re-home bound pairs with the sleep pacing below; an unreachable federation must fail finitely
+        while time.monotonic() < deadline and not self._stop.is_set():
+            for host, port in list(self._federation_fallbacks):
+                if (host, port) == (self.host, self.port):
+                    continue  # the frontend that just died
+                try:
+                    sock = socket.create_connection((host, port), timeout=3)
+                    sock.settimeout(None)
+                    channel = Channel(sock)
+                    channel.send({
+                        "type": P.REGISTER,
+                        "name": self.name,
+                        "peer_port": self.peer_port,
+                        "engine": self.engine,
+                        "pallas": self.pallas or "auto",
+                    })
+                    welcome = channel.recv()
+                    if not welcome or welcome.get("type") != P.WELCOME:
+                        channel.close()
+                        continue
+                except (OSError, ValueError):
+                    continue
+                # Swap BEFORE announcing: _control_send and the heartbeat
+                # loop read self.channel at call time, so from here on
+                # every serve frame rides the new home.
+                old = self.channel
+                self.channel = channel
+                self.host, self.port = host, port
+                if self.send_deadline_s:
+                    channel.set_send_deadline(self.send_deadline_s)
+                self._federation_fallbacks = [
+                    (str(a[0]), int(a[1]))
+                    for a in welcome.get("federation") or []
+                    if isinstance(a, (list, tuple)) and len(a) == 2
+                ] or self._federation_fallbacks
+                try:
+                    old.close()
+                except OSError:
+                    pass
+                try:
+                    channel.send({
+                        "type": P.SHARD_HOME,
+                        **self.serve_plane.home_summary(),
+                    })
+                except OSError:
+                    continue  # the new home died instantly; keep trying
+                print(
+                    f"worker {self.name} re-homed control channel to "
+                    f"{host}:{port}",
+                    flush=True,
+                )
+                return True
+            time.sleep(0.25)
+        return False
 
     def _run_pre_stop_hooks(self) -> None:
         with self._pre_stop_lock:
@@ -1314,6 +1414,12 @@ class BackendWorker:
                 self.channel.send({"type": P.HEARTBEAT})
                 self._m_heartbeats.inc()
             except OSError:
+                # Federated worker: the run() loop may be mid-re-home onto
+                # a surviving frontend — keep this ONE loop alive (it reads
+                # self.channel at each send, so it follows the swap) rather
+                # than racing a restarted thread against it.
+                if self.serve_plane is not None and self._federation_fallbacks:
+                    continue
                 return
 
     def _cost_loop(self, interval: float) -> None:
@@ -1510,6 +1616,15 @@ class BackendWorker:
             # heartbeat-adjacent control traffic.
             if self.serve_plane is not None:
                 self.serve_plane.handle(msg)
+        elif kind == P.FED_PEERS:
+            # Federation peer set changed: refresh the control re-home
+            # fallback list (workers that registered before the federation
+            # converged learn their fallbacks through this push).
+            self._federation_fallbacks = [
+                (str(a[0]), int(a[1]))
+                for a in msg.get("peers") or []
+                if isinstance(a, (list, tuple)) and len(a) == 2
+            ]
         elif kind == P.PROFILE:
             # Cluster profiler fan-out: the capture runs on a daemon
             # thread — a multi-second jax.profiler window must never block

@@ -509,6 +509,36 @@ class SimulationConfig:
     # and digest-compared (gol_memo_certify_*).  0 disables sampling —
     # benchmark configs only; production keeps a nonzero cadence.
     serve_memo_certify_every: int = 64
+    # -- frontend federation (docs/OPERATIONS.md "Frontend scale-out &
+    # HA"): N frontend processes behind ordinary HTTP load balancing, each
+    # owning a rendezvous-hashed slice of the serve shard space, with no
+    # coordinator.  Every field maps to a --frontend-* flag (graftlint
+    # GL-CFG13 enforces the bijection).  frontend_seeds is the master
+    # switch: comma-separated host:port PEER-plane addresses of any live
+    # frontends (Akka Cluster seed-nodes, application.conf:7-12); a node
+    # seeds itself harmlessly.  "" = federation off (single frontend).
+    frontend_seeds: str = ""
+    # Advertised peer address as host:port ("" = the bound host and an
+    # ephemeral peer port — fine on one machine; multi-host deployments
+    # set the externally reachable address).
+    frontend_advertise: str = ""
+    # Gossip cadence: each tick sends membership + slice-table deltas +
+    # budget shares to every live peer and re-dials lost ones.
+    frontend_gossip_interval_s: float = 0.5
+    # Heartbeat age past which a peer is SUSPECT: its slices are contested
+    # — writes park with retryable 429 — until the link actually closes
+    # (confirmed death → promotion) or gossip resumes (flap → no-op).
+    # This asymmetry is the split-brain guard: silence alone never
+    # transfers ownership.
+    frontend_gossip_timeout_s: float = 3.0
+    # Control-state replication to the slice's standby peer: flush the
+    # dirty-row buffer once it holds this many rows (the interval flushes
+    # any dirty remainder regardless, so convergence is exact once
+    # traffic stops).
+    frontend_replicate_every: int = 16
+    # The dirty-row stream pass cadence (also paces ack-watermark
+    # retransmit after a peer reconnect).
+    frontend_replicate_interval_s: float = 0.25
     # -- logarithmic fast-forward (docs/OPERATIONS.md "Logarithmic
     # fast-forward").  XOR-linear (odd-rule) boards jump T epochs in
     # O(log T) device programs (ops/fastforward.py); non-linear rules are
@@ -852,6 +882,42 @@ class SimulationConfig:
                 f"serve_memo_certify_every={self.serve_memo_certify_every} "
                 f"must be >= 0 (0 = no sampled certification)"
             )
+        for name in ("frontend_seeds", "frontend_advertise"):
+            value = getattr(self, name)
+            entries = [s for s in value.split(",") if s.strip()]
+            if name == "frontend_advertise" and len(entries) > 1:
+                raise ValueError(
+                    f"frontend_advertise={value!r} must be one host:port"
+                )
+            for entry in entries:
+                host, sep, port = entry.strip().rpartition(":")
+                if not sep or not host or not port.isdigit():
+                    raise ValueError(
+                        f"{name} entry {entry.strip()!r} must be host:port"
+                    )
+        if self.frontend_gossip_interval_s <= 0:
+            raise ValueError(
+                f"frontend_gossip_interval_s="
+                f"{self.frontend_gossip_interval_s} must be > 0"
+            )
+        if self.frontend_gossip_timeout_s <= self.frontend_gossip_interval_s:
+            raise ValueError(
+                f"frontend_gossip_timeout_s="
+                f"{self.frontend_gossip_timeout_s} must exceed "
+                f"frontend_gossip_interval_s="
+                f"{self.frontend_gossip_interval_s} (a peer must miss "
+                f"multiple gossip ticks before it is suspect)"
+            )
+        if self.frontend_replicate_every < 1:
+            raise ValueError(
+                f"frontend_replicate_every={self.frontend_replicate_every} "
+                f"must be >= 1"
+            )
+        if self.frontend_replicate_interval_s <= 0:
+            raise ValueError(
+                f"frontend_replicate_interval_s="
+                f"{self.frontend_replicate_interval_s} must be > 0"
+            )
         if self.ff_certify_steps < 0:
             raise ValueError(
                 f"ff_certify_steps={self.ff_certify_steps} must be >= 0 "
@@ -906,6 +972,9 @@ _DURATION_FIELDS = {
     "serve_ttl_s",
     "serve_replicate_interval_s",
     "serve_replicate_max_lag_s",
+    "frontend_gossip_interval_s",
+    "frontend_gossip_timeout_s",
+    "frontend_replicate_interval_s",
     "serve_tiled_resident_halo_timeout_s",
     "serve_slo_fast_window_s",
     "serve_slo_slow_window_s",

@@ -99,6 +99,7 @@ _MSG_REQUIRED = {
     P.DRAIN_REQUEST: (),
     P.SERVE_RESULT: ("results",),
     P.SHARD_STATE: ("shard", "seq"),
+    P.SHARD_HOME: ("sessions",),
 }
 # TILE_STATE carries per-reason payloads; each declared reason needs its key.
 _REASON_PAYLOAD = {
@@ -469,6 +470,29 @@ class Frontend:
             (config.host, config.port), reuse_port=False
         )
         self.port = self._listener.getsockname()[1]
+        # Frontend federation (docs/OPERATIONS.md "Frontend scale-out &
+        # HA"): when --frontend-seeds names peer frontends, this frontend
+        # gossips membership + slice ownership with them, forwards
+        # foreign-slice serve ops, and replicates its control state to a
+        # rendezvous standby.  Constructed after the listener so the
+        # advertised identity carries the real bound port.
+        self.federation = None
+        if self.serve_plane is not None and config.frontend_seeds:
+            from akka_game_of_life_tpu.serve.federation import FederationPlane
+
+            adv = config.frontend_advertise or (
+                f"{config.host}:{self.port}"
+            )
+            host, _, port_s = adv.rpartition(":")
+            if host in ("0.0.0.0", ""):
+                host = "127.0.0.1"
+            self.federation = FederationPlane(
+                config, self.serve_plane,
+                name=f"{host}:{int(port_s)}",
+                cluster_addr=(host, int(port_s)),
+                events=self.events,
+            )
+            self.federation.on_peers_changed(self._push_fed_peers)
         self._threads: List[threading.Thread] = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -498,7 +522,13 @@ class Frontend:
                 )
                 routes.update(
                     board_routes(
-                        self.serve_plane, tracer=self.tracer,
+                        # With federation on, /boards mounts the federated
+                        # router: same surface, one extra routing level
+                        # (slice owner) above the plane's shard table.
+                        self.federation.router
+                        if self.federation is not None
+                        else self.serve_plane,
+                        tracer=self.tracer,
                         slo=self._serve_slo,
                     )
                 )
@@ -509,6 +539,12 @@ class Frontend:
                 tracer=self.tracer,
                 routes=routes,
             )
+        if self.federation is not None:
+            if self._metrics_server is not None:
+                # Peers learn this HTTP endpoint via gossip — it is where
+                # their 307 redirects for this frontend's boards point.
+                self.federation.set_http_port(self._metrics_server.port)
+            self.federation.start()
         for fn in (self._accept_loop, self._maintenance_loop, self._io_loop):
             t = threading.Thread(target=fn, daemon=True, name=fn.__name__)
             t.start()
@@ -544,10 +580,28 @@ class Frontend:
             # Outside the frontend lock (frontend → plane is the one
             # permitted nesting order, and health() takes the plane lock).
             doc["serve"] = self.serve_plane.health()
+        if self.federation is not None:
+            # The federation view: peers + gossip ages, the slice map,
+            # forwarded-op/parked counters, promotions in flight.
+            doc["federation"] = self.federation.health()
         # Cost observatory digest (registry takes its own lock): program
         # counts, compile bill, storms, per-member warmth.
         doc["programs"] = self.programs.health_summary()
         return doc
+
+    def _push_fed_peers(self) -> None:
+        """Federation peer set changed: re-push the control re-home
+        fallback list to every live worker (FED_PEERS), so workers that
+        registered before the federation converged — or that outlive a
+        peer loss — always hold current fallbacks."""
+        fallbacks = self.federation.worker_fallbacks()
+        for m in self.membership.alive_members():
+            try:
+                m.channel.send(
+                    {"type": P.FED_PEERS, "peers": fallbacks}
+                )
+            except OSError:
+                pass
 
     def _cluster_profile(self, seconds: Optional[float]) -> dict:
         """POST /profile: capture locally first — the rate limiter lives
@@ -895,12 +949,33 @@ class Frontend:
 
     def stop(self) -> None:
         self._stop.set()
+        handoff = False
+        if self.federation is not None:
+            # Computed BEFORE close() clears the peer table: are there
+            # live peers this frontend's workers can re-home to?
+            handoff = bool(self.federation.worker_fallbacks())
+            # Before the plane closes: peer links drop cleanly (survivors
+            # see EOF + a refused redial and promote — the rolling-restart
+            # discipline), and no forwarded op can land on a closed plane.
+            self.federation.close()
         if self.serve_plane is not None:
             # Before SHUTDOWN frames: pending tenant ops fail fast with
             # "router is closed" instead of timing out against workers
             # that are about to leave.
             self.serve_plane.close()
         for m in self.membership.alive_members():
+            if handoff:
+                # Rolling-restart discipline: leave the serve workers
+                # RUNNING.  A SHUTDOWN would take every session this
+                # frontend owns down with it; an abrupt close instead
+                # makes the worker re-home (state intact) to a surviving
+                # peer via its FED_PEERS fallbacks and announce
+                # SHARD_HOME there — the same path a kill -9 exercises.
+                try:
+                    m.channel.close()
+                except OSError:
+                    pass
+                continue
             try:
                 m.channel.send({"type": P.SHUTDOWN})
             except OSError:
@@ -915,6 +990,14 @@ class Frontend:
             deadline = time.monotonic() + 2.0
             while self.membership.alive_members() and time.monotonic() < deadline:
                 time.sleep(0.01)
+        try:
+            # shutdown() before close(): the accept-loop thread blocked in
+            # accept() holds a kernel reference to the listening socket, so
+            # close() alone leaves the port accepting (and the redial-refused
+            # death confirmation peers rely on never fires).
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
@@ -1009,6 +1092,17 @@ class Frontend:
         member: Optional[Member] = None
         try:
             hello = channel.recv()
+            if (
+                isinstance(hello, dict)
+                and hello.get("type") == P.P_HELLO
+                and self.federation is not None
+            ):
+                # A peer FRONTEND dialed the worker listener: the federation
+                # peer plane shares this port (one address to seed, one
+                # firewall rule).  serve_peer answers the handshake and
+                # becomes this connection's reader until EOF.
+                self.federation.serve_peer(channel, hello)
+                return
             # The listener is an open TCP port: a hello that is not a
             # well-typed REGISTER (port scan, wrong peer, wrong version) is
             # closed without ceremony — and without a thread traceback.
@@ -1058,6 +1152,15 @@ class Frontend:
                     "serve_cluster": True,
                     "serve": serve_policy(self.config),
                 }
+                if self.federation is not None:
+                    # The control-channel re-home fallback list: the live
+                    # peer frontends' worker listeners.  Also re-pushed as
+                    # FED_PEERS whenever the peer set changes, so a worker
+                    # that registered before the federation converged still
+                    # learns its fallbacks.
+                    welcome_serve["federation"] = (
+                        self.federation.worker_fallbacks()
+                    )
             channel.send(
                 {
                     "type": P.WELCOME,
@@ -1255,6 +1358,12 @@ class Frontend:
         elif kind == P.SHARD_REPLICATE:
             if self.serve_plane is not None:
                 self.serve_plane.on_shard_replicate(member.name, msg)
+        elif kind == P.SHARD_HOME:
+            # A worker re-homed its control channel here after its previous
+            # frontend died: its session list is the truth that closes the
+            # federation failover window.
+            if self.serve_plane is not None:
+                self.serve_plane.on_shard_home(member.name, msg)
         elif kind == P.DRAIN_REQUEST:
             self._on_drain_request(member)
         elif kind == P.GOODBYE:

@@ -82,6 +82,37 @@ TILED_HALO_ACK              chunk's O(perimeter) edge strip for a
                             the frontend never touches per-round cell
                             state; the ack clears the sender's
                             retransmit buffer
+P_HELLO                     (new) frontend federation: first frame on a
+                            freshly dialed peer link — name, advertised
+                            addresses, incarnation — answered with the
+                            receiver's own hello (the Akka Cluster seed
+                            handshake, application.conf:7-12)
+P_GOSSIP                    (new) frontend federation: heartbeat-aged
+                            membership + slice-table deltas (LWW by
+                            version) + cluster-budget shares, the
+                            convergence vehicle (application.conf:23-26)
+P_FWD_OPS /                 (new) frontend federation: serve ops for a
+P_FWD_RESULT                foreign slice forwarded to the owning
+                            frontend over the peer link (per-peer FIFO,
+                            executed in arrival order on the owner) and
+                            the coalesced results back
+P_REPLICATE /               (new) frontend federation: a frontend's
+P_REPLICATE_ACK             slice of control state — session index
+                            rows, replication watermarks, certified
+                            floors — streamed to its standby peer with
+                            the PR 14 seq/ack watermark discipline, so
+                            a SIGKILLed frontend's slice promotes from
+                            the last acked row set
+SHARD_HOME                  (new) worker → frontend after a control-
+                            channel re-home: the shards + session truth
+                            this worker hosts, so the adopting frontend
+                            replaces promoted placeholder rows with
+                            worker truth and clears the failover window
+FED_PEERS                   (new) frontend → worker whenever the
+                            federation peer set changes: the live peer
+                            frontends' cluster addresses, the fallback
+                            list a worker re-homes its control channel
+                            to after a frontend loss
 ==========================  ====================================================
 
 Every message constant below must appear in docs/OPERATIONS.md's
@@ -180,3 +211,25 @@ PEER_PULL = "peer_pull"
 # against chunk installs/steps/migrations like every other serve op)
 TILED_HALO = "tiled_halo"
 TILED_HALO_ACK = "tiled_halo_ack"
+
+# frontend ↔ frontend (the federation peer plane): gossip-converged
+# membership, slice-table deltas, forwarded serve ops, and control-state
+# replication between frontends — all on one per-peer FIFO link so
+# forwarded ops can never reorder against the slice-ownership control
+# frames that route them
+P_HELLO = "p_hello"
+P_GOSSIP = "p_gossip"
+P_FWD_OPS = "p_fwd_ops"
+P_FWD_RESULT = "p_fwd_result"
+P_REPLICATE = "p_replicate"
+P_REPLICATE_ACK = "p_replicate_ack"
+
+# worker → frontend: control-channel re-home announcement — after a
+# frontend loss the worker reconnects to a surviving peer and declares
+# the shards/sessions it hosts, which closes that slice's failover window
+SHARD_HOME = "shard_home"
+
+# frontend → worker: the live federation peers' cluster addresses (sent
+# in WELCOME and re-pushed whenever the peer set changes), the fallback
+# list the worker's control channel re-homes to after a frontend loss
+FED_PEERS = "fed_peers"

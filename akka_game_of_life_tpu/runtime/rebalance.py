@@ -374,6 +374,45 @@ class Rebalancer:
                 loads[dest] += 1
         return moves
 
+    def plan_slices(
+        self,
+        owners: Dict[int, str],
+        weights: Dict[int, int],
+        frontends,
+        me: str,
+    ) -> List[Tuple[int, str, str]]:
+        """(slice, source, dest) **frontend-slice** releases — the
+        planner's fourth resource type (the federation's serve-keyspace
+        slices; ``serve/federation.py``).
+
+        Deliberately the narrowest policy of the four: only EMPTY
+        self-owned slices move, and only to their rendezvous-desired
+        owner.  A non-empty slice never migrates between frontends —
+        sessions are process-resident, so moving a loaded slice would
+        mean moving boards across frontends for a placement preference;
+        ownership of loaded slices changes only through confirmed-death
+        promotion.  Empty releases are budget-free (ownership flips in
+        one gossip round, like ``plan_shards``'s weight-0 empties), and
+        the rendezvous target is deterministic over the live set, so a
+        release can never ping-pong.
+
+        ``owners`` is this frontend's view restricted to slices it owns;
+        ``frontends`` is the sorted live frontend-name list (self
+        included); ``me`` is this frontend's name."""
+        from akka_game_of_life_tpu.serve.sessions import rendezvous_pick
+
+        moves: List[Tuple[int, str, str]] = []
+        live = sorted(frontends)
+        if len(live) < 2:
+            return moves
+        for shard, owner in sorted(owners.items()):
+            if owner != me or weights.get(shard, 0):
+                continue
+            desired = rendezvous_pick(f"slice:{shard}", live)
+            if desired is not None and desired != me:
+                moves.append((shard, me, desired))
+        return moves
+
     def plan_resident(
         self,
         owners: Dict[tuple, str],

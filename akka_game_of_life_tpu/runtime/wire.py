@@ -185,6 +185,23 @@ class Channel:
         self.sock.close()
 
 
+def dial(
+    host: str,
+    port: int,
+    *,
+    timeout_s: float = 5.0,
+    send_deadline_s: float = 0.0,
+) -> Channel:
+    """Connect to a peer listener and wrap the socket as a Channel.  The
+    timeout bounds only the CONNECT (a dead seed address must not wedge a
+    gossip tick); the established channel reverts to blocking reads, with
+    the usual optional send deadline.  Raises OSError on failure — every
+    caller treats an undialable peer as simply not-yet-alive."""
+    sock = socket.create_connection((host, port), timeout=timeout_s)
+    sock.settimeout(None)
+    return Channel(sock, send_deadline_s=send_deadline_s)
+
+
 # -- trace-context envelope helpers -------------------------------------------
 #
 # Span context rides INSIDE the message JSON under obs.tracing.TRACE_KEY

@@ -61,6 +61,7 @@ from akka_game_of_life_tpu.obs.httpd import (
     strip_query,
 )
 from akka_game_of_life_tpu.obs.tracing import TRACE_KEY
+from akka_game_of_life_tpu.serve.federation import FederationRedirect
 from akka_game_of_life_tpu.serve.sessions import AdmissionError, SessionRouter
 
 
@@ -163,6 +164,15 @@ class BoardsRoute:
         reason: Optional[str] = None
         try:
             resp = self._dispatch(method, path, body)
+        except FederationRedirect as e:
+            # Federation: the board lives on a peer frontend and its
+            # payload is too fat to proxy — 307 preserves the method and
+            # points the client straight at the owner.
+            resp = (
+                307, JSON_TYPE,
+                (json.dumps({"location": e.url}) + "\n").encode("utf-8"),
+                {"Location": e.url},
+            )
         except AdmissionError as e:
             reason = e.reason
             doc = {

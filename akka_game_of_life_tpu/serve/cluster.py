@@ -102,6 +102,7 @@ from akka_game_of_life_tpu.serve.sessions import (
     JOB_GRACE_S,
     JOB_TIMEOUT_S,
     AdmissionError,
+    rendezvous_pick,
     shard_of,
     validate_create,
 )
@@ -1637,19 +1638,11 @@ class ClusterServePlane:
         (highest-random-weight by (shard, worker)), so a membership
         change re-homes only the shards that must move, not ~all of them
         the way a modulo ring would."""
-        import zlib
-
         if not self._replicate or owner is None:
             return None
         if current is not None and current != owner and current in names:
             return current
-        pool = [n for n in names if n != owner]
-        if not pool:
-            return None
-        return max(
-            pool,
-            key=lambda n: (zlib.crc32(f"{shard}:{n}".encode("utf-8")), n),
-        )
+        return rendezvous_pick(str(shard), (n for n in names if n != owner))
 
     def _refresh_replicas_locked(self) -> None:
         """Reconcile replica assignments with the current membership and
@@ -1957,6 +1950,164 @@ class ClusterServePlane:
                 promoted=promoted, lost=len(lost),
                 digest_refused=len(failed),
                 outcome="lost" if p.error is not None else "promoted",
+            )
+
+    # -- frontend federation hooks (serve/federation.py) ----------------------
+
+    def control_rows(self) -> List[dict]:
+        """This frontend's slice of control state, one row per session —
+        what the federation streams to its standby peer frontend.  Batch
+        rows promote into placeholder index entries on a confirmed
+        frontend death; tiled rows (``shard`` None — the cells live on
+        workers) ride as certified-floor observability only."""
+        with self._lock:
+            return [
+                {
+                    "sid": sid, "tenant": e.tenant, "kind": e.kind,
+                    "rule": e.rule_s, "height": e.height, "width": e.width,
+                    "seed": e.seed, "density": e.density, "shard": e.shard,
+                    "epoch": e.epoch, "digest": e.digest,
+                    "slice": shard_of(sid, self.n_shards),
+                }
+                for sid, e in self.sessions.items()
+            ]
+
+    def begin_federation_promotion(self, rows: List[dict], *,
+                                   origin: str) -> None:
+        """A peer frontend died and THIS frontend (its rendezvous
+        standby) adopted its slices: install the replicated batch rows as
+        placeholder index entries and open a federation failover window
+        per shard — windowed ops answer retryable 429 ``failover`` (never
+        404) until the dead frontend's workers re-home their control
+        channel here and announce session truth with ``SHARD_HOME``
+        (:meth:`on_shard_home`), or the re-home grace expires
+        (:meth:`expire_federation_promotion`)."""
+        now = time.monotonic()
+        shards: set = set()
+        installed = 0
+        with self._lock:
+            for row in rows:
+                if not isinstance(row, dict) or row.get("kind") != "batch":
+                    continue  # tiled cells live on workers; nothing to park
+                sid = str(row.get("sid", ""))
+                if not sid or sid in self.sessions:
+                    continue
+                shard = shard_of(sid, self.n_shards)
+                e = _Entry(
+                    sid, str(row.get("tenant", "default")), "batch",
+                    str(row.get("rule", "B3/S23")),
+                    int(row.get("height", 0)), int(row.get("width", 0)),
+                    int(row.get("seed", 0)),
+                    float(row.get("density", 0.5)), shard,
+                )
+                e.epoch = int(row.get("epoch", 0))
+                e.digest = row.get("digest")
+                e.repl_dirty_since = now
+                self.sessions[sid] = e
+                self._cells += e.height * e.width
+                shards.add(shard)
+                installed += 1
+            for shard in shards:
+                if shard in self._promoting:
+                    continue
+                self._promoting[shard] = {
+                    # dest=None can never collide with a worker name, so
+                    # the replica-promotion reply path (_on_promoted's
+                    # dest guard) ignores these windows.
+                    "fed": True, "dest": None, "origin": origin,
+                    "t0": now, "sessions": installed, "dropped": set(),
+                    "span": self.tracer.start(
+                        "serve.fed_promote", node="frontend", shard=shard,
+                        origin=origin,
+                    ),
+                }
+            self._rebuild_routes_locked()
+            self._wake.set()
+        if self.events is not None:
+            self.events.emit(
+                "serve_federation_promotion", origin=origin,
+                sessions=installed, shards=len(shards),
+            )
+
+    def expire_federation_promotion(self, shard: int) -> None:
+        """No ``SHARD_HOME`` arrived within the re-home grace — the dead
+        frontend's workers died with it.  Close the window honestly: the
+        placeholder sessions are lost (counted, evented), and the shard
+        reopens for fresh creates on a local worker."""
+        lost = 0
+        with self._lock:
+            info = self._promoting.get(shard)
+            if info is None or not info.get("fed"):
+                return
+            del self._promoting[shard]
+            for sid in [
+                s for s, e in self.sessions.items() if e.shard == shard
+            ]:
+                e = self.sessions.pop(sid)
+                self._cells -= e.height * e.width
+                self._m_sessions_lost.inc()
+                lost += 1
+            if info.get("span") is not None:
+                info["span"].set(outcome="lost", sessions=lost).finish()
+            self._rebuild_routes_locked()
+            self._wake.set()
+        if self.events is not None:
+            self.events.emit(
+                "serve_federation_promotion_expired", shard=shard, lost=lost,
+            )
+
+    def on_shard_home(self, member_name: str, msg: dict) -> None:
+        """A worker re-homed its control channel here after its frontend
+        died (``SHARD_HOME``): its session list IS the truth.  Install or
+        refresh index rows from it, point their shards at the worker,
+        close the federation failover windows they held (digest-certified
+        resume: the worker's epoch/digest replace the placeholder's
+        replicated floor), and let replication appoint fresh replicas."""
+        rows = [
+            r for r in (msg.get("sessions") or [])
+            if isinstance(r, dict) and r.get("id")
+        ]
+        now = time.monotonic()
+        touched: set = set()
+        closed = 0
+        with self._lock:
+            for row in rows:
+                sid = str(row["id"])
+                shard = shard_of(sid, self.n_shards)
+                e = self.sessions.get(sid)
+                if e is None:
+                    e = _Entry(
+                        sid, str(row.get("tenant", "default")), "batch",
+                        str(row.get("rule", "B3/S23")),
+                        int(row.get("height", 0)), int(row.get("width", 0)),
+                        int(row.get("seed", 0)),
+                        float(row.get("density", 0.5)), shard,
+                    )
+                    self.sessions[sid] = e
+                    self._cells += e.height * e.width
+                e.epoch = int(row.get("epoch", e.epoch))
+                if row.get("digest") is not None:
+                    e.digest = row["digest"]
+                e.repl_epoch = -1
+                e.repl_dirty_since = now
+                e.last_used = now
+                self.shard_owner[shard] = member_name
+                touched.add(shard)
+            for shard in touched:
+                info = self._promoting.get(shard)
+                if info is not None and info.get("fed"):
+                    del self._promoting[shard]
+                    if info.get("span") is not None:
+                        info["span"].set(outcome="rehomed").finish()
+                    closed += 1
+            self._rebuild_routes_locked()
+            if self._replicate:
+                self._refresh_replicas_locked()
+            self._wake.set()
+        if self.events is not None:
+            self.events.emit(
+                "serve_shard_home", worker=member_name, sessions=len(rows),
+                shards=len(touched), windows_closed=closed,
             )
 
     def _update_lag_locked(self, now: float) -> set:
@@ -2340,20 +2491,12 @@ class ClusterServePlane:
     ) -> Optional[str]:
         """Sticky-first, rendezvous-second, never the chunk's owner —
         the shard-replica policy at chunk granularity."""
-        import zlib
-
         if not self._replicate or owner is None:
             return None
         if current is not None and current != owner and current in names:
             return current
-        pool = [n for n in names if n != owner]
-        if not pool:
-            return None
-        return max(
-            pool,
-            key=lambda n: (
-                zlib.crc32(f"{sid}:{c[0]},{c[1]}:{n}".encode()), n
-            ),
+        return rendezvous_pick(
+            f"{sid}:{c[0]},{c[1]}", (n for n in names if n != owner)
         )
 
     def _assign_tiled_replicas_locked(self, t: _ResidentTiled) -> None:

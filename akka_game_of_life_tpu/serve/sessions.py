@@ -111,6 +111,23 @@ def shard_of(sid: str, n_shards: int) -> int:
     return zlib.crc32(sid.encode("utf-8")) % n_shards
 
 
+def rendezvous_pick(key: str, names):
+    """Highest-random-weight pick: the name maximizing crc32(f"{key}:{n}")
+    (name as the deterministic tiebreak).  The ONE placement function
+    behind shard replicas, tiled-chunk replicas, and the federation's
+    shard→frontend slice map — a membership change re-homes only the keys
+    that must move, never ~all of them the way a modulo ring would.
+    Returns None on an empty candidate pool."""
+    import zlib
+
+    pool = list(names)
+    if not pool:
+        return None
+    return max(
+        pool, key=lambda n: (zlib.crc32(f"{key}:{n}".encode("utf-8")), n)
+    )
+
+
 def validate_create(tenant, rule, height: int, width: int, density: float):
     """Shared create-request validation (raises ValueError, the HTTP
     400); returns the resolved Rule.  ONE implementation on purpose: the

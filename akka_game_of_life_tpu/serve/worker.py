@@ -433,6 +433,14 @@ class ServeWorkerPlane:
     def has_sessions(self) -> bool:
         return self.router.stats()["sessions"] > 0
 
+    def home_summary(self) -> dict:
+        """The ``SHARD_HOME`` payload a re-homed control channel announces
+        to its adopting frontend after a frontend loss: every session this
+        worker hosts (the router's list — id/tenant/rule/epoch/digest per
+        row), which IS the truth that closes the federation failover
+        window (docs/OPERATIONS.md "Frontend scale-out & HA")."""
+        return {"sessions": self.router.list()}
+
     # -- executor -------------------------------------------------------------
 
     def _exec_loop(self) -> None:

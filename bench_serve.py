@@ -50,10 +50,21 @@ lost, every promoted session digest-certified against its single-board
 oracle at its replicated resume epoch, promotion latency p50/p99 in
 BENCH format.
 
+**Federated frontend sweep** (``--frontends N1,N2,...``): each point
+spins N real frontend processes (``serve --serve-cluster on``, seeded
+with each other — docs/OPERATIONS.md "Frontend scale-out & HA") with
+one real numpy worker each, and drives 1-step ops on tiny boards — the
+route-bound regime — through sticky per-frontend client pools, plus a
+burst where every op hits the WRONG frontend (the forwarded peer-hop
+path) and a foreign GET asserting the 307-redirect contract.  One BENCH
+record per point (aggregate ops/sec) + a scaling summary
+(``serve-fed-scaling``); sampled sessions digest-certified.
+
 Also wired into ``bench_suite.py`` as configs 12 (traffic), 17
-(failover), 18 (tiled, ``--tiled-steady-state``) and 19 (memoized
+(failover), 18 (tiled, ``--tiled-steady-state``), 19 (memoized
 macro-stepping, ``--memo`` — the cross-tenant twin-fleet A/B, the
-adversarial within-5% gate, and the gun+eater T=1e6 headline).
+adversarial within-5% gate, and the gun+eater T=1e6 headline) and 20
+(the ``--frontends`` federation sweep).
 """
 
 from __future__ import annotations
@@ -1023,6 +1034,289 @@ def bench_serve_failover(
                 p.kill()
 
 
+def _spin_federation(n, sessions_per_fe, gossip_interval_s=0.2,
+                     gossip_timeout_s=2.0):
+    """One federated serve fleet: n REAL frontend processes (the ``serve
+    --serve-cluster on`` CLI role, seeded with each other's cluster
+    addresses) plus one REAL numpy worker process per frontend.  Real
+    processes on purpose — the route plane is GIL-bound Python, so
+    in-process "frontends" would serialize on one interpreter and the
+    sweep would measure nothing.  Pinned like the ``--workers`` sweep:
+    each frontend+worker pair gets its own fixed CPU slice where taskset
+    exists.  Returns (bases, procs) once every frontend reports a full
+    federation view (n-1 peers, zero unowned slices) on /healthz."""
+    import os
+    import shutil
+    import socket
+    import subprocess
+    import sys
+
+    def _free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    cports = [_free_port() for _ in range(n)]
+    hports = [_free_port() for _ in range(n)]
+    seeds = ",".join(f"127.0.0.1:{p}" for p in cports)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cores = os.cpu_count() or 4
+    per = max(1, cores // max(1, n))
+    pin = shutil.which("taskset")
+    procs = []
+
+    def _pinned(i, cmd):
+        if not pin or cores < 2 * n:
+            return cmd
+        lo = (i * per) % cores
+        return [pin, "-c", f"{lo}-{min(cores - 1, lo + per - 1)}"] + cmd
+
+    for i in range(n):
+        procs.append(subprocess.Popen(
+            _pinned(i, [
+                sys.executable, "-m", "akka_game_of_life_tpu", "serve",
+                "--serve-cluster", "on", "--platform", "cpu",
+                "--host", "127.0.0.1", "--port", str(cports[i]),
+                "--metrics-port", str(hports[i]), "--min-backends", "1",
+                "--frontend-seeds", seeds,
+                "--frontend-gossip-interval-s", str(gossip_interval_s),
+                "--frontend-gossip-timeout-s", str(gossip_timeout_s),
+                "--serve-max-sessions", str(n * sessions_per_fe + 8),
+            ]),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        ))
+    # The frontends are subprocesses that take seconds to boot (jax
+    # import); a worker spawned before its frontend listens dies on
+    # connection-refused.  Wait for each cluster port to accept first.
+    boot = time.monotonic() + 120
+    for i in range(n):
+        while time.monotonic() < boot:
+            try:
+                socket.create_connection(
+                    ("127.0.0.1", cports[i]), timeout=1
+                ).close()
+                break
+            except OSError:
+                assert procs[i].poll() is None, f"frontend {i} died"
+                time.sleep(0.2)
+        else:
+            raise AssertionError(f"frontend {i} never listened")
+        procs.append(subprocess.Popen(
+            _pinned(i, [
+                sys.executable, "-m", "akka_game_of_life_tpu", "backend",
+                "--host", "127.0.0.1", "--port", str(cports[i]),
+                "--name", f"fw{i}", "--engine", "numpy",
+            ]),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        ))
+    bases = [f"http://127.0.0.1:{p}" for p in hports]
+    deadline = time.monotonic() + 120
+    ready = [False] * n
+    while time.monotonic() < deadline and not all(ready):
+        for i, base in enumerate(bases):
+            if ready[i]:
+                continue
+            try:
+                status, doc = _request(base, "GET", "/healthz", timeout=5)
+            except Exception:  # noqa: BLE001 — still booting
+                continue
+            fed = doc.get("federation") or {}
+            slices = fed.get("slices") or {}
+            ready[i] = (
+                status == 200
+                and len(doc.get("serve", {}).get("shards_by_worker") or {})
+                >= 1
+                and len(fed.get("peers") or {}) == n - 1
+                and slices.get("unowned") == 0
+            )
+        if not all(ready):
+            time.sleep(0.1)
+    assert all(ready), f"federation never converged: ready={ready}"
+    return bases, procs
+
+
+def bench_serve_federated(
+    frontends_list=(1, 2, 4),
+    sessions_per_fe: int = 8,
+    rounds: int = 200,
+    threads_per_fe: int = 8,
+    sample_per_fe: int = 4,
+    assert_scaling: bool = False,
+    emit=print,
+) -> list:
+    """The ``--frontends`` sweep: one point (and one BENCH record) per
+    frontend count, plus a scaling summary record.
+
+    Each point spins N real federated frontend processes (one real numpy
+    worker each) and drives 1-step ops on tiny boards — the route-bound
+    regime where the frontend's per-op Python, not worker compute, is
+    the wall — through N sticky client pools (the LB model: clients hit
+    the frontend that minted their session, so the measured number is
+    pure parallel route-plane capacity).  A separate short burst drives
+    every op through the WRONG frontend to price the forwarding path
+    (`p_fwd_ops` peer hop each way), and one foreign GET asserts the
+    fat-payload 307-redirect contract.  A per-frontend session sample is
+    digest-certified against the single-board oracle.  With
+    ``assert_scaling``, gates aggregate ops/s at ≥1.7x@2, ≥3x@4, and
+    >25K ops/s at the top point."""
+    records = []
+    base_ops_per_sec = None
+    for n in frontends_list:
+        bases, procs = _spin_federation(n, sessions_per_fe)
+        config = f"serve-fed-f{n}"
+        try:
+            # -- sessions: minted per frontend, so each lands local ------
+            per_fe_specs = []
+            for i, base in enumerate(bases):
+                specs = []
+                for j in range(sessions_per_fe):
+                    seed = i * sessions_per_fe + j
+                    status, doc = _request(
+                        base, "POST", "/boards",
+                        {"tenant": f"t{i}", "rule": "conway",
+                         "height": 24, "width": 24, "seed": seed},
+                    )
+                    assert status == 201, f"create failed: {status} {doc}"
+                    specs.append((doc["id"], "conway", (24, 24), seed))
+                per_fe_specs.append(specs)
+            issued = [
+                {sid: 0 for sid, _, _, _ in specs}
+                for specs in per_fe_specs
+            ]
+            latencies: list = []
+            lat_lock = threading.Lock()
+
+            def _pool(record, rnds, offset=0):
+                """All frontends driven concurrently, each by its own
+                client pool; offset=k routes frontend i's clients at the
+                sids minted on frontend (i+k)%n — k=0 is the sticky-LB
+                leg, k=1 makes every op a forwarded peer hop."""
+                walls = [None] * n
+
+                def drive(i):
+                    walls[i] = _drive_traffic(
+                        bases[i], per_fe_specs[(i + offset) % n], 1,
+                        threads_per_fe, rnds, issued[(i + offset) % n],
+                        lat_lock, latencies, record=record,
+                    )
+
+                wrappers = [
+                    threading.Thread(target=drive, args=(i,))
+                    for i in range(n)
+                ]
+                t0 = time.perf_counter()
+                for t in wrappers:
+                    t.start()
+                for t in wrappers:
+                    t.join()
+                assert not any(w is None for w in walls), "a driver died"
+                return time.perf_counter() - t0
+
+            _pool(record=False, rnds=max(1, rounds // 10))  # warmup
+            wall = _pool(record=True, rnds=rounds)
+            total_ops = n * sessions_per_fe * rounds
+            ops_per_sec = total_ops / wall
+            lat = sorted(latencies)
+            p50 = _percentile(lat, 0.50) * 1e3
+            p99 = _percentile(lat, 0.99) * 1e3
+
+            # -- forwarding leg: every op crosses the peer plane ---------
+            fwd = {}
+            if n >= 2:
+                fwd_rounds = max(1, rounds // 10)
+                fwd_wall = _pool(record=False, rnds=fwd_rounds, offset=1)
+                fwd = {
+                    "ops_per_sec": n * sessions_per_fe * fwd_rounds
+                    / fwd_wall,
+                }
+                # The fat-GET contract: a foreign-sid GET 307s to the
+                # owner (urllib follows it) and serves the same board.
+                sid = per_fe_specs[1][0][0]
+                status, doc = _request(bases[0], "GET", f"/boards/{sid}")
+                assert status == 200 and doc["id"] == sid, (status, doc)
+                status, health = _request(bases[0], "GET", "/healthz")
+                fed = health["federation"]
+                assert fed["forwarded_ops"] > 0, fed
+                assert fed["forward_redirects"] > 0, fed
+                fwd["forwarded_ops"] = fed["forwarded_ops"]
+                fwd["forward_redirects"] = fed["forward_redirects"]
+
+            # -- digest certification, per frontend ----------------------
+            sampled = sum(
+                _certify_sample(bases[i], per_fe_specs[i], issued[i],
+                                sample_per_fe)
+                for i in range(n)
+            )
+            if base_ops_per_sec is None:
+                base_ops_per_sec = ops_per_sec
+            scaling = (
+                ops_per_sec / base_ops_per_sec if base_ops_per_sec else None
+            )
+            feds = []
+            for base in bases:
+                status, health = _request(base, "GET", "/healthz")
+                f = health["federation"]
+                feds.append({
+                    "name": f["name"], "peers": len(f["peers"]),
+                    "slices_owned": f["slices"]["owned"],
+                    "forwarded_ops": f["forwarded_ops"],
+                })
+            record = {
+                "config": config,
+                "metric": (
+                    f"aggregate route-plane throughput, {n} federated "
+                    f"frontend process(es) x {threads_per_fe} sticky "
+                    f"clients, 1-step ops on 24^2 boards"
+                ),
+                "value": ops_per_sec,
+                "unit": "ops/sec",
+                "frontends": n,
+                "sessions": n * sessions_per_fe,
+                "ops": total_ops,
+                "p50_ms": p50,
+                "p99_ms": p99,
+                "scaling_vs_1": scaling,
+                "forwarded": fwd,
+                "federation": feds,
+                "digest_certified_sessions": sampled,
+            }
+            records.append(record)
+            emit(json.dumps(record))
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=15)
+                except Exception:  # noqa: BLE001 — teardown must complete
+                    p.kill()
+    top = records[-1]
+    summary = {
+        "config": "serve-fed-scaling",
+        "metric": (
+            f"route-plane scaling at {top['frontends']} frontends vs "
+            f"{records[0]['frontends']} (aggregate ops/s ratio)"
+        ),
+        "value": top["scaling_vs_1"],
+        "unit": "x",
+        "points": {r["config"]: r["value"] for r in records},
+    }
+    emit(json.dumps(summary))
+    if assert_scaling:
+        by_n = {r["frontends"]: r for r in records}
+        if 2 in by_n and by_n[2]["scaling_vs_1"] is not None:
+            assert by_n[2]["scaling_vs_1"] >= 1.7, by_n[2]["scaling_vs_1"]
+        if 4 in by_n and by_n[4]["scaling_vs_1"] is not None:
+            assert by_n[4]["scaling_vs_1"] >= 3.0, by_n[4]["scaling_vs_1"]
+        assert top["value"] > 25_000, (
+            f"top point {top['value']:.0f} ops/s <= 25K"
+        )
+    return records
+
+
 def _route_plane_microbench(n_ops: int = 4000) -> dict:
     """The frontend op plane in isolation: one in-process
     ClusterServePlane wired to an ECHO member (the send callable answers
@@ -1216,11 +1510,34 @@ def bench_serve_tiled(
         "bytes_round_ratio": (
             ship["bytes_per_round"] / max(1.0, res["bytes_per_round"])
         ),
-        "route_ms_per_op": route_ms,
-        "route_plane": route,
+        # Two DIFFERENT latencies, named apart (they used to ship as
+        # "route_ms_per_op" vs "route_plane.ms_per_op" — same words,
+        # different planes, a standing confusion): route_e2e_ms_per_op
+        # is one sequential 1-step op end-to-end through the REAL
+        # cluster (frontend routing + wire + worker step + result),
+        # route_submit.ms_per_op is the frontend op plane alone against
+        # an in-process echo member (submit → coalesce → resolve, no
+        # wire, no compute) — the number the routing fast path attacks.
+        "route_e2e_ms_per_op": route_ms,
+        "route_submit": route,
         "digest_certified": True,
     }
     emit(json.dumps(record))
+    # The submit-path number gets its own trend-folded record (unit
+    # direction-mapped in tools/bench_regress.py): the tiled record's
+    # headline is the resident/ship ratio, so a route-plane regression
+    # hiding in a sub-field would never gate.
+    emit(json.dumps({
+        "config": "serve-route-plane",
+        "metric": (
+            "frontend op-plane submit path, in-process echo member, "
+            "sequential 1-step ops (no wire, no compute)"
+        ),
+        "value": route["ops_per_sec"],
+        "unit": "ops/sec",
+        "ms_per_op": route["ms_per_op"],
+        "route_e2e_ms_per_op": route_ms,
+    }))
     return record
 
 
@@ -1590,7 +1907,16 @@ def main() -> int:
     )
     parser.add_argument(
         "--assert-scaling", action="store_true",
-        help="fail unless the sweep meets the 1.5x@2 / 2.2x@4 gates",
+        help="fail unless the sweep meets its scaling gates (workers: "
+        "1.5x@2 / 2.2x@4; frontends: 1.7x@2 / 3x@4 and >25K ops/s)",
+    )
+    parser.add_argument(
+        "--frontends", default=None, metavar="N1,N2,...",
+        help="federated frontend sweep: N real `serve --serve-cluster` "
+        "processes gossiping one slice map (one real worker each), "
+        "pinned like --workers, driven by sticky client pools plus a "
+        "forwarded-op leg — one BENCH record per point with aggregate "
+        "route-plane ops/s + a scaling summary",
     )
     parser.add_argument(
         "--tiled-steady-state", action="store_true",
@@ -1659,6 +1985,15 @@ def main() -> int:
                 tuple(int(v) for v in args.sizes.split(","))
                 if args.sizes else (48, 64)
             ),
+        )
+        return 0
+    if args.frontends:
+        bench_serve_federated(
+            frontends_list=tuple(int(v) for v in args.frontends.split(",")),
+            sessions_per_fe=args.sessions or 8,
+            rounds=args.rounds or 200,
+            threads_per_fe=args.threads or 8,
+            assert_scaling=args.assert_scaling,
         )
         return 0
     if args.workers:

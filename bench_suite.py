@@ -114,6 +114,17 @@ Conway); this suite covers the rest of the BASELINE.json matrix:
                          sampled in-run certification live
                          (docs/OPERATIONS.md "Macro-step memoization").
 
+ 20. serve-fed           federated frontend scale-out
+                         (bench_serve.py --frontends): N real frontend
+                         processes gossiping one slice map (one real
+                         numpy worker each, pinned), driven route-bound
+                         (1-step ops, tiny boards) by sticky client
+                         pools plus a forwarded-op leg and a 307
+                         redirect check — aggregate route-plane ops/sec
+                         per point + the scaling summary, sampled
+                         sessions digest-certified (docs/OPERATIONS.md
+                         "Frontend scale-out & HA").
+
 Usage:
   python bench_suite.py                 # all configs, default sizes
   python bench_suite.py --config 2 5    # a subset
@@ -1250,7 +1261,7 @@ def main() -> None:
         "--config", type=int, nargs="*",
         default=[
             1, 2, 3, 4, 5, 6, 7, 8, 9, 10,
-            11, 12, 13, 14, 15, 16, 17, 18, 19,
+            11, 12, 13, 14, 15, 16, 17, 18, 19, 20,
         ],
     )
     parser.add_argument(
@@ -1436,6 +1447,19 @@ def main() -> None:
         bench_serve_memo(
             tenants=max(16, int(64 * args.scale)),
             gun_epochs=max(65_536, int(1_000_000 * args.scale)),
+        )
+    if 20 in args.config:
+        # Federated frontend scale-out: N real gossiping frontend
+        # processes (one worker each), sticky client pools + the
+        # forwarded-op leg, aggregate route-plane ops/sec per point and
+        # the scaling summary (docs/OPERATIONS.md "Frontend scale-out &
+        # HA").  Scale trims the per-point op count; the point list
+        # stays 1,2,4 — scaling ratios are meaningless off it.
+        from bench_serve import bench_serve_federated
+
+        bench_serve_federated(
+            frontends_list=(1, 2, 4),
+            rounds=max(20, int(200 * args.scale)),
         )
 
     if tee is not None:

@@ -32,6 +32,10 @@ DEADLINE = 120
 def _child_env() -> dict:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # keep sitecustomize from pinning axon
+    # The conftest's virtual 8-device XLA_FLAGS would steer each child into
+    # the multi-device mesh engine; these children are meant to be plain
+    # single-device CPU workers.
+    env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     return env

@@ -52,6 +52,11 @@ def serve_cluster(n_workers: int, **cfg_kw):
     """In-process serve-only cluster: frontend + n shard-host workers."""
     cfg_kw.setdefault("serve_shards", 16)
     cfg_kw.setdefault("rebalance_interval_s", 0.05)
+    # Worker loss in these drills is EOF-driven (channel.close()); the
+    # heartbeat timeout only produces false-positive deaths when the
+    # loaded 1-core CI box starves a beat past the 1 s default — which
+    # honestly deletes sessions and flakes the drill.  Widen the margin.
+    cfg_kw.setdefault("failure_timeout_s", 5.0)
     cfg = SimulationConfig(
         role="serve", serve_cluster=True, port=0, max_epochs=None,
         flight_dir="", **cfg_kw,

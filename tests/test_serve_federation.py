@@ -1,0 +1,692 @@
+"""Frontend federation: gossip convergence, forwarded-op FIFO under
+slice churn, control replication, crash failover, split-brain parking,
+and the federation lint surface (docs/OPERATIONS.md "Frontend scale-out
+& HA").
+
+The in-process tests run REAL Frontends — each with its own cluster
+listener, federation plane, and a BackendWorker thread speaking the
+actual wire protocol — federated over localhost TCP.  A frontend
+"crash" closes its listener and every channel abruptly (no SHUTDOWN, no
+goodbye): exactly what the survivors of a kill -9 observe.  The slow
+tests run the same drills against real ``serve --serve-cluster on`` OS
+processes with a genuine SIGKILL.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from akka_game_of_life_tpu.obs.catalog import install
+from akka_game_of_life_tpu.obs.metrics import MetricsRegistry
+from akka_game_of_life_tpu.obs.tracing import Tracer
+from akka_game_of_life_tpu.ops import digest as odigest, stencil
+from akka_game_of_life_tpu.ops.rules import resolve_rule
+from akka_game_of_life_tpu.runtime.backend import BackendWorker
+from akka_game_of_life_tpu.runtime.config import SimulationConfig
+from akka_game_of_life_tpu.runtime.frontend import Frontend
+from akka_game_of_life_tpu.serve.federation import FederationRedirect
+from akka_game_of_life_tpu.serve.sessions import AdmissionError, shard_of
+from akka_game_of_life_tpu.utils.patterns import random_grid
+
+N_SHARDS = 16
+RETRYABLE = ("failover", "partitioned", "queue_full", "draining")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _oracle_digest(rule: str, shape, seed: int, epochs: int) -> str:
+    board0 = random_grid(shape, density=0.5, seed=seed)
+    board = (
+        np.asarray(
+            stencil.multi_step_fn(resolve_rule(rule), epochs)(
+                jnp.asarray(board0)
+            )
+        )
+        if epochs
+        else board0
+    )
+    return odigest.format_digest(odigest.value(odigest.digest_dense_np(board)))
+
+
+def _boot_fe(port: int, seeds: str, tag: str):
+    """One real federated frontend plus one numpy worker thread."""
+    cfg = SimulationConfig(
+        role="serve", serve_cluster=True, host="127.0.0.1", port=port,
+        max_epochs=None, flight_dir="", serve_shards=N_SHARDS,
+        rebalance_interval_s=0.05,
+        # Lenient worker failure detection: several frontends + gossip
+        # loops share one small CI box, and a starved heartbeat would
+        # auto-down a healthy worker mid-test (it re-homes to a peer and
+        # the drill under test never runs).
+        heartbeat_s=0.5, failure_timeout_s=5.0,
+        frontend_seeds=seeds,
+        frontend_gossip_interval_s=0.1, frontend_gossip_timeout_s=1.0,
+        frontend_replicate_interval_s=0.1,
+    )
+    registry = install(MetricsRegistry())
+    fe = Frontend(cfg, min_backends=1, registry=registry,
+                  tracer=Tracer(node=f"fed-{tag}"))
+    fe.start()
+    w = BackendWorker("127.0.0.1", port, name=f"w-{tag}", engine="numpy",
+                      registry=registry, tracer=fe.tracer)
+    w.crash_hook = w.stop
+    w.connect()
+    threading.Thread(target=w.run, daemon=True, name=f"w-{tag}").start()
+    assert fe.wait_for_backends(timeout=10)
+    return fe, w
+
+
+def _crash(fe) -> None:
+    """Die the way kill -9 looks from outside: listener gone (redials
+    refused), every channel dropped mid-stream, no SHUTDOWN, no
+    goodbye.  The frontend's own worker sees EOF and re-homes via its
+    FED_PEERS fallbacks; the surviving peer sees EOF, redials into a
+    connection-refused, and confirms death."""
+    fe._stop.set()
+    fe.federation._stop.set()
+    with contextlib.suppress(OSError):
+        # shutdown() too: the accept-loop thread blocked in accept()
+        # holds a kernel ref, and close() alone leaves the port accepting
+        # — the survivor's probe would read the corpse as merely wedged.
+        fe._listener.shutdown(socket.SHUT_RDWR)
+    with contextlib.suppress(OSError):
+        fe._listener.close()
+    for p in list(fe.federation.peers.values()):
+        with contextlib.suppress(OSError):
+            p.channel.close()
+    for m in fe.membership.alive_members():
+        with contextlib.suppress(OSError):
+            m.channel.close()
+
+
+def _wait(predicate, what, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _step_until_owned(router, sid, timeout=20.0) -> int:
+    """One step, retrying through the retryable-429 window a failover
+    opens.  Every refusal must be machine-retryable — an unexpected
+    reason (or a 404-shaped KeyError) fails the drill."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            epoch, _digest = router.step(sid, 1)
+            return epoch
+        except AdmissionError as e:
+            assert e.reason in RETRYABLE, e.reason
+            assert time.monotonic() < deadline, "failover never healed"
+            time.sleep(0.05)
+
+
+def _wait_converged(fes, timeout=15.0) -> None:
+    names = {fe.federation.name for fe in fes}
+
+    def ok():
+        for fe in fes:
+            h = fe.federation.health()
+            if len(h["peers"]) != len(fes) - 1:
+                return False
+            if h["slices"]["unowned"] or not h["slices"]["owned"]:
+                return False
+            if set(h["slices"]["by_frontend"]) - names:
+                return False
+        maps = [
+            {s: o for s, (o, _) in fe.federation.slices.items()}
+            for fe in fes
+        ]
+        return all(m == maps[0] for m in maps)
+
+    _wait(ok, "federation convergence", timeout)
+
+
+def _sid_owned_by(fed, owner_name: str, tag: str) -> str:
+    owned = {s for s, (o, _) in fed.slices.items() if o == owner_name}
+    return next(
+        f"{tag}{i:04d}" for i in range(100_000)
+        if shard_of(f"{tag}{i:04d}", N_SHARDS) in owned
+    )
+
+
+@contextlib.contextmanager
+def federation(n: int, ports=None):
+    """In-process federated fleet: n frontends, one worker each, pinned
+    ports (so a flapped frontend can rebind), all-to-all seeds."""
+    ports = ports or [_free_port() for _ in range(n)]
+    seeds = ",".join(f"127.0.0.1:{p}" for p in ports)
+    fes, workers = [], []
+    try:
+        for i, port in enumerate(ports):
+            fe, w = _boot_fe(port, seeds, f"fe{i}")
+            fes.append(fe)
+            workers.append(w)
+        _wait_converged(fes)
+        yield fes, workers, ports, seeds
+    finally:
+        for fe in fes:
+            with contextlib.suppress(Exception):
+                fe.stop()
+        for w in workers:
+            with contextlib.suppress(Exception):
+                w.stop()
+
+
+# -- lint surface --------------------------------------------------------------
+
+
+def test_federation_lint_surface_clean():
+    """The federation knob family holds every bijection: --frontend-* ↔
+    frontend_* (GL-CFG13), frontend_* ↔ the doc knob table (GL-DOC07),
+    and the P_* federation frames ↔ the doc protocol table (GL-DOC03)."""
+    from tools.graftlint import bijection
+    from tools.graftlint.specs import (
+        FRONTEND_CONFIG,
+        FRONTEND_DOC,
+        PROTOCOL_MSGS,
+    )
+
+    repo = Path(__file__).resolve().parent.parent
+    for spec in (FRONTEND_CONFIG, FRONTEND_DOC, PROTOCOL_MSGS):
+        problems = [f.render() for f in bijection.problems(spec, repo)]
+        assert problems == [], problems
+
+
+# -- gossip convergence --------------------------------------------------------
+
+
+def test_gossip_join_converges():
+    """Two seeds converge one slice map; a third frontend joining later
+    (discovering the fleet transitively through the seeds) pulls the map
+    to a three-way split with no unowned slices and no disagreement."""
+    with federation(2) as (fes, workers, ports, seeds):
+        a, b = fes
+        assert sum(
+            fe.federation.health()["slices"]["owned"] for fe in fes
+        ) == N_SHARDS
+
+        fe_c, w_c = _boot_fe(_free_port(), seeds, "fe2")
+        try:
+            _wait_converged([a, b, fe_c])
+            assert fe_c.federation.health()["slices"]["owned"] > 0
+        finally:
+            fe_c.stop()
+            w_c.stop()
+
+
+def test_forwarded_ops_fifo_under_slice_churn():
+    """Concurrent steps against one session through BOTH frontends (half
+    forwarded over the peer plane, half local) land exactly once each —
+    while a third frontend joins mid-run and the slice table churns
+    under the traffic.  The final epoch equaling the issued count is the
+    FIFO/no-loss proof; the digest is certified against the single-board
+    oracle, and the live session's slice never migrated."""
+    with federation(2) as (fes, workers, ports, seeds):
+        a, b = fes
+        sid = _sid_owned_by(a.federation, b.federation.name, "fifo")
+        doc = a.federation.router.create(
+            sid=sid, height=24, width=24, seed=5
+        )  # a forwarded create: A does not own the slice
+        assert doc["id"] == sid
+
+        per_thread, errors = 25, []
+
+        def stepper(router):
+            try:
+                for _ in range(per_thread):
+                    _step_until_owned(router, sid, timeout=30)
+            except Exception as e:  # noqa: BLE001 — recorded, asserted
+                errors.append(repr(e))
+
+        pool = [
+            threading.Thread(target=stepper, args=(fe.federation.router,))
+            for fe in (a, b, a, b)
+        ]
+        for t in pool:
+            t.start()
+        # Mid-traffic join: empty-slice releases rewrite the slice map
+        # underneath the forwarded stream.
+        fe_c, w_c = _boot_fe(_free_port(), seeds, "fe2")
+        try:
+            for t in pool:
+                t.join(90)
+            assert not any(t.is_alive() for t in pool), "a stepper hung"
+            assert errors == [], errors
+            total = len(pool) * per_thread
+            got = b.federation.router.get(sid)
+            assert got["epoch"] == total, (got["epoch"], total)
+            assert got["digest"] == _oracle_digest(
+                "conway", (24, 24), 5, total
+            )
+            # The non-empty slice stayed put through the churn.
+            assert a.federation.slices[shard_of(sid, N_SHARDS)][0] == (
+                b.federation.name
+            )
+        finally:
+            fe_c.stop()
+            w_c.stop()
+
+
+# -- failover ------------------------------------------------------------------
+
+
+def test_crash_promotes_worker_rehomes_zero_loss():
+    """The kill drill, in-process: B crashes without a goodbye.  A
+    confirms death on the refused redial, adopts B's slices from the
+    replicated control rows (the window answers retryable 429
+    ``failover``, never a 404-shaped KeyError), B's orphaned worker
+    re-homes to A and its SHARD_HOME closes the window, and the session
+    steps on with its epoch continuous and its digest certified.  Zero
+    admitted sessions lost — even once the promotion grace expires."""
+    with federation(2) as (fes, workers, ports, seeds):
+        a, b = fes
+        sid = _sid_owned_by(b.federation, b.federation.name, "kill")
+        b.federation.router.create(sid=sid, height=24, width=24, seed=9)
+        b.federation.router.step(sid, 3)
+        _wait(
+            lambda: sum(
+                a.federation.health()["replicated_rows_held"].values()
+            ) >= 1,
+            "control rows replicated to the standby",
+        )
+
+        _crash(b)
+        _wait(
+            lambda: b.federation.name in a.federation.health()["dead"],
+            "A to confirm B dead",
+        )
+        epoch = _step_until_owned(a.federation.router, sid)
+        assert epoch == 4  # 3 pre-crash + 1: state survived the re-home
+
+        h = a.federation.health()
+        assert h["slices"]["owned"] == N_SHARDS
+        assert h["promotions_inflight"] >= 1  # grace still open
+        # Force the grace past its deadline: the windows were already
+        # closed by SHARD_HOME, so expiry must be a no-op — the honest-
+        # loss path must not fire for sessions that re-homed.
+        a.federation._expire_promotions(time.monotonic() + 3600.0)
+        assert a.federation.health()["promotions_inflight"] == 0
+        got = a.federation.router.get(sid)
+        assert got["epoch"] == 4
+        assert got["digest"] == _oracle_digest("conway", (24, 24), 9, 4)
+        snap = a.metrics.snapshot()
+        assert (snap.get("gol_serve_sessions_lost_total") or 0) == 0
+        assert (snap.get("gol_frontend_slice_promotions_total") or 0) >= 1
+        # Label-cardinality reclaim: the dead peer's gossip-age series
+        # must not export forever.
+        assert not any(
+            key.startswith("gol_frontend_gossip_age_seconds")
+            and b.federation.name in key
+            for key in snap
+        ), "dead peer still exports a gossip-age series"
+
+
+def test_flap_dead_frontend_rejoins_and_rebalances():
+    """A flapped frontend (crash, then a fresh process on the same port
+    — the same ``host:port`` identity) re-registers cleanly: the
+    survivor drops the stale replicated rows, gossip re-converges, and
+    the rejoiner wins back its rendezvous share of the empty keyspace —
+    while the slice holding a live adopted session stays with the
+    survivor (sessions never live-migrate between frontends)."""
+    ports = [_free_port(), _free_port()]
+    with federation(2, ports=ports) as (fes, workers, _, seeds):
+        a, b = fes
+        sid = _sid_owned_by(b.federation, b.federation.name, "flap")
+        b.federation.router.create(sid=sid, height=16, width=16, seed=3)
+        _wait(
+            lambda: sum(
+                a.federation.health()["replicated_rows_held"].values()
+            ) >= 1,
+            "replication to the standby",
+        )
+        _crash(b)
+        _wait(
+            lambda: a.federation.health()["slices"]["owned"] == N_SHARDS,
+            "A to adopt every slice",
+        )
+        assert _step_until_owned(a.federation.router, sid) == 1
+        a.federation._expire_promotions(time.monotonic() + 3600.0)
+
+        fe_b2, w_b2 = _boot_fe(ports[1], seeds, "fe1b")
+        try:
+            _wait_converged([a, fe_b2])
+            assert fe_b2.federation.health()["slices"]["owned"] > 0
+            # The adopted session's slice did NOT bounce to the rejoiner.
+            assert a.federation.slices[shard_of(sid, N_SHARDS)][0] == (
+                a.federation.name
+            )
+            assert a.federation.router.get(sid)["epoch"] == 1
+            # The survivor dropped the dead incarnation's replica rows on
+            # re-registration: they describe sessions that no longer
+            # exist anywhere on the rejoiner.
+            held = a.federation.health()["replicated_rows_held"]
+            assert held.get(fe_b2.federation.name, 0) == 0
+        finally:
+            fe_b2.stop()
+            w_b2.stop()
+
+
+def test_split_brain_suspect_parks_writes():
+    """A suspect peer (gossip stale past the timeout, link still open —
+    a wedged process, not a dead one) does NOT promote: writes toward
+    its slices park with retryable 429 ``partitioned``, ownership never
+    flips, and the parked op flows again once gossip resumes."""
+    with federation(2) as (fes, workers, ports, seeds):
+        a, b = fes
+        sid = _sid_owned_by(b.federation, b.federation.name, "park")
+        b.federation.router.create(sid=sid, height=16, width=16, seed=7)
+        shard = shard_of(sid, N_SHARDS)
+
+        # Wedge B: its gossip loop keeps spinning but sends nothing,
+        # while its listener and peer link stay open — the half-failure
+        # the split-brain guard exists for.
+        b.federation._gossip_tick = lambda: None
+        _wait(
+            lambda: b.federation.name in a.federation.health()["suspect"],
+            "A to suspect the wedged peer",
+        )
+        with pytest.raises(AdmissionError) as exc:
+            a.federation.router.step(sid, 1)
+        assert exc.value.reason == "partitioned"
+        # Parked, not promoted: B still owns the slice on BOTH maps.
+        assert a.federation.slices[shard][0] == b.federation.name
+        assert b.federation.slices[shard][0] == b.federation.name
+        snap = a.metrics.snapshot()
+        assert (snap.get("gol_frontend_parked_ops_total") or 0) >= 1
+
+        del b.federation._gossip_tick  # unwedge: the class method resumes
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                epoch, _ = a.federation.router.step(sid, 1)
+                break
+            except AdmissionError as e:
+                assert e.reason == "partitioned", e.reason
+                assert time.monotonic() < deadline, "suspicion never cleared"
+                time.sleep(0.05)
+        assert epoch == 1
+
+
+def test_foreign_get_redirects_local_get_serves():
+    """GET is the fat op: a foreign board 307s to its owner instead of
+    proxying O(h·w) cells through a middleman frontend."""
+    with federation(2) as (fes, workers, ports, seeds):
+        a, b = fes
+        sid = _sid_owned_by(b.federation, b.federation.name, "redir")
+        a.federation.router.create(sid=sid, height=16, width=16, seed=1)
+        with pytest.raises(FederationRedirect) as exc:
+            a.federation.router.get(sid)
+        assert exc.value.url.endswith(f"/boards/{sid}")
+        assert b.federation.router.get(sid)["id"] == sid
+
+
+# -- real-process drills -------------------------------------------------------
+
+
+def _http(port: int, method: str, path: str, doc=None, timeout=30):
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(doc).encode("utf-8") if doc is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _child_env() -> dict:
+    import os
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(repo) + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn_fe(i, cports, hports, seeds, env, logs):
+    return subprocess.Popen(
+        [sys.executable, "-m", "akka_game_of_life_tpu", "serve",
+         "--serve-cluster", "on", "--platform", "cpu",
+         "--host", "127.0.0.1", "--port", str(cports[i]),
+         "--metrics-port", str(hports[i]), "--min-backends", "1",
+         "--frontend-seeds", seeds,
+         "--frontend-gossip-interval-s", "0.2",
+         "--frontend-gossip-timeout-s", "1.5",
+         "--frontend-replicate-interval-s", "0.1"],
+        stdout=open(logs / f"fe{i}.log", "w"),
+        stderr=subprocess.STDOUT, env=env,
+    )
+
+
+def _spawn_worker(i, cports, env, logs, tag=""):
+    return subprocess.Popen(
+        [sys.executable, "-m", "akka_game_of_life_tpu", "backend",
+         "--host", "127.0.0.1", "--port", str(cports[i]),
+         "--name", f"pw{i}{tag}", "--engine", "numpy"],
+        stdout=open(logs / f"w{i}{tag}.log", "w"),
+        stderr=subprocess.STDOUT, env=env,
+    )
+
+
+def _wait_cluster_port(port: int, proc, timeout=120):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=1).close()
+            return
+        except OSError:
+            assert proc.poll() is None, "frontend process died while booting"
+            time.sleep(0.2)
+    raise AssertionError(f"cluster port {port} never listened")
+
+
+def _wait_fed_ready(hport: int, n_peers: int, timeout=120):
+    def ok():
+        try:
+            status, doc = _http(hport, "GET", "/healthz", timeout=5)
+        except Exception:  # noqa: BLE001 — still booting
+            return False
+        fed = doc.get("federation") or {}
+        return (
+            status == 200
+            and len(doc.get("serve", {}).get("shards_by_worker") or {}) >= 1
+            and len(fed.get("peers") or {}) == n_peers
+            and (fed.get("slices") or {}).get("unowned") == 0
+        )
+
+    _wait(ok, f"federated frontend :{hport} ready", timeout)
+
+
+@contextlib.contextmanager
+def _process_federation(tmp_path, n=2):
+    env = _child_env()
+    cports = [_free_port() for _ in range(n)]
+    hports = [_free_port() for _ in range(n)]
+    seeds = ",".join(f"127.0.0.1:{p}" for p in cports)
+    procs = []
+    try:
+        fes = [_spawn_fe(i, cports, hports, seeds, env, tmp_path)
+               for i in range(n)]
+        procs += fes
+        for i in range(n):
+            _wait_cluster_port(cports[i], fes[i])
+        procs += [_spawn_worker(i, cports, env, tmp_path) for i in range(n)]
+        for i in range(n):
+            _wait_fed_ready(hports[i], n - 1)
+        yield fes, cports, hports, seeds
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=15)
+
+
+@pytest.mark.slow
+def test_kill9_frontend_zero_admitted_loss(tmp_path):
+    """kill -9 one of two real frontend processes under admitted load:
+    every session answers 200 or a retryable 429 (``failover`` /
+    ``partitioned``) — never 404 — and afterwards every session serves
+    from the survivor with its epoch intact and its digest certified
+    against the single-board oracle.  Zero admitted sessions lost."""
+    with _process_federation(tmp_path, n=2) as (fes, cports, hports, seeds):
+        # Mint sessions on BOTH frontends (auto-sids mine local slices).
+        specs = []
+        for i, hport in enumerate(hports):
+            for j in range(3):
+                seed = 10 * i + j
+                status, doc = _http(
+                    hport, "POST", "/boards",
+                    {"rule": "conway", "height": 24, "width": 24,
+                     "seed": seed},
+                )
+                assert status in (200, 201), (status, doc)
+                specs.append((doc["id"], seed, i))
+        issued = {}
+        for sid, _, i in specs:
+            status, doc = _http(
+                hports[i], "POST", f"/boards/{sid}/step", {"steps": 2}
+            )
+            assert status == 200, (status, doc)
+            issued[sid] = doc["epoch"]
+
+        time.sleep(1.0)  # a replication beat past the last write
+        fes[0].send_signal(signal.SIGKILL)
+        fes[0].wait(timeout=30)
+
+        survivor = hports[1]
+        deadline = time.monotonic() + 90
+        for sid, _, _ in specs:
+            while True:
+                status, doc = _http(
+                    survivor, "POST", f"/boards/{sid}/step", {"steps": 1}
+                )
+                if status == 200:
+                    issued[sid] = doc["epoch"]
+                    break
+                assert status == 429, (
+                    f"{sid}: {status} {doc} — the never-404 contract broke"
+                )
+                assert doc.get("reason") in RETRYABLE, doc
+                assert time.monotonic() < deadline, "failover never healed"
+                time.sleep(0.1)
+
+        for sid, seed, _ in specs:
+            status, doc = _http(survivor, "GET", f"/boards/{sid}")
+            assert status == 200, (sid, status, doc)
+            assert doc["epoch"] == issued[sid], (sid, doc["epoch"], issued)
+            assert doc["digest"] == _oracle_digest(
+                "conway", (24, 24), seed, issued[sid]
+            ), sid
+        status, health = _http(survivor, "GET", "/healthz")
+        fed = health["federation"]
+        assert fed["slices"]["unowned"] == 0
+        assert fed["slices"]["owned"] == fed["slices"]["total"]
+
+
+@pytest.mark.slow
+def test_rolling_restart_serves_throughout(tmp_path):
+    """Restart both real frontends one at a time (SIGTERM, wait,
+    respawn on the same ports): a session admitted before the roll
+    keeps serving — every op lands 200 or a retryable 429, never 404 —
+    and ends with its epoch intact and its digest certified."""
+    with _process_federation(tmp_path, n=2) as (fes, cports, hports, seeds):
+        status, doc = _http(
+            hports[0], "POST", "/boards",
+            {"rule": "conway", "height": 24, "width": 24, "seed": 77},
+        )
+        assert status in (200, 201), (status, doc)
+        sid = doc["id"]
+
+        def step_anywhere():
+            """One step through whichever frontend takes it — the LB
+            model: clients fail over between frontends; forwarding and
+            failover are the plane's problem, 404s are a test failure."""
+            deadline = time.monotonic() + 90
+            while True:
+                for hport in hports:
+                    try:
+                        status, doc = _http(
+                            hport, "POST", f"/boards/{sid}/step",
+                            {"steps": 1}, timeout=10,
+                        )
+                    except Exception:  # noqa: BLE001 — mid-restart
+                        continue
+                    if status == 200:
+                        return doc["epoch"]
+                    assert status == 429, (
+                        f"{status} {doc} — the never-404 contract broke"
+                    )
+                    assert doc.get("reason") in RETRYABLE, doc
+                assert time.monotonic() < deadline, "service never resumed"
+                time.sleep(0.1)
+
+        fes = list(fes)
+        extra = []  # respawned processes, reaped at the end
+        epochs = []
+        for i in (0, 1):
+            epochs.append(step_anywhere())
+            fes[i].send_signal(signal.SIGTERM)
+            fes[i].wait(timeout=30)
+            epochs.append(step_anywhere())  # serves with one frontend down
+            # Restart the pair: the frontend on its old ports, plus a
+            # fresh worker — the OLD worker re-homed to the survivor
+            # (carrying its sessions) and stays there.
+            fes[i] = _spawn_fe(i, cports, hports, seeds, _child_env(),
+                               tmp_path)
+            extra.append(fes[i])
+            _wait_cluster_port(cports[i], fes[i])
+            extra.append(_spawn_worker(i, cports, _child_env(), tmp_path,
+                                       tag="b"))
+            _wait_fed_ready(hports[i], 1)
+            epochs.append(step_anywhere())
+
+        final = step_anywhere()
+        # Seven steps total, every one admitted exactly once, in order.
+        assert epochs + [final] == [1, 2, 3, 4, 5, 6, 7]
+        found = None
+        for hport in hports:
+            status, doc = _http(hport, "GET", f"/boards/{sid}")
+            if status == 200:
+                found = doc
+                break
+        assert found is not None, "no frontend serves the session"
+        assert found["epoch"] == 7
+        assert found["digest"] == _oracle_digest("conway", (24, 24), 77, 7)
+        # The respawned processes are not in the context manager's list —
+        # reap them here.
+        for p in extra:
+            if p.poll() is None:
+                p.kill()
+        for p in extra:
+            p.wait(timeout=15)

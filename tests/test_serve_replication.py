@@ -60,6 +60,10 @@ def repl_cluster(n_workers: int, **cfg_kw):
     cfg_kw.setdefault("rebalance_interval_s", 0.05)
     cfg_kw.setdefault("serve_replicate_interval_s", 0.05)
     cfg_kw.setdefault("serve_replicate_every", 1)
+    # Worker loss here is EOF-driven (channel.close()); the heartbeat
+    # timeout only yields false-positive deaths when the loaded 1-core
+    # CI box starves a beat past the 1 s default.  Widen the margin.
+    cfg_kw.setdefault("failure_timeout_s", 5.0)
     cfg = SimulationConfig(
         role="serve", serve_cluster=True, port=0, max_epochs=None,
         flight_dir="", **cfg_kw,

@@ -47,7 +47,9 @@ from bench_trend import _RECORD_GLOBS, build_trend, scan_record_file  # noqa: E4
 
 # Unit → direction.  A unit absent here is a capability/latency-free
 # record the gate reports as "skipped", never judges.
-_HIGHER_IS_BETTER = ("cell-updates/sec", "boards/sec", "x", "steps/sec")
+_HIGHER_IS_BETTER = (
+    "cell-updates/sec", "boards/sec", "x", "steps/sec", "ops/sec",
+)
 _LOWER_IS_BETTER = ("seconds",)
 
 

@@ -58,6 +58,8 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
      "--bench-regress-* flags ↔ RegressPolicy fields"),
     ("GL-CFG12", "--serve-memo* flags ↔ SimulationConfig serve_memo* "
      "fields"),
+    ("GL-CFG13", "--frontend-* flags ↔ SimulationConfig frontend_* "
+     "fields"),
     ("GL-DOC01", "gol_* metric literals ↔ obs catalog ↔ OPERATIONS.md"),
     ("GL-DOC02", "span names ↔ SPAN_CATALOG ↔ OPERATIONS.md"),
     ("GL-DOC03", "protocol messages ↔ OPERATIONS.md table"),
@@ -66,6 +68,8 @@ PASS_CATALOG: Tuple[Tuple[str, str], ...] = (
      "knob table"),
     ("GL-DOC06", "SimulationConfig serve_* fields ↔ OPERATIONS.md serving-"
      "plane knob table"),
+    ("GL-DOC07", "SimulationConfig frontend_* fields ↔ OPERATIONS.md "
+     "frontend scale-out knob table"),
 )
 PASS_IDS = frozenset(pid for pid, _ in PASS_CATALOG)
 

@@ -163,6 +163,44 @@ SERVE_MEMO_CONFIG = FlagConfigSpec(
     bare_field="serve_memo",
 )
 
+# The frontend-federation knob family (gossiped shard-map scale-out:
+# seeds, advertise address, gossip cadence/timeout, replication batch/
+# cadence) pinned as its own bijection: GL-CFG13 holds --frontend-* ↔
+# frontend_* and GL-DOC07 closes the field ↔ operator-doc edge against
+# the "Frontend scale-out & HA" knob table, mirroring the GL-CFG07/
+# GL-DOC05 fast-forward triangle.
+FRONTEND_CONFIG = FlagConfigSpec(
+    name="frontend_config", pass_id="GL-CFG13",
+    flag_regex=r"""["'](--frontend-[a-z0-9-]+)["']""",
+    config_class="SimulationConfig",
+    field_regex=r"^    (frontend_\w+)\s*:",
+    flag_strip="--frontend", field_prefix="frontend_",
+)
+
+FRONTEND_DOC = CatalogSpec(
+    name="frontend_doc", pass_id="GL-DOC07",
+    sides={
+        "config": Side(
+            kind="block", path="akka_game_of_life_tpu/runtime/config.py",
+            start="class SimulationConfig", end="\n    def ",
+            regex=r"^    (frontend_\w+)\s*:",
+        ),
+        "doc": Side(
+            kind="section", path=_DOC, start="## Frontend scale-out",
+            end="## ", regex=r"^\|\s*`(frontend_\w+)`",
+        ),
+    },
+    relations=(
+        Relation("config", "doc", "federation knob {name} has no row in "
+                 "the OPERATIONS.md Frontend scale-out knob table"),
+        Relation("doc", "config", "OPERATIONS.md documents federation "
+                 "knob {name} which SimulationConfig does not declare — "
+                 "worse than no row"),
+    ),
+    scan_guard=("config", "scan broken: no frontend_* fields found in "
+                "SimulationConfig"),
+)
+
 SPARSE_CONFIG = FlagConfigSpec(
     name="sparse_config", pass_id="GL-CFG05",
     flag_regex=r"""["'](--sparse-[a-z0-9-]+)["']""",
@@ -345,6 +383,7 @@ SPECS = (
     CHAOS_CONFIG, RING_CONFIG, REBALANCE_CONFIG, SERVE_CONFIG, SERVE_DOC,
     SERVE_REPLICATE_CONFIG, SERVE_TILED_RESIDENT_CONFIG, SERVE_OBS_CONFIG,
     SERVE_MEMO_CONFIG, OBS_PROGRAMS_CONFIG, BENCH_REGRESS_CONFIG,
+    FRONTEND_CONFIG, FRONTEND_DOC,
     SPARSE_CONFIG, FF_CONFIG, FF_DOC, KERNEL_CONFIG, METRICS_DOC,
     TRACE_NAMES, PROTOCOL_MSGS, GRAFTLINT_DOC,
 )
